@@ -1,0 +1,31 @@
+"""Test configuration: fake 8-device CPU mesh.
+
+The reference tests against local[2] Spark (reference: utils/.../test/
+TestSparkContext.scala:33-76); the analogous strategy here is CPU jax with
+8 virtual host devices so sharding/collective code paths run in-process.
+Must run before jax initializes.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from transmogrifai_tpu.utils.uid import reset_uids  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_uids():
+    reset_uids()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

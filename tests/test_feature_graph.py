@@ -1,0 +1,88 @@
+"""Type system + feature graph + DAG tests (mirrors the reference's
+FeatureLike/OpPipelineStage specs, reference: features/src/test/)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder, from_schema
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import NumericColumn, TextColumn
+from transmogrifai_tpu.workflow.dag import compute_dag, validate_dag
+import transmogrifai_tpu.dsl  # noqa: F401  (patches Feature operators)
+
+
+def test_type_lattice():
+    assert issubclass(ft.RealNN, ft.Real)
+    assert issubclass(ft.DateTime, ft.Date)
+    assert issubclass(ft.Date, ft.Integral)
+    assert issubclass(ft.PickList, ft.Text)
+    assert ft.PickList.is_categorical
+    assert ft.RealNN.non_nullable
+    assert ft.TextMap.value_type is ft.Text
+    assert len(ft.all_feature_types()) >= 45
+
+
+def test_numeric_column_masks():
+    c = NumericColumn.from_list([1.0, None, 3.0])
+    assert c.mask.tolist() == [True, False, True]
+    assert c.values[1] == 0.0
+    assert c.to_list() == [1.0, None, 3.0]
+
+
+def test_feature_builder_and_raw_features():
+    age = FeatureBuilder(ft.Real, "age").as_predictor()
+    label = FeatureBuilder(ft.RealNN, "y").as_response()
+    assert age.is_raw() and not age.is_response
+    assert label.is_response
+    s = age + 1
+    total = s * 2
+    raws = total.raw_features()
+    assert [f.name for f in raws] == ["age"]
+
+
+def test_dag_layering():
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    c = a + b        # layer 0
+    d = c * 2        # layer 1
+    e = d + a        # layer 2
+    dag = compute_dag([e])
+    assert len(dag) == 3
+    validate_dag(dag)
+    # execution order: c's stage first, e's stage last
+    assert dag[0][0] is c.origin_stage
+    assert dag[-1][0] is e.origin_stage
+
+
+def test_dag_dedup_shared_subgraph():
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    shared = a + 1
+    x = shared * 2
+    y = shared * 3
+    dag = compute_dag([x, y])
+    stages = [s for layer in dag for s in layer]
+    assert len(stages) == 3  # shared counted once
+
+
+def test_from_schema_sorted_and_typed():
+    resp, preds = from_schema(
+        {"y": ft.Integral, "b": ft.Text, "a": ft.Real}, response="y"
+    )
+    assert resp.ftype is ft.RealNN and resp.is_response
+    assert [p.name for p in preds] == ["a", "b"]
+    assert preds[0].ftype is ft.Real
+
+
+def test_feature_math_transform():
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    out = (a + b) / 2
+    ds = Dataset.from_pylists(
+        {"a": [2.0, None, 4.0], "b": [4.0, 1.0, None]},
+        {"a": ft.Real, "b": ft.Real},
+    )
+    from transmogrifai_tpu.workflow.workflow import fit_and_transform_dag
+
+    dag = compute_dag([out])
+    _, res, _ = fit_and_transform_dag(dag, ds)
+    col = res[out.name]
+    assert col.to_list() == [3.0, None, None]  # null propagation

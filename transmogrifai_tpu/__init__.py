@@ -1,0 +1,36 @@
+"""transmogrifai_tpu: a TPU-native AutoML framework for structured data.
+
+A ground-up JAX/XLA re-design with the capabilities of TransmogrifAI
+(reference at /root/reference): typed features, automated feature
+engineering (transmogrification), automated feature validation
+(SanityChecker, RawFeatureFilter), automated model selection with
+cross-validation fanned out across a TPU device mesh, evaluation, and
+model interpretability (ModelInsights, LOCO) - with columnar mask-based
+data instead of Spark rows, and jitted/sharded array computation instead
+of RDD passes.
+"""
+
+from .features.feature import Feature
+from .features.feature_builder import FeatureBuilder, from_dataframe, from_schema
+from .stages.base import Estimator, LambdaTransformer, PipelineStage, Transformer
+from .types import feature_types as types
+from .types.dataset import Dataset
+from .workflow.workflow import OpWorkflow, OpWorkflowModel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Feature",
+    "FeatureBuilder",
+    "from_dataframe",
+    "from_schema",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "LambdaTransformer",
+    "Dataset",
+    "OpWorkflow",
+    "OpWorkflowModel",
+    "types",
+    "__version__",
+]

@@ -1,0 +1,134 @@
+"""User-facing DSL: rich operations on features.
+
+Counterpart of the reference dsl package (reference: core/.../dsl/
+RichFeaturesCollection.scala:69 transmogrify, RichNumericFeature.scala:479
+sanityCheck + feature math, RichTextFeature pivot/tokenize).  Importing this
+module patches operator methods onto Feature so user code reads like the
+reference:
+
+    family_size = sib_sp + par_ch + 1
+    normed_age = age.fill_missing_with_mean().z_normalize()
+    features = transmogrify([p_class, sex, age, ...])
+    checked = survived.sanity_check(features, remove_bad_features=True)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from .features.feature import Feature
+from .ops.categorical import OneHotVectorizer
+from .ops.scalers import FillMissingWithMean, OpScalarStandardScaler
+from .ops.text import TextTokenizer
+from .ops.transmogrifier import transmogrify
+from .preparators.sanity_checker import SanityChecker
+from .stages.base import LambdaTransformer
+from .types.columns import Column, NumericColumn, TextColumn
+from .types import feature_types as ft
+
+Number = Union[int, float]
+
+
+def _numeric_binary(op_name: str, fn) -> LambdaTransformer:
+    def col_fn(a: Column, b: Column) -> Column:
+        assert isinstance(a, NumericColumn) and isinstance(b, NumericColumn)
+        vals = fn(a.values, b.values)
+        mask = a.mask & b.mask
+        ok = np.isfinite(vals)
+        return NumericColumn(np.where(mask & ok, vals, 0.0), mask & ok, ft.Real)
+
+    return LambdaTransformer(col_fn, ft.Real, operation_name=op_name)
+
+
+def _numeric_unary(op_name: str, fn, out_type=ft.Real) -> LambdaTransformer:
+    def col_fn(a: Column) -> Column:
+        assert isinstance(a, NumericColumn)
+        vals = fn(a.values)
+        ok = np.isfinite(vals)
+        return NumericColumn(np.where(a.mask & ok, vals, 0.0), a.mask & ok, out_type)
+
+    return LambdaTransformer(col_fn, out_type, operation_name=op_name)
+
+
+def _as_feature_op(self: Feature, other, op_name: str, fn, rev: bool = False):
+    """feature-op-feature or feature-op-scalar arithmetic (reference:
+    RichNumericFeature + - * /)."""
+    if isinstance(other, Feature):
+        stage = _numeric_binary(op_name, fn)
+        return stage.set_input(self, other).get_output()
+    k = float(other)
+    scalar_fn = (lambda v: fn(np.full_like(v, k), v)) if rev else (lambda v: fn(v, k))
+    stage = _numeric_unary(f"{op_name}_scalar", scalar_fn)
+    return stage.set_input(self).get_output()
+
+
+def _patch_feature() -> None:
+    F = Feature
+    F.__add__ = lambda s, o: _as_feature_op(s, o, "plus", np.add)
+    F.__radd__ = lambda s, o: _as_feature_op(s, o, "plus", np.add, rev=True)
+    F.__sub__ = lambda s, o: _as_feature_op(s, o, "minus", np.subtract)
+    F.__rsub__ = lambda s, o: _as_feature_op(s, o, "minus", np.subtract, rev=True)
+    F.__mul__ = lambda s, o: _as_feature_op(s, o, "times", np.multiply)
+    F.__rmul__ = lambda s, o: _as_feature_op(s, o, "times", np.multiply, rev=True)
+    F.__truediv__ = lambda s, o: _as_feature_op(s, o, "divide", np.divide)
+    F.__rtruediv__ = lambda s, o: _as_feature_op(s, o, "divide", np.divide, rev=True)
+
+    def fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
+        return FillMissingWithMean(default=default).set_input(self).get_output()
+
+    def z_normalize(self: Feature) -> Feature:
+        return OpScalarStandardScaler().set_input(self).get_output()
+
+    def pivot(self: Feature, top_k: int = 20, min_support: int = 10,
+              track_nulls: bool = True) -> Feature:
+        return (
+            OneHotVectorizer(
+                top_k=top_k, min_support=min_support, track_nulls=track_nulls
+            )
+            .set_input(self)
+            .get_output()
+        )
+
+    def tokenize_f(self: Feature, **kw) -> Feature:
+        return TextTokenizer(**kw).set_input(self).get_output()
+
+    def sanity_check(
+        self: Feature, features: Feature, remove_bad_features: bool = True, **kw
+    ) -> Feature:
+        checker = SanityChecker(remove_bad_features=remove_bad_features, **kw)
+        return checker.set_input(self, features).get_output()
+
+    def map_values(self: Feature, fn, output_type) -> Feature:
+        """Row-function escape hatch (reference: FeatureLike.map) -
+        vectorized over the host column values."""
+
+        def col_fn(c: Column) -> Column:
+            from .types.columns import column_from_list
+
+            return column_from_list([fn(v) for v in c.to_list()], output_type)
+
+        stage = LambdaTransformer(col_fn, output_type, operation_name="map")
+        return stage.set_input(self).get_output()
+
+    def vectorize_defaults(self: Feature, **kw) -> Feature:
+        return transmogrify([self])
+
+    def alias(self: Feature, name: str) -> Feature:
+        from .ops.combiner import AliasTransformer
+
+        return AliasTransformer(name).set_input(self).get_output()
+
+    F.fill_missing_with_mean = fill_missing_with_mean
+    F.z_normalize = z_normalize
+    F.pivot = pivot
+    F.tokenize = tokenize_f
+    F.sanity_check = sanity_check
+    F.map_values = map_values
+    F.vectorize = vectorize_defaults
+    F.alias = alias
+
+
+_patch_feature()
+
+__all__ = ["transmogrify"]

@@ -1,0 +1,94 @@
+"""Predictor stage bases.
+
+Counterpart of the reference's OpPredictorWrapper / OpPredictionModel
+machinery (reference: core/.../stages/sparkwrappers/specific/
+OpPredictorWrapper.scala:67-90, SparkModelConverter.scala): a predictor
+estimator takes (label RealNN, features OPVector) and produces a Prediction
+column.  Unlike the reference - which wraps external Spark/JVM estimators
+and calls their private predict methods reflectively per row - predictors
+here implement two array-level methods and everything else is shared:
+
+* ``fit_arrays(X, y, w) -> params`` - train on [n, d] + [n] (+ sample
+  weights), jitted JAX;
+* ``predict_arrays(params, X) -> (pred, raw, prob)`` - batched scoring.
+
+Sample weights thread through every fit so splitter rebalancing
+(DataBalancer) and CV fold membership are weight masks, not data copies -
+that is what lets cross-validation fan out as one vmapped computation.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import Estimator, Transformer
+from ..types.columns import Column, NumericColumn, PredictionColumn, VectorColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import OPVector, Prediction, RealNN
+
+
+class PredictorModel(Transformer):
+    """Fitted predictor: holds opaque params + the predict function."""
+
+    input_types = [RealNN, OPVector]
+    output_type = Prediction
+
+    def __init__(self, estimator: "PredictorEstimator", params: Any, **kw) -> None:
+        super().__init__(**kw)
+        self.estimator_ref = estimator
+        self.model_params = params
+        self.holdout_metrics: Optional[dict] = None
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        vec = cols[-1]
+        assert isinstance(vec, VectorColumn)
+        pred, raw, prob = self.estimator_ref.predict_arrays(
+            self.model_params, np.asarray(vec.values, dtype=np.float64)
+        )
+        return PredictionColumn(pred, raw, prob)
+
+    # interpretability hooks (reference: ModelInsights contributions)
+    def feature_contributions(self) -> Optional[np.ndarray]:
+        return self.estimator_ref.contributions(self.model_params)
+
+
+class PredictorEstimator(Estimator):
+    """Base estimator over (label, features)."""
+
+    input_types = [RealNN, OPVector]
+    output_type = Prediction
+    model_type: str = "Predictor"
+
+    def fit_arrays(
+        self, X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None
+    ) -> Any:
+        raise NotImplementedError
+
+    def predict_arrays(self, params: Any, X: np.ndarray):
+        raise NotImplementedError
+
+    def contributions(self, params: Any) -> Optional[np.ndarray]:
+        return None
+
+    def hyper_params(self) -> dict:
+        """Hyperparameters relevant to model selection grids."""
+        return dict(self.params)
+
+    def with_params(self, **hp) -> "PredictorEstimator":
+        clone = self.copy()
+        clone.params = dict(self.params)
+        clone.params.update(hp)
+        return clone
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        label, vec = cols
+        assert isinstance(label, NumericColumn)
+        assert isinstance(vec, VectorColumn)
+        if len(label) == 0:
+            raise ValueError("cannot fit on empty dataset")
+        params = self.fit_arrays(
+            np.asarray(vec.values, dtype=np.float64),
+            np.asarray(label.values, dtype=np.float64),
+        )
+        return PredictorModel(self, params)

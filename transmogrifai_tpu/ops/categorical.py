@@ -1,0 +1,219 @@
+"""Categorical pivot (one-hot) vectorizers and string indexing.
+
+Counterparts of OpOneHotVectorizer / OpTextPivotVectorizer / OpStringIndexer
+/ OpIndexToString (reference: core/.../impl/feature/OpOneHotVectorizer.scala,
+OpStringIndexer.scala): pivot top-K values by support into indicator columns
+plus OTHER and (optionally) null-indicator columns.  Label order is count
+descending then value ascending - deterministic, matching the reference's
+sorted pivots.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import Estimator, Transformer
+from ..types.columns import Column, ListColumn, NumericColumn, TextColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import (
+    Integral,
+    MultiPickList,
+    Real,
+    Text,
+)
+from ..types.vector_metadata import (
+    NULL_STRING,
+    OTHER_STRING,
+    VectorColumnMeta,
+)
+from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
+
+
+def _clean_value(v: str, clean_text: bool) -> str:
+    return v.strip().lower().replace(" ", "") if clean_text else v
+
+
+def top_k_labels(
+    counts: Counter, top_k: int, min_support: int
+) -> list[str]:
+    items = [(v, c) for v, c in counts.items() if c >= min_support]
+    items.sort(key=lambda vc: (-vc[1], vc[0]))
+    return [v for v, _ in items[:top_k]]
+
+
+class OneHotModel(SequenceVectorizerModel):
+    def __init__(
+        self,
+        labels_per_feature: Sequence[list[str]],
+        track_nulls: bool,
+        clean_text: bool,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.labels_per_feature = [list(l) for l in labels_per_feature]
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+
+    def _values_of(self, col: Column) -> tuple[list, np.ndarray]:
+        """Per-row value-sets + presence mask for text or set columns."""
+        if isinstance(col, TextColumn):
+            vals = [
+                None if v is None else (_clean_value(v, self.clean_text),)
+                for v in col.values
+            ]
+        elif isinstance(col, ListColumn):
+            vals = [
+                tuple(_clean_value(x, self.clean_text) for x in v) if v else None
+                for v in col.values
+            ]
+        elif isinstance(col, NumericColumn):
+            vals = [
+                (str(int(v)) if float(v).is_integer() else str(float(v)),) if m else None
+                for v, m in zip(col.values, col.mask)
+            ]
+        else:  # pragma: no cover
+            raise TypeError(f"cannot pivot column type {type(col).__name__}")
+        mask = np.array([v is not None for v in vals], dtype=bool)
+        return vals, mask
+
+    def blocks_for(self, col: Column, i: int):
+        feat = self.input_features[i]
+        labels = self.labels_per_feature[i]
+        vals, present = self._values_of(col)
+        n = len(col)
+        width = len(labels) + 1 + (1 if self.track_nulls else 0)
+        arr = np.zeros((n, width), dtype=np.float64)
+        idx = {v: j for j, v in enumerate(labels)}
+        other_j = len(labels)
+        for r, vset in enumerate(vals):
+            if vset is None:
+                continue
+            hit_other = False
+            for v in vset:
+                j = idx.get(v)
+                if j is not None:
+                    arr[r, j] = 1.0
+                else:
+                    hit_other = True
+            if hit_other:
+                arr[r, other_j] = 1.0
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                grouping=feat.name,
+                indicator_value=lab,
+            )
+            for lab in labels
+        ]
+        metas.append(
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                grouping=feat.name,
+                indicator_value=OTHER_STRING,
+            )
+        )
+        if self.track_nulls:
+            arr[:, -1] = (~present).astype(np.float64)
+            metas.append(
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    grouping=feat.name,
+                    indicator_value=NULL_STRING,
+                )
+            )
+        return arr, metas
+
+
+class OneHotVectorizer(SequenceVectorizer):
+    """Pivot top-K by support with OTHER + null columns (reference:
+    OpOneHotVectorizer.scala; defaults TransmogrifierDefaults.scala:52-87:
+    topK=20, minSupport=10, trackNulls=true)."""
+
+    input_types = None  # accepts Text subtypes, MultiPickList, or numerics
+
+    def __init__(
+        self,
+        top_k: int = 20,
+        min_support: int = 10,
+        track_nulls: bool = True,
+        clean_text: bool = True,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        model = OneHotModel([], self.track_nulls, self.clean_text)
+        labels_per = []
+        for col in cols:
+            vals, _ = model._values_of(col)
+            counts: Counter = Counter()
+            for vset in vals:
+                if vset:
+                    counts.update(vset)
+            labels_per.append(top_k_labels(counts, self.top_k, self.min_support))
+        model.labels_per_feature = labels_per
+        return model
+
+
+class StringIndexerModel(Transformer):
+    """value -> index; unseen values map to n_labels (NoFilter semantics,
+    reference: OpStringIndexerNoFilter)."""
+
+    output_type = Real
+
+    def __init__(self, labels: list[str], **kw) -> None:
+        super().__init__(**kw)
+        self.labels = list(labels)
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        idx = {v: float(j) for j, v in enumerate(self.labels)}
+        unseen = float(len(self.labels))
+        vals = np.array(
+            [unseen if v is None else idx.get(v, unseen) for v in col.values]
+        )
+        return NumericColumn(vals, np.ones(len(col), dtype=bool), Real)
+
+
+class StringIndexer(Estimator):
+    """Index labels by frequency desc then value asc (reference:
+    OpStringIndexer.scala wrapping Spark StringIndexer semantics)."""
+
+    input_types = [Text]
+    output_type = Real
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        (col,) = cols
+        counts = Counter(v for v in col.values if v is not None)
+        labels = [v for v, _ in sorted(counts.items(), key=lambda vc: (-vc[1], vc[0]))]
+        return StringIndexerModel(labels)
+
+
+class IndexToString(Transformer):
+    """Inverse of StringIndexer (reference: OpIndexToString.scala)."""
+
+    input_types = [Real]
+    output_type = Text
+
+    def __init__(self, labels: list[str], **kw) -> None:
+        super().__init__(**kw)
+        self.labels = list(labels)
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, NumericColumn)
+        out = [
+            self.labels[int(v)] if m and 0 <= int(v) < len(self.labels) else None
+            for v, m in zip(col.values, col.mask)
+        ]
+        return TextColumn(np.array(out, dtype=object), Text)

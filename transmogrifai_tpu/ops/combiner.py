@@ -1,0 +1,84 @@
+"""Vector assembly.
+
+Counterparts of VectorsCombiner / DropIndicesByTransformer / AliasTransformer
+(reference: core/.../impl/feature/VectorsCombiner.scala:47-82,
+DropIndicesByTransformer.scala, AliasTransformer.scala): concatenate OPVector
+columns preserving per-dimension provenance metadata.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..stages.base import Transformer
+from ..types.columns import Column, VectorColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import OPVector
+from ..types.vector_metadata import VectorColumnMeta, VectorMetadata
+
+
+class VectorsCombiner(Transformer):
+    """Concatenate vectors + merge metadata (reference: VectorsCombiner.scala).
+    Pure transformer here: metadata merging needs no fit pass because each
+    input column already carries its own VectorMetadata."""
+
+    input_types = [OPVector, ...]
+    output_type = OPVector
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        vecs = []
+        metas = []
+        for c in cols:
+            assert isinstance(c, VectorColumn)
+            vecs.append(c.values)
+            metas.append(c.metadata)
+        values = (
+            np.concatenate(vecs, axis=1)
+            if vecs
+            else np.zeros((len(ds), 0), dtype=np.float32)
+        )
+        meta = VectorMetadata.combine(self.output_name, metas)
+        return VectorColumn(values, meta)
+
+
+class DropIndicesByTransformer(Transformer):
+    """Drop vector dimensions whose metadata matches a predicate (reference:
+    DropIndicesByTransformer.scala)."""
+
+    input_types = [OPVector]
+    output_type = OPVector
+
+    def __init__(self, predicate: Callable[[VectorColumnMeta], bool], **kw) -> None:
+        super().__init__(**kw)
+        self.predicate = predicate
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (c,) = cols
+        assert isinstance(c, VectorColumn)
+        keep = [i for i, m in enumerate(c.metadata.columns) if not self.predicate(m)]
+        return VectorColumn(
+            c.values[:, keep],
+            c.metadata.select(keep),
+        )
+
+
+class AliasTransformer(Transformer):
+    """Rename a feature without copying data (reference:
+    AliasTransformer.scala)."""
+
+    def __init__(self, name: str, **kw) -> None:
+        super().__init__(**kw)
+        self.alias = name
+
+    def make_output_name(self) -> str:
+        return self.alias
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (c,) = cols
+        return c
+
+    def set_input(self, *features):
+        super().set_input(*features)
+        self.output_type = features[0].ftype
+        return self

@@ -1,0 +1,97 @@
+"""Date/time vectorizers: circular encodings.
+
+Counterparts of DateToUnitCircleTransformer / DateListVectorizer (reference:
+core/.../impl/feature/DateToUnitCircleTransformer.scala,
+DateListVectorizer.scala, TimePeriod.scala).  Dates are epoch milliseconds
+(Integral); each configured time period maps to (sin, cos) on the unit
+circle so midnight is close to 23:59 (the whole point of the encoding).
+Defaults mirror TransmogrifierDefaults.CircularDateRepresentations:
+HourOfDay, DayOfWeek, DayOfMonth, WeekOfYear.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..types.columns import Column, NumericColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import Date
+from ..types.vector_metadata import NULL_STRING, VectorColumnMeta
+from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
+
+MS_PER_HOUR = 3600 * 1000.0
+MS_PER_DAY = 24 * MS_PER_HOUR
+
+DEFAULT_PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "WeekOfYear")
+
+
+def period_fraction(epoch_ms: np.ndarray, period: str) -> np.ndarray:
+    """Position within the period as a fraction in [0, 1)."""
+    days = epoch_ms / MS_PER_DAY
+    if period == "HourOfDay":
+        return (epoch_ms / MS_PER_HOUR % 24.0) / 24.0
+    if period == "DayOfWeek":
+        # epoch day 0 = Thursday; ISO week starts Monday
+        return ((np.floor(days) + 3.0) % 7.0) / 7.0
+    if period == "DayOfMonth":
+        d = (np.floor(days) % 30.4375) / 30.4375  # mean month length
+        return d
+    if period == "WeekOfYear":
+        return (np.floor(days / 7.0) % 52.1786) / 52.1786
+    if period == "MonthOfYear":
+        return (np.floor(days / 30.4375) % 12.0) / 12.0
+    raise ValueError(f"unknown time period {period!r}")
+
+
+class DateVectorizerModel(SequenceVectorizerModel):
+    def __init__(self, periods: Sequence[str], track_nulls: bool, **kw) -> None:
+        super().__init__(**kw)
+        self.periods = tuple(periods)
+        self.track_nulls = track_nulls
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, NumericColumn)
+        feat = self.input_features[i]
+        blocks, metas = [], []
+        for p in self.periods:
+            frac = period_fraction(col.values, p)
+            rad = 2.0 * np.pi * frac
+            for trig, name in ((np.sin, "sin"), (np.cos, "cos")):
+                v = np.where(col.mask, trig(rad), 0.0)
+                blocks.append(v)
+                metas.append(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=feat.ftype.type_name(),
+                        descriptor_value=f"{p}_{name}",
+                    )
+                )
+        if self.track_nulls:
+            blocks.append((~col.mask).astype(np.float64))
+            metas.append(
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    grouping=feat.name,
+                    indicator_value=NULL_STRING,
+                )
+            )
+        return np.stack(blocks, axis=1), metas
+
+
+class DateVectorizer(SequenceVectorizer):
+    input_types = [Date, ...]
+
+    def __init__(
+        self,
+        periods: Sequence[str] = DEFAULT_PERIODS,
+        track_nulls: bool = True,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.periods = tuple(periods)
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        return DateVectorizerModel(self.periods, self.track_nulls)

@@ -1,0 +1,67 @@
+"""Geolocation vectorizer: fill with geographic mean + null tracking.
+
+Counterpart of GeolocationVectorizer (reference: core/.../impl/feature/
+GeolocationVectorizer.scala): missing (lat, lon, acc) triples are imputed
+with the fit-time geographic mean; a null-indicator column is appended.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..types.columns import Column, GeolocationColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import Geolocation
+from ..types.vector_metadata import NULL_STRING, VectorColumnMeta
+from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
+
+
+class GeolocationVectorizerModel(SequenceVectorizerModel):
+    def __init__(self, fill_values: Sequence[np.ndarray], track_nulls: bool, **kw):
+        super().__init__(**kw)
+        self.fill_values = [np.asarray(f, dtype=np.float64) for f in fill_values]
+        self.track_nulls = track_nulls
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, GeolocationColumn)
+        feat = self.input_features[i]
+        filled = np.where(col.mask[:, None], col.values, self.fill_values[i][None, :])
+        blocks = [filled]
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                descriptor_value=d,
+            )
+            for d in ("lat", "lon", "accuracy")
+        ]
+        if self.track_nulls:
+            blocks.append((~col.mask).astype(np.float64)[:, None])
+            metas.append(
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    grouping=feat.name,
+                    indicator_value=NULL_STRING,
+                )
+            )
+        return np.concatenate(blocks, axis=1), metas
+
+
+class GeolocationVectorizer(SequenceVectorizer):
+    input_types = [Geolocation, ...]
+
+    def __init__(self, track_nulls: bool = True, **kw) -> None:
+        super().__init__(**kw)
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        fills = []
+        for c in cols:
+            assert isinstance(c, GeolocationColumn)
+            if c.mask.any():
+                fills.append(c.values[c.mask].mean(axis=0))
+            else:
+                fills.append(np.zeros(3))
+        return GeolocationVectorizerModel(fills, self.track_nulls)

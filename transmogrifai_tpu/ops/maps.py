@@ -1,0 +1,220 @@
+"""Map vectorizers: per-key expansion of every OPMap type.
+
+Counterparts of the OPMapVectorizer family (reference: core/.../impl/
+feature/OPMapVectorizer.scala, TextMapPivotVectorizer.scala,
+MultiPickListMapVectorizer.scala, DateMapToUnitCircleVectorizer.scala,
+GeolocationMapVectorizer.scala): the key set of each map feature is
+discovered at fit time (sorted, optionally filtered by white/blacklists);
+each key becomes a pseudo-column vectorized by the value type's default
+strategy (impute+null-track for numerics, top-K pivot for categorical text,
+circular encoding for dates, geo-mean fill for geolocations).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..types import feature_types as ft
+from ..types.columns import Column, MapColumn
+from ..types.dataset import Dataset
+from ..types.vector_metadata import NULL_STRING, VectorColumnMeta
+from ..utils.masked_stats import masked_mean, masked_mode
+from .categorical import top_k_labels, _clean_value
+from .dates import period_fraction
+from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
+
+
+def _clean_key(k: str, clean_keys: bool) -> str:
+    return k.strip() if clean_keys else k
+
+
+def _key_values(col: MapColumn, key: str) -> list:
+    return [d.get(key) for d in col.values]
+
+
+def _numeric_key_arrays(col: MapColumn, key: str) -> tuple[np.ndarray, np.ndarray]:
+    vals = _key_values(col, key)
+    mask = np.array([v is not None for v in vals], dtype=bool)
+    arr = np.array([float(v) if v is not None else 0.0 for v in vals])
+    return arr, mask
+
+
+class MapVectorizerModel(SequenceVectorizerModel):
+    """Fitted per-key plans.  plan: list per feature of
+    {"key", "kind", "fill", "labels", "periods"}."""
+
+    def __init__(self, plans: Sequence[list[dict]], track_nulls: bool,
+                 clean_text: bool, **kw) -> None:
+        super().__init__(**kw)
+        self.plans = list(plans)
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, MapColumn)
+        feat = self.input_features[i]
+        tname = feat.ftype.type_name()
+        blocks: list[np.ndarray] = []
+        metas: list[VectorColumnMeta] = []
+
+        def null_block(mask: np.ndarray, key: str) -> None:
+            if self.track_nulls:
+                blocks.append((~mask).astype(np.float64)[:, None])
+                metas.append(VectorColumnMeta(
+                    parent_feature_name=feat.name, parent_feature_type=tname,
+                    grouping=key, indicator_value=NULL_STRING))
+
+        for plan in self.plans[i]:
+            key, kind = plan["key"], plan["kind"]
+            if kind == "numeric":
+                arr, mask = _numeric_key_arrays(col, key)
+                filled = np.where(mask, arr, plan["fill"])
+                blocks.append(filled[:, None])
+                metas.append(VectorColumnMeta(
+                    parent_feature_name=feat.name, parent_feature_type=tname,
+                    grouping=key))
+                null_block(mask, key)
+            elif kind == "pivot":
+                vals = _key_values(col, key)
+                labels = plan["labels"]
+                idx = {v: j for j, v in enumerate(labels)}
+                arr = np.zeros((len(col), len(labels) + 1))
+                mask = np.zeros(len(col), dtype=bool)
+                for r, v in enumerate(vals):
+                    if v is None:
+                        continue
+                    mask[r] = True
+                    vs = (
+                        [_clean_value(x, self.clean_text) for x in v]
+                        if isinstance(v, (set, frozenset, list, tuple))
+                        else [_clean_value(str(v), self.clean_text)]
+                    )
+                    for x in vs:
+                        j = idx.get(x)
+                        if j is None:
+                            arr[r, len(labels)] = 1.0
+                        else:
+                            arr[r, j] = 1.0
+                blocks.append(arr)
+                for lab in labels + ["OTHER"]:
+                    metas.append(VectorColumnMeta(
+                        parent_feature_name=feat.name, parent_feature_type=tname,
+                        grouping=key, indicator_value=lab))
+                null_block(mask, key)
+            elif kind == "date":
+                arr, mask = _numeric_key_arrays(col, key)
+                for p in plan["periods"]:
+                    rad = 2.0 * np.pi * period_fraction(arr, p)
+                    for trig, nm in ((np.sin, "sin"), (np.cos, "cos")):
+                        blocks.append(np.where(mask, trig(rad), 0.0)[:, None])
+                        metas.append(VectorColumnMeta(
+                            parent_feature_name=feat.name,
+                            parent_feature_type=tname,
+                            grouping=key, descriptor_value=f"{p}_{nm}"))
+                null_block(mask, key)
+            elif kind == "geo":
+                vals = _key_values(col, key)
+                mask = np.array([v is not None for v in vals], dtype=bool)
+                dense = np.array(
+                    [list(v)[:3] if v is not None else [0.0, 0.0, 0.0] for v in vals]
+                )
+                filled = np.where(mask[:, None], dense, np.asarray(plan["fill"])[None, :])
+                blocks.append(filled)
+                for d in ("lat", "lon", "accuracy"):
+                    metas.append(VectorColumnMeta(
+                        parent_feature_name=feat.name, parent_feature_type=tname,
+                        grouping=key, descriptor_value=d))
+                null_block(mask, key)
+            else:  # pragma: no cover
+                raise ValueError(kind)
+        if not blocks:
+            return np.zeros((len(col), 0)), []
+        return np.concatenate(blocks, axis=1), metas
+
+
+class MapVectorizer(SequenceVectorizer):
+    """Generic map vectorizer dispatching on the map's value type."""
+
+    input_types = [ft.OPMap, ...]
+
+    def __init__(
+        self,
+        top_k: int = 20,
+        min_support: int = 10,
+        track_nulls: bool = True,
+        clean_text: bool = True,
+        clean_keys: bool = True,
+        allow_keys: Optional[Sequence[str]] = None,
+        block_keys: Optional[Sequence[str]] = None,
+        date_periods: Sequence[str] = ("HourOfDay", "DayOfWeek", "DayOfMonth", "WeekOfYear"),
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+        self.clean_keys = clean_keys
+        self.allow_keys = set(allow_keys) if allow_keys else None
+        self.block_keys = set(block_keys or ())
+        self.date_periods = tuple(date_periods)
+
+    def _keys_of(self, col: MapColumn) -> list[str]:
+        keys = [k for k in col.all_keys() if k not in self.block_keys]
+        if self.allow_keys is not None:
+            keys = [k for k in keys if k in self.allow_keys]
+        return sorted(keys)
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        plans = []
+        for i, col in enumerate(cols):
+            assert isinstance(col, MapColumn)
+            vt = self.input_features[i].ftype.value_type or ft.Real
+            feature_plans = []
+            for key in self._keys_of(col):
+                if issubclass(vt, ft.Date):
+                    feature_plans.append(
+                        {"key": key, "kind": "date", "periods": self.date_periods}
+                    )
+                elif issubclass(vt, ft.Geolocation):
+                    vals = [v for v in _key_values(col, key) if v is not None]
+                    fill = (
+                        np.mean([list(v)[:3] for v in vals], axis=0)
+                        if vals else np.zeros(3)
+                    ).tolist()
+                    feature_plans.append({"key": key, "kind": "geo", "fill": fill})
+                elif issubclass(vt, ft.OPNumeric):
+                    arr, mask = _numeric_key_arrays(col, key)
+                    fill = (
+                        masked_mode(arr, mask)
+                        if issubclass(vt, (ft.Integral, ft.Binary))
+                        else masked_mean(arr, mask)
+                    )
+                    feature_plans.append({"key": key, "kind": "numeric", "fill": fill})
+                else:  # text-ish -> pivot
+                    counts: Counter = Counter()
+                    for v in _key_values(col, key):
+                        if v is None:
+                            continue
+                        if isinstance(v, (set, frozenset, list, tuple)):
+                            counts.update(_clean_value(x, self.clean_text) for x in v)
+                        else:
+                            counts[_clean_value(str(v), self.clean_text)] += 1
+                    labels = top_k_labels(counts, self.top_k, self.min_support)
+                    feature_plans.append({"key": key, "kind": "pivot", "labels": labels})
+            plans.append(feature_plans)
+        return MapVectorizerModel(plans, self.track_nulls, self.clean_text)
+
+
+def transmogrify_map_group(feats: Sequence[Feature], defaults) -> Feature:
+    stage = MapVectorizer(
+        top_k=defaults.top_k,
+        min_support=defaults.min_support,
+        track_nulls=defaults.track_nulls,
+        clean_text=defaults.clean_text,
+        date_periods=defaults.date_periods,
+    )
+    return stage.set_input(*feats).get_output()

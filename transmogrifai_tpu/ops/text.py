@@ -1,0 +1,233 @@
+"""Text processing: tokenization, hashing, cardinality-adaptive vectorization.
+
+Counterparts of TextTokenizer, OPCollectionHashingVectorizer and
+SmartTextVectorizer (reference: core/.../impl/feature/TextTokenizer.scala,
+OPCollectionHashingVectorizer.scala, SmartTextVectorizer.scala:79-99):
+
+* ``TextTokenizer`` - lowercasing + non-alphanumeric splitting + min-length
+  filter (the Lucene standard-analyzer behavior the reference defaults to).
+* ``TextStats`` - monoid value-count statistics with cardinality cap.
+* ``SmartTextVectorizer`` - per feature: cardinality <= max_cardinality ->
+  pivot (one-hot top-K); else -> tokenize + murmur3 hashing-TF; plus null
+  indicators.  This is AutoML step 1's text work-horse.
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import Transformer
+from ..types.columns import Column, ListColumn, TextColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import Text, TextList
+from ..types.vector_metadata import NULL_STRING, VectorColumnMeta
+from ..utils.hashing import hashing_tf
+from .categorical import OneHotModel, top_k_labels, _clean_value
+from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
+
+_TOKEN_RE = re.compile(r"[^\w]+", re.UNICODE)
+
+
+def tokenize(
+    text: Optional[str],
+    to_lowercase: bool = True,
+    min_token_length: int = 1,
+) -> list[str]:
+    """(reference: TextTokenizer.scala defaults: lucene standard analyzer,
+    lowercase, minTokenLength=1)"""
+    if not text:
+        return []
+    if to_lowercase:
+        text = text.lower()
+    return [t for t in _TOKEN_RE.split(text) if len(t) >= min_token_length]
+
+
+class TextTokenizer(Transformer):
+    input_types = [Text]
+    output_type = TextList
+
+    def __init__(self, min_token_length: int = 1, to_lowercase: bool = True, **kw):
+        super().__init__(**kw)
+        self.min_token_length = min_token_length
+        self.to_lowercase = to_lowercase
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        toks = [
+            tuple(tokenize(v, self.to_lowercase, self.min_token_length))
+            for v in col.values
+        ]
+        return ListColumn(toks, TextList)
+
+
+class TextStats:
+    """Monoid value-count stats (reference: SmartTextVectorizer.scala:79-99).
+    Counts distinct raw values, capped at ``max_card + 1`` so huge-cardinality
+    features stop accumulating early."""
+
+    def __init__(self, max_card: int = 100) -> None:
+        self.max_card = max_card
+        self.value_counts: Counter = Counter()
+        self.n_present = 0
+
+    def update(self, value: Optional[str]) -> None:
+        if value is None:
+            return
+        self.n_present += 1
+        if len(self.value_counts) <= self.max_card or value in self.value_counts:
+            self.value_counts[value] += 1
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.value_counts)
+
+    def merge(self, other: "TextStats") -> "TextStats":
+        self.value_counts.update(other.value_counts)
+        self.n_present += other.n_present
+        return self
+
+
+class SmartTextModel(SequenceVectorizerModel):
+    def __init__(
+        self,
+        plans: Sequence[dict],
+        hash_dims: int,
+        track_nulls: bool,
+        clean_text: bool,
+        seed: int = 42,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        # plan per feature: {"mode": "pivot"|"hash"|"ignore", "labels": [...]}
+        self.plans = list(plans)
+        self.hash_dims = hash_dims
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+        self.seed = seed
+
+    def blocks_for(self, col: Column, i: int):
+        feat = self.input_features[i]
+        plan = self.plans[i]
+        tname = feat.ftype.type_name()
+        if plan["mode"] == "pivot":
+            helper = OneHotModel(
+                [plan["labels"]], self.track_nulls, self.clean_text
+            )
+            helper.input_features = (feat,)
+            return helper.blocks_for(col, 0)
+        assert isinstance(col, TextColumn)
+        mask = col.mask
+        toks = [tokenize(v) for v in col.values]
+        arr = hashing_tf(toks, self.hash_dims, seed=self.seed)
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=tname,
+                descriptor_value=f"hash_{j}",
+            )
+            for j in range(self.hash_dims)
+        ]
+        if self.track_nulls:
+            arr = np.concatenate(
+                [arr, (~mask).astype(np.float32)[:, None]], axis=1
+            )
+            metas.append(
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=tname,
+                    grouping=feat.name,
+                    indicator_value=NULL_STRING,
+                )
+            )
+        return arr, metas
+
+
+class TextListHashModel(SequenceVectorizerModel):
+    def __init__(self, hash_dims: int, seed: int = 42, **kw) -> None:
+        super().__init__(**kw)
+        self.hash_dims = hash_dims
+        self.seed = seed
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, ListColumn)
+        feat = self.input_features[i]
+        arr = hashing_tf(
+            [list(v) for v in col.values], self.hash_dims, seed=self.seed
+        )
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                descriptor_value=f"hash_{j}",
+            )
+            for j in range(self.hash_dims)
+        ]
+        return arr, metas
+
+
+class TextListHashingVectorizer(SequenceVectorizer):
+    """Hashing-TF over already-tokenized text lists (reference:
+    OPCollectionHashingVectorizer.scala:42,76-86; 512 default dims)."""
+
+    input_types = [TextList, ...]
+
+    def __init__(self, hash_dims: int = 512, **kw) -> None:
+        super().__init__(**kw)
+        self.hash_dims = hash_dims
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        return TextListHashModel(self.hash_dims)
+
+
+class SmartTextVectorizer(SequenceVectorizer):
+    """Cardinality-adaptive text vectorization (reference:
+    SmartTextVectorizer.scala:79-99; defaults TransmogrifierDefaults:
+    maxCategoricalCardinality=30, 512 hash dims, topK=20, minSupport=10)."""
+
+    input_types = [Text, ...]
+
+    def __init__(
+        self,
+        max_cardinality: int = 30,
+        top_k: int = 20,
+        min_support: int = 10,
+        hash_dims: int = 512,
+        track_nulls: bool = True,
+        clean_text: bool = True,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.hash_dims = hash_dims
+        self.track_nulls = track_nulls
+        self.clean_text = clean_text
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        plans = []
+        for col in cols:
+            assert isinstance(col, TextColumn)
+            stats = TextStats(max_card=max(self.max_cardinality * 2, 100))
+            for v in col.values:
+                stats.update(
+                    None if v is None else _clean_value(v, self.clean_text)
+                )
+            if stats.cardinality <= self.max_cardinality:
+                labels = top_k_labels(stats.value_counts, self.top_k, self.min_support)
+                plans.append({"mode": "pivot", "labels": labels})
+            else:
+                plans.append({"mode": "hash", "labels": []})
+        model = SmartTextModel(
+            plans, self.hash_dims, self.track_nulls, self.clean_text
+        )
+        model.metadata = {
+            "textStats": [
+                {"mode": p["mode"], "nLabels": len(p["labels"])} for p in plans
+            ]
+        }
+        return model

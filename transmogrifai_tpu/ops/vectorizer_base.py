@@ -1,0 +1,51 @@
+"""Shared machinery for sequence vectorizers.
+
+Counterpart of the reference's SequenceEstimator/SequenceTransformer bases
+(reference: features/.../stages/base/sequence/SequenceEstimator.scala and
+the vectorizer pattern of core/.../impl/feature/*Vectorizer.scala): a
+vectorizer takes N same-type input features and emits ONE OPVector column
+whose per-dimension provenance is recorded in VectorMetadata.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Type
+
+import numpy as np
+
+from ..stages.base import Estimator, Transformer
+from ..types.columns import Column, VectorColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import FeatureType, OPVector
+from ..types.vector_metadata import VectorColumnMeta, VectorMetadata
+
+
+class SequenceVectorizerModel(Transformer):
+    """Fitted vectorizer: builds [n, d] dense blocks per input feature and
+    concatenates.  Subclasses implement ``blocks_for(col, feature_idx)``
+    returning (array [n, k], list[VectorColumnMeta])."""
+
+    output_type: Type[FeatureType] = OPVector
+
+    def blocks_for(self, col: Column, i: int) -> tuple[np.ndarray, list[VectorColumnMeta]]:
+        raise NotImplementedError
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        arrays: list[np.ndarray] = []
+        metas: list[VectorColumnMeta] = []
+        for i, col in enumerate(cols):
+            arr, ms = self.blocks_for(col, i)
+            arrays.append(np.asarray(arr, dtype=np.float32))
+            metas.extend(ms)
+        values = (
+            np.concatenate(arrays, axis=1)
+            if arrays
+            else np.zeros((len(ds), 0), dtype=np.float32)
+        )
+        meta = VectorMetadata(self.output_name, tuple(metas)).reindexed()
+        return VectorColumn(values, meta)
+
+
+class SequenceVectorizer(Estimator):
+    """Estimator base for vectorizers needing fit-time statistics."""
+
+    output_type: Type[FeatureType] = OPVector

@@ -1,0 +1,250 @@
+"""SanityChecker: automated feature validation.
+
+Counterpart of the reference SanityChecker (reference: core/.../impl/
+preparators/SanityChecker.scala:59-225 params, :535-709 fit; stats via
+OpStatistics, utils/.../stats/OpStatistics.scala:384).  A binary estimator
+(label RealNN, features OPVector) -> OPVector that:
+
+1. computes per-column stats (mean/var/min/max, null counts) and Pearson (or
+   Spearman) correlation of every feature column with the label - on device
+   as one jitted moment-accumulation pass (the analog of the reference's
+   Statistics.colStats/corr treeAggregate, SanityChecker.scala:575,633-637);
+2. builds label-vs-category contingency tables for every categorical group
+   found in the vector metadata - one one-hot matmul per fit, MXU-friendly -
+   and derives Cramer's V / PMI / association-rule max confidence+support
+   (reference: SanityChecker.scala:440,495-496);
+3. drops feature columns violating minVariance / minCorrelation /
+   maxCorrelation / maxCramersV / maxRuleConfidence;
+4. emits a SanityCheckerSummary into stage metadata, and the fitted model
+   slices kept indices at transform time (reference: SanityChecker.scala:694-709).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import Estimator, Transformer
+from ..types.columns import Column, NumericColumn, VectorColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import OPVector, RealNN
+from ..utils.stats import (
+    cramers_v,
+    max_rule_confidences,
+    pearson_correlation,
+    pointwise_mutual_info,
+)
+from .metadata import ColumnStatistics, SanityCheckerSummary
+
+
+@jax.jit
+def _moments_kernel(x: jnp.ndarray, y: jnp.ndarray):
+    """Single fused pass over the [n, d] design matrix: all the sums the
+    checker needs.  Under pjit with x sharded over rows this lowers the
+    reductions to psums over the mesh (the treeAggregate analog)."""
+    n = x.shape[0]
+    x_sum = x.sum(axis=0)
+    x_sq_sum = (x * x).sum(axis=0)
+    xy_sum = (x * y[:, None]).sum(axis=0)
+    y_sum = y.sum()
+    y_sq_sum = (y * y).sum()
+    x_min = x.min(axis=0)
+    x_max = x.max(axis=0)
+    return x_sum, x_sq_sum, xy_sum, y_sum, y_sq_sum, x_min, x_max
+
+
+@jax.jit
+def _contingency_kernel(label_onehot: jnp.ndarray, indicators: jnp.ndarray):
+    """[n, L]^T @ [n, D] -> [L, D] counts for all categorical indicator
+    columns at once (reference builds these via reduceByKey shuffles,
+    SanityChecker.scala:440; here it is one matmul)."""
+    return label_onehot.T @ indicators
+
+
+class SanityCheckerModel(Transformer):
+    input_types = [RealNN, OPVector]
+    output_type = OPVector
+
+    def __init__(self, indices_to_keep: Sequence[int], **kw) -> None:
+        super().__init__(**kw)
+        self.indices_to_keep = list(indices_to_keep)
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        vec = cols[1]
+        assert isinstance(vec, VectorColumn)
+        return VectorColumn(
+            vec.values[:, self.indices_to_keep],
+            vec.metadata.select(self.indices_to_keep),
+        )
+
+
+class SanityChecker(Estimator):
+    """Defaults mirror the reference (SanityChecker.scala:59-225)."""
+
+    input_types = [RealNN, OPVector]
+    output_type = OPVector
+
+    def __init__(
+        self,
+        check_sample: float = 1.0,
+        sample_upper_limit: int = 1_000_000,
+        min_variance: float = 1e-5,
+        min_correlation: float = 0.0,
+        max_correlation: float = 0.95,
+        max_cramers_v: float = 0.95,
+        max_rule_confidence: float = 1.0,
+        min_required_rule_support: float = 0.3,
+        remove_bad_features: bool = True,
+        remove_feature_group: bool = True,
+        max_label_classes: int = 100,
+        seed: int = 42,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.check_sample = check_sample
+        self.sample_upper_limit = sample_upper_limit
+        self.min_variance = min_variance
+        self.min_correlation = min_correlation
+        self.max_correlation = max_correlation
+        self.max_cramers_v = max_cramers_v
+        self.max_rule_confidence = max_rule_confidence
+        self.min_required_rule_support = min_required_rule_support
+        self.remove_bad_features = remove_bad_features
+        self.remove_feature_group = remove_feature_group
+        self.max_label_classes = max_label_classes
+        self.seed = seed
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        label_col, vec_col = cols
+        assert isinstance(label_col, NumericColumn)
+        assert isinstance(vec_col, VectorColumn)
+        y = np.asarray(label_col.values, dtype=np.float64)
+        x = np.asarray(vec_col.values, dtype=np.float64)
+        n, d = x.shape
+        meta = vec_col.metadata
+
+        # sampling (reference: SanityChecker.scala:68-100 sample bounds)
+        if self.check_sample < 1.0 or n > self.sample_upper_limit:
+            rng = np.random.RandomState(self.seed)
+            target = min(
+                int(np.ceil(n * self.check_sample)), self.sample_upper_limit
+            )
+            idx = rng.choice(n, size=max(target, 1), replace=False)
+            x, y = x[idx], y[idx]
+            n = len(y)
+
+        xs, xss, xys, ys, yss, xmin, xmax = (
+            np.asarray(v, dtype=np.float64)
+            for v in _moments_kernel(jnp.asarray(x), jnp.asarray(y))
+        )
+        mean = xs / n
+        var = np.maximum(xss / n - mean**2, 0.0) * (n / max(n - 1, 1))
+        corr = pearson_correlation(xs, xss, xys, float(ys), float(yss), float(n))
+
+        # contingency tables per categorical group
+        classes = np.unique(y)
+        groups = meta.grouping_indices()
+        cramers: dict[tuple[str, str], float] = {}
+        confidences: dict[int, tuple[float, float]] = {}
+        group_of: dict[int, tuple[str, str]] = {}
+        if len(classes) <= self.max_label_classes and groups:
+            onehot = (y[:, None] == classes[None, :]).astype(np.float64)
+            all_idx = sorted({i for idxs in groups.values() for i in idxs})
+            sub = x[:, all_idx]
+            counts = np.asarray(
+                _contingency_kernel(jnp.asarray(onehot), jnp.asarray(sub))
+            )
+            pos = {col_i: j for j, col_i in enumerate(all_idx)}
+            for gkey, idxs in groups.items():
+                table = counts[:, [pos[i] for i in idxs]]
+                cramers[gkey] = cramers_v(table)
+                conf, support = max_rule_confidences(table)
+                for i, c, s in zip(idxs, conf, support):
+                    confidences[i] = (float(c), float(s))
+                    group_of[i] = gkey
+
+        # drop decisions (reference: SanityChecker.scala:640-690)
+        reasons: dict[int, list[str]] = {}
+
+        def flag(i: int, why: str) -> None:
+            reasons.setdefault(i, []).append(why)
+
+        abs_corr = np.abs(corr)
+        for i in range(d):
+            if var[i] < self.min_variance:
+                flag(i, f"variance {var[i]:.3g} < {self.min_variance}")
+            if np.isfinite(corr[i]):
+                if abs_corr[i] > self.max_correlation:
+                    flag(i, f"|corr| {abs_corr[i]:.3f} > {self.max_correlation}")
+                elif abs_corr[i] < self.min_correlation:
+                    flag(i, f"|corr| {abs_corr[i]:.3f} < {self.min_correlation}")
+            cv = cramers.get(group_of.get(i)) if i in group_of else None
+            if cv is not None and cv > self.max_cramers_v:
+                flag(i, f"group Cramer's V {cv:.3f} > {self.max_cramers_v}")
+            if i in confidences:
+                conf, support = confidences[i]
+                if (
+                    conf > self.max_rule_confidence
+                    and support > self.min_required_rule_support
+                ):
+                    flag(i, f"rule confidence {conf:.3f} support {support:.3f}")
+
+        # remove whole groups when one member is flagged for group reasons
+        if self.remove_feature_group:
+            flagged_groups = {
+                group_of[i]
+                for i in reasons
+                if i in group_of
+                and any("Cramer" in r or "rule" in r for r in reasons[i])
+            }
+            for gkey, idxs in groups.items():
+                if gkey in flagged_groups:
+                    for i in idxs:
+                        if i not in reasons:
+                            flag(i, "categorical group removed")
+
+        if self.remove_bad_features:
+            keep = [i for i in range(d) if i not in reasons]
+        else:
+            keep = list(range(d))
+        if not keep:
+            raise ValueError(
+                "SanityChecker dropped all features "
+                "(reference guard: SanityChecker.scala:682)"
+            )
+
+        null_groups = {
+            i for i, c in enumerate(meta.columns) if c.is_null_indicator
+        }
+        col_stats = [
+            ColumnStatistics(
+                name=meta.columns[i].column_name() if i < meta.size else str(i),
+                pretty_name=meta.columns[i].pretty_name() if i < meta.size else str(i),
+                parent=meta.columns[i].parent_feature_name if i < meta.size else "",
+                mean=float(mean[i]),
+                variance=float(var[i]),
+                min=float(xmin[i]),
+                max=float(xmax[i]),
+                corr_label=float(corr[i]) if np.isfinite(corr[i]) else None,
+                cramers_v=cramers.get(group_of.get(i)) if i in group_of else None,
+                max_rule_confidence=confidences.get(i, (None, None))[0],
+                support=confidences.get(i, (None, None))[1],
+                is_null_indicator=i in null_groups,
+                dropped_reasons=reasons.get(i, []),
+            )
+            for i in range(d)
+        ]
+        summary = SanityCheckerSummary(
+            n_rows=int(n),
+            n_features=int(d),
+            n_kept=len(keep),
+            column_stats=col_stats,
+            dropped=[col_stats[i].name for i in sorted(reasons)],
+            cramers_v_by_group={f"{p}/{g}": v for (p, g), v in cramers.items()},
+        )
+        model = SanityCheckerModel(keep)
+        model.metadata = {"sanity_checker_summary": summary.to_json()}
+        self.metadata = model.metadata
+        return model

@@ -1,0 +1,99 @@
+"""CSV readers.
+
+Counterpart of the reference CSV reader stack (reference: readers/.../
+DataReaders.scala:44-198 factory, CSVAutoReaders auto-infer, utils/.../io/
+csv/): parse a CSV into a columnar Dataset keyed by the requested raw
+features.  Schema-ful (explicit {column: FeatureType}) or auto-inferring.
+"""
+from __future__ import annotations
+
+import csv
+from typing import Mapping, Optional, Sequence, Type
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..features.feature_builder import infer_feature_type
+from ..types.columns import column_from_list
+from ..types.dataset import Dataset
+from ..types.feature_types import FeatureType, OPNumeric
+
+
+def _parse_cell(raw: str, ftype: Type[FeatureType]):
+    if raw is None or raw == "":
+        return None
+    if issubclass(ftype, OPNumeric):
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+    return raw
+
+
+class CSVReader:
+    """Simple batch CSV reader (reference: DataReaders.Simple.csvCase)."""
+
+    def __init__(
+        self,
+        path: str,
+        schema: Optional[Mapping[str, Type[FeatureType]]] = None,
+        headers: Optional[Sequence[str]] = None,
+        has_header: bool = True,
+        key_col: Optional[str] = None,
+    ) -> None:
+        self.path = path
+        self.schema = dict(schema) if schema else None
+        self.headers = list(headers) if headers else None
+        self.has_header = has_header
+        self.key_col = key_col
+
+    def read_raw(self) -> dict[str, list]:
+        with open(self.path, newline="", encoding="utf-8") as f:
+            rows = list(csv.reader(f))
+        if not rows:
+            return {}
+        if self.has_header and self.headers is None:
+            header, rows = rows[0], rows[1:]
+        elif self.headers is not None:
+            header = self.headers
+            if self.has_header:
+                rows = rows[1:]
+        else:
+            header = [f"c{i}" for i in range(len(rows[0]))]
+        cols: dict[str, list] = {h: [] for h in header}
+        for r in rows:
+            for h, v in zip(header, r):
+                cols[h].append(v if v != "" else None)
+            for h in header[len(r):]:
+                cols[h].append(None)
+        return cols
+
+    def generate_dataset(
+        self, raw_features: Sequence[Feature], params: Optional[dict] = None
+    ) -> Dataset:
+        """Reader hand-off (reference: DataReader.generateDataFrame:173-199)."""
+        raw = self.read_raw()
+        out = {}
+        for feat in raw_features:
+            if feat.name not in raw:
+                raise KeyError(f"column {feat.name!r} not in CSV {self.path}")
+            parsed = [_parse_cell(v, feat.ftype) for v in raw[feat.name]]
+            out[feat.name] = column_from_list(parsed, feat.ftype)
+        return Dataset(out)
+
+    def infer_schema(self) -> dict[str, Type[FeatureType]]:
+        raw = self.read_raw()
+        schema = {}
+        for name, vals in raw.items():
+            typed = []
+            for v in vals[:1000]:
+                if v is None:
+                    typed.append(None)
+                    continue
+                try:
+                    fv = float(v)
+                    typed.append(int(fv) if fv.is_integer() else fv)
+                except ValueError:
+                    typed.append(v)
+            schema[name] = infer_feature_type(typed)
+        return schema

@@ -1,0 +1,140 @@
+"""DAG computation: layer stages by distance-to-sink.
+
+Semantics of the reference's FitStagesUtil.computeDAG / cutDAG
+(reference: core/.../utils/stages/FitStagesUtil.scala:173-198, 305-358):
+
+* walk ``parent_stages`` from every result feature, keeping each stage's
+  MAX distance to any sink,
+* group stages by distance, sort layers descending (farthest first), so
+  executing layers in order satisfies all data dependencies,
+* ``cut_dag`` splits the DAG around a ModelSelector into (before, during,
+  after) for leakage-free workflow-level cross-validation.
+
+Stages are deduped by uid; each layer is name-sorted for determinism
+(the reference sorts everything for reproducibility - OpWorkflow.scala:88).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..features.feature import Feature
+from ..stages.base import Estimator, PipelineStage
+from ..stages.feature_generator import FeatureGeneratorStage
+
+Layer = list[PipelineStage]
+
+
+def compute_dag(result_features: Sequence[Feature]) -> list[Layer]:
+    """Layered DAG of stages needed to materialize ``result_features``.
+
+    Returns layers in execution order (dependencies first).  Raw feature
+    generators are excluded - they run at ingest (reader) time.
+    """
+    dist: dict[PipelineStage, int] = {}
+    for f in sorted(result_features, key=lambda f: f.name):
+        for stage, d in f.parent_stages().items():
+            if isinstance(stage, FeatureGeneratorStage):
+                continue
+            if dist.get(stage, -1) < d:
+                dist[stage] = d
+    if not dist:
+        return []
+    layers: dict[int, Layer] = {}
+    for stage, d in dist.items():
+        layers.setdefault(d, []).append(stage)
+    ordered = []
+    for d in sorted(layers, reverse=True):  # farthest from sink = first
+        ordered.append(sorted(layers[d], key=lambda s: s.uid))
+    return ordered
+
+
+def flatten(dag: Sequence[Layer]) -> list[PipelineStage]:
+    return [s for layer in dag for s in layer]
+
+
+def validate_dag(dag: Sequence[Layer]) -> None:
+    """Uid uniqueness + output name uniqueness (reference:
+    OpWorkflow.scala:280-323 validateStages)."""
+    uids: set[str] = set()
+    outs: set[str] = set()
+    for stage in flatten(dag):
+        if stage.uid in uids:
+            raise ValueError(f"duplicate stage uid: {stage.uid}")
+        uids.add(stage.uid)
+        name = stage.output_name
+        if name in outs:
+            raise ValueError(f"duplicate output feature name: {name}")
+        outs.add(name)
+
+
+def cut_dag(
+    dag: Sequence[Layer], model_selectors: Sequence[PipelineStage]
+) -> tuple[list[Layer], list[PipelineStage], list[Layer]]:
+    """Split into (before, during, after) around the given model selectors for
+    workflow-level CV (reference: FitStagesUtil.cutDAG:305-358).
+
+    'during' = the model selectors plus every estimator strictly between the
+    last upstream *estimator* and the selector (those see label-dependent
+    state, so they must be refit inside each fold); 'before' = everything
+    upstream of that; 'after' = everything downstream of the selectors.
+    """
+    if not model_selectors:
+        return list(dag), [], []
+    selector_set = set(model_selectors)
+    # features produced by selectors
+    downstream: set[PipelineStage] = set()
+    produced = {s.get_output().uid for s in selector_set}
+    changed = True
+    all_stages = flatten(dag)
+    while changed:
+        changed = False
+        for s in all_stages:
+            if s in selector_set or s in downstream:
+                continue
+            if any(p.uid in produced for p in s.input_features):
+                downstream.add(s)
+                produced.add(s.get_output().uid)
+                changed = True
+
+    before: list[Layer] = []
+    during: list[PipelineStage] = list(model_selectors)
+    after: list[Layer] = []
+    # walk layers; estimator layers between last estimator and selector move
+    # into 'during'
+    pending_transform_layers: list[Layer] = []
+    for layer in dag:
+        l_before = [s for s in layer if s not in selector_set and s not in downstream]
+        l_after = [s for s in layer if s in downstream]
+        if l_before:
+            before.append(l_before)
+        if l_after:
+            after.append(l_after)
+    # move trailing estimator-containing layers of 'before' into 'during':
+    # any estimator whose output reaches a selector without passing another
+    # estimator must be refit per fold.  Conservative approximation used
+    # here: keep 'before' as-is when its trailing layers are transformers
+    # only; otherwise move trailing estimator layers into 'during'.
+    moved: list[PipelineStage] = []
+    while before:
+        tail = before[-1]
+        ests = [s for s in tail if isinstance(s, Estimator)]
+        if not ests:
+            break
+        # only move if some estimator output feeds a selector (directly or
+        # through transformers already moved)
+        feeds = set()
+        sel_inputs = {p.uid for sel in selector_set for p in sel.input_features}
+        target_uids = sel_inputs | {p.uid for m in moved for p in m.input_features}
+        for s in tail:
+            if s.get_output().uid in target_uids:
+                feeds.add(s)
+        est_feeding = [s for s in ests if s in feeds]
+        if not est_feeding:
+            break
+        before[-1] = [s for s in tail if s not in est_feeding]
+        moved.extend(est_feeding)
+        if not before[-1]:
+            before.pop()
+        break  # single hop like the reference (direct upstream estimators)
+    during = moved + during
+    return before, during, after

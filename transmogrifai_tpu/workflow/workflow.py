@@ -1,0 +1,385 @@
+"""Workflow: DAG construction, training, scoring.
+
+TPU-native counterpart of OpWorkflow / OpWorkflowCore / OpWorkflowModel /
+FitStagesUtil (reference: core/.../OpWorkflow.scala:85-563,
+OpWorkflowCore.scala:136-319, OpWorkflowModel.scala:253-420,
+core/.../utils/stages/FitStagesUtil.scala:96-358).
+
+Execution model: the DAG (layers of stages) is recovered from the requested
+result features; each layer fits its estimators on the train split, then
+transforms train+holdout with every stage of the layer.  Where the reference
+fuses a layer's row-level transformers into one RDD map pass
+(FitStagesUtil.applyOpTransformations:96-119), we execute columnar
+transforms - each stage is a handful of vectorized array ops, and the heavy
+numeric stages (SanityChecker stats, model fits) run as jitted/sharded JAX
+computations on the device mesh.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..stages.base import Estimator, PipelineStage, Transformer
+from ..stages.feature_generator import FeatureGeneratorStage
+from ..types.columns import Column, NumericColumn, column_from_list
+from ..types.dataset import Dataset
+from .dag import Layer, compute_dag, flatten, validate_dag
+
+
+def _as_dataset(data: Any, raw_features: Sequence[Feature]) -> Dataset:
+    """Accept Dataset / pandas DataFrame / mapping of python lists and
+    materialize the raw feature columns (reader hand-off, reference:
+    OpWorkflowCore.setInputDataset:136-160)."""
+    if isinstance(data, Dataset):
+        return data.select([f.name for f in raw_features if f.name in data])
+    cols: dict[str, Column] = {}
+    if hasattr(data, "columns") and hasattr(data, "__getitem__") and not isinstance(data, Mapping):
+        # pandas DataFrame
+        import pandas as pd  # noqa: F401
+
+        for f in raw_features:
+            if f.name not in data.columns:
+                raise KeyError(f"raw feature {f.name!r} missing from input data")
+            series = data[f.name]
+            vals = [
+                None
+                if (v is None or (isinstance(v, float) and np.isnan(v)) or v is np.nan)
+                else v
+                for v in series.tolist()
+            ]
+            cols[f.name] = column_from_list(vals, f.ftype)
+        return Dataset(cols)
+    if isinstance(data, Mapping):
+        for f in raw_features:
+            if f.name not in data:
+                raise KeyError(f"raw feature {f.name!r} missing from input data")
+            cols[f.name] = column_from_list(data[f.name], f.ftype)
+        return Dataset(cols)
+    raise TypeError(f"unsupported input data type: {type(data)}")
+
+
+def fit_and_transform_dag(
+    dag: Sequence[Layer],
+    train: Dataset,
+    holdout: Optional[Dataset] = None,
+) -> tuple[list[PipelineStage], Dataset, Optional[Dataset]]:
+    """Fold layers fit->transform (reference: FitStagesUtil.
+    fitAndTransformDAG:213-240, fitAndTransformLayer:254-293)."""
+    fitted: list[PipelineStage] = []
+    for layer in dag:
+        layer_models: list[Transformer] = []
+        for stage in layer:
+            if isinstance(stage, Estimator):
+                model = stage.fit(train)
+                if stage.has_test_eval and holdout is not None and len(holdout):
+                    try:
+                        model.evaluate_model(holdout)  # type: ignore[attr-defined]
+                    except AttributeError:
+                        pass
+                layer_models.append(model)
+            elif isinstance(stage, Transformer):
+                layer_models.append(stage)
+            else:
+                raise TypeError(f"stage {stage.uid} is neither Transformer nor Estimator")
+        for model in layer_models:
+            train = model.transform(train)
+            if holdout is not None and len(holdout):
+                holdout = model.transform(holdout)
+        fitted.extend(layer_models)
+    return fitted, train, holdout
+
+
+def apply_transformations_dag(
+    dag: Sequence[Layer], data: Dataset
+) -> Dataset:
+    """Scoring executor (reference: OpWorkflowCore.
+    applyTransformationsDAG:295-319): all stages must be transformers."""
+    for layer in dag:
+        for stage in layer:
+            if not isinstance(stage, Transformer):
+                raise ValueError(
+                    f"cannot score with unfitted estimator {stage.uid}; train first"
+                )
+            data = stage.transform(data)
+    return data
+
+
+class OpWorkflow:
+    """User entry point (reference: OpWorkflow.scala:85-563)."""
+
+    def __init__(self) -> None:
+        self.result_features: tuple[Feature, ...] = ()
+        self.raw_features: tuple[Feature, ...] = ()
+        self._input_data: Any = None
+        self._reader = None
+        self.parameters: dict[str, Any] = {}
+        self._raw_feature_filter = None
+        self.blacklisted_features: list[Feature] = []
+        self.blacklisted_map_keys: dict[str, list[str]] = {}
+        self.rff_results: Optional[dict] = None
+
+    def set_result_features(self, *features: Feature) -> "OpWorkflow":
+        self.result_features = tuple(features)
+        raws: dict[str, Feature] = {}
+        for f in features:
+            for r in f.raw_features():
+                raws[r.name] = r
+        self.raw_features = tuple(sorted(raws.values(), key=lambda f: f.name))
+        return self
+
+    def set_input_dataset(self, data: Any) -> "OpWorkflow":
+        self._input_data = data
+        return self
+
+    def set_reader(self, reader) -> "OpWorkflow":
+        self._reader = reader
+        return self
+
+    def set_parameters(self, **params: Any) -> "OpWorkflow":
+        self.parameters.update(params)
+        return self
+
+    def with_raw_feature_filter(self, rff) -> "OpWorkflow":
+        """Attach a RawFeatureFilter run before training (reference:
+        OpWorkflow.withRawFeatureFilter:523-563)."""
+        self._raw_feature_filter = rff
+        return self
+
+    # ------------------------------------------------------------------
+    def generate_raw_data(self) -> Dataset:
+        """Reader hand-off + optional RawFeatureFilter (reference:
+        OpWorkflow.generateRawData:222-246)."""
+        if self._reader is not None:
+            data = self._reader.generate_dataset(self.raw_features, self.parameters)
+        elif self._input_data is not None:
+            data = _as_dataset(self._input_data, self.raw_features)
+        else:
+            raise ValueError("no input data: call set_input_dataset or set_reader")
+        if self._raw_feature_filter is not None:
+            filtered = self._raw_feature_filter.filter_raw_data(
+                data, self.raw_features, workflow=self
+            )
+            self.blacklisted_features = filtered.blacklisted_features
+            self.blacklisted_map_keys = filtered.blacklisted_map_keys
+            self.rff_results = filtered.results
+            data = filtered.clean_data
+            if self.blacklisted_features:
+                self._apply_blacklist()
+        return data
+
+    def _apply_blacklist(self) -> None:
+        """DAG surgery after RawFeatureFilter (reference: OpWorkflow.
+        setBlacklist:112-154): drop blacklisted raw features from every
+        stage's inputs where arity allows, error when a response or a
+        binary-stage input would be removed."""
+        bl = {f.uid for f in self.blacklisted_features}
+        bad_resp = [f for f in self.blacklisted_features if f.is_response]
+        if bad_resp:
+            raise ValueError(f"cannot blacklist response features: {bad_resp}")
+        dag = compute_dag(self.result_features)
+        for stage in flatten(dag):
+            kept = tuple(f for f in stage.input_features if f.uid not in bl)
+            if len(kept) != len(stage.input_features):
+                if not kept:
+                    raise ValueError(
+                        f"all inputs of stage {stage.uid} were blacklisted"
+                    )
+                stage.input_features = kept
+        self.raw_features = tuple(
+            f for f in self.raw_features if f.uid not in bl
+        )
+
+    # ------------------------------------------------------------------
+    def train(self) -> "OpWorkflowModel":
+        """(reference: OpWorkflow.train:332-357)"""
+        t0 = time.time()
+        raw = self.generate_raw_data()
+        dag = compute_dag(self.result_features)
+        validate_dag(dag)
+
+        # reserve a holdout for test-eval stages (reference: Splitter
+        # reserveTestFraction, tuning/Splitter.scala:57)
+        holdout: Optional[Dataset] = None
+        train_data = raw
+        frac = float(self.parameters.get("reserve_test_fraction", 0.0))
+        selector = self._find_selector(dag)
+        if selector is not None:
+            sp = getattr(selector, "splitter", None)
+            if sp is not None:
+                frac = max(frac, getattr(sp, "reserve_test_fraction", 0.0))
+        if frac > 0.0:
+            seed = int(self.parameters.get("split_seed", 42))
+            rng = np.random.RandomState(seed)
+            n = len(raw)
+            perm = rng.permutation(n)
+            n_test = int(np.floor(n * frac))
+            test_idx, train_idx = perm[:n_test], perm[n_test:]
+            train_data, holdout = raw.take(np.sort(train_idx)), raw.take(np.sort(test_idx))
+
+        fitted, train_out, holdout_out = fit_and_transform_dag(dag, train_data, holdout)
+        model = OpWorkflowModel(
+            result_features=self.result_features,
+            raw_features=self.raw_features,
+            stages=fitted,
+            parameters=dict(self.parameters),
+            train_time_s=time.time() - t0,
+            blacklisted_features=list(self.blacklisted_features),
+            rff_results=self.rff_results,
+        )
+        model._train_data_cache = train_out
+        model._holdout_data_cache = holdout_out
+        return model
+
+    def _find_selector(self, dag: Sequence[Layer]):
+        for s in flatten(dag):
+            if getattr(s, "is_model_selector", False):
+                return s
+        return None
+
+    def with_model_stages(self, model: "OpWorkflowModel") -> "OpWorkflow":
+        """Warm start: swap already-fitted stages into this workflow so only
+        new estimators retrain (reference: OpWorkflow.withModelStages:457)."""
+        fitted_by_uid = {s.uid: s for s in model.stages}
+        dag = compute_dag(self.result_features)
+        for layer in dag:
+            for i, stage in enumerate(layer):
+                if stage.uid in fitted_by_uid:
+                    repl = fitted_by_uid[stage.uid]
+                    repl.input_features = stage.input_features
+                    repl._output = stage._output
+        return self
+
+
+class OpWorkflowModel:
+    """Fitted workflow (reference: OpWorkflowModel.scala)."""
+
+    def __init__(
+        self,
+        result_features: Sequence[Feature],
+        raw_features: Sequence[Feature],
+        stages: Sequence[PipelineStage],
+        parameters: Optional[dict] = None,
+        train_time_s: float = 0.0,
+        blacklisted_features: Sequence[Feature] = (),
+        rff_results: Optional[dict] = None,
+    ) -> None:
+        self.result_features = tuple(result_features)
+        self.raw_features = tuple(raw_features)
+        self.stages = list(stages)
+        self.parameters = dict(parameters or {})
+        self.train_time_s = train_time_s
+        self.blacklisted_features = list(blacklisted_features)
+        self.rff_results = rff_results
+        self._train_data_cache: Optional[Dataset] = None
+        self._holdout_data_cache: Optional[Dataset] = None
+        self._scoring_dag: Optional[list[Layer]] = None
+
+    def _dag(self) -> list[Layer]:
+        if self._scoring_dag is None:
+            # rebuild layers from fitted stages, preserving layer order by
+            # recomputing distances on the (now fitted) graph
+            self._scoring_dag = compute_dag(self.result_features)
+            # substitute fitted stages (same uid) into the layers
+            by_uid = {s.uid: s for s in self.stages}
+            self._scoring_dag = [
+                [by_uid.get(s.uid, s) for s in layer] for layer in self._scoring_dag
+            ]
+        return self._scoring_dag
+
+    def score(self, data: Any = None) -> Dataset:
+        """(reference: OpWorkflowModel.score:253)"""
+        if data is None:
+            if self._train_data_cache is not None:
+                return self._train_data_cache
+            raise ValueError("no data to score: pass data=")
+        raw = _as_dataset(data, self.raw_features)
+        return apply_transformations_dag(self._dag(), raw)
+
+    def score_function(self):
+        """Spark-free row scorer analog (reference: local/.../
+        OpWorkflowModelLocal.scala:67): returns fn(record dict) -> dict of
+        result feature values.  Internally batches of one; for throughput
+        call .score on a batch."""
+        dag = self._dag()
+        raw_feats = self.raw_features
+
+        def fn(record: Mapping[str, Any]) -> dict[str, Any]:
+            data = {f.name: [record.get(f.name)] for f in raw_feats}
+            ds = Dataset(
+                {f.name: column_from_list(data[f.name], f.ftype) for f in raw_feats}
+            )
+            out = apply_transformations_dag(dag, ds)
+            return {
+                f.name: out[f.name].to_list()[0]
+                for f in self.result_features
+                if f.name in out
+            }
+
+        return fn
+
+    def _label_and_pred(self, label, prediction):
+        label = label or next(
+            (f.name for f in self.raw_features if f.is_response), None
+        )
+        prediction = prediction or self.result_features[0].name
+        return label, prediction
+
+    def evaluate(self, evaluator, data: Any = None, label: Optional[str] = None,
+                 prediction: Optional[str] = None):
+        scored = self.score(data) if data is not None else self.score()
+        label, prediction = self._label_and_pred(label, prediction)
+        return evaluator.evaluate(scored, label_col=label, pred_col=prediction)
+
+    def evaluate_holdout(self, evaluator, label: Optional[str] = None,
+                         prediction: Optional[str] = None):
+        """Metrics on the reserved holdout (reference: HasTestEval holdout
+        metrics surfaced in summaryPretty)."""
+        if self._holdout_data_cache is None or not len(self._holdout_data_cache):
+            raise ValueError("no holdout was reserved at train time")
+        label, prediction = self._label_and_pred(label, prediction)
+        return evaluator.evaluate(
+            self._holdout_data_cache, label_col=label, pred_col=prediction
+        )
+
+    # -- summaries ----------------------------------------------------------
+    def model_insights(self, feature: Optional[Feature] = None):
+        from ..insights.model_insights import ModelInsights
+
+        return ModelInsights.from_model(self, feature)
+
+    def summary_json(self) -> dict:
+        return {
+            "stages": [
+                {
+                    "uid": s.uid,
+                    "operation": s.operation_name,
+                    "metadata": s.metadata,
+                }
+                for s in self.stages
+                if s.metadata
+            ],
+            "trainTimeSeconds": self.train_time_s,
+        }
+
+    def summary(self) -> str:
+        return json.dumps(self.summary_json(), indent=2, default=str)
+
+    def summary_pretty(self) -> str:
+        from ..insights.model_insights import ModelInsights
+
+        return ModelInsights.from_model(self).pretty()
+
+    def save(self, path: str) -> None:
+        from ..serialization.model_io import save_model
+
+        save_model(self, path)
+
+    @staticmethod
+    def load(path: str, workflow: "OpWorkflow") -> "OpWorkflowModel":
+        from ..serialization.model_io import load_model
+
+        return load_model(path, workflow)
