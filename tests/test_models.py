@@ -1,0 +1,133 @@
+"""Model-level tests on synthetic data (mirrors the reference's
+classification/regression model specs, reference: core/src/test/.../impl/
+classification + regression)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+from transmogrifai_tpu.models.linear_svc import OpLinearSVC
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.naive_bayes import OpNaiveBayes
+from transmogrifai_tpu.models.trees import (
+    OpDecisionTreeClassifier,
+    OpGBTClassifier,
+    OpGBTRegressor,
+    OpRandomForestClassifier,
+    OpRandomForestRegressor,
+)
+
+
+@pytest.fixture
+def binary_data(rng):
+    n, d = 600, 8
+    X = rng.randn(n, d)
+    beta = 2.0 * np.array([2.0, -1.5, 1.0, 0.0, 0.0, 0.5, -0.5, 0.0])
+    p = 1 / (1 + np.exp(-(X @ beta - 0.3)))
+    y = (rng.rand(n) < p).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture
+def regression_data(rng):
+    n, d = 500, 6
+    X = rng.randn(n, d)
+    beta = np.array([1.0, 2.0, 0.0, -1.0, 0.5, 0.0])
+    y = X @ beta + 0.7 + 0.1 * rng.randn(n)
+    return X, y
+
+
+def _acc(est, X, y):
+    params = est.fit_arrays(X, y)
+    pred, raw, prob = est.predict_arrays(params, X)
+    return float((pred == y).mean()), prob
+
+
+def test_logistic_regression_learns(binary_data):
+    X, y = binary_data
+    acc, prob = _acc(OpLogisticRegression(reg_param=0.01), X, y)
+    assert acc > 0.85
+    assert prob.shape == (len(y), 2)
+    assert np.allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_logistic_regression_batched_matches_single(binary_data):
+    X, y = binary_data
+    est = OpLogisticRegression()
+    W = np.ones((3, len(y)))
+    regs = np.array([0.001, 0.01, 0.1])
+    ens = np.zeros(3)
+    betas, b0s = est.fit_arrays_batched(X, y, W, regs, ens)
+    est_single = OpLogisticRegression(reg_param=0.01)
+    single = est_single.fit_arrays(X, y)
+    assert np.allclose(betas[1], single["beta"], atol=1e-3)
+
+
+def test_logistic_regression_sample_weights(binary_data):
+    X, y = binary_data
+    est = OpLogisticRegression(reg_param=0.01)
+    w = np.zeros(len(y))
+    w[:300] = 1.0
+    params_w = est.fit_arrays(X, y, w)
+    params_sub = est.fit_arrays(X[:300], y[:300])
+    assert np.allclose(params_w["beta"], params_sub["beta"], atol=1e-4)
+
+
+def test_linear_svc(binary_data):
+    X, y = binary_data
+    acc, _ = _acc(OpLinearSVC(reg_param=0.01), X, y)
+    assert acc > 0.85
+
+
+def test_naive_bayes(binary_data):
+    X, y = binary_data
+    acc, prob = _acc(OpNaiveBayes(), X, y)
+    assert acc > 0.70
+    assert np.allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_random_forest_classifier(binary_data):
+    X, y = binary_data
+    est = OpRandomForestClassifier(num_trees=20, max_depth=5)
+    acc, prob = _acc(est, X, y)
+    assert acc > 0.85
+    assert prob.shape == (len(y), 2)
+
+
+def test_decision_tree_classifier(binary_data):
+    X, y = binary_data
+    acc, _ = _acc(OpDecisionTreeClassifier(max_depth=5), X, y)
+    assert acc > 0.80
+
+
+def test_gbt_classifier(binary_data):
+    X, y = binary_data
+    acc, _ = _acc(OpGBTClassifier(num_trees=20, max_depth=3), X, y)
+    assert acc > 0.88
+
+
+def test_linear_regression(regression_data):
+    X, y = regression_data
+    est = OpLinearRegression(reg_param=0.001)
+    params = est.fit_arrays(X, y)
+    pred, _, _ = est.predict_arrays(params, X)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.2
+    assert abs(params["intercept"] - 0.7) < 0.1
+
+
+def test_random_forest_regressor(regression_data):
+    X, y = regression_data
+    est = OpRandomForestRegressor(num_trees=20, max_depth=6)
+    params = est.fit_arrays(X, y)
+    pred, _, _ = est.predict_arrays(params, X)
+    r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.7
+
+
+def test_gbt_regressor(regression_data):
+    X, y = regression_data
+    est = OpGBTRegressor(num_trees=30, max_depth=4)
+    params = est.fit_arrays(X, y)
+    pred, _, _ = est.predict_arrays(params, X)
+    r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.8

@@ -1,0 +1,117 @@
+"""ModelSelector / validator / splitter tests (mirrors reference:
+core/src/test/.../impl/selector/ModelSelectorTest.scala, tuning/*Test)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+from transmogrifai_tpu.selector.factories import (
+    BinaryClassificationModelSelector,
+    lr_grid,
+)
+from transmogrifai_tpu.selector.splitters import DataBalancer, DataCutter
+from transmogrifai_tpu.selector.validator import (
+    OpCrossValidation,
+    stratified_kfold_masks,
+)
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+from transmogrifai_tpu.types.vector_metadata import VectorColumnMeta, VectorMetadata
+
+
+def test_stratified_folds_cover_and_balance(rng):
+    y = (rng.rand(300) < 0.2).astype(float)
+    masks = stratified_kfold_masks(y, 3, seed=1, stratify=True)
+    assert masks.shape == (3, 300)
+    # every row is in exactly 2 of 3 train splits
+    assert (masks.sum(axis=0) == 2).all()
+    for f in range(3):
+        val = ~masks[f]
+        frac = y[val].mean()
+        assert abs(frac - 0.2) < 0.07
+
+
+def test_data_balancer_weights(rng):
+    y = (rng.rand(1000) < 0.05).astype(float)
+    prep = DataBalancer(sample_fraction=0.3).prepare(y)
+    w = prep.weights
+    pos_frac = (w * (y == 1)).sum() / w.sum()
+    assert abs(pos_frac - 0.3) < 0.01
+    assert prep.summary["upSampled"]
+
+
+def test_data_cutter_drops_rare_labels(rng):
+    y = np.concatenate([np.zeros(500), np.ones(480), np.full(20, 2.0)])
+    prep = DataCutter(min_label_fraction=0.05).prepare(y)
+    assert prep.keep_mask is not None
+    assert set(np.unique(y[prep.keep_mask])) == {0.0, 1.0}
+    assert prep.summary["labelsDropped"] == [2.0]
+
+
+def _binary_vec_dataset(rng, n=400, d=6):
+    X = rng.randn(n, d)
+    beta = np.linspace(2, -2, d)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-(X @ beta)))).astype(float)
+    meta = VectorMetadata(
+        "features",
+        tuple(
+            VectorColumnMeta(parent_feature_name=f"x{i}", parent_feature_type="Real")
+            for i in range(d)
+        ),
+    ).reindexed()
+    label_f = FeatureBuilder(ft.RealNN, "label").as_response()
+    ds = Dataset(
+        {
+            "label": NumericColumn(y, np.ones(n, dtype=bool), ft.RealNN),
+            "features": VectorColumn(X, meta),
+        }
+    )
+    vec_f = FeatureBuilder(ft.OPVector, "features").as_predictor()
+    return ds, label_f, vec_f, y
+
+
+def test_cross_validation_picks_best_and_writes_summary(rng):
+    ds, label_f, vec_f, y = _binary_vec_dataset(rng)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLogisticRegression(), lr_grid()[:4]),
+            (OpRandomForestClassifier(num_trees=5, max_depth=3), [{}]),
+        ],
+    )
+    selector.set_input(label_f, vec_f)
+    model = selector.fit(ds)
+    md = model.metadata["model_selector_summary"]
+    assert md["best_model_type"] in ("OpLogisticRegression", "OpRandomForestClassifier")
+    # linear data -> LR should win
+    assert md["best_model_type"] == "OpLogisticRegression"
+    assert len(md["validation_results"]) == 5
+    assert md["validation_metric"]["name"] == "AuROC"
+    assert md["validation_metric"]["value"] > 0.85
+    out = model.transform(ds)
+    pc = out[model.output_name]
+    assert pc.probability is not None
+
+    # holdout evaluation path (has_test_eval)
+    metrics = model.evaluate_model(ds.take(np.arange(50)))
+    assert "OpBinaryClassificationEvaluator" in metrics
+
+
+def test_batched_cv_matches_loop_cv(rng):
+    """The vmapped fold x grid fan-out must agree with per-candidate loops."""
+    ds, label_f, vec_f, y = _binary_vec_dataset(rng, n=300, d=4)
+    X = np.asarray(ds["features"].values, dtype=np.float64)
+    grid = [{"reg_param": r, "elastic_net_param": 0.0} for r in (0.001, 0.1)]
+    ev = OpBinaryClassificationEvaluator()
+    cv = OpCrossValidation(num_folds=3, evaluator=ev, seed=7, stratify=True)
+    res_batched = cv.validate([(OpLogisticRegression(), grid)], X, y)
+
+    class NoBatch(OpLogisticRegression):
+        fit_arrays_batched = property()  # hide the batched path
+
+    cv2 = OpCrossValidation(num_folds=3, evaluator=ev, seed=7, stratify=True)
+    res_loop = cv2.validate([(NoBatch(), grid)], X, y)
+    for a, b in zip(res_batched.all_results, res_loop.all_results):
+        assert a["metric"] == pytest.approx(b["metric"], abs=2e-3)

@@ -1,0 +1,77 @@
+"""Linear SVM classifier (squared-hinge, L2) via jitted Newton.
+
+Counterpart of OpLinearSVC (reference: core/.../impl/classification/
+OpLinearSVC.scala wrapping Spark MLlib LinearSVC - hinge loss + OWLQN).
+Squared hinge keeps the objective twice-differentiable so the same
+Newton/solve pattern as logistic regression applies (and the same
+weight-vector CV fan-out).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import PredictorEstimator
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
+    n, d = X.shape
+    ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    mu = (w @ X) / wsum
+    sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu**2, 1e-12))
+    Xs = (X - mu) / sd * (w[:, None] > 0)
+
+    def step(carry, _):
+        beta, b0 = carry
+        margin = ypm * (Xs @ beta + b0)
+        active = (margin < 1.0).astype(Xs.dtype) * w
+        # squared hinge: L = sum_active (1 - m)^2 / wsum + reg |beta|^2
+        r = active * (margin - 1.0) * ypm
+        g = (Xs.T @ r) / wsum + 2.0 * reg * beta
+        H = (Xs.T @ (Xs * active[:, None])) / wsum + jnp.diag(
+            jnp.full((d,), 2.0 * reg + 1e-8)
+        )
+        g0 = r.sum() / wsum
+        h0 = active.sum() / wsum + 1e-8
+        delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+        return (beta - delta, b0 - g0 / h0), None
+
+    (beta_s, b0), _ = jax.lax.scan(
+        step, (jnp.zeros((d,)), jnp.asarray(0.0)), None, length=iters
+    )
+    beta = beta_s / sd
+    return beta, b0 - (mu * beta).sum()
+
+
+class OpLinearSVC(PredictorEstimator):
+    model_type = "OpLinearSVC"
+
+    def __init__(self, reg_param: float = 0.0, max_iter: int = 20, **kw) -> None:
+        super().__init__(**kw)
+        self.params.setdefault("reg_param", reg_param)
+        self.params.setdefault("max_iter", max_iter)
+
+    def fit_arrays(self, X, y, w=None) -> Any:
+        n = len(y)
+        w = np.ones(n) if w is None else w
+        beta, b0 = _svc_fit_kernel(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(float(self.params.get("reg_param", 0.0))),
+            iters=int(self.params.get("max_iter", 20)),
+        )
+        return {"beta": np.asarray(beta), "intercept": float(b0)}
+
+    def predict_arrays(self, params: Any, X: np.ndarray):
+        z = X @ params["beta"] + params["intercept"]
+        pred = (z > 0).astype(np.float64)
+        raw = np.stack([-z, z], axis=1)
+        return pred, raw, None
+
+    def contributions(self, params: Any) -> Optional[np.ndarray]:
+        return np.abs(params["beta"])

@@ -1,0 +1,73 @@
+"""Naive Bayes classifier.
+
+Counterpart of OpNaiveBayes (reference: core/.../impl/classification/
+OpNaiveBayes.scala wrapping Spark MLlib multinomial NaiveBayes, smoothing
+1.0).  Closed-form fit: one matmul for per-class feature sums (MXU), log
+posteriors vectorized.  Multinomial over non-negative features; negative
+inputs are shifted per-feature (the vectorizers emit one-hot/hashed counts,
+so inputs are naturally non-negative in the transmogrified pipeline).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import PredictorEstimator
+
+
+@jax.jit
+def _nb_fit_kernel(X, onehot, w, smoothing):
+    # per-class weighted feature sums [K, d] + class priors [K]
+    cw = onehot * w[:, None]                       # [n, K]
+    feat = cw.T @ X                                # [K, d]
+    class_w = cw.sum(axis=0)                       # [K]
+    theta = jnp.log(feat + smoothing) - jnp.log(
+        (feat + smoothing).sum(axis=1, keepdims=True)
+    )
+    prior = jnp.log(class_w / jnp.maximum(class_w.sum(), 1e-12))
+    return theta, prior
+
+
+@jax.jit
+def _nb_predict_kernel(X, theta, prior):
+    raw = X @ theta.T + prior[None, :]             # [n, K] log posterior
+    prob = jax.nn.softmax(raw, axis=1)
+    return raw, prob
+
+
+class OpNaiveBayes(PredictorEstimator):
+    model_type = "OpNaiveBayes"
+
+    def __init__(self, smoothing: float = 1.0, **kw) -> None:
+        super().__init__(**kw)
+        self.params.setdefault("smoothing", smoothing)
+
+    def fit_arrays(self, X, y, w=None) -> Any:
+        n, d = X.shape
+        w = np.ones(n) if w is None else w
+        classes = np.unique(y)
+        onehot = (y[:, None] == classes[None, :]).astype(np.float64)
+        shift = np.minimum(X.min(axis=0), 0.0)  # ensure non-negativity
+        theta, prior = _nb_fit_kernel(
+            jnp.asarray(X - shift), jnp.asarray(onehot), jnp.asarray(w),
+            jnp.asarray(float(self.params["smoothing"])),
+        )
+        return {
+            "theta": np.asarray(theta),
+            "prior": np.asarray(prior),
+            "classes": classes,
+            "shift": shift,
+        }
+
+    def predict_arrays(self, params: Any, X: np.ndarray):
+        raw, prob = _nb_predict_kernel(
+            jnp.asarray(X - params["shift"]),
+            jnp.asarray(params["theta"]),
+            jnp.asarray(params["prior"]),
+        )
+        raw, prob = np.asarray(raw, np.float64), np.asarray(prob, np.float64)
+        pred = params["classes"][np.argmax(prob, axis=1)].astype(np.float64)
+        return pred, raw, prob

@@ -1,0 +1,229 @@
+"""Model selector factories with default candidate grids.
+
+Counterparts of BinaryClassificationModelSelector /
+MultiClassificationModelSelector / RegressionModelSelector +
+DefaultSelectorParams (reference: core/.../impl/classification/
+BinaryClassificationModelSelector.scala:46-100,
+impl/regression/RegressionModelSelector.scala,
+impl/selector/DefaultSelectorParams.scala:36-61 - MaxDepth {3,6,12},
+Regularization {0.001,0.01,0.1,0.2}, ElasticNet {0.1,0.5}, MaxTrees {50},
+MinInfoGain {0.001,0.01,0.1}, MinInstancesPerNode {10,100}).
+"""
+from __future__ import annotations
+
+from itertools import product
+from typing import Optional, Sequence
+
+from ..evaluators.binary import OpBinaryClassificationEvaluator
+from ..evaluators.multiclass import OpMultiClassificationEvaluator
+from ..evaluators.regression import OpRegressionEvaluator
+from .model_selector import ModelSelector
+from .splitters import DataBalancer, DataCutter, DataSplitter, Splitter
+from .validator import OpCrossValidation, OpTrainValidationSplit
+
+REGULARIZATION = [0.001, 0.01, 0.1, 0.2]
+ELASTIC_NET = [0.1, 0.5]
+MAX_DEPTH = [3, 6, 12]
+MAX_TREES = [50]
+MIN_INFO_GAIN = [0.001, 0.01, 0.1]
+MIN_INSTANCES_PER_NODE = [10, 100]
+
+
+def lr_grid() -> list[dict]:
+    return [
+        {"reg_param": r, "elastic_net_param": e}
+        for r, e in product(REGULARIZATION, ELASTIC_NET)
+    ]
+
+
+def linreg_grid() -> list[dict]:
+    return lr_grid()
+
+
+def rf_grid() -> list[dict]:
+    return [
+        {
+            "max_depth": d,
+            "num_trees": t,
+            "min_info_gain": g,
+            "min_instances_per_node": m,
+        }
+        for d, t, g, m in product(
+            MAX_DEPTH, MAX_TREES, MIN_INFO_GAIN, MIN_INSTANCES_PER_NODE
+        )
+    ]
+
+
+def gbt_grid() -> list[dict]:
+    return [
+        {"max_depth": d, "num_trees": 20, "min_info_gain": g}
+        for d, g in product(MAX_DEPTH, MIN_INFO_GAIN)
+    ]
+
+
+def _binary_models(model_types: Optional[Sequence[str]]):
+    from ..models.logistic_regression import OpLogisticRegression
+    from ..models.naive_bayes import OpNaiveBayes
+    from ..models.trees import OpGBTClassifier, OpRandomForestClassifier
+    from ..models.linear_svc import OpLinearSVC
+
+    registry = {
+        "OpLogisticRegression": lambda: (OpLogisticRegression(), lr_grid()),
+        "OpRandomForestClassifier": lambda: (OpRandomForestClassifier(), rf_grid()),
+        "OpGBTClassifier": lambda: (OpGBTClassifier(), gbt_grid()),
+        "OpLinearSVC": lambda: (OpLinearSVC(), lr_grid()),
+        "OpNaiveBayes": lambda: (OpNaiveBayes(), [{}]),
+    }
+    # reference defaults: LR, RF, GBT, LinearSVC
+    # (BinaryClassificationModelSelector.scala:46-100)
+    wanted = model_types or [
+        "OpLogisticRegression",
+        "OpRandomForestClassifier",
+        "OpGBTClassifier",
+        "OpLinearSVC",
+    ]
+    return [registry[m]() for m in wanted]
+
+
+class BinaryClassificationModelSelector:
+    """Factory (reference: BinaryClassificationModelSelector cv/ts
+    constructors)."""
+
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3,
+        validation_metric=None,
+        model_types_to_use: Optional[Sequence[str]] = None,
+        splitter: Optional[Splitter] = None,
+        seed: int = 42,
+        models_and_parameters=None,
+    ) -> ModelSelector:
+        ev = validation_metric or OpBinaryClassificationEvaluator()
+        return ModelSelector(
+            validator=OpCrossValidation(
+                num_folds=num_folds, evaluator=ev, seed=seed, stratify=True
+            ),
+            models=models_and_parameters or _binary_models(model_types_to_use),
+            splitter=splitter
+            if splitter is not None
+            else DataBalancer(sample_fraction=0.1, reserve_test_fraction=0.1, seed=seed),
+            evaluators=[OpBinaryClassificationEvaluator()],
+        )
+
+    @staticmethod
+    def with_train_validation_split(
+        train_ratio: float = 0.75,
+        validation_metric=None,
+        model_types_to_use: Optional[Sequence[str]] = None,
+        splitter: Optional[Splitter] = None,
+        seed: int = 42,
+        models_and_parameters=None,
+    ) -> ModelSelector:
+        ev = validation_metric or OpBinaryClassificationEvaluator()
+        return ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=train_ratio, evaluator=ev, seed=seed, stratify=True
+            ),
+            models=models_and_parameters or _binary_models(model_types_to_use),
+            splitter=splitter
+            if splitter is not None
+            else DataBalancer(sample_fraction=0.1, reserve_test_fraction=0.1, seed=seed),
+            evaluators=[OpBinaryClassificationEvaluator()],
+        )
+
+    # parameterless call mirrors the reference's `BinaryClassificationModelSelector()`
+    def __new__(cls, *args, **kw) -> ModelSelector:  # type: ignore[misc]
+        return cls.with_cross_validation(*args, **kw)
+
+
+def _multiclass_models(model_types: Optional[Sequence[str]]):
+    from ..models.logistic_regression import OpLogisticRegression
+    from ..models.naive_bayes import OpNaiveBayes
+    from ..models.trees import OpDecisionTreeClassifier, OpRandomForestClassifier
+
+    registry = {
+        "OpLogisticRegression": lambda: (OpLogisticRegression(), lr_grid()),
+        "OpRandomForestClassifier": lambda: (OpRandomForestClassifier(), rf_grid()),
+        "OpDecisionTreeClassifier": lambda: (
+            OpDecisionTreeClassifier(),
+            [{"max_depth": d, "min_info_gain": g}
+             for d, g in product(MAX_DEPTH, MIN_INFO_GAIN)],
+        ),
+        "OpNaiveBayes": lambda: (OpNaiveBayes(), [{}]),
+    }
+    # reference defaults: LR, RF, DT, NB
+    wanted = model_types or [
+        "OpLogisticRegression",
+        "OpRandomForestClassifier",
+        "OpDecisionTreeClassifier",
+        "OpNaiveBayes",
+    ]
+    return [registry[m]() for m in wanted]
+
+
+class MultiClassificationModelSelector:
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3,
+        validation_metric=None,
+        model_types_to_use: Optional[Sequence[str]] = None,
+        splitter: Optional[Splitter] = None,
+        seed: int = 42,
+        models_and_parameters=None,
+    ) -> ModelSelector:
+        ev = validation_metric or OpMultiClassificationEvaluator()
+        return ModelSelector(
+            validator=OpCrossValidation(
+                num_folds=num_folds, evaluator=ev, seed=seed, stratify=True
+            ),
+            models=models_and_parameters or _multiclass_models(model_types_to_use),
+            splitter=splitter
+            if splitter is not None
+            else DataCutter(reserve_test_fraction=0.1, seed=seed),
+            evaluators=[OpMultiClassificationEvaluator()],
+        )
+
+    def __new__(cls, *args, **kw) -> ModelSelector:  # type: ignore[misc]
+        return cls.with_cross_validation(*args, **kw)
+
+
+def _regression_models(model_types: Optional[Sequence[str]]):
+    from ..models.linear_regression import OpLinearRegression
+    from ..models.trees import OpGBTRegressor, OpRandomForestRegressor
+
+    registry = {
+        "OpLinearRegression": lambda: (OpLinearRegression(), linreg_grid()),
+        "OpRandomForestRegressor": lambda: (OpRandomForestRegressor(), rf_grid()),
+        "OpGBTRegressor": lambda: (OpGBTRegressor(), gbt_grid()),
+    }
+    # reference defaults: LinReg, RF, GBT, DT, GLM
+    wanted = model_types or [
+        "OpLinearRegression",
+        "OpRandomForestRegressor",
+        "OpGBTRegressor",
+    ]
+    return [registry[m]() for m in wanted]
+
+
+class RegressionModelSelector:
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3,
+        validation_metric=None,
+        model_types_to_use: Optional[Sequence[str]] = None,
+        splitter: Optional[Splitter] = None,
+        seed: int = 42,
+        models_and_parameters=None,
+    ) -> ModelSelector:
+        ev = validation_metric or OpRegressionEvaluator()
+        return ModelSelector(
+            validator=OpCrossValidation(num_folds=num_folds, evaluator=ev, seed=seed),
+            models=models_and_parameters or _regression_models(model_types_to_use),
+            splitter=splitter
+            if splitter is not None
+            else DataSplitter(reserve_test_fraction=0.1, seed=seed),
+            evaluators=[OpRegressionEvaluator()],
+        )
+
+    def __new__(cls, *args, **kw) -> ModelSelector:  # type: ignore[misc]
+        return cls.with_cross_validation(*args, **kw)

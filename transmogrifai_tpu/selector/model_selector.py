@@ -1,0 +1,148 @@
+"""ModelSelector: automated model selection.
+
+Counterpart of the reference ModelSelector (reference: core/.../impl/
+selector/ModelSelector.scala:74-197): an estimator over (label RealNN,
+features OPVector) -> Prediction that
+
+1. runs splitter preparation (rebalancing as sample weights, §splitters),
+2. hands candidate estimators x hyperparameter grids to the validator,
+   which fans folds x grid points out as one vmapped batch on device,
+3. refits the winning candidate on the full prepared training data,
+4. evaluates training (and, via has_test_eval, holdout) metrics with every
+   registered evaluator,
+5. writes a ModelSelectorSummary into stage metadata.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..evaluators.base import OpEvaluatorBase
+from ..models.base import PredictorEstimator, PredictorModel
+from ..types.columns import Column, NumericColumn, VectorColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import OPVector, Prediction, RealNN
+from ..stages.base import Estimator
+from .splitters import Splitter
+from .validator import OpValidator, ValidationResult
+
+
+class SelectedModel(PredictorModel):
+    """Fitted best model (reference: SelectedModel in ModelSelector.scala).
+    Adds holdout evaluation used by the workflow's test-eval hook."""
+
+    def __init__(self, estimator, params, selector: "ModelSelector", **kw) -> None:
+        super().__init__(estimator, params, **kw)
+        self.selector = selector
+
+    def evaluate_model(self, holdout: Dataset) -> dict:
+        """(reference: FitStagesUtil.scala:266-268 HasTestEval path)"""
+        label_f, vec_f = self.input_features
+        y = np.asarray(holdout[label_f.name].values, dtype=np.float64)
+        X = np.asarray(holdout[vec_f.name].values, dtype=np.float64)
+        pred, raw, prob = self.estimator_ref.predict_arrays(self.model_params, X)
+        from ..types.columns import PredictionColumn
+
+        pc = PredictionColumn(pred, raw, prob)
+        out = {}
+        for ev in self.selector.evaluators:
+            m = ev.evaluate_arrays(y, pc)
+            out[type(ev).__name__] = m.to_json()
+        self.holdout_metrics = out
+        md = self.metadata.get("model_selector_summary", {})
+        md["holdout_metrics"] = _strip_curves(out)
+        self.metadata["model_selector_summary"] = md
+        return out
+
+
+def _strip_curves(metrics: dict) -> dict:
+    """Keep scalar metrics only in the summary blob."""
+    clean = {}
+    for ev_name, m in metrics.items():
+        clean[ev_name] = {
+            k: v for k, v in m.items() if isinstance(v, (int, float, str, bool))
+        }
+    return clean
+
+
+class ModelSelector(Estimator):
+    input_types = [RealNN, OPVector]
+    output_type = Prediction
+    is_model_selector = True
+    has_test_eval = True
+
+    def __init__(
+        self,
+        validator: OpValidator,
+        models: Sequence[tuple[PredictorEstimator, Sequence[dict]]],
+        splitter: Optional[Splitter] = None,
+        evaluators: Sequence[OpEvaluatorBase] = (),
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.validator = validator
+        self.models = list(models)
+        self.splitter = splitter
+        self.evaluators = list(evaluators)
+        self.validation_result: Optional[ValidationResult] = None
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        label, vec = cols
+        assert isinstance(label, NumericColumn)
+        assert isinstance(vec, VectorColumn)
+        y = np.asarray(label.values, dtype=np.float64)
+        X = np.asarray(vec.values, dtype=np.float64)
+        if len(y) == 0:
+            raise ValueError(
+                "empty dataset (reference guard: ModelSelector.scala:148)"
+            )
+
+        weights = np.ones(len(y))
+        splitter_summary = {}
+        if self.splitter is not None:
+            prepared = self.splitter.prepare(y)
+            splitter_summary = prepared.summary
+            weights = prepared.weights
+            if prepared.keep_mask is not None:
+                keep = prepared.keep_mask
+                X, y, weights = X[keep], y[keep], weights[keep]
+
+        result = self.validator.validate(self.models, X, y, weights)
+        self.validation_result = result
+
+        # refit best on full prepared train (reference:
+        # ModelSelector.scala:159-160)
+        best = result.best_estimator
+        best_params = best.fit_arrays(X, y, weights)
+        model = SelectedModel(best, best_params, self)
+
+        # training-set evaluation with all evaluators
+        pred, raw, prob = best.predict_arrays(best_params, X)
+        from ..types.columns import PredictionColumn
+
+        pc = PredictionColumn(pred, raw, prob)
+        train_metrics = {
+            type(ev).__name__: ev.evaluate_arrays(y, pc).to_json()
+            for ev in self.evaluators
+        }
+
+        model.metadata = {
+            "model_selector_summary": {
+                "best_model_type": best.model_type,
+                "best_model_uid": best.uid,
+                "best_params": result.best_params,
+                "validation_metric": {
+                    "name": result.metric_name,
+                    "value": result.best_metric,
+                    "larger_better": result.larger_better,
+                },
+                "validation_results": result.all_results,
+                "splitter_summary": splitter_summary,
+                "train_metrics": _strip_curves(train_metrics),
+                "n_rows": int(len(y)),
+                "n_features": int(X.shape[1]),
+            }
+        }
+        self.metadata = model.metadata
+        return model

@@ -1,0 +1,209 @@
+"""Cross-validation / train-validation split over array-level candidates.
+
+Counterpart of OpValidator / OpCrossValidation / OpTrainValidationSplit
+(reference: core/.../impl/tuning/OpValidator.scala:275-322,
+OpCrossValidation.scala:71-167, OpTrainValidationSplit.scala).  Where the
+reference fans fold x model-type training out on a JVM thread pool (Scala
+Futures, parallelism 8) with Spark jobs inside, here the fan-out is
+ARRAY-BATCHED: folds and grid points become a leading axis of weight
+vectors, and estimators that implement ``fit_arrays_batched`` train the
+whole (fold x grid) batch as ONE vmapped jitted computation - on a sharded
+mesh this is replicas across devices, the direct analog (and replacement)
+of the reference's Future pool.  Estimators without a batched path fall
+back to a per-candidate loop of jitted fits.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..evaluators.base import OpEvaluatorBase
+from ..models.base import PredictorEstimator
+from ..types.columns import PredictionColumn
+
+
+@dataclass
+class ValidationResult:
+    best_estimator: PredictorEstimator
+    best_params: dict
+    best_metric: float
+    metric_name: str
+    larger_better: bool
+    all_results: list = field(default_factory=list)  # per (model, grid) dicts
+
+
+def stratified_kfold_masks(
+    y: np.ndarray, k: int, seed: int, stratify: bool
+) -> np.ndarray:
+    """[k, n] bool masks, True = row in the fold's TRAIN split.  Stratified
+    per label class when requested (reference: OpCrossValidation.scala:161-167
+    label-stratified kFold)."""
+    n = len(y)
+    rng = np.random.RandomState(seed)
+    fold_of = np.empty(n, dtype=np.int64)
+    if stratify:
+        for c in np.unique(y):
+            idx = np.nonzero(y == c)[0]
+            perm = rng.permutation(len(idx))
+            fold_of[idx[perm]] = np.arange(len(idx)) % k
+    else:
+        fold_of[rng.permutation(n)] = np.arange(n) % k
+    return np.stack([fold_of != f for f in range(k)], axis=0)
+
+
+class OpValidator:
+    def __init__(
+        self,
+        evaluator: OpEvaluatorBase,
+        seed: int = 42,
+        stratify: bool = False,
+    ) -> None:
+        self.evaluator = evaluator
+        self.seed = seed
+        self.stratify = stratify
+
+    def train_masks(self, y: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _metric_of(self, y: np.ndarray, pred, raw, prob) -> float:
+        m = self.evaluator.evaluate_arrays(
+            y, PredictionColumn(pred, raw, prob)
+        )
+        return self.evaluator.default_metric(m)
+
+    def validate(
+        self,
+        models: Sequence[tuple[PredictorEstimator, Sequence[dict]]],
+        X: np.ndarray,
+        y: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+    ) -> ValidationResult:
+        """Pick the best (estimator, param-map) by mean validation metric
+        across folds (reference: OpValidator.validate:129 +
+        OpCrossValidation fold aggregation :60,118-124)."""
+        n = len(y)
+        w = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
+        masks = self.train_masks(y)  # [k, n] True=train
+        k = masks.shape[0]
+        larger = self.evaluator.larger_better
+        all_results = []
+        best = None  # (metric, estimator, params)
+
+        for est, grid in models:
+            grid = list(grid) or [{}]
+            g = len(grid)
+            metrics = np.zeros((g, k))
+            if hasattr(est, "fit_arrays_batched") and _lr_style_grid(grid):
+                # ONE vmapped fit for the whole fold x grid batch
+                W = np.repeat(masks.astype(np.float64), g, axis=0) * w[None, :]
+                regs = np.array(
+                    [grid[j].get("reg_param", est.params.get("reg_param", 0.0))
+                     for f in range(k) for j in range(g)]
+                )
+                ens = np.array(
+                    [grid[j].get("elastic_net_param",
+                                 est.params.get("elastic_net_param", 0.0))
+                     for f in range(k) for j in range(g)]
+                )
+                betas, b0s = est.fit_arrays_batched(X, y, W, regs, ens)
+                for f in range(k):
+                    val = ~masks[f]
+                    yv = y[val]
+                    for j in range(g):
+                        b = f * g + j
+                        pred, raw, prob = est.predict_arrays(
+                            {"beta": betas[b], "intercept": float(b0s[b])},
+                            X[val],
+                        )
+                        metrics[j, f] = self._metric_of(yv, pred, raw, prob)
+            else:
+                for f in range(k):
+                    tr, val = masks[f], ~masks[f]
+                    for j, pmap in enumerate(grid):
+                        cand = est.with_params(**pmap)
+                        params = cand.fit_arrays(
+                            X[tr], y[tr], w[tr]
+                        )
+                        pred, raw, prob = cand.predict_arrays(params, X[val])
+                        metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
+            mean_metrics = metrics.mean(axis=1)
+            for j, pmap in enumerate(grid):
+                all_results.append(
+                    {
+                        "model_type": est.model_type,
+                        "model_uid": est.uid,
+                        "params": dict(pmap),
+                        "metric": float(mean_metrics[j]),
+                        "fold_metrics": metrics[j].tolist(),
+                    }
+                )
+            j_best = int(np.argmax(mean_metrics) if larger else np.argmin(mean_metrics))
+            cand_metric = float(mean_metrics[j_best])
+            if best is None or (
+                cand_metric > best[0] if larger else cand_metric < best[0]
+            ):
+                best = (cand_metric, est, dict(grid[j_best]))
+
+        assert best is not None, "no models to validate"
+        return ValidationResult(
+            best_estimator=best[1].with_params(**best[2]),
+            best_params=best[2],
+            best_metric=best[0],
+            metric_name=self.evaluator.metric_name,
+            larger_better=larger,
+            all_results=all_results,
+        )
+
+
+def _lr_style_grid(grid: Sequence[dict]) -> bool:
+    """Batched path applies when every grid key is a batched-fit scalar."""
+    ok = {"reg_param", "elastic_net_param"}
+    return all(set(p) <= ok for p in grid)
+
+
+class OpCrossValidation(OpValidator):
+    """(reference: OpCrossValidation.scala - numFolds default 3)"""
+
+    def __init__(
+        self,
+        num_folds: int = 3,
+        evaluator: Optional[OpEvaluatorBase] = None,
+        seed: int = 42,
+        stratify: bool = False,
+    ) -> None:
+        super().__init__(evaluator, seed, stratify)
+        self.num_folds = num_folds
+
+    def train_masks(self, y: np.ndarray) -> np.ndarray:
+        return stratified_kfold_masks(y, self.num_folds, self.seed, self.stratify)
+
+
+class OpTrainValidationSplit(OpValidator):
+    """(reference: OpTrainValidationSplit.scala - trainRatio default 0.75)"""
+
+    def __init__(
+        self,
+        train_ratio: float = 0.75,
+        evaluator: Optional[OpEvaluatorBase] = None,
+        seed: int = 42,
+        stratify: bool = False,
+    ) -> None:
+        super().__init__(evaluator, seed, stratify)
+        self.train_ratio = train_ratio
+
+    def train_masks(self, y: np.ndarray) -> np.ndarray:
+        n = len(y)
+        rng = np.random.RandomState(self.seed)
+        if self.stratify:
+            mask = np.zeros(n, dtype=bool)
+            for c in np.unique(y):
+                idx = np.nonzero(y == c)[0]
+                perm = rng.permutation(idx)
+                mask[perm[: int(np.ceil(len(idx) * self.train_ratio))]] = True
+        else:
+            perm = rng.permutation(n)
+            mask = np.zeros(n, dtype=bool)
+            mask[perm[: int(np.ceil(n * self.train_ratio))]] = True
+        return mask[None, :]
