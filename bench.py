@@ -1,0 +1,70 @@
+"""Benchmark: Titanic BinaryClassificationModelSelector CV end-to-end.
+
+Mirrors BASELINE.md config 1 (reference: helloworld OpTitanicSimple +
+README.md:59-107 - 3-fold CV, AuPR selection metric, LR + RF candidate
+grids; published holdout AuROC 0.8821603927986905).  Prints ONE JSON line:
+metric = holdout AuROC, vs_baseline = ours / reference, plus wall-clock
+fields for the CV fan-out the TPU build is meant to accelerate.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REFERENCE_HOLDOUT_AUROC = 0.8821603927986905  # README.md:87
+
+
+def main() -> None:
+    t_start = time.time()
+
+    from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+    from transmogrifai_tpu.examples.titanic import titanic_workflow
+    from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    from transmogrifai_tpu.selector.factories import (
+        BinaryClassificationModelSelector,
+        lr_grid,
+        rf_grid,
+    )
+
+    # the README's selector: LR + RF grids, 3-fold CV on AuPR
+    aupr = OpBinaryClassificationEvaluator()
+    aupr.metric_name = "AuPR"
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        validation_metric=aupr,
+        models_and_parameters=[
+            (OpLogisticRegression(), lr_grid()),
+            (OpRandomForestClassifier(), rf_grid()),
+        ],
+    )
+    wf, survived, prediction = titanic_workflow(
+        selector=selector, reserve_test_fraction=0.1
+    )
+    t_setup = time.time()
+    model = wf.train()
+    t_train = time.time()
+
+    holdout = model.evaluate_holdout(OpBinaryClassificationEvaluator())
+    train_m = model.evaluate(OpBinaryClassificationEvaluator())
+    auroc = float(holdout.AuROC)
+
+    insights = model.model_insights()
+    result = {
+        "metric": "titanic_cv_holdout_auroc",
+        "value": auroc,
+        "unit": "AuROC",
+        "vs_baseline": auroc / REFERENCE_HOLDOUT_AUROC,
+        "train_wall_s": round(t_train - t_setup, 3),
+        "total_wall_s": round(time.time() - t_start, 3),
+        "holdout_aupr": float(holdout.AuPR),
+        "train_auroc": float(train_m.AuROC),
+        "selected_model": insights.selected_model_type,
+        "cv_candidates": len(insights.validation_results),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

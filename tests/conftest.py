@@ -6,13 +6,30 @@ TestSparkContext.scala:33-76); the analogous strategy here is CPU jax with
 Must run before jax initializes.
 """
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin registers itself via sitecustomize in every python
+# process.  Unit tests must run on the virtual CPU mesh and never block on
+# the TPU tunnel, so drop the axon backend factory before jax initializes.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,138 @@
+"""RawFeatureFilter + model serialization tests (mirrors reference:
+core/src/test/.../filters/RawFeatureFilterTest.scala,
+OpWorkflowModelReaderWriterTest.scala)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.filters.feature_distribution import compute_distribution
+from transmogrifai_tpu.filters.raw_feature_filter import RawFeatureFilter
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import NumericColumn, TextColumn
+from transmogrifai_tpu.utils.uid import reset_uids
+
+
+def _mk_data(rng, n=300, leak=False):
+    x1 = rng.randn(n)
+    y = (x1 + 0.5 * rng.randn(n) > 0).astype(float)
+    sparse = [None] * n  # nearly empty feature
+    sparse[0] = 1.0
+    x2 = rng.randn(n)
+    null_leak = [float(v) if yy == 1 or not leak else None
+                 for v, yy in zip(x2, y)]
+    cat = [("a" if v > 0 else "b") for v in rng.randn(n)]
+    return {
+        "y": y.tolist(),
+        "x1": x1.tolist(),
+        "sparse": sparse,
+        "leaky": null_leak,
+        "cat": cat,
+    }
+
+
+def _features():
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    x1 = FeatureBuilder(ft.Real, "x1").as_predictor()
+    sparse = FeatureBuilder(ft.Real, "sparse").as_predictor()
+    leaky = FeatureBuilder(ft.Real, "leaky").as_predictor()
+    cat = FeatureBuilder(ft.PickList, "cat").as_predictor()
+    return y, [x1, sparse, leaky, cat]
+
+
+def test_rff_drops_low_fill_and_leaky(rng):
+    data = _mk_data(rng, leak=True)
+    y, preds = _features()
+    types = {"y": ft.RealNN, "x1": ft.Real, "sparse": ft.Real,
+             "leaky": ft.Real, "cat": ft.PickList}
+    ds = Dataset.from_pylists(data, types)
+    rff = RawFeatureFilter(min_fill_rate=0.1, max_correlation=0.8)
+    filtered = rff.filter_raw_data(ds, [y] + preds)
+    dropped = {f.name for f in filtered.blacklisted_features}
+    assert "sparse" in dropped       # fill rate ~0.003
+    assert "leaky" in dropped        # null pattern predicts the label
+    assert "x1" not in dropped and "cat" not in dropped
+    assert "sparse" not in filtered.clean_data
+
+
+def test_rff_js_divergence_drift(rng):
+    n = 500
+    train = Dataset.from_pylists(
+        {"y": [0.0, 1.0] * (n // 2), "x": rng.randn(n).tolist()},
+        {"y": ft.RealNN, "x": ft.Real},
+    )
+    score = Dataset.from_pylists(
+        {"x": (rng.randn(n) + 10.0).tolist()}, {"x": ft.Real}
+    )
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    x = FeatureBuilder(ft.Real, "x").as_predictor()
+    rff = RawFeatureFilter(scoring_data=score, max_js_divergence=0.5)
+    filtered = rff.filter_raw_data(train, [y, x])
+    assert [f.name for f in filtered.blacklisted_features] == ["x"]
+
+
+def test_rff_in_workflow_does_dag_surgery(rng):
+    data = _mk_data(rng, leak=False)
+    y, preds = _features()
+    vec = transmogrify(preds)
+    pred_stage = OpLogisticRegression(reg_param=0.01)
+    prediction = pred_stage.set_input(y, vec).get_output()
+    wf = (
+        OpWorkflow()
+        .set_result_features(prediction)
+        .set_input_dataset(data)
+        .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.1))
+    )
+    model = wf.train()
+    assert "sparse" in {f.name for f in wf.blacklisted_features}
+    # vectorizer lost the blacklisted input
+    scored = model.score(
+        {k: v for k, v in data.items()}
+    )
+    assert prediction.name in scored
+
+
+def test_distribution_monoid_merge(rng):
+    col = NumericColumn.from_list(list(rng.randn(100)) + [None] * 20)
+    d1 = compute_distribution("x", col.take(np.arange(60)), value_range=(-4, 4))
+    d2 = compute_distribution("x", col.take(np.arange(60, 120)), value_range=(-4, 4))
+    full = compute_distribution("x", col, value_range=(-4, 4))
+    merged = d1.merge(d2)
+    assert merged.count == full.count
+    assert merged.nulls == full.nulls
+    assert np.allclose(merged.histogram, full.histogram)
+
+
+def test_model_save_load_roundtrip(tmp_path, rng):
+    def build():
+        reset_uids()
+        y = FeatureBuilder(ft.RealNN, "y").as_response()
+        a = FeatureBuilder(ft.Real, "a").as_predictor()
+        c = FeatureBuilder(ft.PickList, "c").as_predictor()
+        vec = transmogrify([a, c])
+        checked = y.sanity_check(vec, remove_bad_features=False)
+        pred = OpLogisticRegression(reg_param=0.01).set_input(y, checked).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    n = 200
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "c": [("u" if v > 0 else "v") for v in rng.randn(n)],
+    }
+    wf, pred = build()
+    model = wf.train() if wf.set_input_dataset(data) else None
+    scored1 = model.score(data)
+    p1 = scored1[pred.name].probability
+
+    model.save(str(tmp_path / "model"))
+
+    wf2, pred2 = build()  # same code-defined workflow, fresh uids
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    model2 = OpWorkflowModel.load(str(tmp_path / "model"), wf2)
+    scored2 = model2.score(data)
+    p2 = scored2[pred2.name].probability
+    assert np.allclose(p1, p2, atol=1e-6)

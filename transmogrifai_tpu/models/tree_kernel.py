@@ -226,6 +226,49 @@ def fit_forest(
     return jax.lax.map(one, (boot_w, feat_masks, rng_keys))
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth", "max_bins", "impurity_kind", "n_stats", "feature_subset_p"
+    ),
+)
+def fit_forest_folds(
+    bins, stats_row, w_rows,  # w_rows [F, n]: one weight vector per CV fold
+    boot_w, feat_masks, rng_keys,
+    max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
+    min_instances_per_node: float = 1.0,
+    min_info_gain: float = 0.0,
+    feature_subset_p: float = 1.0,
+):
+    """CV fan-out for forests: folds ride the weight axis exactly like the
+    linear models' vmapped Newton fits - binning and the design matrix are
+    shared, only the [F, n] weight masks differ.  (Replaces the reference's
+    per-fold Spark jobs, OpValidator.scala:289-306.)"""
+
+    def one_fold(w):
+        return fit_forest(
+            bins, stats_row, w, boot_w, feat_masks, rng_keys,
+            max_depth, max_bins, impurity_kind, n_stats,
+            min_instances_per_node, min_info_gain, feature_subset_p,
+        )
+
+    return jax.vmap(one_fold)(w_rows)
+
+
+def effective_max_depth(
+    max_depth: int, n_rows: int, min_instances_per_node: float
+) -> int:
+    """Cap depth at what the data can populate: a node needs >=
+    2*min_instances rows to split, so levels beyond
+    log2(n / (2*min_instances)) + 1 hold only unsplittable nodes.  Keeps
+    the static 2^depth histogram shapes proportional to the data instead
+    of the requested depth (the reference grid goes to maxDepth=12 even on
+    891 Titanic rows)."""
+    denom = max(2.0 * max(min_instances_per_node, 1.0), 2.0)
+    cap = int(np.ceil(np.log2(max(n_rows, 2) / denom))) + 1
+    return max(1, min(max_depth, cap))
+
+
 @partial(jax.jit, static_argnames=("max_depth",))
 def predict_forest(bins, heaps, max_depth: int):
     """Average normalized per-tree outputs: [n, C-ish]."""

@@ -20,7 +20,9 @@ import numpy as np
 from .base import PredictorEstimator
 from .tree_kernel import (
     bin_data,
+    effective_max_depth,
     fit_forest,
+    fit_forest_folds,
     fit_tree,
     predict_forest,
     predict_tree,
@@ -84,10 +86,9 @@ class _TreeEnsembleBase(PredictorEstimator):
 class _RandomForest(_TreeEnsembleBase):
     single_tree = False
 
-    def fit_arrays(self, X, y, w=None) -> Any:
+    def _forest_inputs(self, X, y):
         n, d = X.shape
         p = self.params
-        w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
         edges = quantile_bin_edges(X, p["max_bins"])
         bins = bin_data(X, edges)
         stats, C, imp, classes = self._stats_rows(y)
@@ -107,10 +108,21 @@ class _RandomForest(_TreeEnsembleBase):
         keys = jax.vmap(jax.random.PRNGKey)(
             jnp.asarray(rng.randint(0, 2**31 - 1, size=T))
         )
+        depth = effective_max_depth(
+            int(p["max_depth"]), n, float(p["min_instances_per_node"])
+        )
+        return edges, bins, stats, C, imp, classes, boot, feat_masks, keys, subset_p, depth
+
+    def fit_arrays(self, X, y, w=None) -> Any:
+        n, d = X.shape
+        p = self.params
+        w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
+        (edges, bins, stats, C, imp, classes, boot, feat_masks, keys,
+         subset_p, depth) = self._forest_inputs(X, y)
         heaps = fit_forest(
             jnp.asarray(bins), jnp.asarray(stats), jnp.asarray(w),
             jnp.asarray(boot), jnp.asarray(feat_masks), keys,
-            max_depth=int(p["max_depth"]), max_bins=int(p["max_bins"]),
+            max_depth=depth, max_bins=int(p["max_bins"]),
             impurity_kind=imp, n_stats=C,
             min_instances_per_node=float(p["min_instances_per_node"]),
             min_info_gain=float(p["min_info_gain"]),
@@ -120,8 +132,35 @@ class _RandomForest(_TreeEnsembleBase):
             "edges": edges,
             "heaps": tuple(np.asarray(h) for h in heaps),
             "classes": classes,
-            "max_depth": int(p["max_depth"]),
+            "max_depth": depth,
         }
+
+    def fit_arrays_folds(self, X, y, W) -> list:
+        """One vmapped fit over [F, n] fold-weight masks: shared binning,
+        shared bootstrap - the forest CV fan-out."""
+        p = self.params
+        (edges, bins, stats, C, imp, classes, boot, feat_masks, keys,
+         subset_p, depth) = self._forest_inputs(X, y)
+        heaps = fit_forest_folds(
+            jnp.asarray(bins), jnp.asarray(stats),
+            jnp.asarray(np.asarray(W, np.float32)),
+            jnp.asarray(boot), jnp.asarray(feat_masks), keys,
+            max_depth=depth, max_bins=int(p["max_bins"]),
+            impurity_kind=imp, n_stats=C,
+            min_instances_per_node=float(p["min_instances_per_node"]),
+            min_info_gain=float(p["min_info_gain"]),
+            feature_subset_p=float(subset_p),
+        )
+        heaps = tuple(np.asarray(h) for h in heaps)
+        return [
+            {
+                "edges": edges,
+                "heaps": tuple(h[f] for h in heaps),
+                "classes": classes,
+                "max_depth": depth,
+            }
+            for f in range(len(W))
+        ]
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         bins = bin_data(np.asarray(X, np.float32), params["edges"])
@@ -195,7 +234,9 @@ class _GBT(_TreeEnsembleBase):
         wj = jnp.asarray(w)
         T = int(p["num_trees"])
         lr = float(p["step_size"])
-        max_depth = int(p["max_depth"])
+        max_depth = effective_max_depth(
+            int(p["max_depth"]), n, float(p["min_instances_per_node"])
+        )
         max_bins = int(p["max_bins"])
         minipn = float(p["min_instances_per_node"])
         minig = float(p["min_info_gain"])

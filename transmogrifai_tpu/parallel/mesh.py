@@ -1,0 +1,68 @@
+"""Device mesh utilities: the TPU replacement for the Spark executor pool.
+
+(reference counterpart: Spark's partition/treeAggregate substrate, SURVEY
+§2.9/§5.8 - netty shuffle + driver-mediated treeAggregate.)  Here the
+substrate is a jax.sharding.Mesh over ICI/DCN: rows of the design matrix
+shard over the 'data' axis, CV replicas shard over the 'replica' axis, and
+XLA inserts psum/all-gather collectives where the jitted reductions cross
+shards.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def available_devices(min_count: int = 1):
+    """Prefer the default backend; fall back to (virtual) CPU devices when
+    it cannot supply ``min_count`` devices (test/emulation strategy mirroring
+    the reference's local[2] Spark, TestSparkContext.scala:33-76)."""
+    devs = jax.devices()
+    if len(devs) >= min_count:
+        return devs
+    try:
+        cpu = jax.devices("cpu")
+        if len(cpu) >= min_count:
+            return cpu
+    except RuntimeError:
+        pass
+    return devs
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("data",),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    if shape is None:
+        n = n_devices or len(jax.devices())
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    n_total = int(np.prod(shape))
+    devs = available_devices(n_total)[:n_total]
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def shard_rows(arr, mesh: Mesh, axis: str = "data"):
+    """Place an array with its leading axis sharded over the mesh."""
+    ndim = np.ndim(arr)
+    spec = P(axis, *([None] * (ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def replicate(arr, mesh: Mesh):
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def pad_rows_to_multiple(arr: np.ndarray, multiple: int, fill=0.0):
+    """Pad the leading axis so it divides evenly across shards; returns
+    (padded, n_valid).  Padded rows carry zero weight downstream."""
+    n = arr.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_shape = (rem,) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)]), n

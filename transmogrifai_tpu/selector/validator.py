@@ -118,6 +118,18 @@ class OpValidator:
                             X[val],
                         )
                         metrics[j, f] = self._metric_of(yv, pred, raw, prob)
+            elif hasattr(est, "fit_arrays_folds"):
+                # fold-batched path (trees): one vmapped fit per grid point
+                W = masks.astype(np.float64) * w[None, :]
+                for j, pmap in enumerate(grid):
+                    cand = est.with_params(**pmap)
+                    fold_params = cand.fit_arrays_folds(X, y, W)
+                    for f in range(k):
+                        val = ~masks[f]
+                        pred, raw, prob = cand.predict_arrays(
+                            fold_params[f], X[val]
+                        )
+                        metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
             else:
                 for f in range(k):
                     tr, val = masks[f], ~masks[f]

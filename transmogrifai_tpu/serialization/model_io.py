@@ -1,0 +1,181 @@
+"""Workflow model persistence.
+
+Counterpart of OpWorkflowModelWriter / OpWorkflowModelReader (reference:
+core/.../OpWorkflowModelWriter.scala:52-140, OpWorkflowModelReader.scala):
+the whole fitted workflow saves as one JSON document (stage classes, params,
+metadata, result-feature names) plus an .npz of every array-valued piece of
+fitted state.  Loading mirrors the reference's contract: the model is
+restored INTO the same code-defined workflow (OpWorkflow.loadModel,
+OpWorkflow.scala:468) - stages are re-paired with the freshly built DAG in
+deterministic order, so feature wiring never needs serializing.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+MODEL_JSON = "model.json"
+ARRAYS_NPZ = "arrays.npz"
+
+
+def _encode(value: Any, arrays: dict[str, np.ndarray], path: str) -> Any:
+    if isinstance(value, np.ndarray):
+        arrays[path] = value
+        return {"__npz__": path}
+    if isinstance(value, (np.floating, np.integer)):
+        return value.item()
+    if isinstance(value, dict):
+        return {
+            "__dict__": {
+                k: _encode(v, arrays, f"{path}.{k}") for k, v in value.items()
+            }
+        }
+    if isinstance(value, (list, tuple)):
+        enc = [_encode(v, arrays, f"{path}[{i}]") for i, v in enumerate(value)]
+        return {"__list__": enc, "__tuple__": isinstance(value, tuple)}
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    raise TypeError(
+        f"cannot serialize {type(value).__name__} at {path}; stages must keep "
+        "fitted state as arrays/scalars/dicts/lists"
+    )
+
+
+def _decode(value: Any, arrays) -> Any:
+    if isinstance(value, dict):
+        if "__npz__" in value:
+            return arrays[value["__npz__"]]
+        if "__dict__" in value:
+            return {k: _decode(v, arrays) for k, v in value["__dict__"].items()}
+        if "__list__" in value:
+            items = [_decode(v, arrays) for v in value["__list__"]]
+            return tuple(items) if value.get("__tuple__") else items
+    return value
+
+
+# attributes owned by the stage machinery, not fitted state
+_SKIP_ATTRS = {
+    "input_features", "_output", "uid", "operation_name", "params",
+    "metadata", "estimator_ref", "selector", "validator", "models",
+    "splitter", "evaluators", "validation_result", "fn", "predicate",
+    "model",
+}
+
+
+def stage_state(stage) -> dict[str, Any]:
+    out = {}
+    for k, v in vars(stage).items():
+        if k in _SKIP_ATTRS:
+            continue
+        out[k] = v
+    return out
+
+
+def save_model(model, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    stages_doc = []
+    for i, stage in enumerate(model.stages):
+        cls = type(stage)
+        doc: dict[str, Any] = {
+            "index": i,
+            "class": f"{cls.__module__}.{cls.__qualname__}",
+            "uid": stage.uid,
+            "operation_name": stage.operation_name,
+            "output_name": stage.output_name,
+            "params": _encode(stage.params, arrays, f"s{i}.params"),
+            "metadata": _encode(stage.metadata, arrays, f"s{i}.metadata"),
+            "state": _encode(stage_state(stage), arrays, f"s{i}.state"),
+        }
+        if hasattr(stage, "estimator_ref"):
+            est = stage.estimator_ref
+            doc["estimator"] = {
+                "class": f"{type(est).__module__}.{type(est).__qualname__}",
+                "params": _encode(est.params, arrays, f"s{i}.est_params"),
+            }
+        stages_doc.append(doc)
+    doc = {
+        "format_version": 1,
+        "result_features": [f.name for f in model.result_features],
+        "raw_features": [
+            {"name": f.name, "type": f.ftype.__name__, "is_response": f.is_response}
+            for f in model.raw_features
+        ],
+        "parameters": _encode(model.parameters, arrays, "wf.params"),
+        "train_time_s": model.train_time_s,
+        "stages": stages_doc,
+    }
+    with open(os.path.join(path, MODEL_JSON), "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+    np.savez_compressed(os.path.join(path, ARRAYS_NPZ), **arrays)
+
+
+def _load_class(qualname: str):
+    module, _, name = qualname.rpartition(".")
+    mod = importlib.import_module(module)
+    obj = mod
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def load_model(path: str, workflow):
+    """Restore into the code-defined workflow (reference contract:
+    OpWorkflow.loadModel)."""
+    from ..workflow.dag import compute_dag, flatten
+    from ..workflow.workflow import OpWorkflowModel
+
+    with open(os.path.join(path, MODEL_JSON)) as f:
+        doc = json.load(f)
+    arrays = np.load(os.path.join(path, ARRAYS_NPZ), allow_pickle=False)
+
+    dag = compute_dag(workflow.result_features)
+    dag_stages = flatten(dag)
+    if len(dag_stages) != len(doc["stages"]):
+        raise ValueError(
+            f"workflow has {len(dag_stages)} stages but saved model has "
+            f"{len(doc['stages'])}; load requires the same code-defined workflow"
+        )
+
+    fitted = []
+    for stage_def, saved in zip(dag_stages, doc["stages"]):
+        cls = _load_class(saved["class"])
+        inst = cls.__new__(cls)
+        # baseline attrs from the (unfitted) DAG stage, then saved state
+        inst.__dict__.update(
+            {
+                k: v
+                for k, v in vars(stage_def).items()
+                if k not in ("params", "metadata")
+            }
+        )
+        inst.uid = saved["uid"]
+        inst.operation_name = saved["operation_name"]
+        inst.params = _decode(saved["params"], arrays)
+        inst.metadata = _decode(saved["metadata"], arrays)
+        for k, v in _decode(saved["state"], arrays).items():
+            setattr(inst, k, v)
+        if "estimator" in saved:
+            est_cls = _load_class(saved["estimator"]["class"])
+            est = est_cls()
+            est.params = _decode(saved["estimator"]["params"], arrays)
+            inst.estimator_ref = est
+        inst.input_features = stage_def.input_features
+        inst._output = stage_def._output if stage_def._output else None
+        # fitted stage replaces the estimator: same output feature
+        stage_def._output = stage_def.get_output()
+        inst._output = stage_def._output
+        fitted.append(inst)
+
+    model = OpWorkflowModel(
+        result_features=workflow.result_features,
+        raw_features=workflow.raw_features,
+        stages=fitted,
+        parameters=_decode(doc["parameters"], arrays),
+        train_time_s=doc.get("train_time_s", 0.0),
+    )
+    return model
