@@ -1,0 +1,64 @@
+"""Boston regression + Iris multiclass end-to-end (BASELINE.md configs 3-4;
+reference: helloworld OpBoston.scala / OpIris.scala)."""
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.multiclass import OpMultiClassificationEvaluator
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+from transmogrifai_tpu.examples.boston import BOSTON_DATA, boston_workflow
+from transmogrifai_tpu.examples.iris import IRIS_DATA, iris_workflow
+from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+from transmogrifai_tpu.models.naive_bayes import OpNaiveBayes
+from transmogrifai_tpu.models.trees import (
+    OpGBTRegressor,
+    OpRandomForestClassifier,
+)
+from transmogrifai_tpu.selector.factories import (
+    MultiClassificationModelSelector,
+    RegressionModelSelector,
+    linreg_grid,
+)
+
+
+@pytest.mark.skipif(not os.path.exists(BOSTON_DATA), reason="no boston data")
+def test_boston_regression_end_to_end():
+    selector = RegressionModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLinearRegression(), linreg_grid()[:4]),
+            (OpGBTRegressor(num_trees=20, max_depth=4), [{}]),
+        ],
+    )
+    wf, medv, prediction = boston_workflow(selector=selector)
+    model = wf.train()
+    metrics = model.evaluate(OpRegressionEvaluator())
+    assert metrics.R2 > 0.6, metrics
+    md = model.stages[-1].metadata["model_selector_summary"]
+    assert md["best_model_type"] in ("OpLinearRegression", "OpGBTRegressor")
+    holdout = model.evaluate_holdout(OpRegressionEvaluator())
+    assert holdout.RootMeanSquaredError < 8.0, holdout
+
+
+@pytest.mark.skipif(not os.path.exists(IRIS_DATA), reason="no iris data")
+def test_iris_multiclass_end_to_end():
+    selector = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpRandomForestClassifier(num_trees=10, max_depth=4), [{}]),
+            (OpNaiveBayes(), [{}]),
+        ],
+    )
+    wf, label, prediction, labels = iris_workflow(selector=selector)
+    assert labels == ["Iris-setosa", "Iris-versicolor", "Iris-virginica"]
+    model = wf.train()
+    metrics = model.evaluate(OpMultiClassificationEvaluator())
+    assert metrics.F1 > 0.90, metrics
+    # threshold metrics present (reference: OpMultiClassificationEvaluator
+    # ThresholdMetrics topN {1,3})
+    tm = metrics.threshold_metrics
+    assert tm["topns"] == [1, 3]
+    assert len(tm["thresholds"]) == 101
+    holdout = model.evaluate_holdout(OpMultiClassificationEvaluator())
+    assert holdout.Error < 0.2, holdout

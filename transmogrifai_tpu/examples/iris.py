@@ -1,0 +1,68 @@
+"""Iris multiclass classification example.
+
+Counterpart of the reference helloworld app (reference: helloworld/src/main/
+scala/com/salesforce/hw/iris/OpIris.scala + IrisFeatures.scala):
+MultiClassificationModelSelector (RF / NB per BASELINE.md config 4) over the
+four measurements; the string label is indexed to Integral classes.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional
+
+import transmogrifai_tpu.dsl  # noqa: F401
+from ..features.feature_builder import FeatureBuilder
+from ..ops.transmogrifier import transmogrify
+from ..types import feature_types as ft
+from ..types.columns import column_from_list
+from ..types.dataset import Dataset
+from ..workflow.workflow import OpWorkflow
+
+IRIS_DATA = os.environ.get(
+    "IRIS_DATA",
+    "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data",
+)
+COLUMNS = ["sepal_length", "sepal_width", "petal_length", "petal_width", "irisClass"]
+
+
+def load_iris(path: Optional[str] = None) -> tuple[Dataset, list[str]]:
+    rows = []
+    with open(path or IRIS_DATA, newline="") as f:
+        for r in csv.reader(f):
+            if len(r) == 5:
+                rows.append(r)
+    labels = sorted({r[4] for r in rows})
+    label_idx = {l: float(i) for i, l in enumerate(labels)}
+    cols: dict[str, list] = {
+        "sepal_length": [float(r[0]) for r in rows],
+        "sepal_width": [float(r[1]) for r in rows],
+        "petal_length": [float(r[2]) for r in rows],
+        "petal_width": [float(r[3]) for r in rows],
+        "irisClass": [label_idx[r[4]] for r in rows],
+    }
+    types = {c: ft.Real for c in COLUMNS}
+    types["irisClass"] = ft.RealNN
+    return (
+        Dataset({c: column_from_list(v, types[c]) for c, v in cols.items()}),
+        labels,
+    )
+
+
+def iris_workflow(path: Optional[str] = None, selector=None):
+    label = FeatureBuilder(ft.RealNN, "irisClass").as_response()
+    predictors = [
+        FeatureBuilder(ft.Real, c).as_predictor() for c in COLUMNS[:4]
+    ]
+    features = transmogrify(predictors)
+    if selector is None:
+        from ..selector.factories import MultiClassificationModelSelector
+
+        selector = MultiClassificationModelSelector.with_cross_validation(
+            num_folds=3,
+            model_types_to_use=["OpRandomForestClassifier", "OpNaiveBayes"],
+        )
+    prediction = selector.set_input(label, features).get_output()
+    data, labels = load_iris(path)
+    wf = OpWorkflow().set_result_features(prediction).set_input_dataset(data)
+    return wf, label, prediction, labels

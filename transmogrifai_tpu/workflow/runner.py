@@ -1,0 +1,195 @@
+"""Batch application runner.
+
+Counterpart of OpWorkflowRunner / OpApp (reference: core/.../
+OpWorkflowRunner.scala:296-365, OpApp.scala:49-209): run types
+
+* train          - fit the workflow, save the model + summary
+* score          - load model, score the reader's data, write scores
+* features       - materialize raw features only
+* evaluate       - load model, score + evaluate, write metrics
+* streaming_score- micro-batch scoring loop over a batch iterator
+                   (reference: StreamingScore over DStreams,
+                   OpWorkflowRunner.scala:313-332)
+
+plus a CLI (``python -m transmogrifai_tpu.workflow.runner --run-type ...``)
+standing in for OpApp.main's scopt parsing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+from ..types.dataset import Dataset
+from .params import OpParams
+from .workflow import OpWorkflow, OpWorkflowModel
+
+
+@dataclass
+class OpWorkflowRunnerResult:
+    run_type: str
+    model: Optional[OpWorkflowModel] = None
+    scores: Optional[Dataset] = None
+    metrics: Optional[dict] = None
+    summary: Optional[dict] = None
+    wall_s: float = 0.0
+
+
+class OpWorkflowRunner:
+    def __init__(
+        self,
+        workflow: OpWorkflow,
+        evaluator=None,
+        train_reader=None,
+        score_reader=None,
+    ) -> None:
+        self.workflow = workflow
+        self.evaluator = evaluator
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+
+    def run(self, run_type: str, params: Optional[OpParams] = None) -> OpWorkflowRunnerResult:
+        params = params or OpParams()
+        t0 = time.time()
+        from .dag import compute_dag
+
+        dag = compute_dag(self.workflow.result_features)
+        params.apply_to_dag(dag)
+        run_type = run_type.lower().replace("-", "_")
+        if run_type == "train":
+            result = self._train(params)
+        elif run_type == "score":
+            result = self._score(params)
+        elif run_type == "features":
+            result = self._features(params)
+        elif run_type == "evaluate":
+            result = self._evaluate(params)
+        else:
+            raise ValueError(f"unknown run type {run_type!r}")
+        result.wall_s = time.time() - t0
+        return result
+
+    # ------------------------------------------------------------------
+    def _reader(self, which: str):
+        r = self.train_reader if which == "train" else self.score_reader
+        return r or self.workflow._reader
+
+    def _train(self, params: OpParams) -> OpWorkflowRunnerResult:
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        model = self.workflow.train()
+        summary = model.summary_json()
+        if params.model_location:
+            model.save(params.model_location)
+            with open(
+                os.path.join(params.model_location, "summary.json"), "w"
+            ) as f:
+                json.dump(summary, f, indent=1, default=str)
+        return OpWorkflowRunnerResult(
+            run_type="train", model=model, summary=summary
+        )
+
+    def _load_model(self, params: OpParams) -> OpWorkflowModel:
+        if not params.model_location:
+            raise ValueError("model_location required for score/evaluate")
+        return OpWorkflowModel.load(params.model_location, self.workflow)
+
+    def _scored_data(self, params: OpParams, model: OpWorkflowModel) -> Dataset:
+        reader = self._reader("score")
+        if reader is None:
+            raise ValueError("no reader for scoring")
+        raw = reader.generate_dataset(model.raw_features, params.reader_params)
+        return model.score(raw)
+
+    def _score(self, params: OpParams) -> OpWorkflowRunnerResult:
+        model = self._load_model(params)
+        scored = self._scored_data(params, model)
+        if params.write_location:
+            _write_scores(scored, model, params.write_location)
+        return OpWorkflowRunnerResult(run_type="score", model=model, scores=scored)
+
+    def _features(self, params: OpParams) -> OpWorkflowRunnerResult:
+        reader = self._reader("train")
+        raw = reader.generate_dataset(self.workflow.raw_features, params.reader_params)
+        if params.write_location:
+            os.makedirs(params.write_location, exist_ok=True)
+            with open(os.path.join(params.write_location, "features.json"), "w") as f:
+                json.dump(raw.to_pylists(), f, default=str)
+        return OpWorkflowRunnerResult(run_type="features", scores=raw)
+
+    def _evaluate(self, params: OpParams) -> OpWorkflowRunnerResult:
+        if self.evaluator is None:
+            raise ValueError("evaluator required for evaluate run")
+        model = self._load_model(params)
+        scored = self._scored_data(params, model)
+        label = next((f.name for f in model.raw_features if f.is_response), None)
+        pred = model.result_features[0].name
+        metrics = self.evaluator.evaluate(scored, label_col=label, pred_col=pred)
+        mj = metrics.to_json()
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(os.path.join(params.metrics_location, "metrics.json"), "w") as f:
+                json.dump(mj, f, indent=1, default=str)
+        return OpWorkflowRunnerResult(run_type="evaluate", model=model,
+                                      scores=scored, metrics=mj)
+
+    # ------------------------------------------------------------------
+    def streaming_score(
+        self,
+        batches: Iterable[Any],
+        params: Optional[OpParams] = None,
+        on_batch: Optional[Callable[[Dataset], None]] = None,
+    ):
+        """Micro-batch scoring loop (reference: StreamingScore run type,
+        OpWorkflowRunner.scala:313-332 scoring each DStream micro-batch with
+        the row-level score function)."""
+        params = params or OpParams()
+        model = self._load_model(params)
+        for batch in batches:
+            scored = model.score(batch)
+            if on_batch is not None:
+                on_batch(scored)
+            yield scored
+
+
+def _write_scores(scored: Dataset, model: OpWorkflowModel, location: str) -> None:
+    """Column-pruned score output (reference: OpWorkflowModel.saveScores:
+    375-420 - keep result features + response)."""
+    os.makedirs(location, exist_ok=True)
+    keep = [f.name for f in model.result_features if f.name in scored]
+    keep += [
+        f.name for f in model.raw_features if f.is_response and f.name in scored
+    ]
+    out = scored.select(keep).to_pylists()
+    with open(os.path.join(location, "scores.json"), "w") as f:
+        json.dump(out, f, default=str)
+
+
+def main(argv=None) -> int:
+    """CLI entry (OpApp.main analog)."""
+    p = argparse.ArgumentParser(description="transmogrifai_tpu workflow runner")
+    p.add_argument("--run-type", required=True,
+                   choices=["train", "score", "features", "evaluate"])
+    p.add_argument("--params", help="path to OpParams JSON")
+    p.add_argument("--workflow", required=True,
+                   help="module:function returning (workflow, evaluator, readers...)")
+    args = p.parse_args(argv)
+    import importlib
+
+    mod_name, _, fn_name = args.workflow.partition(":")
+    factory = getattr(importlib.import_module(mod_name), fn_name)
+    built = factory()
+    wf = built[0] if isinstance(built, tuple) else built
+    evaluator = built[1] if isinstance(built, tuple) and len(built) > 1 else None
+    runner = OpWorkflowRunner(wf, evaluator=evaluator)
+    params = OpParams.from_file(args.params) if args.params else OpParams()
+    result = runner.run(args.run_type, params)
+    print(json.dumps({"run_type": result.run_type, "wall_s": result.wall_s}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
