@@ -1,0 +1,283 @@
+"""Stage-library unit tests (mirrors reference: core/src/test/.../impl/
+feature/* specs - each op's expected outputs + metadata)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.ops.bucketizers import (
+    DecisionTreeNumericBucketizer,
+    NumericBucketizer,
+)
+from transmogrifai_tpu.ops.categorical import OneHotVectorizer, StringIndexer
+from transmogrifai_tpu.ops.collections import (
+    FilterMap,
+    IsotonicRegressionCalibrator,
+    ScalerTransformer,
+    DescalerTransformer,
+    ToOccurTransformer,
+)
+from transmogrifai_tpu.ops.dates import DateVectorizer
+from transmogrifai_tpu.ops.maps import MapVectorizer
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.ops.text import SmartTextVectorizer, TextTokenizer, tokenize
+from transmogrifai_tpu.ops.text_analysis import (
+    EmailToPickList,
+    JaccardSimilarity,
+    LangDetector,
+    MimeTypeDetector,
+    NGramSimilarity,
+    NameEntityRecognizer,
+    PhoneNumberParser,
+    TextLenTransformer,
+    detect_mime_type,
+    is_valid_phone,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import (
+    ListColumn,
+    MapColumn,
+    NumericColumn,
+    TextColumn,
+    VectorColumn,
+)
+from transmogrifai_tpu.utils.hashing import hashing_tf, murmur3_32
+
+
+def _ds(**cols):
+    data, types = {}, {}
+    for name, (vals, t) in cols.items():
+        data[name], types[name] = vals, t
+    return Dataset.from_pylists(data, types)
+
+
+def _fit_transform(stage, ds, *features):
+    stage.set_input(*features)
+    from transmogrifai_tpu.stages.base import Estimator
+
+    model = stage.fit(ds) if isinstance(stage, Estimator) else stage
+    return model.transform(ds)[model.output_name]
+
+
+def test_murmur3_reference_vectors():
+    # murmur3_x86_32 known-answer tests (seed 0)
+    assert murmur3_32(b"", seed=0) == 0
+    assert murmur3_32(b"hello", seed=0) == 0x248BFA47
+    assert murmur3_32(b"hello, world", seed=0) == 0x149BBB7F
+
+
+def test_hashing_tf_deterministic():
+    out = hashing_tf([["a", "b", "a"], ["c"]], 16)
+    assert out.shape == (2, 16)
+    assert out[0].sum() == 3.0 and out[0].max() == 2.0
+
+
+def test_real_vectorizer_mean_impute_and_nulls():
+    ds = _ds(x=([1.0, None, 3.0], ft.Real))
+    f = FeatureBuilder(ft.Real, "x").as_predictor()
+    out = _fit_transform(RealVectorizer(), ds, f)
+    assert isinstance(out, VectorColumn)
+    np.testing.assert_allclose(
+        out.values, [[1.0, 0.0], [2.0, 1.0], [3.0, 0.0]]
+    )
+    assert out.metadata.columns[1].is_null_indicator
+
+
+def test_one_hot_top_k_other_null():
+    vals = ["a"] * 5 + ["b"] * 3 + ["c"] * 1 + [None]
+    ds = _ds(x=(vals, ft.PickList))
+    f = FeatureBuilder(ft.PickList, "x").as_predictor()
+    out = _fit_transform(OneHotVectorizer(top_k=2, min_support=2), ds, f)
+    labels = [m.indicator_value for m in out.metadata.columns]
+    assert labels == ["a", "b", "OTHER", "NullIndicatorValue"]
+    assert out.values[0].tolist() == [1, 0, 0, 0]
+    assert out.values[8].tolist() == [0, 0, 1, 0]  # "c" below min_support
+    assert out.values[9].tolist() == [0, 0, 0, 1]
+
+
+def test_smart_text_pivots_low_cardinality_hashes_high(rng):
+    low = [f"cat{i % 3}" for i in range(100)]
+    high = [f"txt unique {i}" for i in range(100)]
+    ds = _ds(lo=(low, ft.Text), hi=(high, ft.Text))
+    flo = FeatureBuilder(ft.Text, "lo").as_predictor()
+    fhi = FeatureBuilder(ft.Text, "hi").as_predictor()
+    st = SmartTextVectorizer(max_cardinality=10, hash_dims=32)
+    out = _fit_transform(st, ds, flo, fhi)
+    # lo pivoted (3 + OTHER + null), hi hashed (32 + null)
+    assert out.width == 5 + 33
+
+
+def test_tokenizer():
+    assert tokenize("Hello, World! 123") == ["hello", "world", "123"]
+    ds = _ds(t=(["A b", None], ft.Text))
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    out = TextTokenizer().set_input(f).transform(ds)
+    col = out[TextTokenizer().set_input(f).output_name] if False else list(out.columns().values())[-1]
+    assert isinstance(col, ListColumn)
+
+
+def test_date_vectorizer_circular():
+    ms_per_day = 24 * 3600 * 1000
+    ds = _ds(d=([0.0, ms_per_day / 2], ft.Date))
+    f = FeatureBuilder(ft.Date, "d").as_predictor()
+    out = _fit_transform(DateVectorizer(periods=("HourOfDay",)), ds, f)
+    # midnight: sin 0 cos 1; noon: sin ~0 cos -1
+    np.testing.assert_allclose(out.values[0, :2], [0.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(out.values[1, :2], [0.0, -1.0], atol=1e-6)
+
+
+def test_map_vectorizer_numeric_and_pivot():
+    maps = [{"a": 1.0, "b": 2.0}, {"a": 3.0}, {}]
+    ds = _ds(m=(maps, ft.RealMap))
+    f = FeatureBuilder(ft.RealMap, "m").as_predictor()
+    out = _fit_transform(MapVectorizer(), ds, f)
+    # keys a, b each: value + null indicator
+    assert out.width == 4
+    np.testing.assert_allclose(out.values[:, 0], [1.0, 3.0, 2.0])  # a mean=2
+
+    tmaps = [{"k": "x"}, {"k": "y"}, {"k": "x"}]
+    ds2 = _ds(m=(tmaps, ft.TextMap))
+    f2 = FeatureBuilder(ft.TextMap, "m").as_predictor()
+    out2 = _fit_transform(MapVectorizer(min_support=1, top_k=5), ds2, f2)
+    labels = [m.indicator_value for m in out2.metadata.columns]
+    assert "x" in labels and "y" in labels
+
+
+def test_numeric_bucketizer():
+    ds = _ds(x=([1.0, 5.0, 9.0, None], ft.Real))
+    f = FeatureBuilder(ft.Real, "x").as_predictor()
+    out = NumericBucketizer(splits=[4.0, 8.0]).set_input(f).transform(ds)
+    col = list(out.columns().values())[-1]
+    assert col.values[:, :3].argmax(axis=1).tolist()[:3] == [0, 1, 2]
+    assert col.values[3, 3] == 1.0  # null indicator
+
+
+def test_decision_tree_bucketizer_finds_signal_split(rng):
+    x = rng.uniform(0, 10, 500)
+    y = (x > 5.0).astype(float)
+    ds = _ds(y=(y.tolist(), ft.RealNN), x=(x.tolist(), ft.Real))
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fx = FeatureBuilder(ft.Real, "x").as_predictor()
+    stage = DecisionTreeNumericBucketizer(min_info_gain=0.01)
+    model = stage.set_input(fy, fx).fit(ds)
+    splits = stage.metadata["splits"]
+    assert splits, "expected at least one split"
+    assert any(abs(s - 5.0) < 0.8 for s in splits)
+
+
+def test_decision_tree_bucketizer_no_split_on_noise(rng):
+    x = rng.uniform(0, 10, 500)
+    y = (rng.rand(500) > 0.5).astype(float)
+    ds = _ds(y=(y.tolist(), ft.RealNN), x=(x.tolist(), ft.Real))
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fx = FeatureBuilder(ft.Real, "x").as_predictor()
+    stage = DecisionTreeNumericBucketizer(min_info_gain=0.05)
+    stage.set_input(fy, fx).fit(ds)
+    assert not stage.metadata["should_split"]
+
+
+def test_text_len_lang_ner_mime_phone():
+    ds = _ds(t=(["hello world", None], ft.Text))
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    out = TextLenTransformer().set_input(f).transform(ds)
+    col = list(out.columns().values())[-1]
+    assert col.values[0] == 11.0
+
+    assert is_valid_phone("(650) 123-4567") is True
+    assert is_valid_phone("123") is False
+    assert is_valid_phone(None) is None
+    assert is_valid_phone("+44 7911 123456", "GB") is True
+
+    import base64
+
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n....").decode()
+    assert detect_mime_type(png) == "image/png"
+    assert detect_mime_type(base64.b64encode(b"plain text here").decode()) == "text/plain"
+
+    from transmogrifai_tpu.ops.text_analysis import detect_language
+
+    scores = detect_language("the quick brown fox jumps over the lazy dog")
+    assert next(iter(scores)) == "en"
+
+
+def test_ner_extracts_names():
+    ds = _ds(t=(["Braund, Mr. Owen Harris", "nothing here"], ft.Text))
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    model = NameEntityRecognizer().set_input(f)
+    col = list(model.transform(ds).columns().values())[-1]
+    assert "owen" in col.values[0] and "braund" in col.values[0]
+
+
+def test_similarities():
+    ds = _ds(a=(["kitten", None], ft.Text), b=(["sitting", "x"], ft.Text))
+    fa = FeatureBuilder(ft.Text, "a").as_predictor()
+    fb = FeatureBuilder(ft.Text, "b").as_predictor()
+    col = list(
+        NGramSimilarity().set_input(fa, fb).transform(ds).columns().values()
+    )[-1]
+    assert 0 < col.values[0] < 1
+    assert col.values[1] == 0.0
+
+    ds2 = _ds(
+        a=([["x", "y"], []], ft.MultiPickList), b=([["x"], []], ft.MultiPickList)
+    )
+    fa2 = FeatureBuilder(ft.MultiPickList, "a").as_predictor()
+    fb2 = FeatureBuilder(ft.MultiPickList, "b").as_predictor()
+    col2 = list(
+        JaccardSimilarity().set_input(fa2, fb2).transform(ds2).columns().values()
+    )[-1]
+    assert col2.values[0] == 0.5
+    assert col2.values[1] == 1.0
+
+
+def test_filter_map_and_to_occur():
+    ds = _ds(m=([{"a": "1", "b": "2"}, {"b": "3"}], ft.TextMap))
+    f = FeatureBuilder(ft.TextMap, "m").as_predictor()
+    col = list(
+        FilterMap(block_keys=["b"]).set_input(f).transform(ds).columns().values()
+    )[-1]
+    assert col.values == [{"a": "1"}, {}]
+
+    ds2 = _ds(x=([1.0, 0.0, None], ft.Real))
+    f2 = FeatureBuilder(ft.Real, "x").as_predictor()
+    col2 = list(
+        ToOccurTransformer().set_input(f2).transform(ds2).columns().values()
+    )[-1]
+    assert col2.values.tolist() == [1.0, 0.0, 0.0]
+
+
+def test_scaler_descaler_roundtrip():
+    ds = _ds(x=([1.0, 2.0, 3.0], ft.Real))
+    f = FeatureBuilder(ft.Real, "x").as_predictor()
+    scaler = ScalerTransformer(scaling_type="linear", slope=2.0, intercept=1.0)
+    scaled_f = scaler.set_input(f).get_output()
+    ds2 = scaler.transform(ds)
+    descaler = DescalerTransformer().set_input(scaled_f, scaled_f)
+    col = list(descaler.transform(ds2).columns().values())[-1]
+    np.testing.assert_allclose(col.values, [1.0, 2.0, 3.0])
+
+
+def test_isotonic_calibrator(rng):
+    n = 200
+    score = np.sort(rng.rand(n))
+    y = (rng.rand(n) < score).astype(float)
+    ds = _ds(y=(y.tolist(), ft.RealNN), s=(score.tolist(), ft.Real))
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fs = FeatureBuilder(ft.Real, "s").as_predictor()
+    model = IsotonicRegressionCalibrator().set_input(fy, fs).fit(ds)
+    col = list(model.transform(ds).columns().values())[-1]
+    assert (np.diff(col.values[np.argsort(score)]) >= -1e-9).all()  # monotone
+
+
+def test_string_indexer_and_email_domain():
+    ds = _ds(t=(["b", "a", "b", None], ft.Text))
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    model = StringIndexer().set_input(f).fit(ds)
+    col = list(model.transform(ds).columns().values())[-1]
+    assert col.values.tolist() == [0.0, 1.0, 0.0, 2.0]  # b most frequent
+
+    ds2 = _ds(e=(["joe@corp.COM", "bad"], ft.Email))
+    f2 = FeatureBuilder(ft.Email, "e").as_predictor()
+    col2 = list(
+        EmailToPickList().set_input(f2).transform(ds2).columns().values()
+    )[-1]
+    assert col2.values[0] == "corp.com" and col2.values[1] is None

@@ -1,0 +1,170 @@
+"""Numeric bucketizers, including supervised decision-tree bucketizing.
+
+Counterparts of NumericBucketizer / DecisionTreeNumericBucketizer (reference:
+core/.../impl/feature/NumericBucketizer.scala,
+DecisionTreeNumericBucketizer.scala): the supervised variant fits a
+single-feature decision tree against the label and keeps the split points
+only when total info gain >= min_info_gain - reusing the histogram tree
+kernel (one [n, 1] fit, trivially cheap on device).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import Estimator, Transformer
+from ..types.columns import Column, NumericColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import OPNumeric, OPVector, Real, RealNN
+from ..types.vector_metadata import NULL_STRING, VectorColumnMeta, VectorMetadata
+from ..models.tree_kernel import bin_data, fit_tree, quantile_bin_edges
+
+
+def _bucket_vector(
+    values: np.ndarray,
+    mask: np.ndarray,
+    splits: Sequence[float],
+    track_nulls: bool,
+    feat_name: str,
+    feat_type: str,
+    out_name: str,
+) -> "Column":
+    from ..types.columns import VectorColumn
+
+    splits = list(splits)
+    n_buckets = len(splits) + 1
+    which = np.searchsorted(splits, values, side="right")
+    width = n_buckets + (1 if track_nulls else 0)
+    arr = np.zeros((len(values), width), dtype=np.float32)
+    rows = np.arange(len(values))
+    arr[rows[mask], which[mask]] = 1.0
+    labels = []
+    edges = [-np.inf] + splits + [np.inf]
+    for i in range(n_buckets):
+        labels.append(f"[{edges[i]:.4g}-{edges[i+1]:.4g})")
+    metas = [
+        VectorColumnMeta(
+            parent_feature_name=feat_name,
+            parent_feature_type=feat_type,
+            grouping=feat_name,
+            indicator_value=lab,
+        )
+        for lab in labels
+    ]
+    if track_nulls:
+        arr[:, -1] = (~mask).astype(np.float32)
+        metas.append(
+            VectorColumnMeta(
+                parent_feature_name=feat_name,
+                parent_feature_type=feat_type,
+                grouping=feat_name,
+                indicator_value=NULL_STRING,
+            )
+        )
+    return VectorColumn(arr, VectorMetadata(out_name, tuple(metas)).reindexed())
+
+
+class NumericBucketizerModel(Transformer):
+    output_type = OPVector
+
+    def __init__(self, splits: Sequence[float], track_nulls: bool, **kw) -> None:
+        super().__init__(**kw)
+        self.splits = list(splits)
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        col = cols[-1]
+        assert isinstance(col, NumericColumn)
+        feat = self.input_features[-1]
+        return _bucket_vector(
+            col.values, col.mask, self.splits, self.track_nulls,
+            feat.name, feat.ftype.type_name(), self.output_name,
+        )
+
+
+class NumericBucketizer(Transformer):
+    """Fixed-split bucketizing (reference: NumericBucketizer.scala)."""
+
+    input_types = [OPNumeric]
+    output_type = OPVector
+
+    def __init__(self, splits: Sequence[float], track_nulls: bool = True, **kw):
+        super().__init__(**kw)
+        self.splits = list(splits)
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, NumericColumn)
+        feat = self.input_features[0]
+        return _bucket_vector(
+            col.values, col.mask, self.splits, self.track_nulls,
+            feat.name, feat.ftype.type_name(), self.output_name,
+        )
+
+
+class DecisionTreeNumericBucketizer(Estimator):
+    """Supervised bucketizing: single-feature decision-tree splits vs the
+    label, kept only if the tree finds gain >= min_info_gain (reference:
+    DecisionTreeNumericBucketizer.scala - maxDepth 4ish, minInfoGain 0.01)."""
+
+    input_types = [RealNN, OPNumeric]
+    output_type = OPVector
+
+    def __init__(
+        self,
+        max_depth: int = 4,
+        max_bins: int = 32,
+        min_info_gain: float = 0.01,
+        min_instances_per_node: int = 1,
+        track_nulls: bool = True,
+        **kw,
+    ) -> None:
+        super().__init__(**kw)
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        label, col = cols
+        assert isinstance(label, NumericColumn) and isinstance(col, NumericColumn)
+        y = np.asarray(label.values, dtype=np.float64)
+        x = col.values[col.mask][:, None].astype(np.float32)
+        yv = y[col.mask]
+        splits: list[float] = []
+        if x.size:
+            classes = np.unique(yv)
+            is_cls = len(classes) <= 20
+            if is_cls:
+                onehot = (yv[:, None] == classes[None, :]).astype(np.float32)
+                stats = np.concatenate(
+                    [np.ones((len(yv), 1), np.float32), onehot], axis=1
+                )
+                imp, C = "gini", stats.shape[1]
+            else:
+                stats = np.stack(
+                    [np.ones_like(yv), yv, yv * yv], axis=1
+                ).astype(np.float32)
+                imp, C = "variance", 3
+            edges = quantile_bin_edges(x, self.max_bins)
+            bins = bin_data(x, edges)
+            hf, ht, hl, hv = fit_tree(
+                jnp.asarray(bins), jnp.asarray(stats),
+                jnp.asarray(np.ones(len(yv), np.float32)),
+                jnp.asarray(np.ones((1,), bool)),
+                self.max_depth, self.max_bins, imp, C,
+                float(self.min_instances_per_node), float(self.min_info_gain),
+            )
+            hf, ht, hl = np.asarray(hf), np.asarray(ht), np.asarray(hl)
+            for node in range(len(hf)):
+                if not hl[node] and ht[node] < len(edges[0]):
+                    splits.append(float(edges[0][ht[node]]))
+        splits = sorted(set(splits))
+        model = NumericBucketizerModel(splits, self.track_nulls)
+        model.metadata = {"splits": splits, "should_split": bool(splits)}
+        self.metadata = model.metadata
+        return model

@@ -1,0 +1,304 @@
+"""Text analysis transformers.
+
+Counterparts of the reference's external-library text stack (reference:
+core/.../impl/feature/TextLenTransformer.scala, LangDetector.scala
+(Optimaize), NameEntityRecognizer.scala (OpenNLP), MimeTypeDetector.scala
+(Tika), PhoneNumberParser.scala (libphonenumber), NGramSimilarity.scala,
+JaccardSimilarity.scala, plus the email/URL parsing in dsl/RichTextFeature).
+Self-contained equivalents: character-trigram language profiles, heuristic
+capitalization NER, magic-byte MIME sniffing, prefix-table phone validation,
+and set-based n-gram / Jaccard similarities - all columnar.
+"""
+from __future__ import annotations
+
+import base64
+import binascii
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import Transformer
+from ..types.columns import Column, ListColumn, NumericColumn, TextColumn
+from ..types.dataset import Dataset
+from ..types.feature_types import (
+    Base64,
+    Email,
+    Integral,
+    MultiPickList,
+    Phone,
+    PickList,
+    Real,
+    RealNN,
+    Text,
+    TextList,
+    URL,
+)
+from .text import tokenize
+
+# -- TextLenTransformer ------------------------------------------------------
+
+
+class TextLenTransformer(Transformer):
+    """(reference: TextLenTransformer.scala - token-wise length sum)"""
+
+    input_types = [Text]
+    output_type = Integral
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        vals = np.array([0.0 if v is None else float(len(v)) for v in col.values])
+        return NumericColumn(vals, col.mask, Integral)
+
+
+# -- Language detection ------------------------------------------------------
+# tiny trigram profiles for common languages; enough to route tokenization
+_LANG_PROFILES = {
+    "en": ["the", "and", "ing", "ion", "tio", "ent", "ati", " th", "he ", "er "],
+    "fr": ["les", "ent", "de ", " de", "ion", "es ", "la ", " la", "et ", "que"],
+    "es": ["de ", " de", "la ", " la", "que", "el ", " el", "ión", "os ", "ent"],
+    "de": ["en ", "er ", "ch ", "der", "ein", "sch", "ie ", "die", "und", " un"],
+    "it": ["di ", " di", "la ", " la", "che", "re ", "to ", "no ", "ell", "one"],
+    "pt": ["de ", " de", "ão ", "os ", "da ", " da", "que", "em ", "ar ", "ent"],
+    "nl": ["en ", "de ", " de", "van", " va", "het", " he", "een", " ee", "er "],
+}
+
+
+def detect_language(text: Optional[str]) -> dict[str, float]:
+    """Language -> confidence scores (reference: LangDetector.scala)."""
+    if not text:
+        return {}
+    t = text.lower()
+    scores = {}
+    for lang, grams in _LANG_PROFILES.items():
+        hits = sum(t.count(g) for g in grams)
+        if hits:
+            scores[lang] = hits
+    total = sum(scores.values())
+    return {k: v / total for k, v in sorted(scores.items(), key=lambda kv: -kv[1])}
+
+
+class LangDetector(Transformer):
+    input_types = [Text]
+    output_type = PickList
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        out = []
+        for v in col.values:
+            scores = detect_language(v)
+            out.append(next(iter(scores), None))
+        return TextColumn(np.array(out, dtype=object), PickList)
+
+
+# -- Name entity recognition -------------------------------------------------
+_HONORIFICS = {"mr", "mrs", "ms", "miss", "dr", "prof", "sir", "madam", "rev"}
+
+
+class NameEntityRecognizer(Transformer):
+    """Capitalization-heuristic person-name token extraction (reference:
+    NameEntityRecognizer.scala via OpenNLP tokenizer+NER models)."""
+
+    input_types = [Text]
+    output_type = MultiPickList
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        out = []
+        for v in col.values:
+            names: set[str] = set()
+            if v:
+                tokens = re.findall(r"[A-Za-z][a-z']+|[A-Z]{2,}", v)
+                prev_hon = False
+                for tok in tokens:
+                    low = tok.lower().rstrip(".")
+                    if low in _HONORIFICS:
+                        prev_hon = True
+                        continue
+                    if tok[0].isupper() and (prev_hon or len(tok) > 2):
+                        names.add(low)
+                    prev_hon = False
+            out.append(frozenset(names))
+        return ListColumn(out, MultiPickList)
+
+
+# -- MIME type detection -----------------------------------------------------
+_MAGIC = [
+    (b"\x89PNG", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF8", "image/gif"),
+    (b"%PDF", "application/pdf"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"BM", "image/bmp"),
+    (b"{\\rtf", "application/rtf"),
+    (b"<?xml", "application/xml"),
+    (b"<html", "text/html"),
+]
+
+
+def detect_mime_type(b64: Optional[str]) -> Optional[str]:
+    """(reference: MimeTypeDetector.scala via Tika magic bytes)"""
+    if not b64:
+        return None
+    try:
+        raw = base64.b64decode(b64[:64] + "=" * (-len(b64[:64]) % 4))
+    except (binascii.Error, ValueError):
+        return None
+    for magic, mime in _MAGIC:
+        if raw.startswith(magic):
+            return mime
+    if raw[:1] in (b"{", b"["):
+        return "application/json"
+    try:
+        raw.decode("utf-8")
+        return "text/plain"
+    except UnicodeDecodeError:
+        return "application/octet-stream"
+
+
+class MimeTypeDetector(Transformer):
+    input_types = [Base64]
+    output_type = PickList
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        out = [detect_mime_type(v) for v in col.values]
+        return TextColumn(np.array(out, dtype=object), PickList)
+
+
+# -- Phone parsing -----------------------------------------------------------
+_PHONE_LENGTHS = {"US": 10, "CA": 10, "GB": 10, "FR": 9, "DE": 10, "IN": 10,
+                  "AU": 9, "JP": 10, "BR": 10, "MX": 10}
+_COUNTRY_CODES = {"US": "1", "CA": "1", "GB": "44", "FR": "33", "DE": "49",
+                  "IN": "91", "AU": "61", "JP": "81", "BR": "55", "MX": "52"}
+
+
+def is_valid_phone(phone: Optional[str], region: str = "US") -> Optional[bool]:
+    """(reference: PhoneNumberParser.scala via libphonenumber)"""
+    if not phone:
+        return None
+    digits = re.sub(r"[^\d+]", "", phone)
+    if not digits:
+        return False
+    cc = _COUNTRY_CODES.get(region, "1")
+    if digits.startswith("+"):
+        if not digits[1:].startswith(cc):
+            return False
+        digits = digits[1 + len(cc):]
+    elif digits.startswith(cc) and len(digits) > _PHONE_LENGTHS.get(region, 10):
+        digits = digits[len(cc):]
+    return len(digits) == _PHONE_LENGTHS.get(region, 10)
+
+
+class PhoneNumberParser(Transformer):
+    """Phone -> Binary validity (reference: PhoneNumberParser.scala
+    isValidPhoneDefaultCountry)."""
+
+    input_types = [Phone]
+    output_type = Real
+
+    def __init__(self, region: str = "US", **kw) -> None:
+        super().__init__(**kw)
+        self.region = region
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        return NumericColumn.from_list(
+            [
+                None if (v := is_valid_phone(p, self.region)) is None else float(v)
+                for p in col.values
+            ],
+            Real,
+        )
+
+
+# -- Email / URL parsing (reference: dsl/RichTextFeature) --------------------
+_EMAIL_RE = re.compile(r"^([^@\s]+)@([^@\s]+\.[^@\s]+)$")
+_URL_RE = re.compile(r"^(https?|ftp)://([^/\s:]+)", re.IGNORECASE)
+
+
+class EmailToPickList(Transformer):
+    """Email -> domain as PickList (reference: RichTextFeature.toEmailDomain)."""
+
+    input_types = [Email]
+    output_type = PickList
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        out = []
+        for v in col.values:
+            m = _EMAIL_RE.match(v) if v else None
+            out.append(m.group(2).lower() if m else None)
+        return TextColumn(np.array(out, dtype=object), PickList)
+
+
+class UrlToDomain(Transformer):
+    """URL -> hostname, invalid urls -> null (reference:
+    RichTextFeature.toDomain / isValidUrl)."""
+
+    input_types = [URL]
+    output_type = PickList
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        out = []
+        for v in col.values:
+            m = _URL_RE.match(v) if v else None
+            out.append(m.group(2).lower() if m else None)
+        return TextColumn(np.array(out, dtype=object), PickList)
+
+
+# -- Similarities ------------------------------------------------------------
+def ngrams(s: str, n: int = 3) -> set[str]:
+    s = f" {s.lower()} "
+    return {s[i : i + n] for i in range(max(len(s) - n + 1, 1))}
+
+
+class NGramSimilarity(Transformer):
+    """Character n-gram similarity of two texts (reference:
+    NGramSimilarity.scala via lucene spell NGramDistance)."""
+
+    input_types = [Text, Text]
+    output_type = RealNN
+
+    def __init__(self, n: int = 3, **kw) -> None:
+        super().__init__(**kw)
+        self.n = n
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        a, b = cols
+        out = []
+        for x, y in zip(a.values, b.values):
+            if not x or not y:
+                out.append(0.0)
+                continue
+            ga, gb = ngrams(x, self.n), ngrams(y, self.n)
+            inter = len(ga & gb)
+            out.append(2.0 * inter / max(len(ga) + len(gb), 1))
+        return NumericColumn(np.array(out), np.ones(len(a), bool), RealNN)
+
+
+class JaccardSimilarity(Transformer):
+    """Jaccard similarity of two token sets (reference:
+    JaccardSimilarity.scala)."""
+
+    input_types = [MultiPickList, MultiPickList]
+    output_type = RealNN
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        a, b = cols
+        assert isinstance(a, ListColumn) and isinstance(b, ListColumn)
+        out = []
+        for x, y in zip(a.values, b.values):
+            sx, sy = set(x), set(y)
+            if not sx and not sy:
+                out.append(1.0)
+            else:
+                out.append(len(sx & sy) / max(len(sx | sy), 1))
+        return NumericColumn(np.array(out), np.ones(len(a), bool), RealNN)
