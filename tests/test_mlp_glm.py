@@ -1,0 +1,61 @@
+"""MLP + GLM model tests (reference: OpMultilayerPerceptronClassifierTest,
+OpGeneralizedLinearRegressionTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+from transmogrifai_tpu.models.mlp import OpMultilayerPerceptronClassifier
+from transmogrifai_tpu.selector.random_param_builder import RandomParamBuilder
+
+
+def test_mlp_learns_xor(rng):
+    n = 400
+    X = rng.randn(n, 2)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(float)
+    est = OpMultilayerPerceptronClassifier(hidden_layers=(16, 16), max_iter=400)
+    params = est.fit_arrays(X, y)
+    pred, raw, prob = est.predict_arrays(params, X)
+    assert (pred == y).mean() > 0.9  # linear models cannot do XOR
+    assert prob.shape == (n, 2)
+
+
+def test_glm_poisson(rng):
+    n = 800
+    X = rng.randn(n, 3)
+    beta = np.array([0.5, -0.3, 0.2])
+    lam = np.exp(X @ beta + 1.0)
+    y = rng.poisson(lam).astype(float)
+    est = OpGeneralizedLinearRegression(family="poisson")
+    params = est.fit_arrays(X, y)
+    assert np.allclose(params["beta"], beta, atol=0.1)
+    assert abs(params["intercept"] - 1.0) < 0.1
+    pred, _, _ = est.predict_arrays(params, X)
+    assert pred.min() >= 0
+
+
+def test_glm_gaussian_matches_linreg(rng):
+    n = 300
+    X = rng.randn(n, 2)
+    y = X @ np.array([2.0, -1.0]) + 0.5 + 0.01 * rng.randn(n)
+    est = OpGeneralizedLinearRegression(family="gaussian")
+    params = est.fit_arrays(X, y)
+    assert np.allclose(params["beta"], [2.0, -1.0], atol=0.02)
+
+
+def test_random_param_builder_deterministic():
+    b = (
+        RandomParamBuilder(seed=3)
+        .log_uniform("reg_param", 1e-4, 1e-1)
+        .choice("elastic_net_param", [0.0, 0.5])
+        .int_uniform("max_depth", 3, 12)
+    )
+    g1 = b.build(10)
+    g2 = (
+        RandomParamBuilder(seed=3)
+        .log_uniform("reg_param", 1e-4, 1e-1)
+        .choice("elastic_net_param", [0.0, 0.5])
+        .int_uniform("max_depth", 3, 12)
+    ).build(10)
+    assert g1 == g2
+    assert all(1e-4 <= p["reg_param"] <= 1e-1 for p in g1)
+    assert all(3 <= p["max_depth"] <= 12 for p in g1)

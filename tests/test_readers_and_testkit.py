@@ -1,0 +1,128 @@
+"""Event readers + aggregators + testkit tests (reference: readers/src/test/
+DataReaderTest / JoinedDataReaderDataGenerationTest; testkit specs)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.aggregators import (
+    CutOffTime,
+    Event,
+    FeatureAggregator,
+    GeolocationMidpoint,
+    default_aggregator,
+)
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.readers.events import (
+    AggregateReader,
+    ConditionalReader,
+    JoinedReader,
+    SimpleReader,
+    StreamingReader,
+)
+from transmogrifai_tpu.testkit.random_data import (
+    RandomBinary,
+    RandomReal,
+    RandomText,
+    random_dataset,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_default_aggregators_per_type():
+    assert default_aggregator(ft.Real).aggregate([1.0, 2.0, None]) == 3.0
+    assert default_aggregator(ft.Percent).aggregate([0.2, 0.4]) == pytest.approx(0.3)
+    assert default_aggregator(ft.Binary).aggregate([False, True]) is True
+    assert default_aggregator(ft.Date).aggregate([5, 9, 7]) == 9
+    assert default_aggregator(ft.PickList).aggregate(["a", "b", "a"]) == "a"
+    assert default_aggregator(ft.Text).aggregate(["x", "y"]) == "x y"
+    assert default_aggregator(ft.MultiPickList).aggregate(
+        [frozenset({"a"}), frozenset({"b"})]
+    ) == {"a", "b"}
+    assert default_aggregator(ft.RealMap).aggregate(
+        [{"k": 1.0}, {"k": 2.0, "j": 5.0}]
+    ) == {"k": 3.0, "j": 5.0}
+
+
+def test_geolocation_midpoint():
+    mid = GeolocationMidpoint().aggregate([[0.0, 0.0, 1.0], [0.0, 90.0, 1.0]])
+    assert abs(mid[0]) < 1e-6 and abs(mid[1] - 45.0) < 1e-6
+
+
+def test_aggregate_reader_cutoff_semantics():
+    records = [
+        {"id": "u1", "t": 1.0, "amount": 10.0, "label": 0.0},
+        {"id": "u1", "t": 2.0, "amount": 5.0, "label": 1.0},
+        {"id": "u1", "t": 9.0, "amount": 100.0, "label": 1.0},
+        {"id": "u2", "t": 1.5, "amount": 7.0, "label": 0.0},
+    ]
+    amount = FeatureBuilder(ft.Real, "amount").as_predictor()
+    label = FeatureBuilder(ft.Binary, "label").as_response()
+    reader = AggregateReader(
+        records, key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+        cutoff=CutOffTime(5.0),
+    )
+    ds = reader.generate_dataset([amount, label])
+    # predictors: events <= 5 summed; responses: events > 5 or'd
+    assert ds["amount"].to_list() == [15.0, 7.0]
+    assert ds["label"].to_list() == [1.0, None]
+
+
+def test_conditional_reader_per_key_cutoff():
+    records = [
+        {"id": "a", "t": 1.0, "spend": 3.0, "visit": False, "converted": 0.0},
+        {"id": "a", "t": 2.0, "spend": 4.0, "visit": True, "converted": 0.0},
+        {"id": "a", "t": 3.0, "spend": 9.0, "visit": False, "converted": 1.0},
+        {"id": "b", "t": 1.0, "spend": 2.0, "visit": False, "converted": 0.0},
+    ]
+    spend = FeatureBuilder(ft.Real, "spend").as_predictor()
+    conv = FeatureBuilder(ft.Binary, "converted").as_response()
+    reader = ConditionalReader(
+        records,
+        key_fn=lambda r: r["id"],
+        time_fn=lambda r: r["t"],
+        target_condition=lambda r: r["visit"],
+        response_window=5.0,
+    )
+    ds = reader.generate_dataset([spend, conv])
+    # only key 'a' has the condition; spend aggregates events <= t(visit)=2
+    assert len(ds) == 1
+    assert ds["spend"].to_list() == [7.0]
+    assert ds["converted"].to_list() == [1.0]
+
+
+def test_joined_reader_left_join():
+    left = SimpleReader(
+        [{"k": "1", "x": 1.0}, {"k": "2", "x": 2.0}]
+    )
+    right = SimpleReader([{"k": "1", "z": "hi"}])
+    fx = FeatureBuilder(ft.Real, "x").as_predictor()
+    fk = FeatureBuilder(ft.ID, "k").as_predictor()
+    fz = FeatureBuilder(ft.Text, "z").as_predictor()
+    joined = JoinedReader(left, right, left_key="k")
+    ds = joined.generate_dataset([fk, fx, fz])
+    assert ds["z"].to_list() == ["hi", None]
+
+
+def test_streaming_reader_batches():
+    recs = ({"a": float(i)} for i in range(25))
+    fa = FeatureBuilder(ft.Real, "a").as_predictor()
+    batches = list(StreamingReader(recs, batch_size=10).stream([fa]))
+    assert [len(b) for b in batches] == [10, 10, 5]
+
+
+def test_testkit_generators_deterministic():
+    r1 = RandomReal.normal(1.0, 2.0, seed=7).limit(100)
+    r2 = RandomReal.normal(1.0, 2.0, seed=7).limit(100)
+    assert r1 == r2
+    sparse = RandomReal.uniform(seed=1).with_probability_of_empty(0.5).limit(1000)
+    assert 300 < sum(v is None for v in sparse) < 700
+    picks = RandomText.picklists(["a", "b"], seed=3).limit(50)
+    assert set(picks) <= {"a", "b"}
+    ds = random_dataset(
+        {
+            "x": (RandomReal.normal(seed=1), ft.Real),
+            "b": (RandomBinary(0.3, seed=2), ft.Binary),
+            "t": (RandomText.words(seed=3), ft.Text),
+        },
+        n=50,
+    )
+    assert len(ds) == 50 and set(ds.column_names()) == {"x", "b", "t"}

@@ -1,0 +1,52 @@
+"""Random hyperparameter grids.
+
+Counterpart of RandomParamBuilder (reference: core/.../impl/selector/
+RandomParamBuilder.scala): sample N param maps from per-param
+distributions - uniform/log-uniform ranges for floats, choice lists for
+discrete values.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+
+class RandomParamBuilder:
+    def __init__(self, seed: int = 42) -> None:
+        self._specs: list[tuple[str, str, Any]] = []
+        self._rng = np.random.RandomState(seed)
+
+    def uniform(self, name: str, low: float, high: float) -> "RandomParamBuilder":
+        self._specs.append((name, "uniform", (low, high)))
+        return self
+
+    def log_uniform(self, name: str, low: float, high: float) -> "RandomParamBuilder":
+        assert low > 0 and high > 0
+        self._specs.append((name, "log", (low, high)))
+        return self
+
+    def choice(self, name: str, values: Sequence) -> "RandomParamBuilder":
+        self._specs.append((name, "choice", list(values)))
+        return self
+
+    def int_uniform(self, name: str, low: int, high: int) -> "RandomParamBuilder":
+        self._specs.append((name, "int", (low, high)))
+        return self
+
+    def build(self, n: int) -> list[dict]:
+        grids = []
+        for _ in range(n):
+            p = {}
+            for name, kind, spec in self._specs:
+                if kind == "uniform":
+                    p[name] = float(self._rng.uniform(*spec))
+                elif kind == "log":
+                    lo, hi = np.log(spec[0]), np.log(spec[1])
+                    p[name] = float(np.exp(self._rng.uniform(lo, hi)))
+                elif kind == "int":
+                    p[name] = int(self._rng.randint(spec[0], spec[1] + 1))
+                else:
+                    p[name] = spec[int(self._rng.randint(len(spec)))]
+            grids.append(p)
+        return grids

@@ -1,0 +1,208 @@
+"""Seeded random feature-data generators.
+
+Counterpart of the reference testkit (reference: testkit/src/main/scala/
+com/salesforce/op/testkit/ - RandomReal.scala:45-110 uniform/normal/
+poisson, RandomText, RandomBinary, RandomIntegral, RandomList/Map/Set/
+Vector, ProbabilityOfEmpty mixin, RandomData joiner, InfiniteStream):
+deterministic generators of typed feature columns for tests and synthetic
+benchmarks.
+"""
+from __future__ import annotations
+
+import itertools
+import string
+from typing import Any, Iterator, Optional, Sequence, Type
+
+import numpy as np
+
+from ..types import feature_types as ft
+from ..types.columns import column_from_list
+from ..types.dataset import Dataset
+
+
+class RandomGenerator:
+    """Infinite seeded stream of optional values (ProbabilityOfEmpty
+    semantics: each draw is None with probability_of_empty)."""
+
+    def __init__(self, seed: int = 42, probability_of_empty: float = 0.0):
+        self.rng = np.random.RandomState(seed)
+        self.probability_of_empty = probability_of_empty
+
+    def with_probability_of_empty(self, p: float) -> "RandomGenerator":
+        self.probability_of_empty = p
+        return self
+
+    def _value(self) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            yield self.next()
+
+    def next(self) -> Any:
+        if self.probability_of_empty and self.rng.rand() < self.probability_of_empty:
+            return None
+        return self._value()
+
+    def limit(self, n: int) -> list:
+        return [self.next() for _ in range(n)]
+
+
+class RandomReal(RandomGenerator):
+    """(reference: RandomReal.scala:45-110)"""
+
+    def __init__(self, dist: str = "normal", a: float = 0.0, b: float = 1.0,
+                 seed: int = 42, probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.dist, self.a, self.b = dist, a, b
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, seed=42) -> "RandomReal":
+        return RandomReal("uniform", low, high, seed)
+
+    @staticmethod
+    def normal(mean=0.0, sigma=1.0, seed=42) -> "RandomReal":
+        return RandomReal("normal", mean, sigma, seed)
+
+    @staticmethod
+    def poisson(mean=1.0, seed=42) -> "RandomReal":
+        return RandomReal("poisson", mean, 0.0, seed)
+
+    def _value(self) -> float:
+        if self.dist == "uniform":
+            return float(self.rng.uniform(self.a, self.b))
+        if self.dist == "poisson":
+            return float(self.rng.poisson(self.a))
+        return float(self.rng.normal(self.a, self.b))
+
+
+class RandomIntegral(RandomGenerator):
+    def __init__(self, low: int = 0, high: int = 100, seed: int = 42,
+                 probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.low, self.high = low, high
+
+    def _value(self) -> int:
+        return int(self.rng.randint(self.low, self.high))
+
+
+class RandomBinary(RandomGenerator):
+    def __init__(self, probability_of_true: float = 0.5, seed: int = 42,
+                 probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.p = probability_of_true
+
+    def _value(self) -> bool:
+        return bool(self.rng.rand() < self.p)
+
+
+class RandomText(RandomGenerator):
+    """(reference: RandomText.scala - words / picklists / emails / urls...)"""
+
+    def __init__(self, kind: str = "words", domain: Sequence[str] = (),
+                 seed: int = 42, probability_of_empty: float = 0.0,
+                 n_words: int = 3, word_len: int = 8) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.kind = kind
+        self.domain = list(domain)
+        self.n_words = n_words
+        self.word_len = word_len
+
+    @staticmethod
+    def words(seed=42, n_words=3) -> "RandomText":
+        return RandomText("words", seed=seed, n_words=n_words)
+
+    @staticmethod
+    def picklists(domain: Sequence[str], seed=42) -> "RandomText":
+        return RandomText("pick", domain=domain, seed=seed)
+
+    @staticmethod
+    def emails(domain: str = "example.com", seed=42) -> "RandomText":
+        return RandomText("email", domain=[domain], seed=seed)
+
+    @staticmethod
+    def urls(seed=42) -> "RandomText":
+        return RandomText("url", seed=seed)
+
+    @staticmethod
+    def phones(seed=42) -> "RandomText":
+        return RandomText("phone", seed=seed)
+
+    @staticmethod
+    def ids(seed=42) -> "RandomText":
+        return RandomText("id", seed=seed)
+
+    def _word(self) -> str:
+        letters = string.ascii_lowercase
+        n = self.rng.randint(3, self.word_len + 1)
+        return "".join(letters[self.rng.randint(26)] for _ in range(n))
+
+    def _value(self) -> str:
+        if self.kind == "pick":
+            return self.domain[self.rng.randint(len(self.domain))]
+        if self.kind == "email":
+            return f"{self._word()}@{self.domain[0]}"
+        if self.kind == "url":
+            return f"https://{self._word()}.com/{self._word()}"
+        if self.kind == "phone":
+            return f"{self.rng.randint(200,999)}-{self.rng.randint(200,999)}-{self.rng.randint(1000,9999)}"
+        if self.kind == "id":
+            return f"id_{self.rng.randint(10**8):08d}"
+        return " ".join(self._word() for _ in range(self.n_words))
+
+
+class RandomList(RandomGenerator):
+    def __init__(self, element: RandomGenerator, min_len=0, max_len=5,
+                 seed: int = 42, probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.element = element
+        self.min_len, self.max_len = min_len, max_len
+
+    def _value(self) -> list:
+        n = self.rng.randint(self.min_len, self.max_len + 1)
+        return [v for v in (self.element.next() for _ in range(n)) if v is not None]
+
+
+class RandomSet(RandomList):
+    def _value(self) -> frozenset:
+        return frozenset(super()._value())
+
+
+class RandomMap(RandomGenerator):
+    def __init__(self, value_gen: RandomGenerator, keys: Sequence[str],
+                 seed: int = 42, probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.value_gen = value_gen
+        self.keys = list(keys)
+
+    def _value(self) -> dict:
+        out = {}
+        for k in self.keys:
+            if self.rng.rand() < 0.7:
+                v = self.value_gen.next()
+                if v is not None:
+                    out[k] = v
+        return out
+
+
+class RandomVector(RandomGenerator):
+    def __init__(self, dim: int, seed: int = 42) -> None:
+        super().__init__(seed, 0.0)
+        self.dim = dim
+
+    def _value(self) -> list:
+        return self.rng.randn(self.dim).tolist()
+
+
+def random_dataset(
+    generators: dict[str, tuple[RandomGenerator, Type[ft.FeatureType]]],
+    n: int,
+) -> Dataset:
+    """RandomData joiner analog (reference: RandomData.scala): draw n rows
+    from each named generator into one columnar Dataset."""
+    return Dataset(
+        {
+            name: column_from_list(gen.limit(n), t)
+            for name, (gen, t) in generators.items()
+        }
+    )
