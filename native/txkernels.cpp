@@ -1,0 +1,149 @@
+// Host-side native kernels for transmogrifai_tpu.
+//
+// TPU-native counterpart of the reference's JVM-side text crunching
+// (reference: mllib HashingTF murmur3 used by
+// core/.../impl/feature/OPCollectionHashingVectorizer.scala:42,76-86 and
+// the Lucene analyzers in core/.../utils/text/LuceneTextAnalyzer.scala).
+// The TPU compute path consumes dense [n, dims] hash-TF blocks; these
+// kernels produce them from raw UTF-8 string batches at C++ speed so host
+// feature extraction keeps up with device ingest on multi-million-row
+// datasets.
+//
+// Build: g++ -O3 -march=native -shared -fPIC txkernels.cpp -o libtxkernels.so
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cctype>
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, data + i * 4, 4);
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64u;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+  h1 ^= static_cast<uint32_t>(len);
+  return fmix32(h1);
+}
+
+inline bool is_token_char(uint8_t c) {
+  // \w equivalence for ASCII + any non-ASCII byte (UTF-8 continuation of
+  // letters) - mirrors the python tokenizer's [^\w]+ splitting
+  return std::isalnum(c) || c == '_' || c >= 0x80;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batch murmur3: n strings packed in `data` with n+1 `offsets`.
+void tx_murmur3_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
+                      uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32(data + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// Fused lowercase + tokenize + murmur3 + dense hash-TF accumulation.
+// strings: packed UTF-8; offsets: [n+1]; out: [n, dims] float32 (zeroed by
+// caller).  min_token_length filters like the reference TextTokenizer.
+void tx_tokenize_hash_tf(const uint8_t* data, const int64_t* offsets,
+                         int64_t n, int32_t dims, uint32_t seed,
+                         int32_t min_token_length, int32_t binary,
+                         float* out) {
+  // thread-free: caller shards rows across processes if needed
+  uint8_t token_buf[4096];
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* s = data + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    float* row = out + i * dims;
+    int64_t t = 0;
+    for (int64_t j = 0; j <= len; j++) {
+      const uint8_t c = (j < len) ? s[j] : 0;
+      if (j < len && is_token_char(c)) {
+        if (t < static_cast<int64_t>(sizeof(token_buf))) {
+          token_buf[t++] = (c < 0x80) ? static_cast<uint8_t>(std::tolower(c)) : c;
+        }
+      } else if (t > 0) {
+        if (t >= min_token_length) {
+          const uint32_t h = murmur3_32(token_buf, t, seed);
+          const int32_t idx = static_cast<int32_t>(h % static_cast<uint32_t>(dims));
+          if (binary) {
+            row[idx] = 1.0f;
+          } else {
+            row[idx] += 1.0f;
+          }
+        }
+        t = 0;
+      }
+    }
+  }
+}
+
+// Parse a packed batch of decimal strings to doubles with a validity mask
+// (fast CSV numeric ingestion; empty/invalid -> mask 0).
+void tx_parse_doubles(const uint8_t* data, const int64_t* offsets, int64_t n,
+                      double* out, uint8_t* mask) {
+  for (int64_t i = 0; i < n; i++) {
+    const char* s = reinterpret_cast<const char*>(data + offsets[i]);
+    const int64_t len = offsets[i + 1] - offsets[i];
+    if (len == 0) {
+      out[i] = 0.0;
+      mask[i] = 0;
+      continue;
+    }
+    char buf[64];
+    const int64_t m = len < 63 ? len : 63;
+    std::memcpy(buf, s, m);
+    buf[m] = 0;
+    char* end = nullptr;
+    const double v = std::strtod(buf, &end);
+    if (end == buf || (end && *end != 0 && !std::isspace(*end))) {
+      out[i] = 0.0;
+      mask[i] = 0;
+    } else {
+      out[i] = v;
+      mask[i] = 1;
+    }
+  }
+}
+
+}  // extern "C"

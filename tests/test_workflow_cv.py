@@ -1,0 +1,80 @@
+"""Workflow-level CV tests (reference: core/src/test/.../OpWorkflowCVTest.
+scala - CV equivalence and leakage protection)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector.factories import BinaryClassificationModelSelector
+from transmogrifai_tpu.selector.splitters import DataSplitter
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.dag import compute_dag, cut_dag
+
+
+def _workflow(rng, n=300, workflow_cv=False):
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "b": rng.randn(n).tolist(),
+    }
+    data["a"] = [ai + 2 * yi for ai, yi in zip(data["a"], data["y"])]
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([a, b])
+    checked = y.sanity_check(vec, remove_bad_features=True)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLogisticRegression(), [{"reg_param": r} for r in (0.001, 0.1)])
+        ],
+        splitter=DataSplitter(reserve_test_fraction=0.1),
+    )
+    pred = selector.set_input(y, checked).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    if workflow_cv:
+        wf.with_workflow_cv()
+    return wf, selector, pred
+
+
+def test_cut_dag_partitions():
+    rng = np.random.RandomState(0)
+    wf, selector, pred = _workflow(rng)
+    dag = compute_dag(wf.result_features)
+    before, during, after = cut_dag(dag, [selector])
+    assert selector in during
+    # sanity checker (direct estimator upstream of selector) moves into during
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+
+    assert any(isinstance(s, SanityChecker) for s in during)
+    assert not any(isinstance(s, SanityChecker) for l in before for s in l)
+    assert not after
+
+
+def test_workflow_cv_trains_and_selects(rng):
+    wf, selector, pred = _workflow(rng, workflow_cv=True)
+    model = wf.train()
+    md = model.stages[-1].metadata["model_selector_summary"]
+    assert md["best_model_type"] == "OpLogisticRegression"
+    assert len(md["validation_results"]) == 2
+    metrics = model.evaluate(OpBinaryClassificationEvaluator())
+    assert metrics.AuROC > 0.85
+    # CV result came through the override path
+    assert selector.best_override is not None
+    assert md["validation_metric"]["value"] == pytest.approx(
+        selector.best_override.best_metric
+    )
+
+
+def test_workflow_cv_close_to_plain_cv(rng):
+    wf1, sel1, _ = _workflow(rng, workflow_cv=False)
+    m1 = wf1.train()
+    rng2 = np.random.RandomState(42)
+    wf2, sel2, _ = _workflow(rng2, workflow_cv=True)
+    m2 = wf2.train()
+    v1 = sel1.validation_result.best_metric
+    v2 = sel2.validation_result.best_metric
+    assert abs(v1 - v2) < 0.05  # same data, same models -> similar metric

@@ -25,13 +25,19 @@ def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
     mu = (w @ X) / wsum
     var = (w @ (X * X)) / wsum - mu**2
     sd = jnp.sqrt(jnp.maximum(var, 1e-12))
-    Xs = (X - mu) / sd * (w[:, None] > 0)
     ybar = (w @ y) / wsum
 
     lam_l2 = reg * (1.0 - elastic_net)
     lam_l1 = reg * elastic_net
-    G = (Xs.T @ (Xs * w[:, None])) / wsum
-    c = (Xs.T @ (w * (y - ybar))) / wsum
+    # standardized Gram/moment derived from raw-space reductions (no [n, d]
+    # standardized temporary; see the logistic kernel for the identities)
+    XtWX = X.T @ (X * w[:, None])
+    a = w @ X
+    G = (
+        XtWX - jnp.outer(mu, a) - jnp.outer(a, mu) + wsum * jnp.outer(mu, mu)
+    ) / jnp.outer(sd, sd) / wsum
+    r = w * (y - ybar)
+    c = ((X.T @ r) - mu * r.sum()) / sd / wsum
 
     def step(beta, _):
         l1_diag = lam_l1 / (jnp.abs(beta) + 1e-3)

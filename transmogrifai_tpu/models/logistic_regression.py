@@ -47,26 +47,40 @@ def _lr_fit_kernel(
     mu = (w @ X) / wsum
     var = (w @ (X * X)) / wsum - mu**2
     sd = jnp.sqrt(jnp.maximum(var, 1e-12))
-    Xs = (X - mu) * (w[:, None] > 0) / sd  # standardized, zeroed where w=0
-
+    # Standardization is folded into the algebra instead of materializing a
+    # standardized copy of X: under vmap over (folds x grid) weight vectors a
+    # per-replica Xs would be a [B, n, d] temporary - the whole design
+    # matrix duplicated B times.  With the identities
+    #   Xs = (X - mu) D^{-1},  D = diag(sd)
+    #   Xs^T r = D^{-1} (X^T r - mu sum(r))
+    #   Xs^T W Xs = D^{-1} (X^T W X - mu a^T - a mu^T + s mu mu^T) D^{-1},
+    #     a = X^T W 1, s = 1^T W 1
+    # every step reads the SHARED X (elementwise weights fuse into the
+    # matmuls), so replicas add only O(d^2) state.
     lam_l2 = reg * (1.0 - elastic_net)
     lam_l1 = reg * elastic_net
     eps = 1e-8
 
     def step(carry, _):
-        beta, b0 = carry
-        z = Xs @ beta + b0
+        beta, b0 = carry  # beta in standardized space
+        gamma = beta / sd
+        z = X @ gamma + (b0 - mu @ gamma)
         p = jax.nn.sigmoid(z)
         wt = w * p * (1.0 - p) + eps
         resid = w * (p - y)
-        # approximate L1 via reweighted ridge: lam_l1/(|beta|+eps) diagonal
         l1_diag = lam_l1 / (jnp.abs(beta) + 1e-3)
-        g = (Xs.T @ resid) / wsum + lam_l2 * beta + l1_diag * beta
-        H = (Xs.T @ (Xs * wt[:, None])) / wsum + jnp.diag(
-            lam_l2 + l1_diag + jnp.full((d,), 1e-9)
-        )
-        g0 = resid.sum() / wsum
-        h0 = wt.sum() / wsum
+        Xr = X.T @ resid
+        sr = resid.sum()
+        g = (Xr - mu * sr) / sd / wsum + (lam_l2 + l1_diag) * beta
+        XtWX = X.T @ (X * wt[:, None])
+        a = wt @ X
+        s = wt.sum()
+        Hs = (
+            XtWX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
+        ) / jnp.outer(sd, sd) / wsum
+        H = Hs + jnp.diag(lam_l2 + l1_diag + jnp.full((d,), 1e-9))
+        g0 = sr / wsum
+        h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
         return (beta - delta, b0 - g0 / h0), None
 

@@ -121,8 +121,12 @@ class SmartTextModel(SequenceVectorizerModel):
             return helper.blocks_for(col, 0)
         assert isinstance(col, TextColumn)
         mask = col.mask
-        toks = [tokenize(v) for v in col.values]
-        arr = hashing_tf(toks, self.hash_dims, seed=self.seed)
+        from ..utils.native import tokenize_hash_tf
+
+        arr = tokenize_hash_tf(list(col.values), self.hash_dims, seed=self.seed)
+        if arr is None:  # no native lib: pure-python fallback
+            toks = [tokenize(v) for v in col.values]
+            arr = hashing_tf(toks, self.hash_dims, seed=self.seed)
         metas = [
             VectorColumnMeta(
                 parent_feature_name=feat.name,

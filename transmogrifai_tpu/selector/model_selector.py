@@ -86,6 +86,96 @@ class ModelSelector(Estimator):
         self.splitter = splitter
         self.evaluators = list(evaluators)
         self.validation_result: Optional[ValidationResult] = None
+        # workflow-level CV: when set, fit_model skips its own validation and
+        # uses this result (reference: findBestEstimator,
+        # ModelSelector.scala:113-123)
+        self.best_override: Optional[ValidationResult] = None
+
+    def find_best_estimator(
+        self, ds: Dataset, during_stages: Sequence, seed_data_prepare=None
+    ) -> ValidationResult:
+        """Workflow-level CV (reference: ModelSelector.findBestEstimator:
+        113-123 -> OpValidator in-fold DAG refit :230-256): for each fold,
+        refit every 'during' estimator (e.g. the SanityChecker) on the
+        fold's train rows only, transform both splits with the fold-fitted
+        stages, then score every candidate x grid on the fold's validation
+        rows.  Eliminates leakage from label-aware upstream estimators."""
+        import numpy as np
+
+        from ..stages.base import Estimator as _Est
+        from ..workflow.workflow import fit_and_transform_dag
+
+        label_f, vec_f = self.input_features
+        y_full = np.asarray(ds[label_f.name].values, dtype=np.float64)
+        weights = np.ones(len(y_full))
+        if self.splitter is not None:
+            prepared = self.splitter.prepare(y_full)
+            weights = prepared.weights
+            if prepared.keep_mask is not None:
+                ds = ds.take(np.nonzero(prepared.keep_mask)[0])
+                y_full = y_full[prepared.keep_mask]
+                weights = weights[prepared.keep_mask]
+
+        masks = self.validator.train_masks(y_full)
+        larger = self.validator.evaluator.larger_better
+        non_selector = [s for s in during_stages if s is not self]
+        results: dict[int, list[dict]] = {}
+        for f in range(masks.shape[0]):
+            tr_idx = np.nonzero(masks[f])[0]
+            val_idx = np.nonzero(~masks[f])[0]
+            fold_train, fold_val = ds.take(tr_idx), ds.take(val_idx)
+            if non_selector:
+                # deep-ish copy stages so full-data refit stays clean
+                stages = [s.copy() for s in non_selector]
+                for orig, cp in zip(non_selector, stages):
+                    cp.input_features = orig.input_features
+                    cp._output = orig._output
+                _, fold_train, fold_val = fit_and_transform_dag(
+                    [[s] for s in stages], fold_train, fold_val
+                )
+            Xt = np.asarray(fold_train[vec_f.name].values, dtype=np.float64)
+            yt = np.asarray(fold_train[label_f.name].values, dtype=np.float64)
+            Xv = np.asarray(fold_val[vec_f.name].values, dtype=np.float64)
+            yv = np.asarray(fold_val[label_f.name].values, dtype=np.float64)
+            wt = weights[tr_idx]
+            gi = 0
+            for est, grid in self.models:
+                for pmap in (list(grid) or [{}]):
+                    cand = est.with_params(**pmap)
+                    params = cand.fit_arrays(Xt, yt, wt)
+                    pred, raw, prob = cand.predict_arrays(params, Xv)
+                    m = self.validator._metric_of(yv, pred, raw, prob)
+                    results.setdefault(gi, []).append(
+                        {"model_type": est.model_type, "est": est,
+                         "params": dict(pmap), "metric": m}
+                    )
+                    gi += 1
+        all_results = []
+        best = None
+        for gi, fold_results in results.items():
+            mean_m = float(np.mean([r["metric"] for r in fold_results]))
+            r0 = fold_results[0]
+            all_results.append(
+                {
+                    "model_type": r0["model_type"],
+                    "model_uid": r0["est"].uid,
+                    "params": r0["params"],
+                    "metric": mean_m,
+                    "fold_metrics": [r["metric"] for r in fold_results],
+                }
+            )
+            if best is None or (mean_m > best[0] if larger else mean_m < best[0]):
+                best = (mean_m, r0["est"], r0["params"])
+        result = ValidationResult(
+            best_estimator=best[1].with_params(**best[2]),
+            best_params=best[2],
+            best_metric=best[0],
+            metric_name=self.validator.evaluator.metric_name,
+            larger_better=larger,
+            all_results=all_results,
+        )
+        self.best_override = result
+        return result
 
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
         label, vec = cols
@@ -108,7 +198,10 @@ class ModelSelector(Estimator):
                 keep = prepared.keep_mask
                 X, y, weights = X[keep], y[keep], weights[keep]
 
-        result = self.validator.validate(self.models, X, y, weights)
+        if self.best_override is not None:
+            result = self.best_override
+        else:
+            result = self.validator.validate(self.models, X, y, weights)
         self.validation_result = result
 
         # refit best on full prepared train (reference:

@@ -1,0 +1,128 @@
+"""ctypes bridge to the C++ host kernels (native/txkernels.cpp).
+
+Builds the shared library on first use (g++ is in the image) and falls back
+to the pure-python implementations when compilation is unavailable.  The
+C++ side replaces the reference's JVM text crunching (murmur3 HashingTF +
+Lucene analyzers - see native/txkernels.cpp header for citations) on the
+host side of the TPU pipeline.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "txkernels.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libtxkernels.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.tx_murmur3_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint32, ctypes.c_void_p,
+        ]
+        lib.tx_tokenize_hash_tf.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p,
+        ]
+        lib.tx_parse_doubles.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def pack_strings(values: Sequence[Optional[str]]) -> tuple[np.ndarray, np.ndarray]:
+    """Pack optional strings into (utf-8 byte buffer, offsets[n+1])."""
+    encoded = [v.encode("utf-8") if v else b"" for v in values]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    data = np.frombuffer(b"".join(encoded), dtype=np.uint8).copy()
+    if data.size == 0:
+        data = np.zeros(1, dtype=np.uint8)
+    return data, offsets
+
+
+def murmur3_batch(values: Sequence[Optional[str]], seed: int = 42) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    data, offsets = pack_strings(values)
+    out = np.zeros(len(values), dtype=np.uint32)
+    lib.tx_murmur3_batch(
+        data.ctypes.data, offsets.ctypes.data, len(values),
+        np.uint32(seed), out.ctypes.data,
+    )
+    return out
+
+
+def tokenize_hash_tf(
+    values: Sequence[Optional[str]],
+    dims: int,
+    seed: int = 42,
+    min_token_length: int = 1,
+    binary: bool = False,
+) -> Optional[np.ndarray]:
+    """Fused tokenize+hash TF; None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data, offsets = pack_strings(values)
+    out = np.zeros((len(values), dims), dtype=np.float32)
+    lib.tx_tokenize_hash_tf(
+        data.ctypes.data, offsets.ctypes.data, len(values),
+        np.int32(dims), np.uint32(seed), np.int32(min_token_length),
+        np.int32(1 if binary else 0), out.ctypes.data,
+    )
+    return out
+
+
+def parse_doubles(values: Sequence[Optional[str]]) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    data, offsets = pack_strings(values)
+    out = np.zeros(len(values), dtype=np.float64)
+    mask = np.zeros(len(values), dtype=np.uint8)
+    lib.tx_parse_doubles(
+        data.ctypes.data, offsets.ctypes.data, len(values),
+        out.ctypes.data, mask.ctypes.data,
+    )
+    return out, mask.astype(bool)

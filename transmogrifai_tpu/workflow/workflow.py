@@ -118,6 +118,7 @@ class OpWorkflow:
         self._reader = None
         self.parameters: dict[str, Any] = {}
         self._raw_feature_filter = None
+        self._workflow_cv = False
         self.blacklisted_features: list[Feature] = []
         self.blacklisted_map_keys: dict[str, list[str]] = {}
         self.rff_results: Optional[dict] = None
@@ -147,6 +148,14 @@ class OpWorkflow:
         """Attach a RawFeatureFilter run before training (reference:
         OpWorkflow.withRawFeatureFilter:523-563)."""
         self._raw_feature_filter = rff
+        return self
+
+    def with_workflow_cv(self) -> "OpWorkflow":
+        """Leakage-free workflow-level cross-validation: label-aware
+        estimators between the last upstream estimator and the model
+        selector are refit inside each fold (reference:
+        OpWorkflowCore.withWorkflowCV:108, FitStagesUtil.cutDAG:305-358)."""
+        self._workflow_cv = True
         return self
 
     # ------------------------------------------------------------------
@@ -220,7 +229,23 @@ class OpWorkflow:
             test_idx, train_idx = perm[:n_test], perm[n_test:]
             train_data, holdout = raw.take(np.sort(train_idx)), raw.take(np.sort(test_idx))
 
-        fitted, train_out, holdout_out = fit_and_transform_dag(dag, train_data, holdout)
+        if self._workflow_cv and selector is not None:
+            from .dag import cut_dag
+
+            before, during, after = cut_dag(dag, [selector])
+            fitted_before, train_mid, holdout_mid = fit_and_transform_dag(
+                before, train_data, holdout
+            )
+            selector.find_best_estimator(train_mid, during)
+            # 'during' stages execute as sequential single-stage layers:
+            # moved upstream estimators feed the selector within the cut
+            fitted_rest, train_out, holdout_out = fit_and_transform_dag(
+                [[s] for s in during] + [list(l) for l in after],
+                train_mid, holdout_mid,
+            )
+            fitted = fitted_before + fitted_rest
+        else:
+            fitted, train_out, holdout_out = fit_and_transform_dag(dag, train_data, holdout)
         model = OpWorkflowModel(
             result_features=self.result_features,
             raw_features=self.raw_features,
