@@ -1,0 +1,68 @@
+"""Tracing metrics + CV checkpoint/resume tests (aux subsystems; reference:
+utils/.../spark/OpSparkListener.scala for metrics; checkpointing is the
+TPU-pod preemption gap called out in SURVEY §5.3)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector.validator import OpCrossValidation
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_stage_metrics_collected(rng):
+    n = 100
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    vec = transmogrify([a])
+    pred = OpLogisticRegression().set_input(y, vec).get_output()
+    model = OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    sm = model.summary_json()["stageMetrics"]
+    ops = {s["operation"] for s in sm["stages"]}
+    assert "OpLogisticRegression" in ops
+    phases = {s["phase"] for s in sm["stages"]}
+    assert phases == {"fit", "transform"}
+    assert all(s["wall_s"] >= 0 for s in sm["stages"])
+    assert sm["by_operation"]
+
+
+def test_cv_checkpoint_resume(tmp_path, rng):
+    n, d = 200, 4
+    X = rng.randn(n, d)
+    y = (rng.rand(n) > 0.5).astype(float)
+    grid = [{"max_iter": 10, "reg_param": r} for r in (0.001, 0.1)]
+    path = str(tmp_path / "cv.json")
+    cv = OpCrossValidation(
+        num_folds=2, evaluator=OpBinaryClassificationEvaluator(),
+        checkpoint_path=path,
+    )
+    r1 = cv.validate([(OpLogisticRegression(), grid)], X, y)
+    assert os.path.exists(path)
+    saved = json.load(open(path))
+    assert len(saved) == 2
+
+    # resume: poison fit so any recomputation would crash -> must come
+    # entirely from the checkpoint
+    class Boom(OpLogisticRegression):
+        def fit_arrays(self, *a, **k):
+            raise AssertionError("should not refit: checkpoint resume")
+
+        fit_arrays_batched = property()
+
+    cv2 = OpCrossValidation(
+        num_folds=2, evaluator=OpBinaryClassificationEvaluator(),
+        checkpoint_path=path,
+    )
+    r2 = cv2.validate([(Boom(), grid)], X, y)
+    assert r2.best_metric == pytest.approx(r1.best_metric)
+    assert r2.best_params == r1.best_params

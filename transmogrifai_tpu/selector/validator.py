@@ -54,15 +54,49 @@ def stratified_kfold_masks(
 
 
 class OpValidator:
+    """``checkpoint_path`` enables CV-state checkpointing: each completed
+    (model, grid-point) row of fold metrics is persisted and skipped on
+    restart - the preemption-recovery story the reference delegated to
+    Spark task retry (SURVEY §5.3: on TPU pods this gap is owned here)."""
+
     def __init__(
         self,
         evaluator: OpEvaluatorBase,
         seed: int = 42,
         stratify: bool = False,
+        checkpoint_path: Optional[str] = None,
     ) -> None:
         self.evaluator = evaluator
         self.seed = seed
         self.stratify = stratify
+        self.checkpoint_path = checkpoint_path
+
+    # -- CV checkpoint ------------------------------------------------------
+    def _ckpt_load(self) -> dict:
+        if not self.checkpoint_path:
+            return {}
+        import json
+        import os
+
+        if not os.path.exists(self.checkpoint_path):
+            return {}
+        try:
+            with open(self.checkpoint_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _ckpt_save(self, done: dict) -> None:
+        if not self.checkpoint_path:
+            return
+        import json
+        import os
+
+        tmp = self.checkpoint_path + ".tmp"
+        os.makedirs(os.path.dirname(self.checkpoint_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(done, f)
+        os.replace(tmp, self.checkpoint_path)
 
     def train_masks(self, y: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -90,12 +124,26 @@ class OpValidator:
         larger = self.evaluator.larger_better
         all_results = []
         best = None  # (metric, estimator, params)
+        import json as _json
+
+        ckpt = self._ckpt_load()
+
+        def _key(est, pmap) -> str:
+            return f"{est.model_type}:{_json.dumps(pmap, sort_keys=True)}"
 
         for est, grid in models:
             grid = list(grid) or [{}]
             g = len(grid)
             metrics = np.zeros((g, k))
-            if hasattr(est, "fit_arrays_batched") and _lr_style_grid(grid):
+            done_mask = [
+                _key(est, pmap) in ckpt for pmap in grid
+            ]
+            for j, pmap in enumerate(grid):
+                if done_mask[j]:
+                    metrics[j] = np.asarray(ckpt[_key(est, pmap)])
+            if all(done_mask):
+                pass  # everything restored from checkpoint
+            elif hasattr(est, "fit_arrays_batched") and _lr_style_grid(grid):
                 # ONE vmapped fit for the whole fold x grid batch
                 W = np.repeat(masks.astype(np.float64), g, axis=0) * w[None, :]
                 regs = np.array(
@@ -122,6 +170,8 @@ class OpValidator:
                 # fold-batched path (trees): one vmapped fit per grid point
                 W = masks.astype(np.float64) * w[None, :]
                 for j, pmap in enumerate(grid):
+                    if done_mask[j]:
+                        continue
                     cand = est.with_params(**pmap)
                     fold_params = cand.fit_arrays_folds(X, y, W)
                     for f in range(k):
@@ -130,16 +180,24 @@ class OpValidator:
                             fold_params[f], X[val]
                         )
                         metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
+                    ckpt[_key(est, pmap)] = metrics[j].tolist()
+                    self._ckpt_save(ckpt)
             else:
-                for f in range(k):
-                    tr, val = masks[f], ~masks[f]
-                    for j, pmap in enumerate(grid):
-                        cand = est.with_params(**pmap)
-                        params = cand.fit_arrays(
-                            X[tr], y[tr], w[tr]
-                        )
+                for j, pmap in enumerate(grid):
+                    if done_mask[j]:
+                        continue
+                    cand = est.with_params(**pmap)
+                    for f in range(k):
+                        tr, val = masks[f], ~masks[f]
+                        params = cand.fit_arrays(X[tr], y[tr], w[tr])
                         pred, raw, prob = cand.predict_arrays(params, X[val])
                         metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
+                    ckpt[_key(est, pmap)] = metrics[j].tolist()
+                    self._ckpt_save(ckpt)
+            if not all(done_mask):
+                for j, pmap in enumerate(grid):
+                    ckpt[_key(est, pmap)] = metrics[j].tolist()
+                self._ckpt_save(ckpt)
             mean_metrics = metrics.mean(axis=1)
             for j, pmap in enumerate(grid):
                 all_results.append(
@@ -184,8 +242,9 @@ class OpCrossValidation(OpValidator):
         evaluator: Optional[OpEvaluatorBase] = None,
         seed: int = 42,
         stratify: bool = False,
+        checkpoint_path: Optional[str] = None,
     ) -> None:
-        super().__init__(evaluator, seed, stratify)
+        super().__init__(evaluator, seed, stratify, checkpoint_path)
         self.num_folds = num_folds
 
     def train_masks(self, y: np.ndarray) -> np.ndarray:
@@ -201,8 +260,9 @@ class OpTrainValidationSplit(OpValidator):
         evaluator: Optional[OpEvaluatorBase] = None,
         seed: int = 42,
         stratify: bool = False,
+        checkpoint_path: Optional[str] = None,
     ) -> None:
-        super().__init__(evaluator, seed, stratify)
+        super().__init__(evaluator, seed, stratify, checkpoint_path)
         self.train_ratio = train_ratio
 
     def train_masks(self, y: np.ndarray) -> np.ndarray:

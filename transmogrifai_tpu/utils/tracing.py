@@ -1,0 +1,110 @@
+"""Per-stage execution metrics.
+
+Counterpart of OpSparkListener / AppMetrics / StageMetrics (reference:
+utils/.../spark/OpSparkListener.scala:56-161): structured per-stage
+wall-clock + row-count records accumulated during fit/transform, with the
+same structured-log-line style, retrievable at the end of a run.  The JAX
+profiler (jax.profiler.trace) fills the deep-tracing role the Spark UI
+played; ``profile_to`` wraps a block with an xplane dump.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+log = logging.getLogger("transmogrifai_tpu.metrics")
+
+LOG_PREFIX = "op_stage_metrics"
+
+
+@dataclass
+class StageMetrics:
+    stage_uid: str
+    operation: str
+    phase: str  # 'fit' | 'transform'
+    wall_s: float
+    n_rows: int
+    extra: dict = field(default_factory=dict)
+
+    def log_line(self) -> str:
+        kv = {
+            "uid": self.stage_uid,
+            "op": self.operation,
+            "phase": self.phase,
+            "wall_s": f"{self.wall_s:.4f}",
+            "rows": self.n_rows,
+            **self.extra,
+        }
+        return LOG_PREFIX + " " + " ".join(f"{k}={v}" for k, v in kv.items())
+
+    def to_json(self) -> dict:
+        return {
+            "stage_uid": self.stage_uid,
+            "operation": self.operation,
+            "phase": self.phase,
+            "wall_s": self.wall_s,
+            "n_rows": self.n_rows,
+            **self.extra,
+        }
+
+
+@dataclass
+class AppMetrics:
+    """Whole-run accumulation (reference: AppMetrics, OpSparkListener.scala:
+    133-161)."""
+
+    stages: list[StageMetrics] = field(default_factory=list)
+    start_time: float = field(default_factory=time.time)
+
+    def record(self, m: StageMetrics) -> None:
+        self.stages.append(m)
+        log.info(m.log_line())
+
+    @contextlib.contextmanager
+    def timed(self, stage, phase: str, n_rows: int) -> Iterator[None]:
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.record(
+                StageMetrics(
+                    stage_uid=stage.uid,
+                    operation=stage.operation_name,
+                    phase=phase,
+                    wall_s=time.time() - t0,
+                    n_rows=n_rows,
+                )
+            )
+
+    @property
+    def total_wall_s(self) -> float:
+        return time.time() - self.start_time
+
+    def by_operation(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for m in self.stages:
+            out[m.operation] = out.get(m.operation, 0.0) + m.wall_s
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def to_json(self) -> dict:
+        return {
+            "total_wall_s": self.total_wall_s,
+            "stages": [m.to_json() for m in self.stages],
+            "by_operation": self.by_operation(),
+        }
+
+
+@contextlib.contextmanager
+def profile_to(path: Optional[str]) -> Iterator[None]:
+    """Wrap a block in a JAX profiler trace (xplane dump readable by
+    tensorboard/xprof) when ``path`` is set."""
+    if not path:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(path):
+        yield

@@ -66,15 +66,26 @@ def fit_and_transform_dag(
     dag: Sequence[Layer],
     train: Dataset,
     holdout: Optional[Dataset] = None,
+    metrics=None,
 ) -> tuple[list[PipelineStage], Dataset, Optional[Dataset]]:
     """Fold layers fit->transform (reference: FitStagesUtil.
-    fitAndTransformDAG:213-240, fitAndTransformLayer:254-293)."""
+    fitAndTransformDAG:213-240, fitAndTransformLayer:254-293).  ``metrics``
+    (utils.tracing.AppMetrics) records per-stage wall clock like the
+    reference's OpSparkListener."""
+    import contextlib
+
+    def timed(stage, phase, n):
+        if metrics is None:
+            return contextlib.nullcontext()
+        return metrics.timed(stage, phase, n)
+
     fitted: list[PipelineStage] = []
     for layer in dag:
         layer_models: list[Transformer] = []
         for stage in layer:
             if isinstance(stage, Estimator):
-                model = stage.fit(train)
+                with timed(stage, "fit", len(train)):
+                    model = stage.fit(train)
                 if stage.has_test_eval and holdout is not None and len(holdout):
                     try:
                         model.evaluate_model(holdout)  # type: ignore[attr-defined]
@@ -86,7 +97,8 @@ def fit_and_transform_dag(
             else:
                 raise TypeError(f"stage {stage.uid} is neither Transformer nor Estimator")
         for model in layer_models:
-            train = model.transform(train)
+            with timed(model, "transform", len(train)):
+                train = model.transform(train)
             if holdout is not None and len(holdout):
                 holdout = model.transform(holdout)
         fitted.extend(layer_models)
@@ -205,6 +217,9 @@ class OpWorkflow:
     # ------------------------------------------------------------------
     def train(self) -> "OpWorkflowModel":
         """(reference: OpWorkflow.train:332-357)"""
+        from ..utils.tracing import AppMetrics
+
+        app_metrics = AppMetrics()
         t0 = time.time()
         raw = self.generate_raw_data()
         dag = compute_dag(self.result_features)
@@ -234,18 +249,20 @@ class OpWorkflow:
 
             before, during, after = cut_dag(dag, [selector])
             fitted_before, train_mid, holdout_mid = fit_and_transform_dag(
-                before, train_data, holdout
+                before, train_data, holdout, metrics=app_metrics
             )
             selector.find_best_estimator(train_mid, during)
             # 'during' stages execute as sequential single-stage layers:
             # moved upstream estimators feed the selector within the cut
             fitted_rest, train_out, holdout_out = fit_and_transform_dag(
                 [[s] for s in during] + [list(l) for l in after],
-                train_mid, holdout_mid,
+                train_mid, holdout_mid, metrics=app_metrics,
             )
             fitted = fitted_before + fitted_rest
         else:
-            fitted, train_out, holdout_out = fit_and_transform_dag(dag, train_data, holdout)
+            fitted, train_out, holdout_out = fit_and_transform_dag(
+                dag, train_data, holdout, metrics=app_metrics
+            )
         model = OpWorkflowModel(
             result_features=self.result_features,
             raw_features=self.raw_features,
@@ -257,6 +274,7 @@ class OpWorkflow:
         )
         model._train_data_cache = train_out
         model._holdout_data_cache = holdout_out
+        model.app_metrics = app_metrics
         return model
 
     def _find_selector(self, dag: Sequence[Layer]):
@@ -377,7 +395,7 @@ class OpWorkflowModel:
         return ModelInsights.from_model(self, feature)
 
     def summary_json(self) -> dict:
-        return {
+        out = {
             "stages": [
                 {
                     "uid": s.uid,
@@ -389,6 +407,10 @@ class OpWorkflowModel:
             ],
             "trainTimeSeconds": self.train_time_s,
         }
+        metrics = getattr(self, "app_metrics", None)
+        if metrics is not None:
+            out["stageMetrics"] = metrics.to_json()
+        return out
 
     def summary(self) -> str:
         return json.dumps(self.summary_json(), indent=2, default=str)
