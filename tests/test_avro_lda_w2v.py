@@ -1,0 +1,85 @@
+"""Avro/Parquet readers + LDA + Word2Vec tests."""
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.models.unsupervised import OpLDA, OpWord2Vec
+from transmogrifai_tpu.readers.avro_reader import (
+    AvroReader,
+    ParquetReader,
+    read_avro_records,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import ListColumn, VectorColumn
+from transmogrifai_tpu.types.dataset import Dataset
+from transmogrifai_tpu.types.vector_metadata import VectorMetadata
+
+PASSENGER_AVRO = "/root/reference/test-data/PassengerDataAll.avro"
+PASSENGER_PARQUET = (
+    "/root/reference/test-data/BigPassengerWithHeader.parquet"
+)
+
+
+@pytest.mark.skipif(not os.path.exists(PASSENGER_AVRO), reason="no avro data")
+def test_avro_reader_titanic():
+    schema, records = read_avro_records(PASSENGER_AVRO)
+    assert schema["name"] == "Passenger"
+    assert len(records) == 891
+    assert records[0]["Name"].startswith("Braund")
+    surv = FeatureBuilder(ft.RealNN, "Survived").as_response()
+    age = FeatureBuilder(ft.Real, "Age").as_predictor()
+    sex = FeatureBuilder(ft.PickList, "Sex").as_predictor()
+    ds = AvroReader(PASSENGER_AVRO).generate_dataset([surv, age, sex])
+    assert len(ds) == 891
+    assert set(v for v in ds["Sex"].values if v) == {"male", "female"}
+    assert abs(np.nanmean([v for v in ds["Age"].to_list() if v]) - 29.7) < 0.5
+
+
+@pytest.mark.skipif(
+    not os.path.exists(PASSENGER_PARQUET), reason="no parquet data"
+)
+def test_parquet_reader():
+    surv = FeatureBuilder(ft.RealNN, "survived").as_response()
+    ds = ParquetReader(PASSENGER_PARQUET).generate_dataset([surv])
+    assert len(ds) > 0
+
+
+def test_lda_separates_topics(rng):
+    # two disjoint vocab halves -> topics should specialize
+    n, v, k = 60, 20, 2
+    counts = np.zeros((n, v), dtype=np.float32)
+    for i in range(n):
+        half = i % 2
+        idx = rng.randint(0, v // 2, size=20) + half * (v // 2)
+        np.add.at(counts[i], idx, 1.0)
+    ds = Dataset({"vec": VectorColumn(counts, VectorMetadata("vec", tuple()))})
+    f = FeatureBuilder(ft.OPVector, "vec").as_predictor()
+    model = OpLDA(k=k, max_iter=20).set_input(f).fit(ds)
+    out = model.transform(ds)[model.output_name]
+    theta = out.values
+    assert theta.shape == (n, k)
+    np.testing.assert_allclose(theta.sum(axis=1), 1.0, atol=1e-3)
+    # same-parity docs should cluster on the same dominant topic
+    dom = theta.argmax(axis=1)
+    assert (dom[::2] == dom[0]).mean() > 0.9
+    assert (dom[1::2] == dom[1]).mean() > 0.9
+    assert dom[0] != dom[1]
+
+
+def test_word2vec_embeds_cooccurring_words(rng):
+    docs = []
+    for i in range(200):
+        if i % 2 == 0:
+            docs.append(("cat", "dog", "pet", "animal"))
+        else:
+            docs.append(("car", "road", "drive", "engine"))
+    ds = Dataset({"toks": ListColumn(docs, ft.TextList)})
+    f = FeatureBuilder(ft.TextList, "toks").as_predictor()
+    est = OpWord2Vec(vector_size=16, min_count=2, steps=800, batch=64)
+    model = est.set_input(f).fit(ds)
+    out = model.transform(ds)[model.output_name]
+    assert out.values.shape == (200, 16)
+    sims = dict(model.similar_words("cat", top_k=3))
+    assert set(sims) & {"dog", "pet", "animal"}
