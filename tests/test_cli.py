@@ -1,0 +1,47 @@
+"""CLI project generator test (reference: cli/src/test/.../CliFullCycleTest
+- generate then actually run the generated project)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.cli import generate
+
+
+@pytest.fixture
+def csv_file(tmp_path, rng):
+    n = 200
+    path = tmp_path / "data.csv"
+    with open(path, "w") as f:
+        f.write("y,x1,x2,cat\n")
+        for i in range(n):
+            x1, x2 = rng.randn(), rng.randn()
+            y = int(x1 + 0.5 * x2 + 0.3 * rng.randn() > 0)
+            cat = "a" if rng.rand() > 0.5 else "b"
+            f.write(f"{y},{x1:.4f},{x2:.4f},{cat}\n")
+    return str(path)
+
+
+def test_generate_and_run_project(tmp_path, csv_file):
+    out = tmp_path / "proj"
+    main_py = generate(csv_file, response="y", name="TestApp", output=str(out))
+    assert os.path.exists(main_py)
+    assert os.path.exists(out / "README.md")
+    src = open(main_py).read()
+    assert "BinaryClassificationModelSelector" in src
+    assert "as_response()" in src
+
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": "/root/repo",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, main_py], capture_output=True, text=True,
+        timeout=500, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Selected model" in proc.stdout

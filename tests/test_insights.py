@@ -1,0 +1,76 @@
+"""ModelInsights + LOCO + correlation record insights tests (reference:
+ModelInsightsTest, RecordInsightsLOCOTest)."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401
+from transmogrifai_tpu import Dataset, FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.insights.loco import RecordInsightsCorr, RecordInsightsLOCO
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+
+
+@pytest.fixture
+def fitted(rng):
+    n = 300
+    data = {
+        "y": [],
+        "strong": [],
+        "weak": [],
+    }
+    strong = rng.randn(n)
+    weak = rng.randn(n)
+    y = (strong + 0.1 * weak + 0.3 * rng.randn(n) > 0).astype(float)
+    data = {"y": y.tolist(), "strong": strong.tolist(), "weak": weak.tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fs = FeatureBuilder(ft.Real, "strong").as_predictor()
+    fw = FeatureBuilder(ft.Real, "weak").as_predictor()
+    vec = transmogrify([fs, fw])
+    pred = OpLogisticRegression(reg_param=0.01).set_input(fy, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    model = wf.train()
+    return model, vec, pred
+
+
+def test_model_insights_pretty_and_json(fitted):
+    model, vec, pred = fitted
+    ins = model.model_insights()
+    j = ins.to_json()
+    assert j["feature_insights"] == []  # no sanity checker in this flow
+    text = ins.pretty()
+    assert isinstance(text, str)
+
+
+def test_loco_ranks_strong_feature(fitted):
+    model, vec, pred = fitted
+    predictor_model = next(
+        s for s in model.stages if hasattr(s, "model_params")
+    )
+    scored = model.score()
+    loco = RecordInsightsLOCO(predictor_model, top_k=4).set_input(vec)
+    out = loco.transform(scored)[loco.output_name]
+    row = out.values[0]
+    # the 'strong' value column should dominate |delta| for most rows
+    n_dominant = 0
+    for r in out.values:
+        top_name = max(r, key=lambda k: abs(r[k]))
+        if "strong" in top_name:
+            n_dominant += 1
+    assert n_dominant > len(out.values) * 0.7
+
+
+def test_corr_insights_agree_with_loco_direction(fitted):
+    model, vec, pred = fitted
+    predictor_model = next(
+        s for s in model.stages if hasattr(s, "model_params")
+    )
+    scored = model.score()
+    corr = RecordInsightsCorr(predictor_model, top_k=4).set_input(vec)
+    out = corr.transform(scored)[corr.output_name]
+    n_dominant = sum(
+        1
+        for r in out.values
+        if "strong" in max(r, key=lambda k: abs(r[k]))
+    )
+    assert n_dominant > len(out.values) * 0.7

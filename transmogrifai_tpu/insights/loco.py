@@ -20,6 +20,51 @@ from ..types.dataset import Dataset
 from ..types.feature_types import OPVector, TextMap
 
 
+class RecordInsightsCorr(Transformer):
+    """Correlation-based record insights (reference: core/.../impl/insights/
+    RecordInsightsCorr.scala): per-row contribution of column j approximated
+    as corr(feature_j, score) * standardized deviation of x_ij - one pass
+    of columnar moments, no rescoring."""
+
+    input_types = [OPVector]
+    output_type = TextMap
+
+    def __init__(self, model: PredictorModel, top_k: int = 20, **kw) -> None:
+        super().__init__(**kw)
+        self.model = model
+        self.top_k = top_k
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (vec,) = cols
+        assert isinstance(vec, VectorColumn)
+        X = np.asarray(vec.values, dtype=np.float64)
+        n, d = X.shape
+        est, params = self.model.estimator_ref, self.model.model_params
+        pred, raw, prob = est.predict_arrays(params, X)
+        score = (
+            prob[:, 1]
+            if prob is not None and prob.shape[1] == 2
+            else pred
+        )
+        mu = X.mean(axis=0)
+        sd = X.std(axis=0) + 1e-12
+        s_mu, s_sd = score.mean(), score.std() + 1e-12
+        corr = ((X - mu) * (score - s_mu)[:, None]).mean(axis=0) / (sd * s_sd)
+        contrib = corr[None, :] * (X - mu) / sd  # [n, d]
+        names = vec.metadata.column_names() if vec.metadata.size == d else [
+            str(j) for j in range(d)
+        ]
+        k = min(self.top_k, d)
+        top_idx = np.argsort(-np.abs(contrib), axis=1)[:, :k]
+        return MapColumn(
+            [
+                {names[j]: float(contrib[i, j]) for j in top_idx[i]}
+                for i in range(n)
+            ],
+            TextMap,
+        )
+
+
 class RecordInsightsLOCO(Transformer):
     """Input: the feature vector; carries a fitted predictor model.  Output:
     per-row {column_name: delta} map of the top-K largest prediction moves."""
