@@ -1,0 +1,128 @@
+"""Local (engine-free) scoring: numpy predict parity + row scorer.
+
+Mirrors the reference's local-scoring test (reference: local/src/test/scala/
+com/salesforce/op/local/OpWorkflowModelLocalTest.scala): the compiled
+dict->dict function must agree with batch scoring through the full engine.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.examples.titanic import TITANIC_CSV, titanic_workflow
+from transmogrifai_tpu.local import LocalScorer, score_function
+from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.mlp import OpMultilayerPerceptronClassifier
+from transmogrifai_tpu.models.naive_bayes import OpNaiveBayes
+from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+from transmogrifai_tpu.models.trees import (
+    OpGBTClassifier,
+    OpGBTRegressor,
+    OpRandomForestClassifier,
+    OpRandomForestRegressor,
+)
+
+needs_data = pytest.mark.skipif(
+    not os.path.exists(TITANIC_CSV), reason="titanic csv not available"
+)
+
+
+CLS_MODELS = [
+    OpLogisticRegression(),
+    OpRandomForestClassifier(num_trees=5, max_depth=3),
+    OpGBTClassifier(num_trees=5, max_depth=3),
+    OpNaiveBayes(),
+    OpMultilayerPerceptronClassifier(hidden_layers=(4,), max_iter=20),
+]
+REG_MODELS = [
+    OpLinearRegression(),
+    OpRandomForestRegressor(num_trees=5, max_depth=3),
+    OpGBTRegressor(num_trees=5, max_depth=3),
+    OpGeneralizedLinearRegression(),
+]
+
+
+@pytest.mark.parametrize(
+    "est", CLS_MODELS, ids=[type(m).__name__ for m in CLS_MODELS]
+)
+def test_numpy_predict_parity_classification(est, rng):
+    X = rng.randn(200, 6)
+    X[:, 3:] = np.abs(X[:, 3:])  # NB wants non-negative-ish inputs
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(200) > 0).astype(float)
+    params = est.fit_arrays(X, y)
+    pred_j, raw_j, prob_j = est.predict_arrays(params, X)
+    pred_n, raw_n, prob_n = est.predict_arrays_np(params, X)
+    np.testing.assert_allclose(pred_j, pred_n, atol=1e-5)
+    if prob_j is not None:
+        np.testing.assert_allclose(prob_j, prob_n, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "est", REG_MODELS, ids=[type(m).__name__ for m in REG_MODELS]
+)
+def test_numpy_predict_parity_regression(est, rng):
+    X = rng.randn(200, 6)
+    y = X[:, 0] - 2.0 * X[:, 1] + 0.1 * rng.randn(200)
+    params = est.fit_arrays(X, y)
+    pred_j, _, _ = est.predict_arrays(params, X)
+    pred_n, _, _ = est.predict_arrays_np(params, X)
+    np.testing.assert_allclose(pred_j, pred_n, rtol=1e-4, atol=1e-5)
+
+
+@needs_data
+def test_local_scorer_titanic_parity_and_latency():
+    wf, survived, prediction = titanic_workflow(reserve_test_fraction=0.0)
+    model = wf.train()
+
+    import csv
+
+    fields = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
+              "parCh", "ticket", "fare", "cabin", "embarked"]
+    with open(TITANIC_CSV) as f:
+        rows = [dict(zip(fields, r)) for r in csv.reader(f)]
+
+    def to_record(row):
+        num = lambda v: None if v in (None, "") else float(v)
+        return {
+            "pClass": row["pClass"] or None,
+            "name": row["name"] or None,
+            "sex": row["sex"] or None,
+            "age": num(row["age"]),
+            "sibSp": num(row["sibSp"]),
+            "parCh": num(row["parCh"]),
+            "ticket": row["ticket"] or None,
+            "fare": num(row["fare"]),
+            "cabin": row["cabin"] or None,
+            "embarked": row["embarked"] or None,
+            "survived": num(row["survived"]),
+        }
+
+    records = [to_record(r) for r in rows[:50]]
+
+    scorer = score_function(model)
+    assert isinstance(scorer, LocalScorer)
+
+    # batch parity vs the engine path
+    local_out = scorer.score_batch(records)
+    engine_fn = model.score_function()
+    for rec, loc in zip(records[:10], local_out[:10]):
+        eng = engine_fn(rec)
+        le, ee = loc[prediction.name], eng[prediction.name]
+        assert le["prediction"] == ee["prediction"]
+        assert abs(le["probability_1"] - ee["probability_1"]) < 1e-5
+
+    # per-record call works and is fast enough for serving loops
+    t0 = time.perf_counter()
+    out = [scorer(r) for r in records]
+    per_rec_ms = (time.perf_counter() - t0) / len(records) * 1e3
+    assert len(out) == len(records)
+    assert per_rec_ms < 100, f"local scoring too slow: {per_rec_ms:.1f}ms"
+
+    # streaming path
+    streamed = list(scorer.score_stream(iter(records), batch_size=16))
+    assert len(streamed) == len(records)
+    assert streamed[0][prediction.name]["prediction"] == local_out[0][
+        prediction.name
+    ]["prediction"]

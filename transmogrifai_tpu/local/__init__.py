@@ -1,0 +1,4 @@
+"""Engine-free local scoring (reference ``local`` module analog)."""
+from .scorer import LocalScorer, score_function
+
+__all__ = ["LocalScorer", "score_function"]

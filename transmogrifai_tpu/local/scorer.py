@@ -1,0 +1,94 @@
+"""Engine-free local scoring of a fitted workflow.
+
+Counterpart of the reference's ``local`` module (reference: local/.../
+OpWorkflowModelLocal.scala:30-120, OpWorkflowRunnerLocal) which compiles a
+fitted Spark pipeline into a plain ``Map[String, Any] => Map[String, Any]``
+function: OP stages score through the row-level ``transformKeyValue``
+interface and Spark-wrapped models run through MLeap's local runtime.
+
+The TPU-native analog needs neither Spark nor MLeap: every stage already
+transforms host-side numpy columns, and every predictor exposes a pure-numpy
+``predict_arrays_np`` path (models/base.py), so "local" here means:
+
+* the scoring DAG is resolved ONCE at construction;
+* predictor stages are swapped to their numpy predict path - no JAX
+  dispatch, no device transfer, per-record latency is pure python/numpy;
+* records score one dict at a time (``__call__``) or as micro-batches
+  (``score_batch``) - the same row-level contract as the reference's
+  scoreFunction, usable for request/response serving or streaming loops.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterable, Mapping, Optional, Sequence
+
+from ..models.base import PredictorModel
+from ..types.columns import column_from_list
+from ..types.dataset import Dataset
+from ..workflow.workflow import OpWorkflowModel, apply_transformations_dag
+
+
+class LocalScorer:
+    """Compiled dict->dict scorer over a fitted OpWorkflowModel."""
+
+    def __init__(self, model: OpWorkflowModel) -> None:
+        self.raw_features = tuple(
+            f for f in model.raw_features
+            if not any(f.name == b.name for b in model.blacklisted_features)
+        )
+        self.result_features = tuple(model.result_features)
+        # shallow-copy the DAG so flipping prefer_numpy never mutates the
+        # model object the caller still holds
+        dag = model._dag()
+        self._dag = []
+        for layer in dag:
+            new_layer = []
+            for stage in layer:
+                if isinstance(stage, PredictorModel):
+                    stage = copy.copy(stage)
+                    stage.prefer_numpy = True
+                new_layer.append(stage)
+            self._dag.append(new_layer)
+
+    # -- scoring ------------------------------------------------------------
+    def score_batch(
+        self, records: Sequence[Mapping[str, Any]]
+    ) -> list[dict[str, Any]]:
+        """Score a micro-batch of record dicts -> list of result dicts."""
+        cols = {
+            f.name: column_from_list(
+                [r.get(f.name) for r in records], f.ftype
+            )
+            for f in self.raw_features
+        }
+        out = apply_transformations_dag(self._dag, Dataset(cols))
+        names = [f.name for f in self.result_features if f.name in out]
+        lists = {name: out[name].to_list() for name in names}
+        return [
+            {name: lists[name][i] for name in names}
+            for i in range(len(records))
+        ]
+
+    def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
+        return self.score_batch([record])[0]
+
+    def score_stream(
+        self, records: Iterable[Mapping[str, Any]], batch_size: int = 256
+    ) -> Iterable[dict[str, Any]]:
+        """Micro-batched streaming scoring (the analog of the reference's
+        StreamingScore run type scoring each DStream batch with the local
+        scoreFn, OpWorkflowRunner.scala:313-332)."""
+        batch: list[Mapping[str, Any]] = []
+        for r in records:
+            batch.append(r)
+            if len(batch) >= batch_size:
+                yield from self.score_batch(batch)
+                batch = []
+        if batch:
+            yield from self.score_batch(batch)
+
+
+def score_function(model: OpWorkflowModel) -> LocalScorer:
+    """Compile a fitted model into a reusable dict->dict scorer (reference:
+    OpWorkflowModelLocal.scoreFunction:67)."""
+    return LocalScorer(model)

@@ -40,10 +40,20 @@ class PredictorModel(Transformer):
         self.model_params = params
         self.holdout_metrics: Optional[dict] = None
 
+    #: when True, scoring uses the estimator's pure-numpy predict path -
+    #: set by the local scorer (see transmogrifai_tpu.local) to avoid
+    #: device dispatch latency on per-record scoring
+    prefer_numpy = False
+
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         vec = cols[-1]
         assert isinstance(vec, VectorColumn)
-        pred, raw, prob = self.estimator_ref.predict_arrays(
+        predict = (
+            self.estimator_ref.predict_arrays_np
+            if self.prefer_numpy
+            else self.estimator_ref.predict_arrays
+        )
+        pred, raw, prob = predict(
             self.model_params, np.asarray(vec.values, dtype=np.float64)
         )
         return PredictionColumn(pred, raw, prob)
@@ -67,6 +77,13 @@ class PredictorEstimator(Estimator):
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         raise NotImplementedError
+
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        """Pure-numpy scoring path for engine-free local serving (the analog
+        of the reference's MLeap conversion, local/.../OpWorkflowModelLocal.
+        scala:79).  Subclasses whose ``predict_arrays`` dispatches to JAX
+        override this; the default assumes predict is already host-side."""
+        return self.predict_arrays(params, X)
 
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         return None

@@ -107,5 +107,9 @@ class OpLinearRegression(PredictorEstimator):
         )
         return pred, None, None
 
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        pred = (X @ params["beta"] + params["intercept"]).astype(np.float64)
+        return pred, None, None
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         return np.abs(params["beta"])

@@ -160,5 +160,13 @@ class OpLogisticRegression(PredictorEstimator):
         )
         return np.asarray(pred), np.asarray(raw), np.asarray(prob)
 
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        z = X @ params["beta"] + params["intercept"]
+        p1 = 1.0 / (1.0 + np.exp(-np.clip(z, -500, 500)))
+        prob = np.stack([1.0 - p1, p1], axis=1)
+        raw = np.stack([-z, z], axis=1)
+        pred = (p1 > 0.5).astype(np.float64)
+        return pred, raw, prob
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         return np.abs(params["beta"])

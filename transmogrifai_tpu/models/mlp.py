@@ -126,6 +126,17 @@ class OpMultilayerPerceptronClassifier(PredictorEstimator):
         Xs = jnp.asarray((X - params["mu"]) / params["sd"], jnp.float32)
         layers = [(jnp.asarray(W), jnp.asarray(b)) for W, b in params["layers"]]
         logits = np.asarray(_forward(layers, Xs), dtype=np.float64)
+        return self._finalize_np(params, logits)
+
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        h = ((X - params["mu"]) / params["sd"]).astype(np.float64)
+        for W, b in params["layers"][:-1]:
+            h = np.maximum(h @ W + b, 0.0)
+        W, b = params["layers"][-1]
+        return self._finalize_np(params, h @ W + b)
+
+    @staticmethod
+    def _finalize_np(params, logits):
         prob = np.exp(logits - logits.max(axis=1, keepdims=True))
         prob /= prob.sum(axis=1, keepdims=True)
         pred = params["classes"][prob.argmax(axis=1)].astype(np.float64)

@@ -71,3 +71,10 @@ class OpNaiveBayes(PredictorEstimator):
         raw, prob = np.asarray(raw, np.float64), np.asarray(prob, np.float64)
         pred = params["classes"][np.argmax(prob, axis=1)].astype(np.float64)
         return pred, raw, prob
+
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        raw = (X - params["shift"]) @ params["theta"].T + params["prior"][None, :]
+        ex = np.exp(raw - raw.max(axis=1, keepdims=True))
+        prob = ex / ex.sum(axis=1, keepdims=True)
+        pred = params["classes"][np.argmax(prob, axis=1)].astype(np.float64)
+        return pred, raw, prob

@@ -269,6 +269,22 @@ def effective_max_depth(
     return max(1, min(max_depth, cap))
 
 
+def predict_tree_np(bins, heap_feature, heap_thr, heap_leaf, heap_value,
+                    max_depth: int):
+    """Pure-numpy tree traversal for engine-free local scoring (same gather
+    walk as predict_tree, no device dispatch)."""
+    n = bins.shape[0]
+    idx = np.zeros((n,), dtype=np.int64)
+    for _ in range(max_depth):
+        f = heap_feature[idx]
+        t = heap_thr[idx]
+        leaf = heap_leaf[idx]
+        row_bin = np.take_along_axis(bins, f[:, None].astype(np.int64), 1)[:, 0]
+        nxt = idx * 2 + 1 + (row_bin > t).astype(np.int64)
+        idx = np.where(leaf, idx, nxt)
+    return heap_value[idx]
+
+
 @partial(jax.jit, static_argnames=("max_depth",))
 def predict_forest(bins, heaps, max_depth: int):
     """Average normalized per-tree outputs: [n, C-ish]."""

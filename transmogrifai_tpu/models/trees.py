@@ -26,6 +26,7 @@ from .tree_kernel import (
     fit_tree,
     predict_forest,
     predict_tree,
+    predict_tree_np,
     quantile_bin_edges,
 )
 
@@ -178,6 +179,22 @@ class _RandomForest(_TreeEnsembleBase):
             return pred.astype(np.float64), prob, prob
         return out[:, 0].astype(np.float64), None, None
 
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        bins = bin_data(np.asarray(X, np.float32), params["edges"])
+        hf, ht, hl, hv = params["heaps"]
+        outs = []
+        for t in range(hf.shape[0]):
+            out = predict_tree_np(bins, hf[t], ht[t], hl[t], hv[t],
+                                  params["max_depth"])
+            w = np.maximum(out[:, 0:1], 1e-12)
+            outs.append(out[:, 1:] / w)
+        out = np.mean(outs, axis=0)
+        if self.is_classification:
+            classes = params["classes"]
+            pred = classes[np.argmax(out, axis=1)]
+            return pred.astype(np.float64), out, out
+        return out[:, 0].astype(np.float64), None, None
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         """Split-frequency importance: how often each feature splits,
         weighted by level depth (cheap stand-in for impurity-decrease
@@ -293,6 +310,21 @@ class _GBT(_TreeEnsembleBase):
         contribs = jax.vmap(one)(hf, ht, hl, hv)  # [T, n]
         F = params["f0"] + params["step_size"] * contribs.sum(axis=0)
         F = np.asarray(F, dtype=np.float64)
+        if self.is_classification:
+            p1 = 1.0 / (1.0 + np.exp(-F))
+            prob = np.stack([1.0 - p1, p1], axis=1)
+            raw = np.stack([-F, F], axis=1)
+            return (p1 > 0.5).astype(np.float64), raw, prob
+        return F, None, None
+
+    def predict_arrays_np(self, params: Any, X: np.ndarray):
+        bins = bin_data(np.asarray(X, np.float32), params["edges"])
+        hf, ht, hl, hv = params["heaps"]
+        F = np.full((len(X),), params["f0"], dtype=np.float64)
+        for t in range(hf.shape[0]):
+            out = predict_tree_np(bins, hf[t], ht[t], hl[t], hv[t],
+                                  params["max_depth"])
+            F += params["step_size"] * out[:, 1] / np.maximum(out[:, 3], 1e-12)
         if self.is_classification:
             p1 = 1.0 / (1.0 + np.exp(-F))
             prob = np.stack([1.0 - p1, p1], axis=1)

@@ -62,7 +62,7 @@ _SKIP_ATTRS = {
     "input_features", "_output", "uid", "operation_name", "params",
     "metadata", "estimator_ref", "selector", "validator", "models",
     "splitter", "evaluators", "validation_result", "fn", "predicate",
-    "model",
+    "model", "output_type", "input_types", "prefer_numpy",
 }
 
 
@@ -144,6 +144,18 @@ def load_model(path: str, workflow):
     fitted = []
     for stage_def, saved in zip(dag_stages, doc["stages"]):
         cls = _load_class(saved["class"])
+        # stages pair positionally with the code-defined workflow; estimators
+        # save their fitted-model class, so accept either an exact class match
+        # or estimator->model pairs (both carry the estimator's operation_name)
+        if (
+            type(stage_def).__name__ != cls.__name__
+            and stage_def.operation_name != saved["operation_name"]
+        ):
+            raise ValueError(
+                f"saved stage {saved['class']} does not match workflow stage "
+                f"{type(stage_def).__name__} at the same DAG position; load "
+                "requires the same code-defined workflow"
+            )
         inst = cls.__new__(cls)
         # baseline attrs from the (unfitted) DAG stage, then saved state
         inst.__dict__.update(
@@ -153,7 +165,9 @@ def load_model(path: str, workflow):
                 if k not in ("params", "metadata")
             }
         )
-        inst.uid = saved["uid"]
+        # adopt the TARGET workflow's uid so DAG substitution by uid works
+        # regardless of where the fresh build's uid counters start
+        inst.uid = stage_def.uid
         inst.operation_name = saved["operation_name"]
         inst.params = _decode(saved["params"], arrays)
         inst.metadata = _decode(saved["metadata"], arrays)
