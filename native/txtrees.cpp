@@ -1,0 +1,377 @@
+// Native histogram-based decision-tree learner: the libxgboost-equivalent
+// host library mandated by SURVEY.md §2.9/§7 step 5.  The reference's only
+// native dependency is libxgboost (C++) via ml.dmlc:xgboost4j-spark
+// (reference: core/build.gradle:27); Spark MLlib trees do the same
+// histogram aggregation in JVM code (RandomForest.scala findBestSplits).
+// This file is the TPU-framework's host-side counterpart: exact same tree
+// semantics as the jitted JAX kernels in
+// transmogrifai_tpu/models/tree_kernel.py (level-wise growth over
+// pre-binned features, flat-heap output), so fitted trees are
+// interchangeable between backends and every predict/serialize path is
+// shared.
+//
+// Layout contract (must stay in sync with tree_kernel.fit_tree):
+//   M = 2^(max_depth+1) - 1 heap slots; children of i are 2i+1 / 2i+2
+//   heap_feature [M] int32, heap_thr [M] int32 (B = "all left"),
+//   heap_leaf [M] uint8, heap_value [M, C] float (raw stat sums)
+//   routing: go_right iff node splittable && bin[row, feat] > thr
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+inline double unit_double(uint64_t h) {
+  return (double)(h >> 11) * (1.0 / 9007199254740992.0);
+}
+
+// weighted impurity + weight from accumulated stat channels.
+// kind 0 = gini (channels: w, w*1[class==c]...), 1 = variance (w, wy, wyy).
+inline void impurity(const double* s, int32_t C, int32_t kind, double* imp_w,
+                     double* w) {
+  const double ww = s[0];
+  const double sw = ww > 1e-12 ? ww : 1e-12;
+  double imp;
+  if (kind == 1) {
+    const double mean = s[1] / sw;
+    imp = s[2] / sw - mean * mean;
+  } else {
+    double acc = 0.0;
+    for (int32_t c = 1; c < C; ++c) {
+      const double p = s[c] / sw;
+      acc += p * p;
+    }
+    imp = 1.0 - acc;
+  }
+  *imp_w = imp * ww;
+  *w = ww;
+}
+
+struct TreeScratch {
+  std::vector<double> hist;        // [L, d, B, C]
+  std::vector<double> node_stats;  // [L, C]
+  std::vector<int32_t> node_of_row;
+  std::vector<float> stats_w;      // [n, C]
+  std::vector<uint8_t> active;     // [n] row weight != 0
+  std::vector<double> left, right;
+  std::vector<int32_t> best_feat, best_bin;
+  std::vector<uint8_t> split_ok;
+};
+
+void fit_one_tree(const int32_t* bins, const float* stats_row,
+                  const float* w_eff, const uint8_t* feat_mask, uint64_t seed,
+                  int64_t n, int32_t d, int32_t max_depth, int32_t B,
+                  int32_t C, int32_t impurity_kind, double min_instances,
+                  double min_info_gain, double subset_p, int32_t* hf,
+                  int32_t* ht, uint8_t* hl, float* hv, TreeScratch& ws) {
+  const int64_t M = ((int64_t)1 << (max_depth + 1)) - 1;
+  for (int64_t i = 0; i < M; ++i) {
+    hf[i] = 0;
+    ht[i] = B;
+    hl[i] = 1;
+  }
+  std::memset(hv, 0, sizeof(float) * (size_t)M * C);
+
+  ws.node_of_row.assign((size_t)n, 0);
+  ws.stats_w.resize((size_t)n * C);
+  ws.active.resize((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    const float w = w_eff[i];
+    ws.active[i] = (w != 0.0f);
+    float* dst = &ws.stats_w[(size_t)i * C];
+    const float* src = &stats_row[(size_t)i * C];
+    for (int32_t c = 0; c < C; ++c) dst[c] = src[c] * w;
+  }
+  ws.left.resize(C);
+  ws.right.resize(C);
+
+  for (int32_t level = 0; level <= max_depth; ++level) {
+    const int64_t L = (int64_t)1 << level;
+    const int64_t base = L - 1;
+    ws.hist.assign((size_t)L * d * B * C, 0.0);
+    ws.node_stats.assign((size_t)L * C, 0.0);
+
+    for (int64_t i = 0; i < n; ++i) {
+      if (!ws.active[i]) continue;
+      const int32_t node = ws.node_of_row[i];
+      const float* sw = &ws.stats_w[(size_t)i * C];
+      double* ns = &ws.node_stats[(size_t)node * C];
+      for (int32_t c = 0; c < C; ++c) ns[c] += sw[c];
+      const int32_t* br = &bins[(size_t)i * d];
+      double* nh = &ws.hist[(size_t)node * d * B * C];
+      for (int32_t j = 0; j < d; ++j) {
+        double* cell = nh + ((size_t)j * B + br[j]) * C;
+        for (int32_t c = 0; c < C; ++c) cell[c] += sw[c];
+      }
+    }
+    for (int64_t q = 0; q < L; ++q) {
+      const double* ns = &ws.node_stats[(size_t)q * C];
+      float* v = hv + (size_t)(base + q) * C;
+      for (int32_t c = 0; c < C; ++c) v[c] = (float)ns[c];
+    }
+    if (level == max_depth) break;
+
+    ws.best_feat.assign((size_t)L, 0);
+    ws.best_bin.assign((size_t)L, B);
+    ws.split_ok.assign((size_t)L, 0);
+
+    for (int64_t q = 0; q < L; ++q) {
+      const double* ns = &ws.node_stats[(size_t)q * C];
+      double node_imp, node_w;
+      impurity(ns, C, impurity_kind, &node_imp, &node_w);
+      if (node_w <= 0.0) continue;
+      double best_gain = -INFINITY;
+      int32_t bf = -1, bb = -1;
+      const double* nh = &ws.hist[(size_t)q * d * B * C];
+      for (int32_t j = 0; j < d; ++j) {
+        if (!feat_mask[j]) continue;
+        if (subset_p < 1.0) {
+          const uint64_t h = splitmix64(
+              seed ^ ((uint64_t)level * 0x100000001B3ULL) ^
+              ((uint64_t)q * 0x9E3779B1ULL) ^ (uint64_t)j);
+          if (unit_double(h) >= subset_p) continue;
+        }
+        std::fill(ws.left.begin(), ws.left.end(), 0.0);
+        const double* fh = nh + (size_t)j * B * C;
+        for (int32_t b = 0; b < B; ++b) {
+          for (int32_t c = 0; c < C; ++c) ws.left[c] += fh[(size_t)b * C + c];
+          double li, lw, ri, rw;
+          for (int32_t c = 0; c < C; ++c) ws.right[c] = ns[c] - ws.left[c];
+          impurity(ws.left.data(), C, impurity_kind, &li, &lw);
+          impurity(ws.right.data(), C, impurity_kind, &ri, &rw);
+          if (lw < min_instances || rw < min_instances) continue;
+          const double gain =
+              (node_imp - li - ri) / (node_w > 1e-12 ? node_w : 1e-12);
+          if (gain > best_gain) {
+            best_gain = gain;
+            bf = j;
+            bb = b;
+          }
+        }
+      }
+      if (bf >= 0 && std::isfinite(best_gain) && best_gain >= min_info_gain) {
+        ws.best_feat[q] = bf;
+        ws.best_bin[q] = bb;
+        ws.split_ok[q] = 1;
+        hf[base + q] = bf;
+        ht[base + q] = bb;
+        hl[base + q] = 0;
+      }
+    }
+
+    for (int64_t i = 0; i < n; ++i) {
+      if (!ws.active[i]) continue;
+      const int32_t node = ws.node_of_row[i];
+      int32_t go_right = 0;
+      if (ws.split_ok[node]) {
+        const int32_t b = bins[(size_t)i * d + ws.best_feat[node]];
+        go_right = b > ws.best_bin[node] ? 1 : 0;
+      }
+      ws.node_of_row[i] = node * 2 + go_right;
+    }
+  }
+}
+
+// walk a fitted heap for one pre-binned row -> heap index of its leaf
+inline int64_t walk_leaf(const int32_t* row_bins, const int32_t* hf,
+                         const int32_t* ht, const uint8_t* hl,
+                         int32_t max_depth, int32_t d) {
+  int64_t idx = 0;
+  for (int32_t s = 0; s < max_depth; ++s) {
+    if (hl[idx]) break;
+    const int32_t b = row_bins[hf[idx]];
+    idx = idx * 2 + 1 + (b > ht[idx] ? 1 : 0);
+  }
+  return idx;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Random-forest fit: T trees in parallel (threads), bootstrap weights per
+// tree, per-node Bernoulli(subset_p) feature subsets (Spark RF
+// featureSubsetStrategy analog; reference: OpRandomForestClassifier
+// defaults in core/.../impl/classification/OpRandomForestClassifier.scala).
+void tx_fit_forest_hist(const int32_t* bins, const float* stats_row,
+                        const float* w_row, const float* boot_w,
+                        const uint8_t* feat_masks, const uint64_t* seeds,
+                        int64_t n, int32_t d, int32_t T, int32_t max_depth,
+                        int32_t max_bins, int32_t C, int32_t impurity_kind,
+                        double min_instances, double min_info_gain,
+                        double subset_p, int32_t n_threads, int32_t* hf,
+                        int32_t* ht, uint8_t* hl, float* hv) {
+  const int64_t M = ((int64_t)1 << (max_depth + 1)) - 1;
+  int32_t workers = n_threads > 0
+                        ? n_threads
+                        : (int32_t)std::thread::hardware_concurrency();
+  workers = std::max(1, std::min(workers, T));
+  // Each worker's deepest-level histogram is 2^depth * d * B * C doubles;
+  // cap total scratch at ~2 GB (the JAX path streams trees via lax.map for
+  // the same reason - tree_kernel.fit_forest).
+  const double peak_bytes =
+      (double)((int64_t)1 << max_depth) * d * max_bins * C * sizeof(double);
+  const double budget = 2.0 * 1024.0 * 1024.0 * 1024.0;
+  if (peak_bytes * workers > budget)
+    workers = std::max(1, (int32_t)(budget / peak_bytes));
+
+  auto run = [&](int32_t t0, int32_t t1) {
+    TreeScratch ws;
+    std::vector<float> w_eff((size_t)n);
+    for (int32_t t = t0; t < t1; ++t) {
+      const float* bw = &boot_w[(size_t)t * n];
+      for (int64_t i = 0; i < n; ++i) w_eff[i] = w_row[i] * bw[i];
+      fit_one_tree(bins, stats_row, w_eff.data(),
+                   &feat_masks[(size_t)t * d], seeds[t], n, d, max_depth,
+                   max_bins, C, impurity_kind, min_instances, min_info_gain,
+                   subset_p, hf + (size_t)t * M, ht + (size_t)t * M,
+                   hl + (size_t)t * M, hv + (size_t)t * M * C, ws);
+    }
+  };
+
+  if (workers == 1) {
+    run(0, T);
+    return;
+  }
+  std::vector<std::thread> pool;
+  const int32_t chunk = (T + workers - 1) / workers;
+  for (int32_t w = 0; w < workers; ++w) {
+    const int32_t t0 = w * chunk;
+    const int32_t t1 = std::min(T, t0 + chunk);
+    if (t0 >= t1) break;
+    pool.emplace_back(run, t0, t1);
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Gradient-boosted trees: sequential Newton boosting on pre-binned data.
+// Channels per tree: [1, g, g*g, h] with variance impurity on the first
+// three (Friedman) and leaf value sum(wg)/sum(wh) — identical to the JAX
+// scan in tree_kernel / trees._GBT (reference: OpGBTClassifier /
+// OpGBTRegressor, MLlib GradientBoostedTrees logistic/squared loss).
+// F_out [n] returns the final margin on train rows (diagnostics).
+void tx_fit_gbt_hist(const int32_t* bins, const float* y, const float* w_row,
+                     int64_t n, int32_t d, int32_t T, int32_t max_depth,
+                     int32_t max_bins, int32_t is_classification,
+                     double step_size, double f0, double min_instances,
+                     double min_info_gain, int32_t* hf, int32_t* ht,
+                     uint8_t* hl, float* hv, float* F_out) {
+  const int64_t M = ((int64_t)1 << (max_depth + 1)) - 1;
+  const int32_t C = 4;
+  std::vector<double> F((size_t)n, f0);
+  std::vector<float> stats((size_t)n * C);
+  std::vector<uint8_t> mask((size_t)d, 1);
+  TreeScratch ws;
+
+  for (int32_t t = 0; t < T; ++t) {
+    for (int64_t i = 0; i < n; ++i) {
+      double g, h;
+      if (is_classification) {
+        const double pr = 1.0 / (1.0 + std::exp(-F[i]));
+        g = (double)y[i] - pr;
+        h = std::max(pr * (1.0 - pr), 1e-6);
+      } else {
+        g = (double)y[i] - F[i];
+        h = 1.0;
+      }
+      float* s = &stats[(size_t)i * C];
+      s[0] = 1.0f;
+      s[1] = (float)g;
+      s[2] = (float)(g * g);
+      s[3] = (float)h;
+    }
+    int32_t* thf = hf + (size_t)t * M;
+    int32_t* tht = ht + (size_t)t * M;
+    uint8_t* thl = hl + (size_t)t * M;
+    float* thv = hv + (size_t)t * M * C;
+    fit_one_tree(bins, stats.data(), w_row, mask.data(), 0, n, d, max_depth,
+                 max_bins, C, /*variance*/ 1, min_instances, min_info_gain,
+                 1.0, thf, tht, thl, thv, ws);
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t leaf = walk_leaf(&bins[(size_t)i * d], thf, tht, thl,
+                                     max_depth, d);
+      const float* v = &thv[(size_t)leaf * C];
+      const double denom = v[3] > 1e-12f ? (double)v[3] : 1e-12;
+      F[i] += step_size * (double)v[1] / denom;
+    }
+  }
+  if (F_out != nullptr)
+    for (int64_t i = 0; i < n; ++i) F_out[i] = (float)F[i];
+}
+
+// Batch prediction over a fitted forest: per-tree leaf walk, channel
+// normalization (out[1:]/out[0]), mean over trees. out [n, C-1].
+void tx_predict_forest_hist(const int32_t* bins, const int32_t* hf,
+                            const int32_t* ht, const uint8_t* hl,
+                            const float* hv, int64_t n, int32_t d, int32_t T,
+                            int32_t max_depth, int32_t C, float* out) {
+  const int64_t M = ((int64_t)1 << (max_depth + 1)) - 1;
+  std::memset(out, 0, sizeof(float) * (size_t)n * (C - 1));
+  for (int32_t t = 0; t < T; ++t) {
+    const int32_t* thf = hf + (size_t)t * M;
+    const int32_t* tht = ht + (size_t)t * M;
+    const uint8_t* thl = hl + (size_t)t * M;
+    const float* thv = hv + (size_t)t * M * C;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t leaf =
+          walk_leaf(&bins[(size_t)i * d], thf, tht, thl, max_depth, d);
+      const float* v = &thv[(size_t)leaf * C];
+      const float w = v[0] > 1e-12f ? v[0] : 1e-12f;
+      float* o = &out[(size_t)i * (C - 1)];
+      for (int32_t c = 1; c < C; ++c) o[c - 1] += v[c] / w;
+    }
+  }
+  const float inv = 1.0f / (float)T;
+  for (int64_t i = 0; i < (int64_t)n * (C - 1); ++i) out[i] *= inv;
+}
+
+// Per-feature quantile binning on the host (reference: Spark
+// findSplitsBySorting / xgboost hist sketch). edges [d, max_bins-1]
+// must be precomputed; emits int32 bins via branchless binary search.
+void tx_bin_data(const float* X, const float* edges, int64_t n, int32_t d,
+                 int32_t n_edges, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = &X[(size_t)i * d];
+    int32_t* orow = &out[(size_t)i * d];
+    for (int32_t j = 0; j < d; ++j) {
+      const float* e = &edges[(size_t)j * n_edges];
+      const float v = row[j];
+      if (std::isnan(v)) {
+        // numpy total order: NaN sorts last, so lower_bound(NaN) is the
+        // first NaN edge (NaN edges sit at the tail), or n_edges if none
+        int32_t lo = 0, hi = n_edges;
+        while (lo < hi) {
+          const int32_t mid = (lo + hi) >> 1;
+          if (!std::isnan(e[mid]))
+            lo = mid + 1;
+          else
+            hi = mid;
+        }
+        orow[j] = lo;
+        continue;
+      }
+      // lower_bound: first edge index with e[idx] >= v  (side="left")
+      int32_t lo = 0, hi = n_edges;
+      while (lo < hi) {
+        const int32_t mid = (lo + hi) >> 1;
+        if (e[mid] < v)
+          lo = mid + 1;
+        else
+          hi = mid;
+      }
+      orow[j] = lo;
+    }
+  }
+}
+
+}  // extern "C"

@@ -39,7 +39,18 @@ def quantile_bin_edges(X: np.ndarray, max_bins: int) -> np.ndarray:
 
 
 def bin_data(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Assign bins [n, d] int32 via per-feature searchsorted."""
+    """Assign bins [n, d] int32 via per-feature searchsorted (C++ kernel
+    when available - native/txtrees.cpp tx_bin_data, same side='left'
+    lower-bound semantics)."""
+    X = np.asarray(X, np.float32)
+    try:
+        from . import native_trees
+
+        out = native_trees.bin_data(X, edges)
+        if out is not None:
+            return out
+    except Exception:
+        pass
     n, d = X.shape
     out = np.empty((n, d), dtype=np.int32)
     for j in range(d):

@@ -13,10 +13,13 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import native_trees
 from .base import PredictorEstimator
 from .tree_kernel import (
     bin_data,
@@ -29,6 +32,23 @@ from .tree_kernel import (
     predict_tree_np,
     quantile_bin_edges,
 )
+
+
+def _resolve_backend(requested: str) -> str:
+    """'jax' | 'native' | 'auto'.  auto = the C++ host learner when no
+    accelerator is attached (local/CPU runs - the Spark-local analog) and
+    the device histogram kernels when a TPU is; TX_TREE_BACKEND overrides.
+    """
+    requested = os.environ.get("TX_TREE_BACKEND", requested)
+    if requested == "native":
+        return "native" if native_trees.available() else "jax"
+    if requested == "auto":
+        try:
+            on_cpu = jax.default_backend() == "cpu"
+        except Exception:
+            on_cpu = True
+        return "native" if (on_cpu and native_trees.available()) else "jax"
+    return "jax"
 
 
 def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:
@@ -54,10 +74,12 @@ class _TreeEnsembleBase(PredictorEstimator):
         subsampling_rate: float = 1.0,
         feature_subset_strategy: str = "auto",
         seed: int = 42,
+        backend: str = "auto",
         **kw,
     ) -> None:
         super().__init__(**kw)
         p = self.params
+        p.setdefault("backend", backend)
         p.setdefault("num_trees", num_trees)
         p.setdefault("max_depth", max_depth)
         p.setdefault("max_bins", max_bins)
@@ -106,29 +128,43 @@ class _RandomForest(_TreeEnsembleBase):
                 p["feature_subset_strategy"], d, self.is_classification
             )
         feat_masks = np.ones((T, d), dtype=bool)
-        keys = jax.vmap(jax.random.PRNGKey)(
-            jnp.asarray(rng.randint(0, 2**31 - 1, size=T))
-        )
+        seed_ints = rng.randint(0, 2**31 - 1, size=T)
         depth = effective_max_depth(
             int(p["max_depth"]), n, float(p["min_instances_per_node"])
         )
-        return edges, bins, stats, C, imp, classes, boot, feat_masks, keys, subset_p, depth
+        return (edges, bins, stats, C, imp, classes, boot, feat_masks,
+                seed_ints, subset_p, depth)
 
     def fit_arrays(self, X, y, w=None) -> Any:
         n, d = X.shape
         p = self.params
         w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
-        (edges, bins, stats, C, imp, classes, boot, feat_masks, keys,
-         subset_p, depth) = self._forest_inputs(X, y)
-        heaps = fit_forest(
-            jnp.asarray(bins), jnp.asarray(stats), jnp.asarray(w),
-            jnp.asarray(boot), jnp.asarray(feat_masks), keys,
-            max_depth=depth, max_bins=int(p["max_bins"]),
-            impurity_kind=imp, n_stats=C,
-            min_instances_per_node=float(p["min_instances_per_node"]),
-            min_info_gain=float(p["min_info_gain"]),
-            feature_subset_p=float(subset_p),
-        )
+        (edges, bins, stats, C, imp, classes, boot, feat_masks,
+         seed_ints, subset_p, depth) = self._forest_inputs(X, y)
+        backend = _resolve_backend(str(p.get("backend", "auto")))
+        if backend == "native":
+            heaps = native_trees.fit_forest(
+                bins, stats, w, boot, feat_masks,
+                seed_ints.astype(np.uint64),
+                max_depth=depth, max_bins=int(p["max_bins"]),
+                impurity_kind=imp,
+                min_instances_per_node=float(p["min_instances_per_node"]),
+                min_info_gain=float(p["min_info_gain"]),
+                feature_subset_p=float(subset_p),
+            )
+        else:
+            heaps = None
+        if heaps is None:
+            keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seed_ints))
+            heaps = fit_forest(
+                jnp.asarray(bins), jnp.asarray(stats), jnp.asarray(w),
+                jnp.asarray(boot), jnp.asarray(feat_masks), keys,
+                max_depth=depth, max_bins=int(p["max_bins"]),
+                impurity_kind=imp, n_stats=C,
+                min_instances_per_node=float(p["min_instances_per_node"]),
+                min_info_gain=float(p["min_info_gain"]),
+                feature_subset_p=float(subset_p),
+            )
         return {
             "edges": edges,
             "heaps": tuple(np.asarray(h) for h in heaps),
@@ -140,8 +176,33 @@ class _RandomForest(_TreeEnsembleBase):
         """One vmapped fit over [F, n] fold-weight masks: shared binning,
         shared bootstrap - the forest CV fan-out."""
         p = self.params
-        (edges, bins, stats, C, imp, classes, boot, feat_masks, keys,
-         subset_p, depth) = self._forest_inputs(X, y)
+        (edges, bins, stats, C, imp, classes, boot, feat_masks,
+         seed_ints, subset_p, depth) = self._forest_inputs(X, y)
+        backend = _resolve_backend(str(p.get("backend", "auto")))
+        if backend == "native":
+            W = np.asarray(W, np.float32)
+            out = []
+            for f in range(len(W)):
+                heaps_f = native_trees.fit_forest(
+                    bins, stats, W[f], boot, feat_masks,
+                    seed_ints.astype(np.uint64),
+                    max_depth=depth, max_bins=int(p["max_bins"]),
+                    impurity_kind=imp,
+                    min_instances_per_node=float(p["min_instances_per_node"]),
+                    min_info_gain=float(p["min_info_gain"]),
+                    feature_subset_p=float(subset_p),
+                )
+                if heaps_f is None:
+                    break
+                out.append({
+                    "edges": edges,
+                    "heaps": heaps_f,
+                    "classes": classes,
+                    "max_depth": depth,
+                })
+            if len(out) == len(W):
+                return out
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seed_ints))
         heaps = fit_forest_folds(
             jnp.asarray(bins), jnp.asarray(stats),
             jnp.asarray(np.asarray(W, np.float32)),
@@ -165,13 +226,19 @@ class _RandomForest(_TreeEnsembleBase):
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         bins = bin_data(np.asarray(X, np.float32), params["edges"])
-        out = np.asarray(
-            predict_forest(
-                jnp.asarray(bins),
-                tuple(jnp.asarray(h) for h in params["heaps"]),
-                max_depth=params["max_depth"],
+        out = None
+        if _resolve_backend(str(self.params.get("backend", "auto"))) == "native":
+            out = native_trees.predict_forest(
+                bins, params["heaps"], params["max_depth"]
             )
-        )
+        if out is None:
+            out = np.asarray(
+                predict_forest(
+                    jnp.asarray(bins),
+                    tuple(jnp.asarray(h) for h in params["heaps"]),
+                    max_depth=params["max_depth"],
+                )
+            )
         if self.is_classification:
             prob = out  # [n, K] mean class distributions
             classes = params["classes"]
@@ -241,11 +308,51 @@ class _GBT(_TreeEnsembleBase):
         super().__init__(num_trees=num_trees, **kw)
         self.params.setdefault("step_size", step_size)
 
+    def _fit_native(self, X, y, w, edges) -> Optional[Any]:
+        """C++ boosting path (native/txtrees.cpp tx_fit_gbt_hist); same
+        init margin / loss / Newton leaf values as the JAX scan below."""
+        p = self.params
+        n = len(y)
+        y32 = np.asarray(y, np.float32)
+        wsum = max(float(w.sum()), 1e-12)
+        if self.is_classification:
+            pbar = float(np.clip((w * y32).sum() / wsum, 1e-6, 1 - 1e-6))
+            f0 = float(np.log(pbar / (1.0 - pbar)))
+        else:
+            f0 = float((w * y32).sum() / wsum)
+        max_depth = effective_max_depth(
+            int(p["max_depth"]), n, float(p["min_instances_per_node"])
+        )
+        bins = bin_data(np.asarray(X, np.float32), edges)
+        heaps = native_trees.fit_gbt(
+            bins, y32, w,
+            num_trees=int(p["num_trees"]), max_depth=max_depth,
+            max_bins=int(p["max_bins"]),
+            is_classification=self.is_classification,
+            step_size=float(p["step_size"]), f0=f0,
+            min_instances_per_node=float(p["min_instances_per_node"]),
+            min_info_gain=float(p["min_info_gain"]),
+        )
+        if heaps is None:
+            return None
+        return {
+            "edges": edges,
+            "heaps": heaps,
+            "f0": f0,
+            "max_depth": max_depth,
+            "step_size": float(p["step_size"]),
+        }
+
     def fit_arrays(self, X, y, w=None) -> Any:
         n, d = X.shape
         p = self.params
         w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
         edges = quantile_bin_edges(X, p["max_bins"])
+        backend = _resolve_backend(str(p.get("backend", "auto")))
+        if backend == "native":
+            result = self._fit_native(X, y, w, edges)
+            if result is not None:
+                return result
         bins = jnp.asarray(bin_data(X, edges))
         yj = jnp.asarray(y, jnp.float32)
         wj = jnp.asarray(w)

@@ -18,6 +18,7 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "txkernels.cpp")
+_SRC_TREES = os.path.join(_REPO_ROOT, "native", "txtrees.cpp")
 _LIB = os.path.join(_REPO_ROOT, "native", "libtxkernels.so")
 
 _lock = threading.Lock()
@@ -26,10 +27,11 @@ _tried = False
 
 
 def _build() -> bool:
+    srcs = [s for s in (_SRC, _SRC_TREES) if os.path.exists(s)]
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
-            check=True, capture_output=True, timeout=120,
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", *srcs, "-o", _LIB],
+            check=True, capture_output=True, timeout=240,
         )
         return True
     except Exception:
@@ -42,11 +44,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
-        ):
-            if not os.path.exists(_SRC) or not _build():
+        stale = any(
+            os.path.exists(s) and os.path.getmtime(s) > os.path.getmtime(_LIB)
+            for s in (_SRC, _SRC_TREES)
+        ) if os.path.exists(_LIB) else True
+        if stale:
+            built = os.path.exists(_SRC) and _build()
+            # a stale-but-present .so is still usable if the rebuild failed
+            # (e.g. no g++ on the serving host)
+            if not built and not os.path.exists(_LIB):
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
@@ -65,8 +71,45 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
+        try:  # tree learner entry points (native/txtrees.cpp)
+            lib.tx_fit_forest_hist.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_double, ctypes.c_double,
+                ctypes.c_double, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.tx_fit_gbt_hist.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_double, ctypes.c_double, ctypes.c_double,
+                ctypes.c_double,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.tx_predict_forest_hist.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+            ]
+            lib.tx_bin_data.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+            ]
+        except AttributeError:  # stale lib without the tree symbols
+            pass
         _lib = lib
         return _lib
+
+
+def has_tree_symbols() -> bool:
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "tx_fit_forest_hist")
 
 
 def pack_strings(values: Sequence[Optional[str]]) -> tuple[np.ndarray, np.ndarray]:
