@@ -98,7 +98,10 @@ void fit_one_tree(const int32_t* bins, const float* stats_row,
   for (int32_t level = 0; level <= max_depth; ++level) {
     const int64_t L = (int64_t)1 << level;
     const int64_t base = L - 1;
-    ws.hist.assign((size_t)L * d * B * C, 0.0);
+    const bool last = (level == max_depth);
+    // the final level only emits leaf values - no split search, so no
+    // [L, d, B, C] histogram (it would be the largest one)
+    if (!last) ws.hist.assign((size_t)L * d * B * C, 0.0);
     ws.node_stats.assign((size_t)L * C, 0.0);
 
     for (int64_t i = 0; i < n; ++i) {
@@ -107,6 +110,7 @@ void fit_one_tree(const int32_t* bins, const float* stats_row,
       const float* sw = &ws.stats_w[(size_t)i * C];
       double* ns = &ws.node_stats[(size_t)node * C];
       for (int32_t c = 0; c < C; ++c) ns[c] += sw[c];
+      if (last) continue;
       const int32_t* br = &bins[(size_t)i * d];
       double* nh = &ws.hist[(size_t)node * d * B * C];
       for (int32_t j = 0; j < d; ++j) {
@@ -119,7 +123,7 @@ void fit_one_tree(const int32_t* bins, const float* stats_row,
       float* v = hv + (size_t)(base + q) * C;
       for (int32_t c = 0; c < C; ++c) v[c] = (float)ns[c];
     }
-    if (level == max_depth) break;
+    if (last) break;
 
     ws.best_feat.assign((size_t)L, 0);
     ws.best_bin.assign((size_t)L, B);
@@ -216,11 +220,13 @@ void tx_fit_forest_hist(const int32_t* bins, const float* stats_row,
                         ? n_threads
                         : (int32_t)std::thread::hardware_concurrency();
   workers = std::max(1, std::min(workers, T));
-  // Each worker's deepest-level histogram is 2^depth * d * B * C doubles;
-  // cap total scratch at ~2 GB (the JAX path streams trees via lax.map for
-  // the same reason - tree_kernel.fit_forest).
+  // Each worker's deepest histogram sits at level max_depth-1 (the final
+  // level skips the histogram): 2^(depth-1) * d * B * C doubles; cap total
+  // scratch at ~2 GB (the JAX path streams trees via lax.map for the same
+  // reason - tree_kernel.fit_forest).
+  const int32_t deepest = max_depth > 0 ? max_depth - 1 : 0;
   const double peak_bytes =
-      (double)((int64_t)1 << max_depth) * d * max_bins * C * sizeof(double);
+      (double)((int64_t)1 << deepest) * d * max_bins * C * sizeof(double);
   const double budget = 2.0 * 1024.0 * 1024.0 * 1024.0;
   if (peak_bytes * workers > budget)
     workers = std::max(1, (int32_t)(budget / peak_bytes));

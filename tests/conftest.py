@@ -23,6 +23,14 @@ try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # pallas must import while "tpu" is still a known platform (its TPU
+    # lowering registrations reject unknown platforms), so pull it in
+    # before the factory purge below
+    try:
+        from jax.experimental import pallas as _pl  # noqa: F401
+        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+    except Exception:
+        pass
     from jax._src import xla_bridge as _xb
 
     for _name in list(getattr(_xb, "_backend_factories", {})):

@@ -51,6 +51,35 @@ def _resolve_backend(requested: str) -> str:
     return "jax"
 
 
+_EDGE_SAMPLE_CAP = 1 << 19  # rows used for the quantile sketch on huge inputs
+
+
+def _sampled_bin_edges(X, max_bins: int, seed: int) -> np.ndarray:
+    """Quantile edges from a row subsample above the cap (the xgboost-hist /
+    Spark findSplits approx-sketch move; exact quantiles below the cap)."""
+    n = X.shape[0]
+    if n <= _EDGE_SAMPLE_CAP:
+        return quantile_bin_edges(X, max_bins)
+    # with-replacement draw: O(cap) and statistically equivalent for a
+    # quantile sketch (choice(replace=False) would build an O(n) permutation)
+    idx = np.random.RandomState(seed).randint(0, n, _EDGE_SAMPLE_CAP)
+    return quantile_bin_edges(np.asarray(X)[idx], max_bins)
+
+
+def _bin_for_backend(X, edges):
+    """Bin assignment routed to the fastest path: the pallas device kernel
+    when a TPU is attached (parallel/pallas_kernels.bin_matrix - stays in
+    HBM), host C++/searchsorted otherwise."""
+    try:
+        if jax.default_backend() not in ("cpu",):
+            from ..parallel.pallas_kernels import bin_matrix
+
+            return bin_matrix(np.asarray(X, np.float32), edges)
+    except Exception:
+        pass
+    return bin_data(X, edges)
+
+
 def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:
     if strategy == "all":
         return 1.0
@@ -112,8 +141,8 @@ class _RandomForest(_TreeEnsembleBase):
     def _forest_inputs(self, X, y):
         n, d = X.shape
         p = self.params
-        edges = quantile_bin_edges(X, p["max_bins"])
-        bins = bin_data(X, edges)
+        edges = _sampled_bin_edges(X, int(p["max_bins"]), int(p["seed"]))
+        bins = _bin_for_backend(X, edges)
         stats, C, imp, classes = self._stats_rows(y)
         T = 1 if self.single_tree else int(p["num_trees"])
         rng = np.random.RandomState(p["seed"])
@@ -225,7 +254,7 @@ class _RandomForest(_TreeEnsembleBase):
         ]
 
     def predict_arrays(self, params: Any, X: np.ndarray):
-        bins = bin_data(np.asarray(X, np.float32), params["edges"])
+        bins = _bin_for_backend(np.asarray(X, np.float32), params["edges"])
         out = None
         if _resolve_backend(str(self.params.get("backend", "auto"))) == "native":
             out = native_trees.predict_forest(
@@ -347,13 +376,13 @@ class _GBT(_TreeEnsembleBase):
         n, d = X.shape
         p = self.params
         w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
-        edges = quantile_bin_edges(X, p["max_bins"])
+        edges = _sampled_bin_edges(X, int(p["max_bins"]), int(p["seed"]))
         backend = _resolve_backend(str(p.get("backend", "auto")))
         if backend == "native":
             result = self._fit_native(X, y, w, edges)
             if result is not None:
                 return result
-        bins = jnp.asarray(bin_data(X, edges))
+        bins = jnp.asarray(_bin_for_backend(X, edges))
         yj = jnp.asarray(y, jnp.float32)
         wj = jnp.asarray(w)
         T = int(p["num_trees"])
@@ -406,7 +435,9 @@ class _GBT(_TreeEnsembleBase):
         }
 
     def predict_arrays(self, params: Any, X: np.ndarray):
-        bins = jnp.asarray(bin_data(np.asarray(X, np.float32), params["edges"]))
+        bins = jnp.asarray(
+            _bin_for_backend(np.asarray(X, np.float32), params["edges"])
+        )
         hf, ht, hl, hv = (jnp.asarray(h) for h in params["heaps"])
         max_depth = params["max_depth"]
 

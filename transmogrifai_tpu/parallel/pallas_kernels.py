@@ -1,0 +1,227 @@
+"""Pallas TPU kernels for the framework's hot device ops.
+
+Two kernels, both single-HBM-pass fusions of work the reference does as
+separate Spark aggregations:
+
+* ``fused_moments`` - every column statistic the SanityChecker needs
+  (count-weighted sums, squares, label cross-moments, min/max) in ONE
+  sweep of the [n, d] design matrix through VMEM (reference:
+  Statistics.colStats + corr treeAggregates, SanityChecker.scala:575,
+  633-637 - two full passes there, one here).
+* ``bin_matrix`` - quantile-edge binning of the design matrix on device
+  (reference: Spark findSplitsBySorting / xgboost hist sketch assigns
+  bins on executors).  Feeds the histogram tree learner without a host
+  round-trip; matches np.searchsorted side='left' semantics incl. NaN.
+
+Both pad to TPU tile boundaries on the wrapper side, run a sequential
+row-tile grid that accumulates into a single output block (TPU grids are
+sequential, so the output block persists across steps), and fall back to
+plain jnp off-TPU.  ``interpret=True`` is used on CPU test meshes.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+_TILE_R = 512  # rows per grid step
+_LANES = 128   # TPU lane width: pad d to a multiple
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _pad_cols(d: int) -> int:
+    return ((d + _LANES - 1) // _LANES) * _LANES
+
+
+# ---------------------------------------------------------------------------
+# fused moments
+# ---------------------------------------------------------------------------
+def _moments_kernel_body(n_ref, x_ref, y_ref, out_ref):
+    """Grid step: accumulate [8, D] stats for one row tile.
+
+    Rows: 0 x_sum, 1 x_sq_sum, 2 xy_sum, 3 x_min, 4 x_max,
+    5 y_sum (lane 0), 6 y_sq_sum (lane 0), 7 valid-row count (lane 0).
+    """
+    i = pl.program_id(0)
+    n = n_ref[0]
+    x = x_ref[:]
+    y = y_ref[:]
+    tile_r, d = x.shape
+
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (tile_r, 1), 0) + i * tile_r
+    valid = row_ids < n  # [tile_r, 1]
+    vx = jnp.where(valid, x, 0.0)
+    vy = jnp.where(valid, y, 0.0)
+
+    pos_inf = jnp.full_like(x, jnp.inf)
+    neg_inf = jnp.full_like(x, -jnp.inf)
+    x_for_min = jnp.where(valid, x, pos_inf)
+    x_for_max = jnp.where(valid, x, neg_inf)
+
+    x_sum = vx.sum(axis=0)
+    x_sq = (vx * vx).sum(axis=0)
+    xy = (vx * vy).sum(axis=0)
+    x_min = x_for_min.min(axis=0)
+    x_max = x_for_max.max(axis=0)
+    y_sum = vy.sum()
+    y_sq = (vy * vy).sum()
+    cnt = valid.astype(jnp.float32).sum()
+
+    lane0 = jax.lax.broadcasted_iota(jnp.int32, (d,), 0) == 0
+    scalars_y = jnp.where(lane0, y_sum, 0.0)
+    scalars_ysq = jnp.where(lane0, y_sq, 0.0)
+    scalars_cnt = jnp.where(lane0, cnt, 0.0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[0, :] = x_sum
+        out_ref[1, :] = x_sq
+        out_ref[2, :] = xy
+        out_ref[3, :] = x_min
+        out_ref[4, :] = x_max
+        out_ref[5, :] = scalars_y
+        out_ref[6, :] = scalars_ysq
+        out_ref[7, :] = scalars_cnt
+
+    @pl.when(i != 0)
+    def _():
+        out_ref[0, :] = out_ref[0, :] + x_sum
+        out_ref[1, :] = out_ref[1, :] + x_sq
+        out_ref[2, :] = out_ref[2, :] + xy
+        out_ref[3, :] = jnp.minimum(out_ref[3, :], x_min)
+        out_ref[4, :] = jnp.maximum(out_ref[4, :], x_max)
+        out_ref[5, :] = out_ref[5, :] + scalars_y
+        out_ref[6, :] = out_ref[6, :] + scalars_ysq
+        out_ref[7, :] = out_ref[7, :] + scalars_cnt
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _moments_pallas(x, y, interpret=False):
+    """No host-side padding: partial row tiles are masked in-kernel via the
+    n scalar; partial lane blocks read junk that the caller slices off."""
+    n, d = x.shape
+    dp = _pad_cols(d)
+    n_tiles = (n + _TILE_R - 1) // _TILE_R
+    n_arr = jnp.array([n], dtype=jnp.int32)
+
+    out = pl.pallas_call(
+        _moments_kernel_body,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((_TILE_R, dp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_R, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, dp), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, dp), jnp.float32),
+        interpret=interpret,
+    )(n_arr, x, y[:, None])
+    return out[:, :d], out[:, 0]
+
+
+def fused_moments(x, y, force_pallas: bool | None = None):
+    """One-pass column moments of [n, d] x against label y.
+
+    Returns (x_sum, x_sq_sum, xy_sum, y_sum, y_sq_sum, x_min, x_max) with
+    the same contract as the jnp reference path.  Dispatch: pallas on TPU
+    (or interpret-mode when force_pallas=True on CPU), fused jnp
+    reductions otherwise.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    use_pallas = _on_tpu() if force_pallas is None else force_pallas
+    if use_pallas and HAS_PALLAS:
+        interpret = not _on_tpu()
+        stats, col0 = _moments_pallas(x, y, interpret=interpret)
+        return (
+            stats[0], stats[1], stats[2], col0[5], col0[6],
+            stats[3], stats[4],
+        )
+    return _moments_jnp(x, y)
+
+
+@jax.jit
+def _moments_jnp(x, y):
+    """Fused jitted fallback (one multi-output XLA fusion pass)."""
+    return (
+        x.sum(axis=0), (x * x).sum(axis=0), (x * y[:, None]).sum(axis=0),
+        y.sum(), (y * y).sum(), x.min(axis=0), x.max(axis=0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# on-device quantile binning
+# ---------------------------------------------------------------------------
+def _bin_kernel_body(x_ref, edges_ref, out_ref):
+    """bins = #edges strictly below x (np.searchsorted side='left'),
+    NaN -> first NaN edge position (NaN edges sit at the tail) computed as
+    #non-NaN edges, matching numpy's total order."""
+    x = x_ref[:]                      # [tile_r, D]
+    edges = edges_ref[:]              # [E, D] (edge-major for lane layout)
+    n_edges = edges.shape[0]
+    acc = jnp.zeros(x.shape, jnp.int32)
+    nan_edge_count = jnp.zeros((1, x.shape[1]), jnp.int32)
+    for b in range(n_edges):
+        e = edges[b, :][None, :]      # [1, D]
+        acc = acc + (e < x).astype(jnp.int32)
+        nan_edge_count = nan_edge_count + (~jnp.isnan(e)).astype(jnp.int32)
+    out_ref[:] = jnp.where(jnp.isnan(x), nan_edge_count, acc)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _bin_pallas(x, edges_t, interpret=False):
+    n, d = x.shape
+    dp = _pad_cols(d)
+    n_tiles = (n + _TILE_R - 1) // _TILE_R
+    e = edges_t.shape[0]
+
+    out = pl.pallas_call(
+        _bin_kernel_body,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((_TILE_R, dp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((e, dp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_TILE_R, dp), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, d), jnp.int32),
+        interpret=interpret,
+    )(x, edges_t)
+    return out
+
+
+def bin_matrix(x, edges, force_pallas: bool | None = None):
+    """Device-side bin assignment [n, d] int32 from per-feature quantile
+    edges [d, n_edges] (same contract as tree_kernel.bin_data)."""
+    x = jnp.asarray(x, jnp.float32)
+    edges = jnp.asarray(edges, jnp.float32)
+    use_pallas = _on_tpu() if force_pallas is None else force_pallas
+    if use_pallas and HAS_PALLAS:
+        interpret = not _on_tpu()
+        return _bin_pallas(x, edges.T, interpret=interpret)
+    # jnp fallback: vectorized comparison count (same semantics)
+    lt = edges[None, :, :] < x[:, :, None]  # [n, d, E]
+    acc = lt.sum(axis=-1).astype(jnp.int32)
+    nan_edges = (~jnp.isnan(edges)).sum(axis=1).astype(jnp.int32)
+    return jnp.where(jnp.isnan(x), nan_edges[None, :], acc)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel.pallas_kernels import fused_moments
 from ..stages.base import Estimator, Transformer
 from ..types.columns import Column, NumericColumn, VectorColumn
 from ..types.dataset import Dataset
@@ -37,22 +38,6 @@ from ..utils.stats import (
     pointwise_mutual_info,
 )
 from .metadata import ColumnStatistics, SanityCheckerSummary
-
-
-@jax.jit
-def _moments_kernel(x: jnp.ndarray, y: jnp.ndarray):
-    """Single fused pass over the [n, d] design matrix: all the sums the
-    checker needs.  Under pjit with x sharded over rows this lowers the
-    reductions to psums over the mesh (the treeAggregate analog)."""
-    n = x.shape[0]
-    x_sum = x.sum(axis=0)
-    x_sq_sum = (x * x).sum(axis=0)
-    xy_sum = (x * y[:, None]).sum(axis=0)
-    y_sum = y.sum()
-    y_sq_sum = (y * y).sum()
-    x_min = x.min(axis=0)
-    x_max = x.max(axis=0)
-    return x_sum, x_sq_sum, xy_sum, y_sum, y_sq_sum, x_min, x_max
 
 
 @jax.jit
@@ -135,9 +120,11 @@ class SanityChecker(Estimator):
             x, y = x[idx], y[idx]
             n = len(y)
 
+        # one-HBM-pass pallas kernel on TPU, the jitted jnp reductions off
+        # it (parallel/pallas_kernels.fused_moments)
         xs, xss, xys, ys, yss, xmin, xmax = (
             np.asarray(v, dtype=np.float64)
-            for v in _moments_kernel(jnp.asarray(x), jnp.asarray(y))
+            for v in fused_moments(jnp.asarray(x), jnp.asarray(y))
         )
         mean = xs / n
         var = np.maximum(xss / n - mean**2, 0.0) * (n / max(n - 1, 1))

@@ -49,7 +49,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
             for s in (_SRC, _SRC_TREES)
         ) if os.path.exists(_LIB) else True
         if stale:
-            built = os.path.exists(_SRC) and _build()
+            built = any(
+                os.path.exists(s) for s in (_SRC, _SRC_TREES)
+            ) and _build()
             # a stale-but-present .so is still usable if the rebuild failed
             # (e.g. no g++ on the serving host)
             if not built and not os.path.exists(_LIB):
