@@ -59,7 +59,17 @@ def _synth_section(result: dict) -> None:
     on_tpu = jax.devices()[0].platform not in ("cpu",)
     n = int(os.environ.get("SYNTH_ROWS", 10_000_000 if on_tpu else 200_000))
     t0 = time.time()
-    X, y, meta = synthetic_design_matrix(n, text_dims=32)
+    if on_tpu:
+        # generate directly in HBM - the 10M x d matrix never crosses the
+        # host->device pipe (examples/synthetic.synthetic_design_matrix_device)
+        from transmogrifai_tpu.examples.synthetic import (
+            synthetic_design_matrix_device,
+        )
+
+        X, y, meta = synthetic_design_matrix_device(n, text_dims=32)
+        jax.block_until_ready(X)
+    else:
+        X, y, meta = synthetic_design_matrix(n, text_dims=32)
     t_gen = time.time() - t0
     cv = OpCrossValidation(
         num_folds=3, evaluator=OpBinaryClassificationEvaluator(), stratify=True

@@ -10,6 +10,27 @@ data instead of Spark rows, and jitted/sharded array computation instead
 of RDD passes.
 """
 
+import os as _os
+
+# Persistent XLA compilation cache: CV grids compile one executable per
+# static shape combination (depth/bins/iters), and on a tunneled TPU the
+# 20-40s compiles dominate small-data training wall-clock.  The disk cache
+# makes every later process (including the benchmark driver) reuse them.
+# Opt out with TX_NO_COMPILE_CACHE=1.
+if _os.environ.get("TX_NO_COMPILE_CACHE") != "1":
+    try:
+        import jax as _jax
+
+        _cache_dir = _os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            _os.path.join(_os.path.expanduser("~"), ".cache", "tx_jax_cache"),
+        )
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
 from .features.feature import Feature
 from .features.feature_builder import FeatureBuilder, from_dataframe, from_schema
 from .stages.base import Estimator, LambdaTransformer, PipelineStage, Transformer

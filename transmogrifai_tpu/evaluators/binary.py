@@ -13,8 +13,80 @@ from typing import Optional
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from ..types.columns import PredictionColumn
 from .base import EvaluationMetrics, OpEvaluatorBase
+
+
+_N_BINS = 1024  # threshold groups (mllib BinaryClassificationMetrics bins
+_HI = 32        # at ~1000 thresholds for big data the same way); 1024 =
+_LO = 32        # 32x32 so the histogram is one outer-product matmul
+
+
+@jax.jit
+def _masked_rank_metrics_kernel(scores, y, w):
+    """Batched AuROC + AuPR entirely on device: scores [B, n] (higher =
+    more positive), y [n] in {0,1}, w [B, n] 0/1 validation-row masks.
+
+    Sort-free and scatter-free (both are pathologically slow TPU
+    primitives at [B, n] scale): scores quantize to 1024 threshold bins
+    whose index splits into hi/lo digits, so each candidate's score
+    histogram is ONE [n, 32]^T @ [n, 32] outer-product matmul on the MXU.
+    AuROC is the trapezoid over the binned ROC (identical to the host
+    evaluator's tie-grouped _roc_pr_areas when binning is lossless) and
+    AuPR the step-wise area the same way.  Built so CV fan-outs never ship
+    per-fold matrix slices back to the host."""
+    smin = scores.min(axis=1, keepdims=True)
+    smax = scores.max(axis=1, keepdims=True)
+    span = jnp.maximum(smax - smin, 1e-12)
+    idx = jnp.clip(
+        jnp.floor((scores - smin) / span * (_N_BINS - 1) + 0.5).astype(
+            jnp.int32
+        ),
+        0, _N_BINS - 1,
+    )
+    hi = idx // _LO
+    lo = idx % _LO
+    hi_iota = jnp.arange(_HI, dtype=jnp.int32)
+    lo_iota = jnp.arange(_LO, dtype=jnp.int32)
+    wpos = w * y[None, :]
+    wneg = w * (1.0 - y[None, :])
+
+    def hists_of(args):
+        hi_r, lo_r, wp, wn = args
+        oh_hi = (hi_r[:, None] == hi_iota[None, :]).astype(jnp.float32)
+        oh_lo = (lo_r[:, None] == lo_iota[None, :]).astype(jnp.float32)
+        hp = (oh_hi * wp[:, None]).T @ oh_lo   # [32, 32] -> 1024 bins
+        hn = (oh_hi * wn[:, None]).T @ oh_lo
+        return hp.reshape(-1), hn.reshape(-1)
+
+    hp, hn = jax.lax.map(hists_of, (hi, lo, wpos, wneg))  # [B, 1024] asc
+    hp = hp[:, ::-1]  # descending score order
+    hn = hn[:, ::-1]
+    P = hp.sum(axis=1)
+    N = hn.sum(axis=1)
+    cum_p = jnp.cumsum(hp, axis=1)          # inclusive
+    cum_n = jnp.cumsum(hn, axis=1)
+    cum_p_excl = cum_p - hp
+    denom = jnp.maximum(P * N, 1e-12)[:, None]
+    auroc = ((hn * (cum_p_excl + 0.5 * hp)) / denom).sum(axis=1)
+    prec = cum_p / jnp.maximum(cum_p + cum_n, 1e-12)
+    aupr = (hp * prec).sum(axis=1) / jnp.maximum(P, 1e-12)
+    return auroc, aupr
+
+
+def masked_rank_metrics(scores, y, val_masks):
+    """Device wrapper: returns (auroc [B], aupr [B]) numpy arrays for B
+    candidates evaluated on their masked validation rows.  Metrics are
+    1024-threshold-binned (error O(1/1024) vs the exact host evaluator)."""
+    a, p = _masked_rank_metrics_kernel(
+        jnp.asarray(scores, jnp.float32),
+        jnp.asarray(y, jnp.float32),
+        jnp.asarray(val_masks, jnp.float32),
+    )
+    return np.asarray(a, np.float64), np.asarray(p, np.float64)
 
 
 def _roc_pr_areas(y: np.ndarray, score: np.ndarray) -> tuple[float, float]:

@@ -83,6 +83,19 @@ def synthetic_design_matrix(
         ds["height"].values[:, None],
         ds["weight"].values[:, None],
     ]
+    gender = ds["gender"].values
+    for g in _GENDERS:
+        blocks.append((gender == g).astype(np.float64)[:, None])
+    # hashed pseudo-text block: random small-vocab counts
+    if text_dims:
+        counts = rng.poisson(0.15, size=(n, text_dims)).astype(np.float64)
+        blocks.append(counts)
+    X = np.concatenate(blocks, axis=1).astype(dtype)
+    y = np.asarray(ds["survived"].values, dtype=np.float64)
+    return X, y, _design_matrix_metas(text_dims)
+
+
+def _design_matrix_metas(text_dims: int) -> VectorMetadata:
     metas = [
         VectorColumnMeta("age", "Real"),
         VectorColumnMeta("age", "Real", grouping="age",
@@ -90,23 +103,62 @@ def synthetic_design_matrix(
         VectorColumnMeta("height", "Real"),
         VectorColumnMeta("weight", "Real"),
     ]
-    gender = ds["gender"].values
     for g in _GENDERS:
-        blocks.append((gender == g).astype(np.float64)[:, None])
         metas.append(
             VectorColumnMeta("gender", "PickList", grouping="gender",
                              indicator_value=str(g))
         )
-    # hashed pseudo-text block: random small-vocab counts
-    if text_dims:
-        counts = rng.poisson(0.15, size=(n, text_dims)).astype(np.float64)
-        blocks.append(counts)
-        metas.extend(
-            VectorColumnMeta("description", "Text",
-                             descriptor_value=f"hash_{j}")
-            for j in range(text_dims)
+    metas.extend(
+        VectorColumnMeta("description", "Text", descriptor_value=f"hash_{j}")
+        for j in range(text_dims)
+    )
+    return VectorMetadata("features", tuple(metas)).reindexed()
+
+
+def synthetic_design_matrix_device(
+    n: int, seed: int = 42, text_dims: int = 32
+):
+    """Same schema as synthetic_design_matrix but generated ON DEVICE with
+    jax.random under jit: at 10M rows the host path would ship a ~1.5 GB
+    design matrix through the host->HBM pipe before a single fit; here
+    only the [n] label vector ever crosses (SURVEY §7 'hard parts:
+    10M-row ingest')."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n", "text_dims"))
+    def gen(key, n, text_dims):
+        ks = jax.random.split(key, 8)
+        age = jax.random.randint(ks[0], (n,), 1, 90).astype(jnp.float32)
+        age_present = jax.random.uniform(ks[1], (n,)) > 0.1
+        height = 170.0 + 15.0 * jax.random.normal(ks[2], (n,))
+        weight = (70.0 + 12.0 * jax.random.normal(ks[3], (n,))
+                  + 0.3 * (height - 170.0))
+        gidx = jax.random.randint(ks[4], (n,), 0, 3)  # 1 = "female"
+        logit = (
+            0.03 * (age - 45.0) - 0.02 * (height - 170.0)
+            + jnp.where(gidx == 1, 1.2, -0.4)
+            + 0.5 * jax.random.normal(ks[5], (n,))
         )
-    X = np.concatenate(blocks, axis=1).astype(dtype)
-    y = np.asarray(ds["survived"].values, dtype=np.float64)
-    meta = VectorMetadata("features", tuple(metas)).reindexed()
-    return X, y, meta
+        y = (jax.random.uniform(ks[6], (n,)) < jax.nn.sigmoid(logit))
+        age_mean = (age * age_present).sum() / jnp.maximum(
+            age_present.sum(), 1.0
+        )
+        blocks = [
+            jnp.where(age_present, age, age_mean)[:, None],
+            (~age_present).astype(jnp.float32)[:, None],
+            height[:, None],
+            weight[:, None],
+        ]
+        for g in range(3):
+            blocks.append((gidx == g).astype(jnp.float32)[:, None])
+        if text_dims:
+            counts = jax.random.poisson(
+                ks[7], 0.15, (n, text_dims)
+            ).astype(jnp.float32)
+            blocks.append(counts)
+        return jnp.concatenate(blocks, axis=1), y.astype(jnp.float32)
+
+    X, y = gen(jax.random.PRNGKey(seed), n, text_dims)
+    return X, np.asarray(y, np.float64), _design_matrix_metas(text_dims)

@@ -61,9 +61,11 @@ def _sampled_bin_edges(X, max_bins: int, seed: int) -> np.ndarray:
     if n <= _EDGE_SAMPLE_CAP:
         return quantile_bin_edges(X, max_bins)
     # with-replacement draw: O(cap) and statistically equivalent for a
-    # quantile sketch (choice(replace=False) would build an O(n) permutation)
+    # quantile sketch (choice(replace=False) would build an O(n)
+    # permutation).  X[idx] gathers BEFORE np.asarray so a device-resident
+    # X ships only the sample, not the full matrix.
     idx = np.random.RandomState(seed).randint(0, n, _EDGE_SAMPLE_CAP)
-    return quantile_bin_edges(np.asarray(X)[idx], max_bins)
+    return quantile_bin_edges(np.asarray(X[idx]), max_bins)
 
 
 def _bin_for_backend(X, edges):
@@ -71,13 +73,13 @@ def _bin_for_backend(X, edges):
     when a TPU is attached (parallel/pallas_kernels.bin_matrix - stays in
     HBM), host C++/searchsorted otherwise."""
     try:
-        if jax.default_backend() not in ("cpu",):
+        if jax.default_backend() == "tpu":
             from ..parallel.pallas_kernels import bin_matrix
 
-            return bin_matrix(np.asarray(X, np.float32), edges)
-    except Exception:
+            return bin_matrix(X, edges)  # no host round-trip: the kernel
+    except Exception:                    # jnp.asarray's a device X itself
         pass
-    return bin_data(X, edges)
+    return bin_data(np.asarray(X), edges)
 
 
 def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:

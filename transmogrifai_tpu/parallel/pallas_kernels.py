@@ -40,8 +40,8 @@ _LANES = 128   # TPU lane width: pad d to a multiple
 
 def _on_tpu() -> bool:
     try:
-        return jax.default_backend() not in ("cpu",)
-    except Exception:
+        return jax.default_backend() == "tpu"  # GPU must NOT take the
+    except Exception:                          # Mosaic TPU lowering
         return False
 
 
