@@ -1,0 +1,58 @@
+"""PredictionDeIndexer, vector column history, and the train-time
+serializability gate (reference: impl/preparators/PredictionDeIndexer.scala,
+OpVectorColumnHistory, ClosureUtils.checkSerializable at OpWorkflow:265-272).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.preparators.deindexer import PredictionDeIndexer
+from transmogrifai_tpu.types.columns import PredictionColumn, TextColumn
+from transmogrifai_tpu.types.dataset import Dataset
+from transmogrifai_tpu.types.vector_metadata import VectorColumnMeta, VectorMetadata
+
+
+def test_prediction_deindexer_roundtrip():
+    labels = np.array(["setosa", "versicolor", "setosa", "virginica",
+                       "setosa", "versicolor"], dtype=object)
+    ds = Dataset({"label": TextColumn(labels, None)})
+    # indexed by frequency desc, value asc: setosa=0, versicolor=1, virginica=2
+    pred = PredictionColumn(np.array([0.0, 1.0, 2.0, 0.0, 5.0, 1.0]), None, None)
+    est = PredictionDeIndexer()
+    model = est.fit_model([ds["label"], pred], ds)
+    out = model.transform_columns([ds["label"], pred], ds)
+    assert list(out.values[:4]) == ["setosa", "versicolor", "virginica", "setosa"]
+    assert out.values[4] is None  # unseen index -> None (NoFilter semantics)
+
+
+def test_vector_column_history():
+    meta = VectorMetadata("features", (
+        VectorColumnMeta("sex", "PickList", grouping="sex", indicator_value="female"),
+        VectorColumnMeta("age", "Real"),
+    )).reindexed()
+    hist = meta.column_history()
+    assert hist[0]["indicatorValue"] == "female"
+    assert hist[0]["index"] == 0 and hist[1]["parentFeatureName"] == "age"
+
+    class FakeFeature:
+        def history(self):
+            return {"originFeatures": ["sex"], "stages": ["OneHot_0"]}
+
+    hist = meta.column_history({"sex": FakeFeature()})
+    assert hist[0]["stages"] == ["OneHot_0"]
+
+
+def test_serializability_gate_rejects_bad_stage():
+    from transmogrifai_tpu.workflow.dag import validate_dag
+    from transmogrifai_tpu.stages.base import Transformer
+
+    class BadStage(Transformer):
+        def __init__(self):
+            super().__init__()
+            self.bad_state = object()  # not encodable by the model writer
+
+    from types import SimpleNamespace
+
+    s = BadStage()
+    s._output = SimpleNamespace(name="bad_out")
+    with pytest.raises(ValueError, match="cannot serialize|holds state"):
+        validate_dag([[s]])

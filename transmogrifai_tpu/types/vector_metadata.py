@@ -106,6 +106,29 @@ class VectorMetadata:
     def column_names(self) -> list[str]:
         return [c.column_name() for c in self.columns]
 
+    def column_history(self, features: Optional[dict] = None) -> list[dict]:
+        """Per-column lineage records (counterpart of OpVectorColumnHistory,
+        reference: features/.../utils/spark/OpVectorColumnMetadata.scala +
+        OpVectorColumnHistory): provenance fields plus, when a
+        {feature_name: Feature} mapping is supplied, the origin raw
+        features and stage uids that produced each column's parent."""
+        out = []
+        for c in self.columns:
+            entry = {
+                "columnName": c.column_name(),
+                "parentFeatureName": c.parent_feature_name,
+                "parentFeatureType": c.parent_feature_type,
+                "grouping": c.grouping,
+                "indicatorValue": c.indicator_value,
+                "descriptorValue": c.descriptor_value,
+                "index": c.index,
+            }
+            feat = (features or {}).get(c.parent_feature_name)
+            if feat is not None and hasattr(feat, "history"):
+                entry.update(feat.history())
+            out.append(entry)
+        return out
+
     def grouping_indices(self) -> dict[tuple[str, str], list[int]]:
         """Indices of indicator columns per (parent, grouping) categorical
         group - used by SanityChecker's Cramer's V contingency tables."""

@@ -53,8 +53,13 @@ def flatten(dag: Sequence[Layer]) -> list[PipelineStage]:
 
 
 def validate_dag(dag: Sequence[Layer]) -> None:
-    """Uid uniqueness + output name uniqueness (reference:
-    OpWorkflow.scala:280-323 validateStages)."""
+    """Uid uniqueness + output name uniqueness + stage serializability
+    (reference: OpWorkflow.scala:265-323 - validateStages plus the
+    ClosureUtils.checkSerializable gate run on every stage before
+    training, so save/warm-start failures surface at train() time with
+    the offending stage named, not at save() time)."""
+    from ..serialization.model_io import _encode, stage_state
+
     uids: set[str] = set()
     outs: set[str] = set()
     for stage in flatten(dag):
@@ -65,6 +70,13 @@ def validate_dag(dag: Sequence[Layer]) -> None:
         if name in outs:
             raise ValueError(f"duplicate output feature name: {name}")
         outs.add(name)
+        try:  # dry-run the model writer's encoder on the stage's state
+            _encode(stage_state(stage), {}, stage.uid)
+        except TypeError as e:
+            raise ValueError(
+                f"stage {stage.uid} ({type(stage).__name__}) holds "
+                f"state the model writer cannot serialize: {e}"
+            ) from e
 
 
 def cut_dag(
