@@ -1,0 +1,98 @@
+"""Shared CPU-mesh backend guard (importable before jax, no package deps).
+
+The axon TPU plugin registers a backend factory in every python process via
+sitecustomize; when its tunnel is wedged, the first ``jax.devices()`` call
+blocks forever.  Every entry point that must run on a virtual CPU mesh
+(tests/conftest.py, __graft_entry__.dryrun_multichip, bench.py's fallback)
+applies the same three-part guard — force the cpu platform, request N
+virtual host devices, and purge every non-cpu backend factory — so it
+lives here once.
+
+This module must stay importable with zero side effects and without
+importing the transmogrifai_tpu package (whose __init__ imports jax).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+
+def set_host_device_count(n_devices: int, env: dict | None = None) -> None:
+    """Set --xla_force_host_platform_device_count=n in XLA_FLAGS, replacing
+    any existing value for that flag and preserving all other flags."""
+    env = os.environ if env is None else env
+    flags = env.get("XLA_FLAGS", "")
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
+
+def cpu_mesh_env(n_devices: int, base: dict | None = None) -> dict:
+    """A copy of ``base`` (default os.environ) prepared for a CPU-mesh
+    subprocess: cpu platform, n virtual devices, axon tunnel dropped."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TX_DRYRUN_PLATFORM", None)  # child must not retry real hardware
+    set_host_device_count(n_devices, env)
+    return env
+
+
+def ensure_cpu_mesh(n_devices: int, force_cpu: bool = True) -> bool:
+    """Make this process safe for an n-device virtual CPU mesh.
+
+    Must run before jax instantiates a backend to take full effect.  When
+    ``force_cpu`` is False an explicit caller-set JAX_PLATFORMS other than
+    cpu is respected (the caller wants a real multi-chip backend).
+
+    Returns True when this process can host the mesh, False when jax has
+    already initialized a backend that cannot (caller should re-run in a
+    subprocess under ``cpu_mesh_env``).
+    """
+    # NB: the ambient axon environment exports JAX_PLATFORMS=axon globally,
+    # so a set JAX_PLATFORMS is NOT evidence of caller intent; callers that
+    # really want a multi-chip hardware backend pass force_cpu=False AND
+    # set TX_DRYRUN_PLATFORM.
+    explicit = os.environ.get("TX_DRYRUN_PLATFORM", "")
+    if not force_cpu and explicit and explicit != "cpu":
+        os.environ["JAX_PLATFORMS"] = explicit
+        import jax
+
+        try:
+            return len(jax.devices()) >= n_devices
+        except Exception:
+            return False
+
+    import jax
+
+    from jax._src import xla_bridge as _xb
+
+    if not getattr(_xb, "_backends", {}):
+        # Backend not instantiated yet: XLA_FLAGS/JAX_PLATFORMS are read
+        # lazily at backend creation, so setting them works even if jax was
+        # imported long ago (e.g. by sitecustomize).  Force cpu and drop
+        # every other factory so nothing can reach the wedging plugin.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        set_host_device_count(n_devices)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        try:
+            # pallas lowering registrations need "tpu" to still be a known
+            # platform at import time; import before purging factories
+            from jax.experimental import pallas as _pl  # noqa: F401
+            from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+        except Exception:
+            pass
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+    try:
+        return len(jax.devices("cpu")) >= n_devices
+    except Exception:
+        return False

@@ -6,37 +6,31 @@ TestSparkContext.scala:33-76); the analogous strategy here is CPU jax with
 Must run before jax initializes.
 """
 import os
+import re
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The axon TPU plugin registers itself via sitecustomize in every python
 # process.  Unit tests must run on the virtual CPU mesh and never block on
-# the TPU tunnel, so drop the axon backend factory before jax initializes.
+# the TPU tunnel.  Set the env unconditionally (hang-proof even if the
+# shared guard module were missing), then let the guard purge the non-cpu
+# backend factories before jax initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+).strip()
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
 try:
-    import jax
+    from _backend_guard import ensure_cpu_mesh
 
-    jax.config.update("jax_platforms", "cpu")
-    # pallas must import while "tpu" is still a known platform (its TPU
-    # lowering registrations reject unknown platforms), so pull it in
-    # before the factory purge below
-    try:
-        from jax.experimental import pallas as _pl  # noqa: F401
-        from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
-    except Exception:
-        pass
-    from jax._src import xla_bridge as _xb
-
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-except Exception:
+    assert ensure_cpu_mesh(8), "cannot provision the 8-device CPU test mesh"
+except ImportError:
     pass
 
 import numpy as np  # noqa: E402
