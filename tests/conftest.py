@@ -29,7 +29,8 @@ os.environ["XLA_FLAGS"] = (
 try:
     from _backend_guard import ensure_cpu_mesh
 
-    assert ensure_cpu_mesh(8), "cannot provision the 8-device CPU test mesh"
+    _mesh_ok = ensure_cpu_mesh(8)  # not inside assert: -O must still purge
+    assert _mesh_ok, "cannot provision the 8-device CPU test mesh"
 except ImportError:
     pass
 

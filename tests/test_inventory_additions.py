@@ -56,3 +56,28 @@ def test_serializability_gate_rejects_bad_stage():
     s._output = SimpleNamespace(name="bad_out")
     with pytest.raises(ValueError, match="cannot serialize|holds state"):
         validate_dag([[s]])
+
+
+def test_serializability_gate_rejects_bad_params_and_metadata():
+    """save_model also encodes stage.params and stage.metadata, so the
+    train-time gate must dry-run those too (a stage passing validate_dag
+    must never fail later at save() time)."""
+    from types import SimpleNamespace
+
+    from transmogrifai_tpu.stages.base import Transformer
+    from transmogrifai_tpu.workflow.dag import validate_dag
+
+    class ParamStage(Transformer):
+        pass
+
+    s = ParamStage()
+    s._output = SimpleNamespace(name="p_out")
+    s.params["callback"] = lambda v: v  # not encodable
+    with pytest.raises(ValueError, match="cannot serialize|holds state"):
+        validate_dag([[s]])
+
+    s2 = ParamStage()
+    s2._output = SimpleNamespace(name="m_out")
+    s2.metadata["handle"] = object()
+    with pytest.raises(ValueError, match="cannot serialize|holds state"):
+        validate_dag([[s2]])

@@ -40,6 +40,23 @@ def test_fused_moments_parity(n, d):
         )
 
 
+def test_fused_moments_chunked_combine(monkeypatch):
+    """Above _CHUNK_ROWS the pass splits and partials combine in float64
+    (the 2^24 float32 exactness cliff must not corrupt 10M-row stats);
+    exercised here by shrinking the chunk threshold."""
+    monkeypatch.setattr(pk, "_CHUNK_ROWS", 257)
+    rng = np.random.RandomState(3)
+    x = rng.randn(1000, 13).astype(np.float32) * 2.0
+    y = rng.rand(1000).astype(np.float32)
+    want = _moments_ref(x, y)
+    got = pk.fused_moments(x, y, force_pallas=False)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b, dtype=np.float64),
+            rtol=3e-5, atol=3e-3,
+        )
+
+
 def test_fused_moments_jnp_fallback_matches():
     rng = np.random.RandomState(1)
     x = rng.randn(300, 20).astype(np.float32)

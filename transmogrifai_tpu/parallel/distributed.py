@@ -19,6 +19,7 @@ DCN across slices - there is no first-party NCCL/MPI to port, by design.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -28,6 +29,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _initialized = False
 
+# env vars the pod launcher sets for env-driven bootstrap; presence of any
+# means "this is one process of a multi-host job" (jax.distributed
+# .initialize() with no args reads them itself)
+_BOOTSTRAP_ENV = (
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+    "COORDINATOR_ADDRESS",
+)
+
 
 def initialize(
     coordinator_address: Optional[str] = None,
@@ -35,19 +46,37 @@ def initialize(
     process_id: Optional[int] = None,
 ) -> None:
     """Bring up the cross-host runtime.  No-op on single-process setups
-    (local chip, CPU test meshes); parameters default to the JAX_*
-    environment variables the pod launcher sets."""
+    (local chip, CPU test meshes); with no arguments, defers to the JAX_*
+    environment variables the pod launcher sets.
+
+    Must run before any jax API instantiates a backend -
+    jax.distributed.initialize raises once a backend exists, so this guard
+    deliberately consults ONLY os.environ and the explicit arguments
+    (never jax.process_count(), which would itself initialize the backend).
+    """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
         return
-    if coordinator_address is None and num_processes is None:
-        return  # single process - nothing to do
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    explicit = coordinator_address is not None or num_processes is not None
+    env_driven = any(k in os.environ for k in _BOOTSTRAP_ENV)
+    if not explicit and not env_driven:
+        # single process - nothing to bring up; do NOT latch, so a later
+        # call with real coordinator arguments still initializes
+        return
+    try:
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()
+    except RuntimeError as e:
+        # idempotency: absorb "already initialized" (e.g. the launcher
+        # framework brought jax.distributed up before us)
+        if "already" not in str(e).lower():
+            raise
     _initialized = True
 
 

@@ -138,16 +138,40 @@ def _moments_pallas(x, y, interpret=False):
     return out[:, :d], out[:, 0]
 
 
+_CHUNK_ROWS = 1 << 22  # float32 accumulators are exact for counts and
+# well-conditioned for sums only well below 2^24 rows; above this the
+# single device pass is split and partials combine in float64
+
+
 def fused_moments(x, y, force_pallas: bool | None = None):
     """One-pass column moments of [n, d] x against label y.
 
     Returns (x_sum, x_sq_sum, xy_sum, y_sum, y_sq_sum, x_min, x_max) with
     the same contract as the jnp reference path.  Dispatch: pallas on TPU
     (or interpret-mode when force_pallas=True on CPU), fused jnp
-    reductions otherwise.
+    reductions otherwise.  Above ``_CHUNK_ROWS`` rows the sweep runs in
+    chunks whose partial sums are combined in float64 host-side, so the
+    advertised 10M+-row scale does not silently drift (float32 integer
+    exactness ends at 2^24).
     """
     x = jnp.asarray(x, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
+    n = x.shape[0]
+    if n > _CHUNK_ROWS:
+        acc = None
+        for i in range(0, n, _CHUNK_ROWS):
+            part = fused_moments(
+                x[i : i + _CHUNK_ROWS], y[i : i + _CHUNK_ROWS], force_pallas
+            )
+            part = [np.asarray(v, np.float64) for v in part]
+            if acc is None:
+                acc = part
+            else:
+                for j in range(5):  # sums
+                    acc[j] = acc[j] + part[j]
+                acc[5] = np.minimum(acc[5], part[5])
+                acc[6] = np.maximum(acc[6], part[6])
+        return tuple(jnp.asarray(v, jnp.float32) for v in acc)
     use_pallas = _on_tpu() if force_pallas is None else force_pallas
     if use_pallas and HAS_PALLAS:
         interpret = not _on_tpu()

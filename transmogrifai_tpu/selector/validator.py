@@ -135,21 +135,48 @@ class OpValidator:
         best = None  # (metric, estimator, params)
         import json as _json
 
-        ckpt = self._ckpt_load()
+        # The 1024-bin device approximation of AuROC/AuPR (~5e-3 error)
+        # only pays for itself where it saves host-device transfers of the
+        # per-fold validation slices: on an accelerator with enough rows.
+        # On CPU hosts - or small data, where near-tied candidates could
+        # flip on quantization - use the exact host metrics.
+        approx_rank = (
+            jax.default_backend() == "tpu" and n >= 100_000
+        )
 
-        def _key(est, pmap) -> str:
-            return f"{est.model_type}:{_json.dumps(pmap, sort_keys=True)}"
+        ckpt = self._ckpt_load()
+        metric_name = getattr(self.evaluator, "metric_name", "")
+
+        def _est_mode(est, grid) -> str:
+            """Whether THIS estimator's metrics will come from the 1024-bin
+            device approximation; only the batched-LR rank-metric branch
+            uses it - tree/generic paths are exact on every backend."""
+            uses_approx = (
+                approx_rank
+                and metric_name in ("AuROC", "AuPR")
+                and hasattr(est, "fit_arrays_batched")
+                and _lr_style_grid(grid)
+            )
+            return "approx" if uses_approx else "exact"
+
+        def _key(est, pmap, mode) -> str:
+            # metric mode is part of the key so checkpoints produced by the
+            # approximate device path never mix with exact host metrics
+            return (
+                f"{est.model_type}:{_json.dumps(pmap, sort_keys=True)}:{mode}"
+            )
 
         for est, grid in models:
             grid = list(grid) or [{}]
             g = len(grid)
+            mode = _est_mode(est, grid)
             metrics = np.zeros((g, k))
             done_mask = [
-                _key(est, pmap) in ckpt for pmap in grid
+                _key(est, pmap, mode) in ckpt for pmap in grid
             ]
             for j, pmap in enumerate(grid):
                 if done_mask[j]:
-                    metrics[j] = np.asarray(ckpt[_key(est, pmap)])
+                    metrics[j] = np.asarray(ckpt[_key(est, pmap, mode)])
             if all(done_mask):
                 pass  # everything restored from checkpoint
             elif hasattr(est, "fit_arrays_batched") and _lr_style_grid(grid):
@@ -176,8 +203,7 @@ class OpValidator:
                     wj = jnp.asarray(w, jnp.float32)
                     Wj = jnp.repeat(trainj * wj[None, :], g, axis=0)
                 betas, b0s = est.fit_arrays_batched(Xj, y, Wj, regs, ens)
-                metric_name = getattr(self.evaluator, "metric_name", "")
-                if metric_name in ("AuROC", "AuPR"):
+                if mode == "approx":
                     # rank-based binary metrics computed ON DEVICE against
                     # the already-resident X: no per-fold slices ever leave
                     # HBM (the host loop below ships [n_val, d] k*g times)
@@ -220,7 +246,7 @@ class OpValidator:
                             fold_params[f], Xh[val]
                         )
                         metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
-                    ckpt[_key(est, pmap)] = metrics[j].tolist()
+                    ckpt[_key(est, pmap, mode)] = metrics[j].tolist()
                     self._ckpt_save(ckpt)
             else:
                 Xh = np.asarray(X)
@@ -233,11 +259,11 @@ class OpValidator:
                         params = cand.fit_arrays(Xh[tr], y[tr], w[tr])
                         pred, raw, prob = cand.predict_arrays(params, Xh[val])
                         metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
-                    ckpt[_key(est, pmap)] = metrics[j].tolist()
+                    ckpt[_key(est, pmap, mode)] = metrics[j].tolist()
                     self._ckpt_save(ckpt)
             if not all(done_mask):
                 for j, pmap in enumerate(grid):
-                    ckpt[_key(est, pmap)] = metrics[j].tolist()
+                    ckpt[_key(est, pmap, mode)] = metrics[j].tolist()
                 self._ckpt_save(ckpt)
             mean_metrics = metrics.mean(axis=1)
             for j, pmap in enumerate(grid):

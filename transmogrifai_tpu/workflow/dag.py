@@ -70,8 +70,13 @@ def validate_dag(dag: Sequence[Layer]) -> None:
         if name in outs:
             raise ValueError(f"duplicate output feature name: {name}")
         outs.add(name)
-        try:  # dry-run the model writer's encoder on the stage's state
+        try:  # dry-run the model writer's encoder on everything save_model
+            # will encode: fitted state, ctor params, and metadata (a stage
+            # holding an unserializable value in params must fail HERE, at
+            # train() time, not at save() time)
             _encode(stage_state(stage), {}, stage.uid)
+            _encode(stage.params, {}, stage.uid)
+            _encode(stage.metadata, {}, stage.uid)
         except TypeError as e:
             raise ValueError(
                 f"stage {stage.uid} ({type(stage).__name__}) holds "
