@@ -267,17 +267,110 @@ def fit_forest_folds(
 
 
 def effective_max_depth(
-    max_depth: int, n_rows: int, min_instances_per_node: float
+    max_depth: int,
+    n_rows: int,
+    min_instances_per_node: float,
+    n_features: int | None = None,
+    max_bins: int | None = None,
+    n_stats: int | None = None,
+    cap: str = "auto",
 ) -> int:
-    """Cap depth at what the data can populate: a node needs >=
-    2*min_instances rows to split, so levels beyond
-    log2(n / (2*min_instances)) + 1 hold only unsplittable nodes.  Keeps
-    the static 2^depth histogram shapes proportional to the data instead
-    of the requested depth (the reference grid goes to maxDepth=12 even on
-    891 Titanic rows)."""
-    denom = max(2.0 * max(min_instances_per_node, 1.0), 2.0)
-    cap = int(np.ceil(np.log2(max(n_rows, 2) / denom))) + 1
-    return max(1, min(max_depth, cap))
+    """Depth cap - default-on, overridable with ``cap="off"``.
+
+    Two provably-lossless bounds (no expressible tree is excluded):
+
+    * support: every split keeps >= min_instances rows in each child, so a
+      root-to-leaf path peels off at least min_instances rows per level -
+      no leaf sits deeper than n / min_instances even in a maximally
+      unbalanced tree.  (A balanced-tree log2 bound would silently forbid
+      the reference's winning Titanic config, RF maxDepth=12 on 891 rows -
+      /root/reference/README.md:61-78.)
+    * memory: cap depth so the split search's working set stays under
+      TX_TREE_HIST_BYTES (default 1 GiB).  The deepest level concurrently
+      holds hist + its cumsum + the right-side complement (3 x
+      [2^depth, d, bins, C]) plus the left/right impurity and gain arrays
+      (3 x [2^depth, d, bins]), so the budget divides by that full
+      multiplier, not just the raw histogram.
+    """
+    md = max(1, int(max_depth))
+    if cap == "off":
+        return md
+    m = max(float(min_instances_per_node), 1.0)
+    support_cap = int(max(n_rows, 2) // m)
+    caps = [md, max(1, support_cap)]
+    if n_features and max_bins and n_stats:
+        import os
+
+        budget = float(os.environ.get("TX_TREE_HIST_BYTES", 1 << 30))
+        per_node = 4.0 * n_features * max_bins * (3.0 * n_stats + 3.0)
+        caps.append(int(np.floor(np.log2(max(budget / per_node, 2.0)))))
+    return max(1, min(caps))
+
+
+def _impurity_np(stats: np.ndarray, kind: str) -> np.ndarray:
+    """Weighted impurity per node from stored heap stats (numpy mirror of
+    _impurity): stats [..., C] with channel 0 = node weight."""
+    w = stats[..., 0]
+    safe_w = np.maximum(w, 1e-12)
+    if kind == "variance":
+        mean = stats[..., 1] / safe_w
+        imp = stats[..., 2] / safe_w - mean**2
+    else:  # gini
+        p = stats[..., 1:] / safe_w[..., None]
+        imp = 1.0 - (p * p).sum(axis=-1)
+    return imp * w
+
+
+def heap_impurity_importances(
+    heaps: tuple, d: int, impurity_kind: str
+) -> np.ndarray:
+    """Impurity-decrease feature importances computed from stored heaps.
+
+    The flat heap keeps full node stats at EVERY slot (heap_value), so the
+    weighted impurity decrease of internal node i is
+    imp_w(i) - imp_w(2i+1) - imp_w(2i+2) - no extra bookkeeping in the fit
+    kernels (JAX or C++, both emit the same layout).  Aggregation follows
+    Spark's featureImportances contract (reference: ModelInsights.scala:
+    435-525 surfaces Spark's treeModels featureImportances): accumulate
+    gain x node-weight per split feature, normalize per tree, average over
+    trees, normalize.
+    """
+    hf, ht, hl, hv = (np.asarray(h) for h in heaps)
+    if hf.ndim == 1:  # single tree -> add tree axis
+        hf, ht, hl, hv = hf[None], ht[None], hl[None], hv[None]
+    T, M = hf.shape
+    n_inner = (M - 1) // 2  # nodes with children inside the heap
+    imp = _impurity_np(hv, impurity_kind)            # [T, M]
+    parents = np.arange(n_inner)
+    decrease = (
+        imp[:, parents]
+        - imp[:, 2 * parents + 1]
+        - imp[:, 2 * parents + 2]
+    )
+    # Reachability gate: rows under an already-leaf node keep flowing into
+    # a "shadow" left child that inherits the parent's stats; with per-node
+    # random feature subsets such a shadow node can later pass the gain
+    # gate and be marked internal even though prediction never reaches it.
+    # Only splits on the real tree may contribute.
+    reach = np.zeros((T, M), dtype=bool)
+    reach[:, 0] = True
+    for i in range(n_inner):
+        ok = reach[:, i] & ~hl[:, i]
+        reach[:, 2 * i + 1] |= ok
+        reach[:, 2 * i + 2] |= ok
+    internal = (~hl[:, :n_inner]) & reach[:, :n_inner]
+    contrib = np.where(internal, np.maximum(decrease, 0.0), 0.0)  # [T, n_inner]
+    per_tree = np.zeros((T, d))
+    feats = np.clip(hf[:, :n_inner], 0, d - 1)
+    for t in range(T):
+        np.add.at(per_tree[t], feats[t][internal[t]], contrib[t][internal[t]])
+    totals = per_tree.sum(axis=1, keepdims=True)
+    normed = np.divide(
+        per_tree, totals, out=np.zeros_like(per_tree), where=totals > 0
+    )
+    mean = normed.mean(axis=0)
+    s = mean.sum()
+    return mean / s if s > 0 else mean
 
 
 def predict_tree_np(bins, heap_feature, heap_thr, heap_leaf, heap_value,

@@ -27,6 +27,7 @@ from .tree_kernel import (
     fit_forest,
     fit_forest_folds,
     fit_tree,
+    heap_impurity_importances,
     predict_forest,
     predict_tree,
     predict_tree_np,
@@ -106,6 +107,7 @@ class _TreeEnsembleBase(PredictorEstimator):
         feature_subset_strategy: str = "auto",
         seed: int = 42,
         backend: str = "auto",
+        depth_cap: str = "auto",
         **kw,
     ) -> None:
         super().__init__(**kw)
@@ -119,6 +121,7 @@ class _TreeEnsembleBase(PredictorEstimator):
         p.setdefault("subsampling_rate", subsampling_rate)
         p.setdefault("feature_subset_strategy", feature_subset_strategy)
         p.setdefault("seed", seed)
+        p.setdefault("depth_cap", depth_cap)  # "auto" | "off" (honor as-is)
 
     # -- shared helpers -----------------------------------------------------
     def _stats_rows(self, y: np.ndarray) -> tuple[np.ndarray, int, str, np.ndarray]:
@@ -161,7 +164,8 @@ class _RandomForest(_TreeEnsembleBase):
         feat_masks = np.ones((T, d), dtype=bool)
         seed_ints = rng.randint(0, 2**31 - 1, size=T)
         depth = effective_max_depth(
-            int(p["max_depth"]), n, float(p["min_instances_per_node"])
+            int(p["max_depth"]), n, float(p["min_instances_per_node"]),
+            d, int(p["max_bins"]), C, cap=str(p.get("depth_cap", "auto")),
         )
         return (edges, bins, stats, C, imp, classes, boot, feat_masks,
                 seed_ints, subset_p, depth)
@@ -294,17 +298,15 @@ class _RandomForest(_TreeEnsembleBase):
         return out[:, 0].astype(np.float64), None, None
 
     def contributions(self, params: Any) -> Optional[np.ndarray]:
-        """Split-frequency importance: how often each feature splits,
-        weighted by level depth (cheap stand-in for impurity-decrease
-        importances; refined later)."""
-        hf, ht, hl, hv = params["heaps"]
-        d = int(params["edges"].shape[0])
-        counts = np.zeros(d)
-        internal = ~hl
-        for t in range(hf.shape[0]):
-            feats = hf[t][internal[t]]
-            np.add.at(counts, feats, 1.0)
-        return counts / max(counts.sum(), 1.0)
+        """Impurity-decrease feature importances recovered from the stored
+        heaps (Spark featureImportances contract - gain x node weight per
+        split, per-tree normalized, averaged; reference:
+        ModelInsights.scala:435-525)."""
+        return heap_impurity_importances(
+            params["heaps"],
+            int(params["edges"].shape[0]),
+            "gini" if self.is_classification else "variance",
+        )
 
 
 class OpRandomForestClassifier(_RandomForest):
@@ -352,7 +354,9 @@ class _GBT(_TreeEnsembleBase):
         else:
             f0 = float((w * y32).sum() / wsum)
         max_depth = effective_max_depth(
-            int(p["max_depth"]), n, float(p["min_instances_per_node"])
+            int(p["max_depth"]), n, float(p["min_instances_per_node"]),
+            X.shape[1], int(p["max_bins"]), 4,
+            cap=str(p.get("depth_cap", "auto")),
         )
         bins = bin_data(np.asarray(X, np.float32), edges)
         heaps = native_trees.fit_gbt(
@@ -390,7 +394,8 @@ class _GBT(_TreeEnsembleBase):
         T = int(p["num_trees"])
         lr = float(p["step_size"])
         max_depth = effective_max_depth(
-            int(p["max_depth"]), n, float(p["min_instances_per_node"])
+            int(p["max_depth"]), n, float(p["min_instances_per_node"]),
+            d, int(p["max_bins"]), 4, cap=str(p.get("depth_cap", "auto")),
         )
         max_bins = int(p["max_bins"])
         minipn = float(p["min_instances_per_node"])
@@ -473,13 +478,12 @@ class _GBT(_TreeEnsembleBase):
         return F, None, None
 
     def contributions(self, params: Any) -> Optional[np.ndarray]:
-        hf, ht, hl, hv = params["heaps"]
-        d = int(params["edges"].shape[0])
-        counts = np.zeros(d)
-        internal = ~hl
-        for t in range(hf.shape[0]):
-            np.add.at(counts, hf[t][internal[t]], 1.0)
-        return counts / max(counts.sum(), 1.0)
+        """Impurity-decrease importances on the gradient-variance channels
+        (Friedman gain) from the stored heaps - same contract as the
+        forest path."""
+        return heap_impurity_importances(
+            params["heaps"], int(params["edges"].shape[0]), "variance"
+        )
 
 
 class OpGBTClassifier(_GBT):
