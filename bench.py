@@ -18,29 +18,58 @@ REFERENCE_HOLDOUT_AUROC = 0.8821603927986905  # README.md:87
 
 
 def _ensure_working_backend() -> None:
-    """Probe jax device init in a subprocess; if the TPU plugin's tunnel is
-    wedged (init blocks), re-exec under a CPU-only environment so the bench
-    always completes."""
+    """Probe jax device init in a subprocess, RETRYING first - the axon
+    tunnel wedge can be transient, and a premature CPU fallback cost round
+    1 its TPU evidence.  Only after every attempt fails does the bench
+    re-exec under a CPU-only environment, recording WHY in
+    TX_BENCH_FALLBACK_REASON so the emitted JSON is self-describing."""
     if os.environ.get("TX_BENCH_REEXEC") == "1":
         return
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            check=True, timeout=90, capture_output=True,
-        )
-        return  # backend healthy
-    except Exception:
-        pass
+    attempts = int(os.environ.get("TX_BENCH_TPU_RETRIES", "3"))
+    last_err = ""
+    for i in range(attempts):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                check=True, timeout=90 + 60 * i, capture_output=True,
+            )
+            return  # backend healthy
+        except subprocess.TimeoutExpired:
+            last_err = (
+                f"jax.devices() timed out after {90 + 60 * i}s "
+                f"(attempt {i + 1}/{attempts}: TPU tunnel wedged)"
+            )
+        except Exception as e:
+            last_err = f"jax.devices() failed (attempt {i + 1}/{attempts}): {e}"
+        if i < attempts - 1:
+            time.sleep(5)
     env = dict(os.environ)
     env.update(
         {
             "TX_BENCH_REEXEC": "1",
             "PYTHONPATH": "",
             "JAX_PLATFORMS": "cpu",
+            "TX_BENCH_FALLBACK_REASON": last_err,
         }
     )
     env.pop("PALLAS_AXON_POOL_IPS", None)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+# marketed bf16 peak per chip, by device-kind substring (MFU denominators;
+# fits run in f32, so against the bf16 peak these are conservative)
+_PEAK_FLOPS = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v4", 275e12), ("v6", 918e12),
+)
+
+
+def _peak_flops_of(device) -> float | None:
+    kind = str(getattr(device, "device_kind", device)).lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
 
 
 def _synth_section(result: dict) -> None:
@@ -77,21 +106,46 @@ def _synth_section(result: dict) -> None:
     t0 = time.time()
     res = cv.validate([(OpLogisticRegression(), lr_grid())], X, y)
     t_cv = time.time() - t0
+
+    # FLOPs accounting for the CV fan-out (dominant terms of the batched
+    # Newton fit, logistic_regression._lr_fit_kernel: XtWX 2nd^2 + two
+    # [n,d] matvecs per iteration, plus the d^3 solve), and the 1024-bin
+    # rank-metric outer-product histograms when the device path ran.
+    d = int(X.shape[1])
+    B = 3 * len(lr_grid())  # folds x grid replicas
+    iters = 25
+    fit_flops = B * iters * (2.0 * n * d * d + 4.0 * n * d + (2 / 3) * d**3)
+    approx_used = any(
+        r.get("rank_metric_mode") == "approx" for r in res.all_results
+    )
+    metric_flops = (
+        B * (8.0 * n * 32 * 32 + 4.0 * n * d) if approx_used else 0.0
+    )
+    total_flops = fit_flops + metric_flops
     result.update(
         {
             "synth_rows": n,
+            "synth_dims": d,
             "synth_gen_wall_s": round(t_gen, 3),
             "synth_cv_wall_s": round(t_cv, 3),
             "synth_cv_candidates": len(res.all_results),
             "synth_cv_auroc": round(res.best_metric, 6),
             "synth_rows_per_s": round(n * 3 * len(lr_grid()) / t_cv, 1),
+            "synth_cv_tflops": round(total_flops / 1e12, 3),
+            "synth_cv_tflops_per_s": round(total_flops / t_cv / 1e12, 3),
         }
     )
+    peak = _peak_flops_of(jax.devices()[0])
+    if on_tpu and peak:
+        result["synth_cv_mfu"] = round(total_flops / t_cv / peak, 5)
+        result["mfu_peak_flops_assumed"] = peak
 
 
 def main() -> None:
     _ensure_working_backend()
     t_start = time.time()
+
+    import jax
 
     from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
     from transmogrifai_tpu.examples.titanic import titanic_workflow
@@ -126,11 +180,15 @@ def main() -> None:
     auroc = float(holdout.AuROC)
 
     insights = model.model_insights()
+    dev0 = jax.devices()[0]
     result = {
         "metric": "titanic_cv_holdout_auroc",
         "value": auroc,
         "unit": "AuROC",
         "vs_baseline": auroc / REFERENCE_HOLDOUT_AUROC,
+        "platform": jax.default_backend(),
+        "device": str(getattr(dev0, "device_kind", dev0)),
+        "n_devices": jax.device_count(),
         "train_wall_s": round(t_train - t_setup, 3),
         "total_wall_s": round(time.time() - t_start, 3),
         "holdout_aupr": float(holdout.AuPR),
@@ -138,6 +196,9 @@ def main() -> None:
         "selected_model": insights.selected_model_type,
         "cv_candidates": len(insights.validation_results),
     }
+    fb = os.environ.get("TX_BENCH_FALLBACK_REASON")
+    if fb:
+        result["platform_fallback_reason"] = fb
     try:
         _synth_section(result)
     except Exception as e:  # synth is best-effort; Titanic is THE metric

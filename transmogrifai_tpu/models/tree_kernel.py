@@ -286,11 +286,12 @@ def effective_max_depth(
       the reference's winning Titanic config, RF maxDepth=12 on 891 rows -
       /root/reference/README.md:61-78.)
     * memory: cap depth so the split search's working set stays under
-      TX_TREE_HIST_BYTES (default 1 GiB).  The deepest level concurrently
-      holds hist + its cumsum + the right-side complement (3 x
-      [2^depth, d, bins, C]) plus the left/right impurity and gain arrays
-      (3 x [2^depth, d, bins]), so the budget divides by that full
-      multiplier, not just the raw histogram.
+      TX_TREE_HIST_BYTES (default 4 GiB - a quarter of a v5e chip's HBM).
+      Split search concurrently holds hist + its cumsum + the right-side
+      complement (3 x [2^l, d, bins, C]) plus the left/right impurity and
+      gain arrays (3 x [2^l, d, bins]) - but only up to level depth-1
+      (fit_tree breaks before searching the final level), so a budget
+      fitting 2^l nodes admits depth l+1.
     """
     md = max(1, int(max_depth))
     if cap == "off":
@@ -301,9 +302,9 @@ def effective_max_depth(
     if n_features and max_bins and n_stats:
         import os
 
-        budget = float(os.environ.get("TX_TREE_HIST_BYTES", 1 << 30))
+        budget = float(os.environ.get("TX_TREE_HIST_BYTES", 1 << 32))
         per_node = 4.0 * n_features * max_bins * (3.0 * n_stats + 3.0)
-        caps.append(int(np.floor(np.log2(max(budget / per_node, 2.0)))))
+        caps.append(int(np.floor(np.log2(max(budget / per_node, 2.0)))) + 1)
     return max(1, min(caps))
 
 

@@ -274,6 +274,11 @@ class OpValidator:
                         "params": dict(pmap),
                         "metric": float(mean_metrics[j]),
                         "fold_metrics": metrics[j].tolist(),
+                        # which evaluator produced these numbers: "approx" =
+                        # the 1024-bin device rank metrics, "exact" = host
+                        # (consumers like bench FLOPs accounting read this
+                        # instead of re-deriving the gate)
+                        "rank_metric_mode": mode,
                     }
                 )
             j_best = int(np.argmax(mean_metrics) if larger else np.argmin(mean_metrics))
