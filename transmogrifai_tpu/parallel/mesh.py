@@ -46,6 +46,43 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
+def data_mesh_or_none(min_devices: int = 2) -> Optional[Mesh]:
+    """1-axis 'data' mesh over every device, or None when the process has
+    fewer than ``min_devices`` (or TX_PRODUCT_MESH=0 disables product-path
+    sharding).  The product train/validate/SanityChecker paths call this to
+    decide whether to shard their row axis - the Spark-partition analog."""
+    import os
+
+    if os.environ.get("TX_PRODUCT_MESH", "1") == "0":
+        return None
+    devs = jax.devices()
+    if len(devs) < min_devices:
+        return None
+    return Mesh(np.array(devs), ("data",))
+
+
+def cv_mesh_or_none(n_replicas: int, min_devices: int = 2) -> Optional[Mesh]:
+    """2-axis ('replica', 'data') mesh for the CV fold x grid fan-out
+    (the Future-pool analog, reference OpValidator.scala:289-306): the
+    replica axis takes the largest divisor r of the device count that also
+    divides ``n_replicas`` with r^2 <= devices, keeping the data axis -
+    where the big [n, d] matrix lives - at least as large as the replica
+    axis so HBM per device stays bounded."""
+    import os
+
+    if os.environ.get("TX_PRODUCT_MESH", "1") == "0":
+        return None
+    devs = jax.devices()
+    nd = len(devs)
+    if nd < min_devices:
+        return None
+    r = 1
+    for cand in range(1, int(np.sqrt(nd)) + 1):
+        if nd % cand == 0 and n_replicas % cand == 0:
+            r = cand
+    return Mesh(np.array(devs).reshape(r, nd // r), ("replica", "data"))
+
+
 def shard_rows(arr, mesh: Mesh, axis: str = "data"):
     """Place an array with its leading axis sharded over the mesh."""
     ndim = np.ndim(arr)

@@ -143,6 +143,21 @@ _CHUNK_ROWS = 1 << 22  # float32 accumulators are exact for counts and
 # single device pass is split and partials combine in float64
 
 
+def _combine_moments_f64(parts):
+    """Combine per-chunk 7-tuples in float64 (5 sums, then min/max)."""
+    acc = None
+    for p in parts:
+        p = [np.asarray(v, np.float64) for v in p]
+        if acc is None:
+            acc = p
+        else:
+            for j in range(5):
+                acc[j] = acc[j] + p[j]
+            acc[5] = np.minimum(acc[5], p[5])
+            acc[6] = np.maximum(acc[6], p[6])
+    return acc
+
+
 def fused_moments(x, y, force_pallas: bool | None = None):
     """One-pass column moments of [n, d] x against label y.
 
@@ -158,19 +173,12 @@ def fused_moments(x, y, force_pallas: bool | None = None):
     y = jnp.asarray(y, jnp.float32)
     n = x.shape[0]
     if n > _CHUNK_ROWS:
-        acc = None
-        for i in range(0, n, _CHUNK_ROWS):
-            part = fused_moments(
+        acc = _combine_moments_f64(
+            fused_moments(
                 x[i : i + _CHUNK_ROWS], y[i : i + _CHUNK_ROWS], force_pallas
             )
-            part = [np.asarray(v, np.float64) for v in part]
-            if acc is None:
-                acc = part
-            else:
-                for j in range(5):  # sums
-                    acc[j] = acc[j] + part[j]
-                acc[5] = np.minimum(acc[5], part[5])
-                acc[6] = np.maximum(acc[6], part[6])
+            for i in range(0, n, _CHUNK_ROWS)
+        )
         return tuple(jnp.asarray(v, jnp.float32) for v in acc)
     use_pallas = _on_tpu() if force_pallas is None else force_pallas
     if use_pallas and HAS_PALLAS:
@@ -189,6 +197,67 @@ def _moments_jnp(x, y):
     return (
         x.sum(axis=0), (x * x).sum(axis=0), (x * y[:, None]).sum(axis=0),
         y.sum(), (y * y).sum(), x.min(axis=0), x.max(axis=0),
+    )
+
+
+@jax.jit
+def _moments_jnp_masked(x, y, valid):
+    """Same contract with a [n] 0/1 validity mask (padding rows excluded
+    from every statistic)."""
+    v = valid[:, None]
+    xv = x * v
+    return (
+        xv.sum(axis=0),
+        (xv * x).sum(axis=0),
+        (xv * y[:, None]).sum(axis=0),
+        (y * valid).sum(),
+        (y * y * valid).sum(),
+        jnp.where(v > 0, x, jnp.inf).min(axis=0),
+        jnp.where(v > 0, x, -jnp.inf).max(axis=0),
+    )
+
+
+def fused_moments_sharded(x, y, mesh):
+    """Moments with the row axis sharded over ``mesh``'s 'data' axis: pads
+    rows to the shard multiple (masked out of every statistic), places the
+    shards, and runs the jitted masked kernel - GSPMD partitions it and
+    inserts the psum collectives (the treeAggregate analog; the pallas
+    kernel has no SPMD rule, so sharded inputs take this path).
+
+    Host-resident inputs are padded host-side and device_put straight into
+    their sharded layout (no staging copy of the full matrix on device 0);
+    above _CHUNK_ROWS the pass chunks with float64-combined partials like
+    fused_moments, so multi-device stats are never less accurate than the
+    single-device path.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = x.shape[0]
+    if n > _CHUNK_ROWS:
+        acc = _combine_moments_f64(
+            fused_moments_sharded(
+                x[i : i + _CHUNK_ROWS], y[i : i + _CHUNK_ROWS], mesh
+            )
+            for i in range(0, n, _CHUNK_ROWS)
+        )
+        return tuple(jnp.asarray(v, jnp.float32) for v in acc)
+    nd = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    pad = (-n) % nd
+    on_device = isinstance(x, jax.Array)
+    xp = jnp if on_device else np
+    x = x.astype(jnp.float32) if on_device else np.asarray(x, np.float32)
+    y = (jnp.asarray(y, jnp.float32) if on_device
+         else np.asarray(y, np.float32))
+    valid = xp.ones((n,), xp.float32)
+    if pad:
+        x = xp.concatenate([x, xp.zeros((pad, x.shape[1]), xp.float32)])
+        y = xp.concatenate([y, xp.zeros((pad,), xp.float32)])
+        valid = xp.concatenate([valid, xp.zeros((pad,), xp.float32)])
+    row = NamedSharding(mesh, P("data", *[None] * (x.ndim - 1)))
+    vec = NamedSharding(mesh, P("data"))
+    return _moments_jnp_masked(
+        jax.device_put(x, row), jax.device_put(y, vec),
+        jax.device_put(valid, vec),
     )
 
 

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.pallas_kernels import fused_moments
+from ..parallel.mesh import data_mesh_or_none
+from ..parallel.pallas_kernels import fused_moments, fused_moments_sharded
 from ..stages.base import Estimator, Transformer
 from ..types.columns import Column, NumericColumn, VectorColumn
 from ..types.dataset import Dataset
@@ -106,7 +107,12 @@ class SanityChecker(Estimator):
         assert isinstance(label_col, NumericColumn)
         assert isinstance(vec_col, VectorColumn)
         y = np.asarray(label_col.values, dtype=np.float64)
-        x = np.asarray(vec_col.values, dtype=np.float64)
+        vals = vec_col.values
+        # a device-resident (possibly sharded) design matrix stays on
+        # device: the moment pass reads it in place and only the [8, d]
+        # stat rows ever reach the host
+        on_device = isinstance(vals, jax.Array)
+        x = vals if on_device else np.asarray(vals, dtype=np.float64)
         n, d = x.shape
         meta = vec_col.metadata
 
@@ -117,14 +123,24 @@ class SanityChecker(Estimator):
                 int(np.ceil(n * self.check_sample)), self.sample_upper_limit
             )
             idx = rng.choice(n, size=max(target, 1), replace=False)
+            if on_device:
+                idx = np.sort(idx)  # device gather; sorted = coalesced
             x, y = x[idx], y[idx]
             n = len(y)
 
         # one-HBM-pass pallas kernel on TPU, the jitted jnp reductions off
-        # it (parallel/pallas_kernels.fused_moments)
+        # it (parallel/pallas_kernels.fused_moments); with >1 device the
+        # row axis shards over the 'data' mesh and the reductions lower to
+        # psum collectives (the treeAggregate analog,
+        # SanityChecker.scala:575)
+        mesh = data_mesh_or_none()
+        if mesh is not None:
+            moments = fused_moments_sharded(x, y, mesh)
+        else:
+            moments = fused_moments(jnp.asarray(x, jnp.float32),
+                                    jnp.asarray(y, jnp.float32))
         xs, xss, xys, ys, yss, xmin, xmax = (
-            np.asarray(v, dtype=np.float64)
-            for v in fused_moments(jnp.asarray(x), jnp.asarray(y))
+            np.asarray(v, dtype=np.float64) for v in moments
         )
         mean = xs / n
         var = np.maximum(xss / n - mean**2, 0.0) * (n / max(n - 1, 1))

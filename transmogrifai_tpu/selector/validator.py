@@ -23,6 +23,7 @@ import numpy as np
 
 from ..evaluators.base import OpEvaluatorBase
 from ..models.base import PredictorEstimator
+from ..parallel.mesh import cv_mesh_or_none
 from ..types.columns import PredictionColumn
 
 
@@ -202,7 +203,50 @@ class OpValidator:
                 else:
                     wj = jnp.asarray(w, jnp.float32)
                     Wj = jnp.repeat(trainj * wj[None, :], g, axis=0)
-                betas, b0s = est.fit_arrays_batched(Xj, y, Wj, regs, ens)
+                # >1 device: the fold x grid batch shards over 'replica'
+                # and rows over 'data' - XLA inserts the psum collectives
+                # where each replica's Newton reductions cross row shards
+                # (the treeAggregate / Future-pool analog on the mesh).
+                # Rows pad to the data-shard multiple with zero weight in
+                # BOTH the train masks (W=0) and the validation masks
+                # (trainj=1 -> vmask=0), so pads touch no statistic.
+                y_fit = jnp.asarray(y, jnp.float32)
+                mesh = cv_mesh_or_none(k * g)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+
+                    nd_data = mesh.shape["data"]
+                    pad = (-Xj.shape[0]) % nd_data
+                    if pad:
+                        Xj = jnp.concatenate(
+                            [Xj, jnp.zeros((pad, Xj.shape[1]), Xj.dtype)]
+                        )
+                        Wj = jnp.concatenate(
+                            [Wj, jnp.zeros((Wj.shape[0], pad), Wj.dtype)],
+                            axis=1,
+                        )
+                        trainj = jnp.concatenate(
+                            [trainj, jnp.ones((k, pad), trainj.dtype)], axis=1
+                        )
+                        y_fit = jnp.concatenate(
+                            [y_fit, jnp.zeros((pad,), y_fit.dtype)]
+                        )
+                    Xj = jax.device_put(Xj, NamedSharding(mesh, P("data", None)))
+                    y_fit = jax.device_put(
+                        y_fit, NamedSharding(mesh, P("data"))
+                    )
+                    Wj = jax.device_put(
+                        Wj, NamedSharding(mesh, P("replica", "data"))
+                    )
+                    regs = jax.device_put(
+                        jnp.asarray(regs, jnp.float32),
+                        NamedSharding(mesh, P("replica")),
+                    )
+                    ens = jax.device_put(
+                        jnp.asarray(ens, jnp.float32),
+                        NamedSharding(mesh, P("replica")),
+                    )
+                betas, b0s = est.fit_arrays_batched(Xj, y_fit, Wj, regs, ens)
                 if mode == "approx":
                     # rank-based binary metrics computed ON DEVICE against
                     # the already-resident X: no per-fold slices ever leave
@@ -212,9 +256,9 @@ class OpValidator:
                     scores = _margins_kernel(
                         Xj, jnp.asarray(betas, jnp.float32),
                         jnp.asarray(b0s, jnp.float32),
-                    ).T  # [B, n]
+                    ).T  # [B, n(+pad)]
                     vmask = jnp.repeat(1.0 - trainj, g, axis=0)
-                    auroc_b, aupr_b = masked_rank_metrics(scores, y, vmask)
+                    auroc_b, aupr_b = masked_rank_metrics(scores, y_fit, vmask)
                     vals = auroc_b if metric_name == "AuROC" else aupr_b
                     for f in range(k):
                         for j in range(g):

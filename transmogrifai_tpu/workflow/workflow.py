@@ -217,7 +217,14 @@ class OpWorkflow:
     # ------------------------------------------------------------------
     def train(self) -> "OpWorkflowModel":
         """(reference: OpWorkflow.train:332-357)"""
+        from ..parallel.distributed import initialize
         from ..utils.tracing import AppMetrics
+
+        # env-driven multi-host bootstrap (no-op single-process): on a pod,
+        # every host must join the jax.distributed runtime before any stage
+        # touches a device so the 'data' mesh can span hosts (the Spark
+        # executor-bootstrap analog, SURVEY §5.8)
+        initialize()
 
         app_metrics = AppMetrics()
         t0 = time.time()
