@@ -1,0 +1,79 @@
+"""SanityChecker correlation options (reference: SanityChecker.scala:633-637
+CorrelationType.{Pearson,Spearman} -> Statistics.corr) and the host rank
+transform behind the Spearman path."""
+import numpy as np
+import pytest
+import scipy.stats
+
+from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.types.columns import NumericColumn, VectorColumn
+from transmogrifai_tpu.types.dataset import Dataset
+from transmogrifai_tpu.types.feature_types import RealNN
+from transmogrifai_tpu.types.vector_metadata import (
+    VectorColumnMeta,
+    VectorMetadata,
+)
+from transmogrifai_tpu.utils.stats import average_ranks
+
+
+def test_average_ranks_match_scipy(rng):
+    v = rng.randn(500)
+    v[:50] = np.round(v[:50], 1)  # force ties
+    np.testing.assert_allclose(
+        average_ranks(v), scipy.stats.rankdata(v, method="average")
+    )
+    M = rng.randn(200, 4)
+    M[:, 2] = np.round(M[:, 2])  # heavy ties in one column
+    got = average_ranks(M)
+    for j in range(4):
+        np.testing.assert_allclose(
+            got[:, j], scipy.stats.rankdata(M[:, j], method="average")
+        )
+
+
+def _fit_summary(X, y, **kw):
+    n, d = X.shape
+    meta = VectorMetadata(
+        "features", tuple(VectorColumnMeta(f"f{j}", "Real") for j in range(d))
+    ).reindexed()
+    label = NumericColumn(y, np.ones(n, bool), RealNN)
+    vec = VectorColumn(X, meta)
+    ds = Dataset({"label": label, "features": vec})
+    sc = SanityChecker(remove_bad_features=False, **kw)
+    sc.fit_model([label, vec], ds)
+    return sc.metadata["sanity_checker_summary"]
+
+
+def test_sanity_checker_spearman_matches_scipy(rng):
+    n, d = 600, 5
+    X = rng.randn(n, d)
+    X[:, 1] = np.exp(X[:, 1])          # monotone-transformed signal
+    X[:, 3] = np.round(X[:, 3], 1)     # ties
+    y = (X[:, 1] > np.median(X[:, 1])).astype(np.float64)
+    s = _fit_summary(X, y, correlation_type="spearman")
+    for j, c in enumerate(s["column_stats"]):
+        want = scipy.stats.spearmanr(X[:, j], y).statistic
+        np.testing.assert_allclose(c["corr_label"], want, rtol=1e-4, atol=1e-4)
+
+
+def test_sanity_checker_spearman_invariant_to_monotone_transform(rng):
+    """The defining property Pearson lacks: rank correlation is identical
+    under strictly monotone feature transforms."""
+    n = 400
+    base = rng.randn(n)
+    y = (base + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    X1 = np.stack([base, rng.randn(n)], axis=1)
+    X2 = np.stack([np.exp(2.0 * base), rng.randn(n)], axis=1)
+    X2[:, 1] = X1[:, 1]
+    s1 = _fit_summary(X1, y, correlation_type="spearman")
+    s2 = _fit_summary(X2, y, correlation_type="spearman")
+    np.testing.assert_allclose(
+        s1["column_stats"][0]["corr_label"],
+        s2["column_stats"][0]["corr_label"],
+        rtol=1e-5,
+    )
+
+
+def test_sanity_checker_rejects_unknown_correlation_type():
+    with pytest.raises(ValueError, match="correlation_type"):
+        SanityChecker(correlation_type="kendall")

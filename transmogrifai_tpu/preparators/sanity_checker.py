@@ -33,6 +33,7 @@ from ..types.columns import Column, NumericColumn, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import OPVector, RealNN
 from ..utils.stats import (
+    average_ranks,
     cramers_v,
     max_rule_confidences,
     pearson_correlation,
@@ -86,9 +87,16 @@ class SanityChecker(Estimator):
         remove_feature_group: bool = True,
         max_label_classes: int = 100,
         seed: int = 42,
+        correlation_type: str = "pearson",
         **kw,
     ) -> None:
         super().__init__(**kw)
+        if correlation_type not in ("pearson", "spearman"):
+            raise ValueError(
+                f"correlation_type must be 'pearson' or 'spearman', "
+                f"got {correlation_type!r}"
+            )
+        self.correlation_type = correlation_type
         self.check_sample = check_sample
         self.sample_upper_limit = sample_upper_limit
         self.min_variance = min_variance
@@ -144,7 +152,47 @@ class SanityChecker(Estimator):
         )
         mean = xs / n
         var = np.maximum(xss / n - mean**2, 0.0) * (n / max(n - 1, 1))
-        corr = pearson_correlation(xs, xss, xys, float(ys), float(yss), float(n))
+        if self.correlation_type == "spearman":
+            # Spearman = Pearson on average ranks (reference:
+            # SanityChecker.scala:633-637 CorrelationType.Spearman ->
+            # Statistics.corr(..., "spearman")).  Ranks transform on host
+            # under the sample cap (<= 1M rows), then the SAME moment ->
+            # correlation pipeline runs on the ranked matrix.
+            # Ranking is global (needs a total order over all rows), so it
+            # runs on host over the SAMPLED matrix - the sample cap bounds
+            # the transfer.  A multi-host global array cannot be ranked
+            # here; fail with guidance rather than crash in np.asarray.
+            if on_device and not getattr(x, "is_fully_addressable", True):
+                raise ValueError(
+                    "correlation_type='spearman' needs the (sampled) design "
+                    "matrix on the host for rank transformation, but it "
+                    "spans non-addressable devices; lower sample_upper_limit "
+                    "or use correlation_type='pearson'"
+                )
+            x_host = np.asarray(
+                jax.device_get(x) if on_device else x, dtype=np.float64
+            )
+            # center/scale ranks to ~[-0.5, 0.5] before the f32 device
+            # pass: correlation is affine-invariant, and raw ranks up to
+            # the 1M sample cap would overflow f32 moment precision
+            # (sum of squared ranks ~ n^3/3)
+            xr = (average_ranks(x_host) - (n + 1) / 2.0) / n
+            yr = (average_ranks(y) - (n + 1) / 2.0) / n
+            if mesh is not None:
+                r_moments = fused_moments_sharded(xr, yr, mesh)
+            else:
+                r_moments = fused_moments(jnp.asarray(xr, jnp.float32),
+                                          jnp.asarray(yr, jnp.float32))
+            rxs, rxss, rxys, rys, ryss, _, _ = (
+                np.asarray(v, dtype=np.float64) for v in r_moments
+            )
+            corr = pearson_correlation(
+                rxs, rxss, rxys, float(rys), float(ryss), float(n)
+            )
+        else:
+            corr = pearson_correlation(
+                xs, xss, xys, float(ys), float(yss), float(n)
+            )
 
         # contingency tables per categorical group
         classes = np.unique(y)

@@ -70,6 +70,35 @@ def max_rule_confidences(contingency: np.ndarray) -> tuple[np.ndarray, np.ndarra
     return conf, support
 
 
+def average_ranks(a: np.ndarray) -> np.ndarray:
+    """Average (fractional) ranks, 1-based, ties averaged - scipy
+    rankdata(method='average') semantics, vectorized per column for 2-D
+    input.  Host-side by design: Spearman runs under the SanityChecker
+    sample cap (<= 1M rows), where host ranking is cheap and sort-free
+    device ranking is not (TPU sorts at [n, d] scale are pathologically
+    slow - see the rank-metric kernel's design notes)."""
+    a = np.asarray(a, dtype=np.float64)
+    if a.ndim == 1:
+        return _average_ranks_1d(a)
+    out = np.empty_like(a)
+    for j in range(a.shape[1]):
+        out[:, j] = _average_ranks_1d(a[:, j])
+    return out
+
+
+def _average_ranks_1d(v: np.ndarray) -> np.ndarray:
+    order = np.argsort(v, kind="stable")
+    sv = v[order]
+    new_group = np.r_[True, sv[1:] != sv[:-1]]
+    group_ids = np.cumsum(new_group) - 1
+    firsts = np.nonzero(new_group)[0]
+    counts = np.diff(np.r_[firsts, len(v)])
+    avg = firsts + (counts - 1) / 2.0 + 1.0  # 1-based average rank per group
+    ranks = np.empty(len(v), dtype=np.float64)
+    ranks[order] = avg[group_ids]
+    return ranks
+
+
 def pearson_correlation(
     x_sum: np.ndarray,
     x_sq_sum: np.ndarray,
