@@ -58,8 +58,9 @@ inline void impurity(const double* s, int32_t C, int32_t kind, double* imp_w,
 }
 
 struct TreeScratch {
-  std::vector<double> hist;        // [L, d, B, C]
-  std::vector<double> node_stats;  // [L, C]
+  std::vector<double> hist;        // [A, d, B, C] - ACTIVE nodes only
+  std::vector<double> node_stats;  // [A, C]
+  std::vector<int32_t> slot_of_node;  // [L] node -> compact slot (-1 empty)
   std::vector<int32_t> node_of_row;
   std::vector<float> stats_w;      // [n, C]
   std::vector<uint8_t> active;     // [n] row weight != 0
@@ -99,27 +100,44 @@ void fit_one_tree(const int32_t* bins, const float* stats_row,
     const int64_t L = (int64_t)1 << level;
     const int64_t base = L - 1;
     const bool last = (level == max_depth);
-    // the final level only emits leaf values - no split search, so no
-    // [L, d, B, C] histogram (it would be the largest one)
-    if (!last) ws.hist.assign((size_t)L * d * B * C, 0.0);
-    ws.node_stats.assign((size_t)L * C, 0.0);
 
+    // Compact ACTIVE nodes (those holding >=1 weighted row) to slots: the
+    // number of occupied nodes is bounded by the row count, not 2^level,
+    // so deep trees never allocate/zero the exponential [L, d, B, C]
+    // histogram (at depth 12 the dense form is ~100 MB per level per
+    // tree; the active form stays ~A/L of that).
+    ws.slot_of_node.assign((size_t)L, -1);
+    int64_t A = 0;
     for (int64_t i = 0; i < n; ++i) {
       if (!ws.active[i]) continue;
       const int32_t node = ws.node_of_row[i];
+      if (ws.slot_of_node[node] < 0) ws.slot_of_node[node] = (int32_t)A++;
+    }
+    if (A == 0) break;  // no populated nodes -> nothing more to emit
+
+    // the final level only emits leaf values - no split search, so no
+    // [A, d, B, C] histogram (it would be the largest one)
+    if (!last) ws.hist.assign((size_t)A * d * B * C, 0.0);
+    ws.node_stats.assign((size_t)A * C, 0.0);
+
+    for (int64_t i = 0; i < n; ++i) {
+      if (!ws.active[i]) continue;
+      const int32_t slot = ws.slot_of_node[ws.node_of_row[i]];
       const float* sw = &ws.stats_w[(size_t)i * C];
-      double* ns = &ws.node_stats[(size_t)node * C];
+      double* ns = &ws.node_stats[(size_t)slot * C];
       for (int32_t c = 0; c < C; ++c) ns[c] += sw[c];
       if (last) continue;
       const int32_t* br = &bins[(size_t)i * d];
-      double* nh = &ws.hist[(size_t)node * d * B * C];
+      double* nh = &ws.hist[(size_t)slot * d * B * C];
       for (int32_t j = 0; j < d; ++j) {
         double* cell = nh + ((size_t)j * B + br[j]) * C;
         for (int32_t c = 0; c < C; ++c) cell[c] += sw[c];
       }
     }
     for (int64_t q = 0; q < L; ++q) {
-      const double* ns = &ws.node_stats[(size_t)q * C];
+      const int32_t slot = ws.slot_of_node[q];
+      if (slot < 0) continue;  // heap value stays zeroed (empty node)
+      const double* ns = &ws.node_stats[(size_t)slot * C];
       float* v = hv + (size_t)(base + q) * C;
       for (int32_t c = 0; c < C; ++c) v[c] = (float)ns[c];
     }
@@ -130,13 +148,15 @@ void fit_one_tree(const int32_t* bins, const float* stats_row,
     ws.split_ok.assign((size_t)L, 0);
 
     for (int64_t q = 0; q < L; ++q) {
-      const double* ns = &ws.node_stats[(size_t)q * C];
+      const int32_t slot = ws.slot_of_node[q];
+      if (slot < 0) continue;
+      const double* ns = &ws.node_stats[(size_t)slot * C];
       double node_imp, node_w;
       impurity(ns, C, impurity_kind, &node_imp, &node_w);
       if (node_w <= 0.0) continue;
       double best_gain = -INFINITY;
       int32_t bf = -1, bb = -1;
-      const double* nh = &ws.hist[(size_t)q * d * B * C];
+      const double* nh = &ws.hist[(size_t)slot * d * B * C];
       for (int32_t j = 0; j < d; ++j) {
         if (!feat_mask[j]) continue;
         if (subset_p < 1.0) {

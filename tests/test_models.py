@@ -194,3 +194,33 @@ def test_impurity_importances_ignore_shadow_splits():
     hv[0, 4] = [50.0, 0.0, 50.0]
     imp = heap_impurity_importances((hf, ht, hl, hv), 4, "gini")
     assert imp.sum() == 0.0  # nothing reachable splits -> no importance
+
+
+def test_grid_batched_forest_matches_per_config(rng):
+    """fit_arrays_folds_grid (one dispatch per static-shape group) must
+    produce EXACTLY the trees the per-config fit_arrays_folds path grows -
+    same seeds, same traced min_* scalars, just batched."""
+    n, d = 300, 6
+    X = rng.randn(n, d)
+    y = ((X[:, 0] + X[:, 3]) > 0).astype(np.float64)
+    W = np.stack([np.r_[np.ones(200), np.zeros(100)],
+                  np.r_[np.zeros(100), np.ones(200)]])
+    grid = [
+        {"max_depth": 4, "min_info_gain": 0.0, "min_instances_per_node": 1},
+        {"max_depth": 4, "min_info_gain": 0.05, "min_instances_per_node": 5},
+        {"max_depth": 3, "min_info_gain": 0.0, "min_instances_per_node": 1},
+    ]
+    est = OpRandomForestClassifier(num_trees=4, backend="jax")
+    batched = est.fit_arrays_folds_grid(X, y, W, grid)
+    assert batched is not None and len(batched) == 3
+    for j, pmap in enumerate(grid):
+        cand = est.with_params(**pmap)
+        single = cand.fit_arrays_folds(X, y, W)
+        for f in range(2):
+            for hb, hs in zip(batched[j][f]["heaps"], single[f]["heaps"]):
+                np.testing.assert_array_equal(np.asarray(hb), np.asarray(hs))
+            pb = batched[j][f]
+            ps = single[f]
+            predb = cand.predict_arrays(pb, X)[0]
+            preds = cand.predict_arrays(ps, X)[0]
+            np.testing.assert_array_equal(predb, preds)

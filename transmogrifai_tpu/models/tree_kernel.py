@@ -266,6 +266,43 @@ def fit_forest_folds(
     return jax.vmap(one_fold)(w_rows)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth", "max_bins", "impurity_kind", "n_stats", "feature_subset_p"
+    ),
+)
+def fit_forest_folds_grid(
+    bins, stats_row, w_rows,      # w_rows [F, n] fold weights
+    boot_w, feat_masks, rng_keys,
+    min_instances_g, min_info_gain_g,  # [G] per-grid-point TRACED scalars
+    max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
+    feature_subset_p: float = 1.0,
+):
+    """Grid x fold forest fan-out in ONE dispatch.
+
+    min_instances_per_node / min_info_gain are traced scalars in fit_tree,
+    so every grid point sharing the static shape params (depth, bins,
+    trees, subset strategy) batches along a sequential lax.map axis over
+    the fold-vmapped fit - a 16-config RF grid x 3 folds compiles once and
+    dispatches once instead of 16 host-loop iterations (reference
+    counterpart: the Future pool training all paramMap variants
+    concurrently, OpValidator.scala:289-306).  Returns heaps with leading
+    axes [G, F, T, ...]."""
+
+    def one_cfg(args):
+        minipn, minig = args
+        return fit_forest_folds(
+            bins, stats_row, w_rows, boot_w, feat_masks, rng_keys,
+            max_depth, max_bins, impurity_kind, n_stats,
+            minipn, minig, feature_subset_p,
+        )
+
+    # sequential over grid points (lax.map), vmapped over folds inside:
+    # peak memory stays at one fold-batch of level histograms
+    return jax.lax.map(one_cfg, (min_instances_g, min_info_gain_g))
+
+
 def effective_max_depth(
     max_depth: int,
     n_rows: int,

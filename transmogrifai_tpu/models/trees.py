@@ -26,6 +26,7 @@ from .tree_kernel import (
     effective_max_depth,
     fit_forest,
     fit_forest_folds,
+    fit_forest_folds_grid,
     fit_tree,
     heap_impurity_importances,
     predict_forest,
@@ -258,6 +259,72 @@ class _RandomForest(_TreeEnsembleBase):
             }
             for f in range(len(W))
         ]
+
+    def fit_arrays_folds_grid(self, X, y, W, grid) -> Optional[list]:
+        """Whole-grid CV fan-out: groups grid points by their STATIC shape
+        params (effective depth, bins, trees, subset strategy, seed), then
+        fits each group's configs x folds as ONE device dispatch
+        (tree_kernel.fit_forest_folds_grid; min_info_gain and
+        min_instances ride a traced lax.map axis).  Returns params[g][f]
+        aligned with ``grid``, or None when the native host backend is
+        active (its per-config C++ loop is already the fast path there).
+        """
+        p0 = self.params
+        if _resolve_backend(str(p0.get("backend", "auto"))) == "native":
+            return None
+        n, d = X.shape
+        cands = [self.with_params(**pmap) for pmap in grid]
+        n_stats = (len(np.unique(y)) + 1) if self.is_classification else 3
+        groups: dict[tuple, list[int]] = {}
+        for j, cand in enumerate(cands):
+            p = cand.params
+            depth = effective_max_depth(
+                int(p["max_depth"]), n, float(p["min_instances_per_node"]),
+                d, int(p["max_bins"]), n_stats,
+                cap=str(p.get("depth_cap", "auto")),
+            )
+            key = (
+                depth, int(p["max_bins"]), int(p["num_trees"]),
+                str(p["feature_subset_strategy"]), int(p["seed"]),
+                float(p["subsampling_rate"]),
+            )
+            groups.setdefault(key, []).append(j)
+        results: list = [None] * len(grid)
+        W32 = jnp.asarray(np.asarray(W, np.float32))
+        for key, js in groups.items():
+            rep = cands[js[0]]
+            (edges, bins, stats, C, imp, classes, boot, feat_masks,
+             seed_ints, subset_p, depth) = rep._forest_inputs(X, y)
+            assert depth == key[0]
+            minipn_g = jnp.asarray(
+                [float(cands[j].params["min_instances_per_node"]) for j in js],
+                jnp.float32,
+            )
+            minig_g = jnp.asarray(
+                [float(cands[j].params["min_info_gain"]) for j in js],
+                jnp.float32,
+            )
+            keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seed_ints))
+            heaps = fit_forest_folds_grid(
+                jnp.asarray(bins), jnp.asarray(stats), W32,
+                jnp.asarray(boot), jnp.asarray(feat_masks), keys,
+                minipn_g, minig_g,
+                max_depth=depth, max_bins=int(rep.params["max_bins"]),
+                impurity_kind=imp, n_stats=C,
+                feature_subset_p=float(subset_p),
+            )
+            heaps = tuple(np.asarray(h) for h in heaps)  # [G', F, T, ...]
+            for gi, j in enumerate(js):
+                results[j] = [
+                    {
+                        "edges": edges,
+                        "heaps": tuple(h[gi][f] for h in heaps),
+                        "classes": classes,
+                        "max_depth": depth,
+                    }
+                    for f in range(len(W))
+                ]
+        return results
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         bins = _bin_for_backend(np.asarray(X, np.float32), params["edges"])

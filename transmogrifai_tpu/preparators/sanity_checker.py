@@ -142,14 +142,19 @@ class SanityChecker(Estimator):
         # psum collectives (the treeAggregate analog,
         # SanityChecker.scala:575)
         mesh = data_mesh_or_none()
-        if mesh is not None:
-            moments = fused_moments_sharded(x, y, mesh)
-        else:
-            moments = fused_moments(jnp.asarray(x, jnp.float32),
-                                    jnp.asarray(y, jnp.float32))
-        xs, xss, xys, ys, yss, xmin, xmax = (
-            np.asarray(v, dtype=np.float64) for v in moments
-        )
+
+        def moments_f64(a, b):
+            """One dispatch policy for every moment pass in this fit:
+            sharded over the data mesh when present, single-device
+            otherwise; f64 on the way out."""
+            if mesh is not None:
+                mom = fused_moments_sharded(a, b, mesh)
+            else:
+                mom = fused_moments(jnp.asarray(a, jnp.float32),
+                                    jnp.asarray(b, jnp.float32))
+            return tuple(np.asarray(v, dtype=np.float64) for v in mom)
+
+        xs, xss, xys, ys, yss, xmin, xmax = moments_f64(x, y)
         mean = xs / n
         var = np.maximum(xss / n - mean**2, 0.0) * (n / max(n - 1, 1))
         if self.correlation_type == "spearman":
@@ -178,14 +183,7 @@ class SanityChecker(Estimator):
             # (sum of squared ranks ~ n^3/3)
             xr = (average_ranks(x_host) - (n + 1) / 2.0) / n
             yr = (average_ranks(y) - (n + 1) / 2.0) / n
-            if mesh is not None:
-                r_moments = fused_moments_sharded(xr, yr, mesh)
-            else:
-                r_moments = fused_moments(jnp.asarray(xr, jnp.float32),
-                                          jnp.asarray(yr, jnp.float32))
-            rxs, rxss, rxys, rys, ryss, _, _ = (
-                np.asarray(v, dtype=np.float64) for v in r_moments
-            )
+            rxs, rxss, rxys, rys, ryss, _, _ = moments_f64(xr, yr)
             corr = pearson_correlation(
                 rxs, rxss, rxys, float(rys), float(ryss), float(n)
             )

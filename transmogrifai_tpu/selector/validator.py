@@ -276,14 +276,24 @@ class OpValidator:
                             )
                             metrics[j, f] = self._metric_of(yv, pred, raw, prob)
             elif hasattr(est, "fit_arrays_folds"):
-                # fold-batched path (trees): one vmapped fit per grid point
+                # fold-batched path (trees): grid x folds in one-or-few
+                # device dispatches when the estimator supports whole-grid
+                # batching, else one vmapped fit per grid point
                 Xh = np.asarray(X)
                 W = masks.astype(np.float64) * w[None, :]
-                for j, pmap in enumerate(grid):
-                    if done_mask[j]:
-                        continue
+                todo = [j for j in range(g) if not done_mask[j]]
+                grid_fold_params = None
+                if todo and hasattr(est, "fit_arrays_folds_grid"):
+                    grid_fold_params = est.fit_arrays_folds_grid(
+                        Xh, y, W, [grid[j] for j in todo]
+                    )
+                for pos, j in enumerate(todo):
+                    pmap = grid[j]
                     cand = est.with_params(**pmap)
-                    fold_params = cand.fit_arrays_folds(Xh, y, W)
+                    if grid_fold_params is not None:
+                        fold_params = grid_fold_params[pos]
+                    else:
+                        fold_params = cand.fit_arrays_folds(Xh, y, W)
                     for f in range(k):
                         val = ~masks[f]
                         pred, raw, prob = cand.predict_arrays(
