@@ -1,0 +1,597 @@
+"""Uniform per-stage contract tests.
+
+Analog of the reference's OpTransformerSpec / OpEstimatorSpec library specs
+(reference: features/src/main/scala/com/salesforce/op/test/
+OpEstimatorSpec.scala:55, OpTransformerSpec.scala): EVERY public stage
+class in ops/, models/ and preparators/ is driven through one shared
+contract —
+
+  construct -> wire testkit-generated inputs -> train -> score ->
+  metadata presence -> deterministic re-transform -> save/load round-trip
+  into a freshly built workflow -> bit-identical re-score -> copy isolation
+
+A final coverage test asserts no public stage class escaped the
+parametrization (estimator-produced Model classes are credited when an
+estimator's contract run instantiates them).
+"""
+from __future__ import annotations
+
+import base64
+import importlib
+import inspect
+import pkgutil
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.stages.base import Estimator, PipelineStage
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import (
+    GeolocationColumn,
+    ListColumn,
+    MapColumn,
+    NumericColumn,
+    PredictionColumn,
+    TextColumn,
+    VectorColumn,
+)
+from transmogrifai_tpu.utils.uid import reset_uids
+from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+N = 80  # rows per contract dataset
+
+# ---------------------------------------------------------------------------
+# testkit-style typed value generation
+# ---------------------------------------------------------------------------
+_WORDS = ["alpha", "bravo", "charlie", "delta", "echo", "golf", "hotel"]
+_PICKS = ["red", "green", "blue"]
+
+
+def _scalar(t, rng):
+    """One random value of feature type t (most-specific subtype first)."""
+    if issubclass(t, ft.Binary):
+        return bool(rng.rand() < 0.5)
+    if issubclass(t, ft.Date):  # Date/DateTime (epoch millis)
+        return int(1.5e12) + int(rng.randint(0, 10**9))
+    if issubclass(t, ft.Integral):
+        return int(rng.randint(0, 50))
+    if issubclass(t, ft.Real):  # Real/RealNN/Percent/Currency
+        return float(rng.randn())
+    if issubclass(t, ft.Email):
+        return f"{_WORDS[rng.randint(len(_WORDS))]}@example.com"
+    if issubclass(t, ft.Phone):
+        return f"650-{rng.randint(200, 999)}-{rng.randint(1000, 9999)}"
+    if issubclass(t, ft.URL):
+        return f"https://{_WORDS[rng.randint(len(_WORDS))]}.example.com/x"
+    if issubclass(t, ft.Base64):
+        payload = b"\x89PNG\r\n\x1a\n" + bytes(rng.randint(0, 256, 16).tolist())
+        return base64.b64encode(payload).decode("ascii")
+    if issubclass(t, ft.PickList) or issubclass(t, ft.ComboBox):
+        return _PICKS[rng.randint(len(_PICKS))]
+    if issubclass(t, ft.Country):
+        return ["France", "Japan", "Brazil"][rng.randint(3)]
+    if issubclass(t, ft.State):
+        return ["CA", "NY", "TX"][rng.randint(3)]
+    if issubclass(t, ft.PostalCode):
+        return f"{rng.randint(10000, 99999)}"
+    if issubclass(t, ft.Text):  # Text/TextArea/ID/City/Street
+        k = rng.randint(1, 4)
+        return " ".join(_WORDS[rng.randint(len(_WORDS))] for _ in range(k))
+    if issubclass(t, ft.MultiPickList):
+        k = rng.randint(0, 3)
+        return frozenset(_PICKS[rng.randint(len(_PICKS))] for _ in range(k))
+    if issubclass(t, ft.Geolocation):
+        return (float(rng.uniform(-60, 60)), float(rng.uniform(-180, 180)), 5.0)
+    if issubclass(t, ft.TextList):
+        k = rng.randint(0, 4)
+        return [_WORDS[rng.randint(len(_WORDS))] for _ in range(k)]
+    if issubclass(t, ft.DateList):
+        k = rng.randint(0, 3)
+        return [int(1.5e12) + int(rng.randint(0, 10**9)) for _ in range(k)]
+    raise TypeError(f"no generator for {t.__name__}")
+
+
+def _values(t, n, rng, p_empty=0.1):
+    """n optional values of type t (nullable types draw ~p_empty Nones)."""
+    if issubclass(t, ft.OPMap):
+        vt = t.value_type or ft.Text
+        out = []
+        for _ in range(n):
+            if rng.rand() < p_empty:
+                out.append({})
+            else:
+                out.append(
+                    {k: _scalar(vt, rng) for k in ("k1", "k2", "k3")
+                     if rng.rand() < 0.8}
+                )
+        return out
+    if issubclass(t, ft.OPVector):
+        return [rng.randn(4).tolist() for _ in range(n)]
+    nullable = not t.non_nullable
+    return [
+        None if (nullable and rng.rand() < p_empty) else _scalar(t, rng)
+        for _ in range(n)
+    ]
+
+
+def _raw(name, t, response=False):
+    fb = FeatureBuilder(t, name)
+    return fb.as_response() if response else fb.as_predictor()
+
+
+# ---------------------------------------------------------------------------
+# spec builders: each returns (result_feature, data_dict) for seeded rng
+# ---------------------------------------------------------------------------
+def _wire_simple(cls, in_types, ctor=None, data_fn=None):
+    """Stage over raw features of in_types; ctor() builds the instance."""
+
+    def build(n, rng):
+        feats, data = [], {}
+        for i, t in enumerate(in_types):
+            name = f"in{i}"
+            feats.append(_raw(name, t))
+            data[name] = (data_fn or _values)(t, n, rng) if data_fn is None \
+                else data_fn(i, t, n, rng)
+        stage = cls() if ctor is None else ctor()
+        stage.set_input(*feats)
+        return stage.get_output(), data
+
+    return build
+
+
+def _wire_labeled(cls, x_type, ctor=None, binary_label=True):
+    """Estimator over (RealNN label, x_type feature): label correlates with
+    the input so fits are non-degenerate."""
+
+    def build(n, rng):
+        x = _values(x_type, n, rng)
+        xv = np.array([0.0 if v is None else float(v) for v in x])
+        noise = rng.randn(n) * 0.5
+        y = (xv + noise > 0).astype(float) if binary_label else xv * 2 + noise
+        lab = _raw("y", ft.RealNN, response=True)
+        xf = _raw("x", x_type)
+        stage = cls() if ctor is None else ctor()
+        stage.set_input(lab, xf)
+        return stage.get_output(), {"y": y.tolist(), "x": x}
+
+    return build
+
+
+def _predictor_data(n, rng, task):
+    """3 Real predictors + label via a planted linear rule."""
+    x1, x2, x3 = rng.randn(n), rng.randn(n), rng.randn(n)
+    z = 1.2 * x1 - 0.8 * x2 + 0.3 * rng.randn(n)
+    y = (z > 0).astype(float) if task == "clf" else z
+    data = {"y": y.tolist(), "x1": x1.tolist(), "x2": x2.tolist(),
+            "x3": x3.tolist()}
+    return data
+
+
+def _wire_predictor(cls, ctor=None, task="clf"):
+    """(label, RealVectorizer([x1,x2,x3])) -> predictor -> Prediction."""
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+
+    def build(n, rng):
+        data = _predictor_data(n, rng, task)
+        y = _raw("y", ft.RealNN, response=True)
+        xs = [_raw(f"x{i}", ft.Real) for i in (1, 2, 3)]
+        vec = RealVectorizer().set_input(*xs).get_output()
+        stage = cls() if ctor is None else ctor()
+        stage.set_input(y, vec)
+        return stage.get_output(), data
+
+    return build
+
+
+def _wire_vectorizer(cls, in_type, ctor=None, n_feats=2):
+    """Variadic vectorizer over n_feats raw features of in_type."""
+
+    def build(n, rng):
+        feats, data = [], {}
+        for i in range(n_feats):
+            name = f"v{i}"
+            feats.append(_raw(name, in_type))
+            data[name] = _values(in_type, n, rng)
+        stage = cls() if ctor is None else ctor()
+        stage.set_input(*feats)
+        return stage.get_output(), data
+
+    return build
+
+
+def _build_descaler(n, rng):
+    from transmogrifai_tpu.ops.collections import (
+        DescalerTransformer,
+        ScalerTransformer,
+    )
+
+    a = _raw("a", ft.Real)
+    scaled = ScalerTransformer(scaling_type="linear", slope=2.0,
+                               intercept=1.0).set_input(a).get_output()
+    out = DescalerTransformer().set_input(scaled, scaled).get_output()
+    return out, {"a": _values(ft.Real, n, rng)}
+
+
+def _build_drop_indices(n, rng):
+    from transmogrifai_tpu.ops.combiner import DropIndicesByTransformer
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+
+    a, b = _raw("a", ft.Real), _raw("b", ft.Real)
+    vec = RealVectorizer().set_input(a, b).get_output()
+    out = (
+        DropIndicesByTransformer(predicate=_drop_null_indicators)
+        .set_input(vec)
+        .get_output()
+    )
+    return out, {"a": _values(ft.Real, n, rng), "b": _values(ft.Real, n, rng)}
+
+
+def _drop_null_indicators(meta):  # module-level: survives workflow rebuild
+    return meta.is_null_indicator
+
+
+def _build_vectors_combiner(n, rng):
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    from transmogrifai_tpu.ops.numeric import IntegralVectorizer, RealVectorizer
+
+    a, b = _raw("a", ft.Real), _raw("b", ft.Integral)
+    v1 = RealVectorizer().set_input(a).get_output()
+    v2 = IntegralVectorizer().set_input(b).get_output()
+    out = VectorsCombiner().set_input(v1, v2).get_output()
+    return out, {"a": _values(ft.Real, n, rng),
+                 "b": _values(ft.Integral, n, rng)}
+
+
+def _build_sanity_checker(n, rng):
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+
+    data = _predictor_data(n, rng, "clf")
+    y = _raw("y", ft.RealNN, response=True)
+    xs = [_raw(f"x{i}", ft.Real) for i in (1, 2, 3)]
+    vec = RealVectorizer().set_input(*xs).get_output()
+    out = SanityChecker().set_input(y, vec).get_output()
+    return out, data
+
+
+def _build_deindexer(n, rng):
+    from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.preparators.deindexer import PredictionDeIndexer
+
+    data = _predictor_data(n, rng, "clf")
+    data["ytext"] = ["yes" if v else "no" for v in data["y"]]
+    y = _raw("y", ft.RealNN, response=True)
+    ytext = _raw("ytext", ft.PickList)
+    xs = [_raw(f"x{i}", ft.Real) for i in (1, 2, 3)]
+    vec = RealVectorizer().set_input(*xs).get_output()
+    pred = OpLogisticRegression(max_iter=5).set_input(y, vec).get_output()
+    out = PredictionDeIndexer().set_input(ytext, pred).get_output()
+    return out, data
+
+
+def _build_lda(n, rng):
+    from transmogrifai_tpu.models.unsupervised import OpLDA
+
+    vec = _raw("counts", ft.OPVector)
+    data = {"counts": [rng.poisson(2.0, 6).astype(float).tolist()
+                       for _ in range(n)]}
+    out = OpLDA(k=3, max_iter=5).set_input(vec).get_output()
+    return out, data
+
+
+def _build_word2vec(n, rng):
+    from transmogrifai_tpu.models.unsupervised import OpWord2Vec
+
+    tl = _raw("tokens", ft.TextList)
+    data = {"tokens": [[_WORDS[rng.randint(len(_WORDS))]
+                        for _ in range(rng.randint(2, 6))] for _ in range(n)]}
+    out = (
+        OpWord2Vec(vector_size=8, min_count=1, steps=50)
+        .set_input(tl)
+        .get_output()
+    )
+    return out, data
+
+
+def _int_index_values(i, t, n, rng):
+    return [float(rng.randint(0, 3)) for _ in range(n)]
+
+
+def _lazy(module, name):
+    def ctor_factory(**kw):
+        cls = getattr(importlib.import_module(module), name)
+        return cls(**kw)
+
+    return ctor_factory
+
+
+# ---------------------------------------------------------------------------
+# the spec registry: class name -> build(n, rng) -> (result_feature, data)
+# ---------------------------------------------------------------------------
+def _specs():
+    from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+    from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+    from transmogrifai_tpu.models.linear_svc import OpLinearSVC
+    from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+    from transmogrifai_tpu.models.mlp import OpMultilayerPerceptronClassifier
+    from transmogrifai_tpu.models.naive_bayes import OpNaiveBayes
+    from transmogrifai_tpu.models import trees as tr
+    from transmogrifai_tpu.ops import text_analysis as ta
+    from transmogrifai_tpu.ops.bucketizers import (
+        DecisionTreeNumericBucketizer,
+        NumericBucketizer,
+    )
+    from transmogrifai_tpu.ops.categorical import (
+        IndexToString,
+        OneHotVectorizer,
+        StringIndexer,
+    )
+    from transmogrifai_tpu.ops.collections import (
+        FilterMap,
+        IsotonicRegressionCalibrator,
+        ScalerTransformer,
+        ToOccurTransformer,
+    )
+    from transmogrifai_tpu.ops.combiner import AliasTransformer
+    from transmogrifai_tpu.ops.dates import DateVectorizer
+    from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.ops.numeric import (
+        BinaryVectorizer,
+        IntegralVectorizer,
+        RealNNVectorizer,
+        RealVectorizer,
+    )
+    from transmogrifai_tpu.ops.scalers import (
+        FillMissingWithMean,
+        OpScalarStandardScaler,
+        PercentileCalibrator,
+    )
+    from transmogrifai_tpu.ops.text import (
+        SmartTextVectorizer,
+        TextListHashingVectorizer,
+        TextTokenizer,
+    )
+
+    specs = {
+        # -- plain transformers ------------------------------------------
+        "NumericBucketizer": _wire_simple(
+            NumericBucketizer, [ft.Real],
+            ctor=lambda: NumericBucketizer(splits=[-np.inf, -1.0, 0.0, 1.0,
+                                                   np.inf])),
+        "IndexToString": (lambda n, rng: (
+            IndexToString(labels=["a", "b", "c"])
+            .set_input(_raw("idx", ft.Real)).get_output(),
+            {"idx": _int_index_values(0, ft.Real, n, rng)})),
+        "FilterMap": _wire_simple(
+            FilterMap, [ft.TextMap],
+            ctor=lambda: FilterMap(block_keys=["k2"])),
+        "ScalerTransformer": _wire_simple(
+            ScalerTransformer, [ft.Real],
+            ctor=lambda: ScalerTransformer(scaling_type="linear", slope=2.0,
+                                           intercept=1.0)),
+        "DescalerTransformer": _build_descaler,
+        "ToOccurTransformer": _wire_simple(ToOccurTransformer, [ft.Text]),
+        "AliasTransformer": _wire_simple(
+            AliasTransformer, [ft.Real],
+            ctor=lambda: AliasTransformer(name="aliased")),
+        "DropIndicesByTransformer": _build_drop_indices,
+        "VectorsCombiner": _build_vectors_combiner,
+        "TextTokenizer": _wire_simple(TextTokenizer, [ft.Text]),
+        "EmailToPickList": _wire_simple(ta.EmailToPickList, [ft.Email]),
+        "JaccardSimilarity": _wire_simple(
+            ta.JaccardSimilarity, [ft.MultiPickList, ft.MultiPickList]),
+        "LangDetector": _wire_simple(ta.LangDetector, [ft.Text]),
+        "MimeTypeDetector": _wire_simple(ta.MimeTypeDetector, [ft.Base64]),
+        "NGramSimilarity": _wire_simple(ta.NGramSimilarity, [ft.Text, ft.Text]),
+        "NameEntityRecognizer": _wire_simple(ta.NameEntityRecognizer, [ft.Text]),
+        "PhoneNumberParser": _wire_simple(ta.PhoneNumberParser, [ft.Phone]),
+        "TextLenTransformer": _wire_simple(ta.TextLenTransformer, [ft.Text]),
+        "UrlToDomain": _wire_simple(ta.UrlToDomain, [ft.URL]),
+        # -- label-free estimators ---------------------------------------
+        "StringIndexer": _wire_simple(StringIndexer, [ft.PickList]),
+        "OneHotVectorizer": _wire_vectorizer(
+            OneHotVectorizer, ft.PickList,
+            ctor=lambda: OneHotVectorizer(top_k=10, min_support=2)),
+        "DateVectorizer": _wire_vectorizer(DateVectorizer, ft.Date),
+        "GeolocationVectorizer": _wire_vectorizer(
+            GeolocationVectorizer, ft.Geolocation),
+        "MapVectorizer": (lambda n, rng: (
+            MapVectorizer(top_k=10, min_support=2)
+            .set_input(_raw("m1", ft.RealMap), _raw("m2", ft.PickListMap))
+            .get_output(),
+            {"m1": _values(ft.RealMap, n, rng),
+             "m2": _values(ft.PickListMap, n, rng)})),
+        "BinaryVectorizer": _wire_vectorizer(BinaryVectorizer, ft.Binary),
+        "IntegralVectorizer": _wire_vectorizer(IntegralVectorizer, ft.Integral),
+        "RealNNVectorizer": _wire_vectorizer(RealNNVectorizer, ft.RealNN),
+        "RealVectorizer": _wire_vectorizer(RealVectorizer, ft.Real),
+        "FillMissingWithMean": _wire_simple(FillMissingWithMean, [ft.Real]),
+        "OpScalarStandardScaler": _wire_simple(OpScalarStandardScaler,
+                                               [ft.Real]),
+        "PercentileCalibrator": _wire_simple(
+            PercentileCalibrator, [ft.Real],
+            ctor=lambda: PercentileCalibrator(buckets=10)),
+        "SmartTextVectorizer": _wire_vectorizer(
+            SmartTextVectorizer, ft.Text,
+            ctor=lambda: SmartTextVectorizer(max_cardinality=5, top_k=10,
+                                             min_support=2, hash_dims=16)),
+        "TextListHashingVectorizer": _wire_simple(
+            TextListHashingVectorizer, [ft.TextList],
+            ctor=lambda: TextListHashingVectorizer(hash_dims=16)),
+        # -- labeled estimators ------------------------------------------
+        "DecisionTreeNumericBucketizer": _wire_labeled(
+            DecisionTreeNumericBucketizer, ft.Real,
+            ctor=lambda: DecisionTreeNumericBucketizer(max_depth=2)),
+        "IsotonicRegressionCalibrator": _wire_labeled(
+            IsotonicRegressionCalibrator, ft.Real),
+        "SanityChecker": _build_sanity_checker,
+        "PredictionDeIndexer": _build_deindexer,
+        # -- predictors --------------------------------------------------
+        "OpLogisticRegression": _wire_predictor(
+            OpLogisticRegression, ctor=lambda: OpLogisticRegression(max_iter=5)),
+        "OpLinearRegression": _wire_predictor(OpLinearRegression, task="reg"),
+        "OpLinearSVC": _wire_predictor(
+            OpLinearSVC, ctor=lambda: OpLinearSVC(max_iter=5)),
+        "OpNaiveBayes": _wire_predictor(OpNaiveBayes),
+        "OpMultilayerPerceptronClassifier": _wire_predictor(
+            OpMultilayerPerceptronClassifier,
+            ctor=lambda: OpMultilayerPerceptronClassifier(
+                hidden_layers=(4,), max_iter=10)),
+        "OpGeneralizedLinearRegression": _wire_predictor(
+            OpGeneralizedLinearRegression,
+            ctor=lambda: OpGeneralizedLinearRegression(max_iter=5),
+            task="reg"),
+        "OpRandomForestClassifier": _wire_predictor(
+            tr.OpRandomForestClassifier,
+            ctor=lambda: tr.OpRandomForestClassifier(num_trees=5, max_depth=3)),
+        "OpRandomForestRegressor": _wire_predictor(
+            tr.OpRandomForestRegressor,
+            ctor=lambda: tr.OpRandomForestRegressor(num_trees=5, max_depth=3),
+            task="reg"),
+        "OpDecisionTreeClassifier": _wire_predictor(
+            tr.OpDecisionTreeClassifier,
+            ctor=lambda: tr.OpDecisionTreeClassifier(max_depth=3)),
+        "OpDecisionTreeRegressor": _wire_predictor(
+            tr.OpDecisionTreeRegressor,
+            ctor=lambda: tr.OpDecisionTreeRegressor(max_depth=3), task="reg"),
+        "OpGBTClassifier": _wire_predictor(
+            tr.OpGBTClassifier,
+            ctor=lambda: tr.OpGBTClassifier(num_trees=3)),
+        "OpGBTRegressor": _wire_predictor(
+            tr.OpGBTRegressor,
+            ctor=lambda: tr.OpGBTRegressor(num_trees=3), task="reg"),
+        "OpXGBoostClassifier": _wire_predictor(
+            tr.OpXGBoostClassifier,
+            ctor=lambda: tr.OpXGBoostClassifier(num_round=3)),
+        "OpXGBoostRegressor": _wire_predictor(
+            tr.OpXGBoostRegressor,
+            ctor=lambda: tr.OpXGBoostRegressor(num_round=3), task="reg"),
+        "OpLDA": _build_lda,
+        "OpWord2Vec": _build_word2vec,
+    }
+    return specs
+
+
+SPECS = _specs()
+
+# classes with no standalone contract, with justification
+EXCLUDED = {
+    # abstract bases: concrete subclasses carry the contract
+    "PredictorEstimator", "SequenceVectorizer", "SequenceVectorizerModel",
+}
+
+# classes instantiated during some estimator's contract run (filled at
+# runtime; checked by test_zz_every_stage_class_is_covered)
+_FITTED_SEEN: set[str] = set()
+
+
+def _cols_equal(a, b) -> bool:
+    if type(a) is not type(b) or len(a) != len(b):
+        return False
+    if isinstance(a, NumericColumn):
+        return (np.array_equal(a.values, b.values)
+                and np.array_equal(a.mask, b.mask))
+    if isinstance(a, TextColumn):
+        return list(a.values) == list(b.values)
+    if isinstance(a, (ListColumn, MapColumn)):
+        return a.values == b.values
+    if isinstance(a, GeolocationColumn):
+        return (np.array_equal(a.values, b.values)
+                and np.array_equal(a.mask, b.mask))
+    if isinstance(a, VectorColumn):
+        return (np.array_equal(a.values, b.values)
+                and a.metadata.column_names() == b.metadata.column_names())
+    if isinstance(a, PredictionColumn):
+        for x, y in ((a.prediction, b.prediction),
+                     (a.raw_prediction, b.raw_prediction),
+                     (a.probability, b.probability)):
+            if (x is None) != (y is None):
+                return False
+            if x is not None and not np.array_equal(x, y):
+                return False
+        return True
+    raise TypeError(f"unknown column type {type(a).__name__}")
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_stage_contract(name, tmp_path):
+    build = SPECS[name]
+
+    def mk():
+        reset_uids()
+        rng = np.random.RandomState(7)
+        out, data = build(N, rng)
+        wf = OpWorkflow().set_result_features(out)
+        return wf, out, data
+
+    wf, out, data = mk()
+    wf.set_input_dataset(data)
+    model = wf.train()
+    _FITTED_SEEN.update(type(s).__name__ for s in model.stages)
+
+    # 1. scoring produces a full-length column of the declared output kind
+    col = model.score(data)[out.name]
+    assert len(col) == N
+
+    # 2. vector outputs carry coherent provenance metadata
+    if isinstance(col, VectorColumn):
+        assert col.metadata.size == col.width
+        assert all(c.parent_feature_name for c in col.metadata.columns)
+
+    # 3. deterministic re-transform
+    col_b = model.score(data)[out.name]
+    assert _cols_equal(col, col_b), "transform is not deterministic"
+
+    # 4. save/load round-trip into a freshly built same-code workflow
+    path = str(tmp_path / "model")
+    model.save(path)
+    wf2, out2, data2 = mk()
+    model2 = OpWorkflowModel.load(path, wf2)
+    col2 = model2.score(data2)[out2.name]
+    assert _cols_equal(col, col2), "save/load round-trip changed outputs"
+
+    # 5. round-trip equality must hold on UNSEEN data as well (catches
+    #    fitted state that only looked right because training-data caches
+    #    papered over it)
+    _, data_new = build(N, np.random.RandomState(11))
+    col_n1 = model.score(data_new)[out.name]
+    col_n2 = model2.score(data_new)[out2.name]
+    assert _cols_equal(col_n1, col_n2), (
+        "loaded model diverges from original on unseen data"
+    )
+
+    # 6. copy isolation: mutating a copy's params never leaks back
+    for s in model.stages:
+        c = s.copy()
+        c.set(__contract_probe__=1)
+        assert "__contract_probe__" not in s.params
+
+
+def _discover():
+    found = {}
+    for pkg in ("ops", "models", "preparators"):
+        p = importlib.import_module(f"transmogrifai_tpu.{pkg}")
+        for m in pkgutil.iter_modules(p.__path__):
+            mn = f"transmogrifai_tpu.{pkg}.{m.name}"
+            mod = importlib.import_module(mn)
+            for cname, obj in vars(mod).items():
+                if (inspect.isclass(obj) and issubclass(obj, PipelineStage)
+                        and obj.__module__ == mn
+                        and not cname.startswith("_")):
+                    found[cname] = obj
+    return found
+
+
+def test_zz_every_stage_class_is_covered():
+    """Coverage gate: every public stage class has a contract — directly
+    parametrized, instantiated by an estimator's contract run, or
+    explicitly excluded with justification."""
+    found = _discover()
+    missing = [
+        n for n in found
+        if n not in SPECS and n not in EXCLUDED and n not in _FITTED_SEEN
+    ]
+    assert not missing, f"stage classes with no contract coverage: {missing}"

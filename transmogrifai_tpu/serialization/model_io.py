@@ -37,6 +37,13 @@ def _encode(value: Any, arrays: dict[str, np.ndarray], path: str) -> Any:
     if isinstance(value, (list, tuple)):
         enc = [_encode(v, arrays, f"{path}[{i}]") for i, v in enumerate(value)]
         return {"__list__": enc, "__tuple__": isinstance(value, tuple)}
+    if isinstance(value, (set, frozenset)):
+        try:
+            items = sorted(value)
+        except TypeError:
+            items = list(value)
+        enc = [_encode(v, arrays, f"{path}{{{i}}}") for i, v in enumerate(items)]
+        return {"__set__": enc, "__frozen__": isinstance(value, frozenset)}
     if value is None or isinstance(value, (bool, int, float, str)):
         return value
     raise TypeError(
@@ -54,6 +61,9 @@ def _decode(value: Any, arrays) -> Any:
         if "__list__" in value:
             items = [_decode(v, arrays) for v in value["__list__"]]
             return tuple(items) if value.get("__tuple__") else items
+        if "__set__" in value:
+            items = [_decode(v, arrays) for v in value["__set__"]]
+            return frozenset(items) if value.get("__frozen__") else set(items)
     return value
 
 

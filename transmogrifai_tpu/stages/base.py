@@ -109,7 +109,12 @@ class PipelineStage:
         return [ds[f.name] for f in self.input_features]
 
     def copy(self) -> "PipelineStage":
-        return _copy.copy(self)
+        # Spark's defaultCopy copies the param map: mutating a copy's params
+        # or metadata must never leak into the original stage
+        new = _copy.copy(self)
+        new.params = _copy.deepcopy(self.params)
+        new.metadata = _copy.deepcopy(self.metadata)
+        return new
 
     def __repr__(self) -> str:
         ins = ", ".join(f.name for f in self.input_features)
