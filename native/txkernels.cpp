@@ -15,6 +15,8 @@
 #include <cstdlib>
 #include <cstring>
 #include <cctype>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -144,6 +146,133 @@ void tx_parse_doubles(const uint8_t* data, const int64_t* offsets, int64_t n,
       mask[i] = 1;
     }
   }
+}
+
+// ---------------------------------------------------------------------------
+// CSV ingestion (the reference streams CSVs through Spark partitions,
+// readers/.../DataReader.scala:173; here a byte-chunk state machine indexes
+// rows once, then cell extraction + numeric parsing fan out over threads).
+// ---------------------------------------------------------------------------
+
+// Single RFC-4180-style pass: record the byte offset of each row start
+// (newlines inside quoted fields do NOT break rows).  `row_starts` must
+// hold at least (#'\n' in buf) + 1 entries.  Returns the number of rows
+// (trailing newline does not open a phantom row).
+int64_t tx_csv_index(const uint8_t* buf, int64_t len, int64_t* row_starts) {
+  int64_t nrows = 0;
+  bool in_quotes = false;
+  bool at_row_start = true;
+  for (int64_t i = 0; i < len; i++) {
+    if (at_row_start) {
+      row_starts[nrows++] = i;
+      at_row_start = false;
+    }
+    const uint8_t c = buf[i];
+    if (c == '"') {
+      in_quotes = !in_quotes;  // doubled "" toggles twice: net unchanged
+    } else if (c == '\n' && !in_quotes) {
+      at_row_start = true;
+    }
+  }
+  return nrows;
+}
+
+namespace {
+
+// Extract one row's cells into column-major outputs.
+inline void csv_row_cells(const uint8_t* buf, int64_t row_begin,
+                          int64_t row_end, int64_t row, int64_t nrows,
+                          int32_t ncols, const uint8_t* is_num,
+                          double* num_out, uint8_t* num_mask,
+                          int64_t* cell_begin, int64_t* cell_end) {
+  int64_t i = row_begin;
+  for (int32_t col = 0; col < ncols; col++) {
+    int64_t cb, ce;
+    if (i >= row_end) {           // short row: missing trailing cells
+      cb = ce = row_end;
+    } else if (buf[i] == '"') {   // quoted cell: content excludes quotes
+      cb = ++i;
+      while (i < row_end) {
+        if (buf[i] == '"') {
+          if (i + 1 < row_end && buf[i + 1] == '"') { i += 2; continue; }
+          break;                  // closing quote
+        }
+        i++;
+      }
+      ce = i;
+      if (i < row_end) i++;       // skip closing quote
+      while (i < row_end && buf[i] != ',') i++;  // to delimiter
+      if (i < row_end) i++;       // skip comma
+    } else {
+      cb = i;
+      while (i < row_end && buf[i] != ',') i++;
+      ce = i;
+      if (i < row_end) i++;       // skip comma
+    }
+    if (ce > cb && buf[ce - 1] == '\r') ce--;  // CRLF tail on last cell
+    const int64_t slot = static_cast<int64_t>(col) * nrows + row;
+    cell_begin[slot] = cb;
+    cell_end[slot] = ce;
+    if (is_num[col]) {
+      const int64_t clen = ce - cb;
+      if (clen <= 0) {
+        num_out[slot] = 0.0;
+        num_mask[slot] = 0;
+      } else {
+        char tmp[64];
+        const int64_t m = clen < 63 ? clen : 63;
+        std::memcpy(tmp, buf + cb, m);
+        tmp[m] = 0;
+        char* end = nullptr;
+        const double v = std::strtod(tmp, &end);
+        if (end == tmp || (end && *end != 0 && !std::isspace(*end))) {
+          num_out[slot] = 0.0;
+          num_mask[slot] = 0;
+        } else {
+          num_out[slot] = v;
+          num_mask[slot] = 1;
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+// Cell extraction + numeric parse, threaded over row ranges.  Outputs are
+// COLUMN-major ([ncols, nrows]) so each parsed column is a contiguous
+// slice on the python side.  `row_starts` comes from tx_csv_index.
+void tx_csv_cells(const uint8_t* buf, int64_t len, const int64_t* row_starts,
+                  int64_t nrows, int32_t ncols, const uint8_t* is_num,
+                  double* num_out, uint8_t* num_mask, int64_t* cell_begin,
+                  int64_t* cell_end) {
+  const unsigned hw = std::thread::hardware_concurrency();
+  const int64_t nthreads =
+      nrows < 4096 ? 1 : (hw > 8 ? 8 : (hw ? hw : 1));
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++) {
+      const int64_t rb = row_starts[r];
+      int64_t re = (r + 1 < nrows) ? row_starts[r + 1] : len;
+      // trim the row terminator (tx_csv_index row starts follow '\n')
+      if (re > rb && r + 1 < nrows) re--;           // the '\n' itself
+      else if (re > rb && buf[re - 1] == '\n') re--; // last row w/ newline
+      csv_row_cells(buf, rb, re, r, nrows, ncols, is_num, num_out,
+                    num_mask, cell_begin, cell_end);
+    }
+  };
+  if (nthreads == 1) {
+    work(0, nrows);
+    return;
+  }
+  std::vector<std::thread> ts;
+  const int64_t step = (nrows + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; t++) {
+    const int64_t lo = t * step;
+    const int64_t hi = lo + step < nrows ? lo + step : nrows;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& th : ts) th.join();
 }
 
 }  // extern "C"

@@ -21,11 +21,14 @@ from .base import PredictorEstimator
 
 @partial(jax.jit, static_argnames=("family", "iters"))
 def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
+    """Standardization folded into the algebra (identities documented in
+    logistic_regression._lr_fit_kernel): no standardized copy of X is
+    materialized, so a vmap over CV fold weight vectors reads the shared
+    design matrix and adds only O(d^2) per-replica state."""
     n, d = X.shape
     wsum = jnp.maximum(w.sum(), 1e-12)
     mu_x = (w @ X) / wsum
     sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu_x**2, 1e-12))
-    Xs = (X - mu_x) / sd * (w[:, None] > 0)
 
     ybar = (w @ y) / wsum
     if family == "poisson":
@@ -51,17 +54,24 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
         return eta, jnp.ones_like(eta)  # gaussian identity
 
     def step(carry, _):
-        beta, b0 = carry
-        eta = Xs @ beta + b0
+        beta, b0 = carry  # beta in standardized space
+        gamma = beta / sd
+        eta = X @ gamma + (b0 - mu_x @ gamma)
         mu, wt = mean_and_weight(eta)
         wt = w * wt + 1e-8
         resid = w * (mu - y)
-        g = (Xs.T @ resid) / wsum + reg * beta
-        H = (Xs.T @ (Xs * wt[:, None])) / wsum + jnp.diag(
-            jnp.full((d,), reg + 1e-9)
-        )
-        g0 = resid.sum() / wsum
-        h0 = wt.sum() / wsum
+        sr = resid.sum()
+        g = (X.T @ resid - mu_x * sr) / sd / wsum + reg * beta
+        XtWX = X.T @ (X * wt[:, None])
+        a = wt @ X
+        s = wt.sum()
+        Hs = (
+            XtWX - jnp.outer(mu_x, a) - jnp.outer(a, mu_x)
+            + s * jnp.outer(mu_x, mu_x)
+        ) / jnp.outer(sd, sd) / wsum
+        H = Hs + jnp.diag(jnp.full((d,), reg + 1e-9))
+        g0 = sr / wsum
+        h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
         return (beta - delta, b0 - g0 / h0), None
 
@@ -70,6 +80,13 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
     )
     beta = beta_s / sd
     return beta, b0 - (mu_x * beta).sum()
+
+
+@partial(jax.jit, static_argnames=("family", "iters"))
+def _glm_fit_folds_kernel(X, y, W, reg, family: str, iters: int):
+    return jax.vmap(
+        lambda w: _glm_fit_kernel(X, y, w, reg, family, iters)
+    )(W)
 
 
 class OpGeneralizedLinearRegression(PredictorEstimator):
@@ -98,6 +115,23 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
             "intercept": float(b0),
             "family": self.params["family"],
         }
+
+    def fit_arrays_folds(self, X, y, W) -> list:
+        """CV fan-out: folds ride the weight axis of one vmapped IRLS
+        dispatch (no per-fold host loop)."""
+        betas, b0s = _glm_fit_folds_kernel(
+            jnp.asarray(X), jnp.asarray(y),
+            jnp.asarray(np.asarray(W, np.float64)),
+            jnp.asarray(float(self.params["reg_param"])),
+            family=self.params["family"],
+            iters=int(self.params["max_iter"]),
+        )
+        betas, b0s = np.asarray(betas), np.asarray(b0s)
+        return [
+            {"beta": betas[f], "intercept": float(b0s[f]),
+             "family": self.params["family"]}
+            for f in range(len(W))
+        ]
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         eta = X @ params["beta"] + params["intercept"]

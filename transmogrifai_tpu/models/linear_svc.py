@@ -20,25 +20,35 @@ from .base import PredictorEstimator
 
 @partial(jax.jit, static_argnames=("iters",))
 def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
+    """Standardization is folded into the algebra (the identities in
+    logistic_regression._lr_fit_kernel) so the kernel never materializes a
+    standardized copy of X - under vmap over CV fold/grid weight vectors
+    every replica reads the SHARED design matrix and adds only O(d^2)
+    state."""
     n, d = X.shape
     ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
     wsum = jnp.maximum(w.sum(), 1e-12)
     mu = (w @ X) / wsum
     sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu**2, 1e-12))
-    Xs = (X - mu) / sd * (w[:, None] > 0)
 
     def step(carry, _):
-        beta, b0 = carry
-        margin = ypm * (Xs @ beta + b0)
-        active = (margin < 1.0).astype(Xs.dtype) * w
+        beta, b0 = carry  # beta in standardized space
+        gamma = beta / sd
+        margin = ypm * (X @ gamma + (b0 - mu @ gamma))
+        active = (margin < 1.0).astype(X.dtype) * w
         # squared hinge: L = sum_active (1 - m)^2 / wsum + reg |beta|^2
         r = active * (margin - 1.0) * ypm
-        g = (Xs.T @ r) / wsum + 2.0 * reg * beta
-        H = (Xs.T @ (Xs * active[:, None])) / wsum + jnp.diag(
-            jnp.full((d,), 2.0 * reg + 1e-8)
-        )
-        g0 = r.sum() / wsum
-        h0 = active.sum() / wsum + 1e-8
+        sr = r.sum()
+        g = (X.T @ r - mu * sr) / sd / wsum + 2.0 * reg * beta
+        XtAX = X.T @ (X * active[:, None])
+        a = active @ X
+        s = active.sum()
+        Hs = (
+            XtAX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
+        ) / jnp.outer(sd, sd) / wsum
+        H = Hs + jnp.diag(jnp.full((d,), 2.0 * reg + 1e-8))
+        g0 = sr / wsum
+        h0 = s / wsum + 1e-8
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
         return (beta - delta, b0 - g0 / h0), None
 
@@ -47,6 +57,13 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
     )
     beta = beta_s / sd
     return beta, b0 - (mu * beta).sum()
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _svc_fit_batched(X, y, W, regs, iters: int):
+    return jax.vmap(
+        lambda w, r: _svc_fit_kernel(X, y, w, r, iters)
+    )(W, regs)
 
 
 class OpLinearSVC(PredictorEstimator):
@@ -66,6 +83,17 @@ class OpLinearSVC(PredictorEstimator):
             iters=int(self.params.get("max_iter", 20)),
         )
         return {"beta": np.asarray(beta), "intercept": float(b0)}
+
+    def fit_arrays_batched(self, X, y, W, regs, ens):
+        """Batched fit: W [B, n] weight masks, regs [B] -> stacked params;
+        the whole CV x grid fan-out as one vmapped dispatch (same contract
+        as OpLogisticRegression.fit_arrays_batched; SVC has no elastic-net
+        term, so ``ens`` is accepted and ignored)."""
+        beta, b0 = _svc_fit_batched(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(W), jnp.asarray(regs),
+            iters=int(self.params.get("max_iter", 20)),
+        )
+        return np.asarray(beta), np.asarray(b0)
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         z = X @ params["beta"] + params["intercept"]

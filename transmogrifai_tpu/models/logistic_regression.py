@@ -92,12 +92,11 @@ def _lr_fit_kernel(
     return beta, intercept
 
 
-_lr_fit_batched = jax.jit(
-    jax.vmap(
-        lambda X, y, w, reg, en: _lr_fit_kernel(X, y, w, reg, en),
-        in_axes=(None, None, 0, 0, 0),
-    )
-)
+@partial(jax.jit, static_argnames=("iters",))
+def _lr_fit_batched(X, y, W, regs, ens, iters: int = 25):
+    return jax.vmap(
+        lambda w, reg, en: _lr_fit_kernel(X, y, w, reg, en, iters)
+    )(W, regs, ens)
 
 
 @jax.jit
@@ -150,6 +149,7 @@ class OpLogisticRegression(PredictorEstimator):
         beta, b0 = _lr_fit_batched(
             jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
             jnp.asarray(regs), jnp.asarray(ens),
+            iters=int(self.params.get("max_iter", 25)),
         )
         return np.asarray(beta), np.asarray(b0)
 

@@ -40,8 +40,14 @@ def _forward(params, X):
     return h @ W + b  # logits
 
 
-@partial(jax.jit, static_argnames=("sizes", "steps"))
-def _mlp_fit_kernel(X, onehot, w, key, sizes: tuple, steps: int, lr: float = 1e-2):
+def _mlp_fit_impl(X, onehot, w, key, sizes: tuple, steps: int, lr: float = 1e-2):
+    # weighted standardization from TRAIN rows only (w=0 rows contribute
+    # nothing) so CV folds never leak held-out statistics; returned so
+    # scoring reproduces the same transform
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    mu = (w @ X) / wsum
+    sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu**2, 0.0)) + 1e-8
+    X = (X - mu) / sd
     params = _init_params(key, sizes)
     opt_state = [(jnp.zeros_like(W), jnp.zeros_like(b),
                   jnp.zeros_like(W), jnp.zeros_like(b)) for W, b in params]
@@ -77,7 +83,20 @@ def _mlp_fit_kernel(X, onehot, w, key, sizes: tuple, steps: int, lr: float = 1e-
     (params, _), _ = jax.lax.scan(
         step, (params, opt_state), jnp.arange(steps, dtype=jnp.float32)
     )
-    return params
+    return params, mu, sd
+
+
+_mlp_fit_kernel = partial(jax.jit, static_argnames=("sizes", "steps"))(
+    _mlp_fit_impl
+)
+
+
+@partial(jax.jit, static_argnames=("sizes", "steps"))
+def _mlp_fit_folds_kernel(X, onehot, W, key, sizes: tuple, steps: int,
+                          lr: float = 1e-2):
+    return jax.vmap(
+        lambda w: _mlp_fit_impl(X, onehot, w, key, sizes, steps, lr)
+    )(W)
 
 
 class OpMultilayerPerceptronClassifier(PredictorEstimator):
@@ -105,11 +124,9 @@ class OpMultilayerPerceptronClassifier(PredictorEstimator):
         w = np.ones(n) if w is None else w
         classes = np.unique(y)
         onehot = (y[:, None] == classes[None, :]).astype(np.float32)
-        mu, sd = X.mean(axis=0), X.std(axis=0) + 1e-8
-        Xs = (X - mu) / sd
         sizes = (d, *self.params["hidden_layers"], len(classes))
-        params = _mlp_fit_kernel(
-            jnp.asarray(Xs, jnp.float32), jnp.asarray(onehot),
+        params, mu, sd = _mlp_fit_kernel(
+            jnp.asarray(X, jnp.float32), jnp.asarray(onehot),
             jnp.asarray(w, jnp.float32),
             jax.random.PRNGKey(int(self.params["seed"])),
             sizes, int(self.params["max_iter"]),
@@ -118,9 +135,36 @@ class OpMultilayerPerceptronClassifier(PredictorEstimator):
         return {
             "layers": [(np.asarray(W), np.asarray(b)) for W, b in params],
             "classes": classes,
-            "mu": mu,
-            "sd": sd,
+            "mu": np.asarray(mu, np.float64),
+            "sd": np.asarray(sd, np.float64),
         }
+
+    def fit_arrays_folds(self, X, y, W) -> list:
+        """CV fan-out: folds batch as a leading axis of the weight vector
+        through one vmapped Adam scan; standardization is weighted
+        per-fold inside the kernel.  The class set (output layer width) is
+        the full-data label set - a static shape, matching the reference
+        where the MLP layer spec fixes the output size up front."""
+        n, d = X.shape
+        classes = np.unique(y)
+        onehot = (y[:, None] == classes[None, :]).astype(np.float32)
+        sizes = (d, *self.params["hidden_layers"], len(classes))
+        params_f, mus, sds = _mlp_fit_folds_kernel(
+            jnp.asarray(X, jnp.float32), jnp.asarray(onehot),
+            jnp.asarray(np.asarray(W, np.float32)),
+            jax.random.PRNGKey(int(self.params["seed"])),
+            sizes, int(self.params["max_iter"]),
+            float(self.params["step_size"]),
+        )
+        mus, sds = np.asarray(mus, np.float64), np.asarray(sds, np.float64)
+        out = []
+        for f in range(len(W)):
+            layers = [
+                (np.asarray(Wl[f]), np.asarray(bl[f])) for Wl, bl in params_f
+            ]
+            out.append({"layers": layers, "classes": classes, "mu": mus[f],
+                        "sd": sds[f]})
+        return out
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         Xs = jnp.asarray((X - params["mu"]) / params["sd"], jnp.float32)

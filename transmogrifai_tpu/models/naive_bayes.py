@@ -18,17 +18,28 @@ import numpy as np
 from .base import PredictorEstimator
 
 
-@jax.jit
-def _nb_fit_kernel(X, onehot, w, smoothing):
+def _nb_fit_impl(X, onehot, w, smoothing):
+    # non-negativity shift from TRAIN rows only (w > 0): a held-out fold's
+    # outlier must not move the multinomial offsets of other folds
+    shift = jnp.minimum(
+        jnp.where((w > 0)[:, None], X, jnp.inf).min(axis=0), 0.0
+    )
+    Xs = X - shift
     # per-class weighted feature sums [K, d] + class priors [K]
     cw = onehot * w[:, None]                       # [n, K]
-    feat = cw.T @ X                                # [K, d]
+    feat = cw.T @ Xs                               # [K, d]
     class_w = cw.sum(axis=0)                       # [K]
     theta = jnp.log(feat + smoothing) - jnp.log(
         (feat + smoothing).sum(axis=1, keepdims=True)
     )
     prior = jnp.log(class_w / jnp.maximum(class_w.sum(), 1e-12))
-    return theta, prior
+    return theta, prior, shift
+
+
+_nb_fit_kernel = jax.jit(_nb_fit_impl)
+_nb_fit_folds_kernel = jax.jit(
+    jax.vmap(_nb_fit_impl, in_axes=(None, None, 0, None))
+)
 
 
 @jax.jit
@@ -50,17 +61,38 @@ class OpNaiveBayes(PredictorEstimator):
         w = np.ones(n) if w is None else w
         classes = np.unique(y)
         onehot = (y[:, None] == classes[None, :]).astype(np.float64)
-        shift = np.minimum(X.min(axis=0), 0.0)  # ensure non-negativity
-        theta, prior = _nb_fit_kernel(
-            jnp.asarray(X - shift), jnp.asarray(onehot), jnp.asarray(w),
+        theta, prior, shift = _nb_fit_kernel(
+            jnp.asarray(X), jnp.asarray(onehot), jnp.asarray(w),
             jnp.asarray(float(self.params["smoothing"])),
         )
         return {
             "theta": np.asarray(theta),
             "prior": np.asarray(prior),
             "classes": classes,
-            "shift": shift,
+            "shift": np.asarray(shift),
         }
+
+    def fit_arrays_folds(self, X, y, W) -> list:
+        """CV fan-out: the closed-form fit is one matmul, so folds batch as
+        a leading axis of the weight vector in a single dispatch.  The
+        non-negativity shift is per-fold (train rows only, in-kernel); the
+        class set is the full-data label set, a static shape by design -
+        in the reference the multinomial class count is likewise fixed by
+        the label indexer, not re-derived per fold."""
+        classes = np.unique(y)
+        onehot = (y[:, None] == classes[None, :]).astype(np.float64)
+        thetas, priors, shifts = _nb_fit_folds_kernel(
+            jnp.asarray(X), jnp.asarray(onehot),
+            jnp.asarray(np.asarray(W, np.float64)),
+            jnp.asarray(float(self.params["smoothing"])),
+        )
+        thetas, priors = np.asarray(thetas), np.asarray(priors)
+        shifts = np.asarray(shifts)
+        return [
+            {"theta": thetas[f], "prior": priors[f], "classes": classes,
+             "shift": shifts[f]}
+            for f in range(len(W))
+        ]
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         raw, prob = _nb_predict_kernel(

@@ -303,6 +303,84 @@ def fit_forest_folds_grid(
     return jax.lax.map(one_cfg, (min_instances_g, min_info_gain_g))
 
 
+@partial(
+    jax.jit,
+    static_argnames=("num_trees", "max_depth", "max_bins", "is_classification"),
+)
+def fit_gbt_folds(
+    bins, y, w_rows,           # w_rows [F, n]: one weight vector per CV fold
+    num_trees: int, max_depth: int, max_bins: int, is_classification: bool,
+    step_size, min_instances_per_node, min_info_gain,  # traced scalars
+):
+    """GBT CV fan-out: folds ride the weight axis through the boosting
+    scan, exactly like fit_forest_folds - binning and the design matrix
+    are shared, only the [F, n] fold masks differ.  step_size /
+    min_instances / min_info_gain are traced, so grid points sharing the
+    static shape params (num_trees, depth, bins) can batch over them too
+    (fit_gbt_folds_grid).  Returns (f0 [F], heaps with leading [F, T]).
+    """
+    n, d = bins.shape
+    feat_mask = jnp.ones((d,), dtype=bool)
+
+    def one_fold(w):
+        wsum = jnp.maximum(w.sum(), 1e-12)
+        if is_classification:
+            pbar = jnp.clip((w * y).sum() / wsum, 1e-6, 1 - 1e-6)
+            f0 = jnp.log(pbar / (1.0 - pbar))
+        else:
+            f0 = (w * y).sum() / wsum
+
+        def body(F, _):
+            if is_classification:
+                pr = jax.nn.sigmoid(F)
+                g = y - pr
+                h = jnp.maximum(pr * (1.0 - pr), 1e-6)
+            else:
+                g = y - F
+                h = jnp.ones_like(g)
+            stats = jnp.stack([jnp.ones_like(g), g, g * g, h], axis=1)
+            heap = fit_tree(
+                bins, stats, w, feat_mask,
+                max_depth, max_bins, "variance", 4,
+                min_instances_per_node, min_info_gain,
+            )
+            hf, ht, hl, hv = heap
+            out = predict_tree(bins, hf, ht, hl, hv, max_depth)
+            leaf_val = out[:, 1] / jnp.maximum(out[:, 3], 1e-12)
+            return F + step_size * leaf_val, heap
+
+        _, heaps = jax.lax.scan(
+            body, jnp.full((n,), f0), None, length=num_trees
+        )
+        return f0, heaps
+
+    return jax.vmap(one_fold)(w_rows)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_trees", "max_depth", "max_bins", "is_classification"),
+)
+def fit_gbt_folds_grid(
+    bins, y, w_rows,
+    step_g, min_instances_g, min_info_gain_g,  # [G] traced per-grid-point
+    num_trees: int, max_depth: int, max_bins: int, is_classification: bool,
+):
+    """Grid x fold GBT fan-out in one dispatch: sequential lax.map over the
+    traced grid scalars around the fold-vmapped boosting scan (same shape
+    discipline as fit_forest_folds_grid).  Returns (f0 [G, F], heaps with
+    leading [G, F, T])."""
+
+    def one_cfg(args):
+        ss, mi, mg = args
+        return fit_gbt_folds(
+            bins, y, w_rows, num_trees, max_depth, max_bins,
+            is_classification, ss, mi, mg,
+        )
+
+    return jax.lax.map(one_cfg, (step_g, min_instances_g, min_info_gain_g))
+
+
 def effective_max_depth(
     max_depth: int,
     n_rows: int,

@@ -27,6 +27,8 @@ from .tree_kernel import (
     fit_forest,
     fit_forest_folds,
     fit_forest_folds_grid,
+    fit_gbt_folds,
+    fit_gbt_folds_grid,
     fit_tree,
     heap_impurity_importances,
     predict_forest,
@@ -408,9 +410,10 @@ class _GBT(_TreeEnsembleBase):
         super().__init__(num_trees=num_trees, **kw)
         self.params.setdefault("step_size", step_size)
 
-    def _fit_native(self, X, y, w, edges) -> Optional[Any]:
+    def _fit_native(self, X, y, w, edges, bins=None) -> Optional[Any]:
         """C++ boosting path (native/txtrees.cpp tx_fit_gbt_hist); same
-        init margin / loss / Newton leaf values as the JAX scan below."""
+        init margin / loss / Newton leaf values as the JAX scan below.
+        ``bins`` lets CV callers share one binning pass across folds."""
         p = self.params
         n = len(y)
         y32 = np.asarray(y, np.float32)
@@ -425,7 +428,8 @@ class _GBT(_TreeEnsembleBase):
             X.shape[1], int(p["max_bins"]), 4,
             cap=str(p.get("depth_cap", "auto")),
         )
-        bins = bin_data(np.asarray(X, np.float32), edges)
+        if bins is None:
+            bins = bin_data(np.asarray(X, np.float32), edges)
         heaps = native_trees.fit_gbt(
             bins, y32, w,
             num_trees=int(p["num_trees"]), max_depth=max_depth,
@@ -507,6 +511,116 @@ class _GBT(_TreeEnsembleBase):
             "max_depth": max_depth,
             "step_size": lr,
         }
+
+    def _gbt_depth(self, n: int, d: int) -> int:
+        p = self.params
+        return effective_max_depth(
+            int(p["max_depth"]), n, float(p["min_instances_per_node"]),
+            d, int(p["max_bins"]), 4, cap=str(p.get("depth_cap", "auto")),
+        )
+
+    def fit_arrays_folds(self, X, y, W) -> list:
+        """CV fan-out: one fold-vmapped boosting scan sharing the binning
+        and the design matrix (folds are weight masks, like the forests).
+        On the native host backend the C++ learner loops folds but still
+        shares one binning pass."""
+        n, d = X.shape
+        p = self.params
+        W = np.asarray(W, np.float32)
+        edges = _sampled_bin_edges(X, int(p["max_bins"]), int(p["seed"]))
+        backend = _resolve_backend(str(p.get("backend", "auto")))
+        if backend == "native":
+            bins_host = bin_data(np.asarray(X, np.float32), edges)
+            out = []
+            for f in range(len(W)):
+                res = self._fit_native(X, y, W[f], edges, bins=bins_host)
+                if res is None:
+                    break
+                out.append(res)
+            if len(out) == len(W):
+                return out
+        depth = self._gbt_depth(n, d)
+        bins = jnp.asarray(_bin_for_backend(X, edges))
+        f0s, heaps = fit_gbt_folds(
+            bins, jnp.asarray(y, jnp.float32), jnp.asarray(W),
+            num_trees=int(p["num_trees"]), max_depth=depth,
+            max_bins=int(p["max_bins"]),
+            is_classification=self.is_classification,
+            step_size=jnp.asarray(float(p["step_size"])),
+            min_instances_per_node=jnp.asarray(
+                float(p["min_instances_per_node"])),
+            min_info_gain=jnp.asarray(float(p["min_info_gain"])),
+        )
+        f0s = np.asarray(f0s)
+        heaps = tuple(np.asarray(h) for h in heaps)  # [F, T, ...]
+        return [
+            {
+                "edges": edges,
+                "heaps": tuple(h[f] for h in heaps),
+                "f0": float(f0s[f]),
+                "max_depth": depth,
+                "step_size": float(p["step_size"]),
+            }
+            for f in range(len(W))
+        ]
+
+    def fit_arrays_folds_grid(self, X, y, W, grid) -> Optional[list]:
+        """Whole-grid GBT CV: grid points sharing static shapes
+        (num_trees, effective depth, max_bins) batch as one dispatch over
+        a traced (step_size, min_instances, min_info_gain) axis - the GBT
+        analog of the forest grid batching (reference trains all paramMap
+        variants concurrently on its Future pool, OpValidator.scala:
+        289-306).  None on the native host backend."""
+        p0 = self.params
+        if _resolve_backend(str(p0.get("backend", "auto"))) == "native":
+            return None
+        n, d = X.shape
+        cands = [self.with_params(**pmap) for pmap in grid]
+        groups: dict[tuple, list[int]] = {}
+        for j, cand in enumerate(cands):
+            p = cand.params
+            depth = cand._gbt_depth(n, d)
+            key = (depth, int(p["max_bins"]), int(p["num_trees"]),
+                   int(p["seed"]))
+            groups.setdefault(key, []).append(j)
+        results: list = [None] * len(grid)
+        W32 = jnp.asarray(np.asarray(W, np.float32))
+        yj = jnp.asarray(y, jnp.float32)
+        edges_cache: dict[tuple, np.ndarray] = {}
+        for key, js in groups.items():
+            depth, max_bins, num_trees, seed = key
+            ekey = (max_bins, seed)
+            if ekey not in edges_cache:
+                edges_cache[ekey] = _sampled_bin_edges(X, max_bins, seed)
+            edges = edges_cache[ekey]
+            bins = jnp.asarray(_bin_for_backend(X, edges))
+            step_g = jnp.asarray(
+                [float(cands[j].params["step_size"]) for j in js], jnp.float32)
+            minipn_g = jnp.asarray(
+                [float(cands[j].params["min_instances_per_node"])
+                 for j in js], jnp.float32)
+            minig_g = jnp.asarray(
+                [float(cands[j].params["min_info_gain"]) for j in js],
+                jnp.float32)
+            f0s, heaps = fit_gbt_folds_grid(
+                bins, yj, W32, step_g, minipn_g, minig_g,
+                num_trees=num_trees, max_depth=depth, max_bins=max_bins,
+                is_classification=self.is_classification,
+            )
+            f0s = np.asarray(f0s)                      # [G', F]
+            heaps = tuple(np.asarray(h) for h in heaps)  # [G', F, T, ...]
+            for gi, j in enumerate(js):
+                results[j] = [
+                    {
+                        "edges": edges,
+                        "heaps": tuple(h[gi][f] for h in heaps),
+                        "f0": float(f0s[gi][f]),
+                        "max_depth": depth,
+                        "step_size": float(cands[j].params["step_size"]),
+                    }
+                    for f in range(len(W))
+                ]
+        return results
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         bins = jnp.asarray(

@@ -71,7 +71,23 @@ class CSVReader:
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
     ) -> Dataset:
-        """Reader hand-off (reference: DataReader.generateDataFrame:173-199)."""
+        """Reader hand-off (reference: DataReader.generateDataFrame:173-199).
+        Numeric/text schemas stream through the chunked C++ scanner
+        (readers/fast_csv.py) - no per-value python work for numeric
+        columns; anything else (or no native lib) takes the python path."""
+        if all(f.ftype.kind in ("numeric", "text") for f in raw_features):
+            try:
+                from .fast_csv import read_csv_columnar
+
+                cols = read_csv_columnar(
+                    self.path,
+                    schema={f.name: f.ftype for f in raw_features},
+                    headers=self.headers,
+                    has_header=self.has_header,
+                )
+                return Dataset(cols)
+            except RuntimeError:
+                pass  # native kernels unavailable: python fallback
         raw = self.read_raw()
         out = {}
         for feat in raw_features:

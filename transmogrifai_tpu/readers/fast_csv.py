@@ -1,0 +1,280 @@
+"""Chunked columnar CSV ingestion.
+
+The reference streams arbitrarily large CSVs through Spark partitions
+(reference: readers/src/main/scala/com/salesforce/op/readers/
+DataReader.scala:173 generateDataFrame, DataReaders.scala:44-198); the
+TPU-native counterpart streams fixed-size byte chunks through the C++ CSV
+scanner (native/txkernels.cpp tx_csv_index/tx_csv_cells - quote-aware row
+indexing + threaded cell extraction + inline numeric parsing) and
+assembles columnar arrays with ZERO per-value python work for numeric
+columns.  Chunk boundaries are aligned to newlines with even quote parity
+so quoted embedded newlines never split a record.
+
+Two consumers:
+
+* :func:`read_csv_columnar` - file -> {name: Column} for Dataset ingest
+  (the CSVReader fast path).
+* :class:`DeviceCSVIngest` - file -> device-resident [n, d] design matrix
+  with DOUBLE-BUFFERED host->device hand-off: the C++ parse of chunk i+1
+  overlaps the device transfer of chunk i (the
+  make_array_from_process_local_data pipelining analog, SURVEY §7).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Mapping, Optional, Sequence, Type
+
+import numpy as np
+
+from ..types.columns import Column, NumericColumn, TextColumn
+from ..types.feature_types import FeatureType, OPNumeric, Text
+from ..utils import native
+
+DEFAULT_CHUNK_BYTES = 64 << 20
+
+
+def _aligned_chunks(path: str, chunk_bytes: int):
+    """Yield byte chunks ending on a record boundary: the cut point is a
+    newline with an even number of quote bytes before it (cumulative from
+    file start), so a '\\n' inside a quoted field never splits a row."""
+    carry = b""
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                if carry:
+                    yield carry
+                return
+            buf = carry + block
+            # split at the last newline whose prefix has even quote parity;
+            # scan newline candidates from the end (rarely more than one
+            # iteration - pathological all-quoted tails degrade to carry)
+            cut = -1
+            search_end = len(buf)
+            total_quotes = buf.count(b'"')
+            while search_end > 0:
+                nl = buf.rfind(b"\n", 0, search_end)
+                if nl < 0:
+                    break
+                quotes_after = buf.count(b'"', nl + 1)
+                if (total_quotes - quotes_after) % 2 == 0:
+                    cut = nl
+                    break
+                search_end = nl
+            if cut < 0:
+                carry = buf  # no safe boundary yet: grow the carry
+                continue
+            yield buf[: cut + 1]
+            carry = buf[cut + 1 :]
+
+
+def _decode_text_column(
+    buf: bytes, begin: np.ndarray, end: np.ndarray
+) -> np.ndarray:
+    """Cell (begin, end) offsets -> object array of optional strings.
+    Doubled quotes inside quoted cells are unescaped lazily (only when a
+    quote byte is present in the slice)."""
+    out = np.empty(len(begin), dtype=object)
+    for i in range(len(begin)):
+        b, e = begin[i], end[i]
+        if e <= b:
+            out[i] = None
+            continue
+        s = buf[b:e].decode("utf-8", errors="replace")
+        if '"' in s:
+            s = s.replace('""', '"')
+        out[i] = s if s else None
+    return out
+
+
+def _parse_header(path: str) -> list[str]:
+    with open(path, "rb") as f:
+        line = f.readline()
+    res = native.csv_scan(line, line.count(b",") + 1,
+                          np.zeros(line.count(b",") + 1, np.uint8))
+    if res is None:  # pure-python fallback
+        import csv as _csv
+        import io
+
+        return next(_csv.reader(io.StringIO(line.decode("utf-8"))))
+    _, _, _, cb, ce = res
+    return [line[cb[c][0]:ce[c][0]].decode("utf-8").replace('""', '"')
+            for c in range(cb.shape[0])]
+
+
+def fast_path_available() -> bool:
+    return native.csv_scan(b"x\n", 1, np.zeros(1, np.uint8)) is not None
+
+
+def read_csv_columnar(
+    path: str,
+    schema: Mapping[str, Type[FeatureType]],
+    headers: Optional[Sequence[str]] = None,
+    has_header: bool = True,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    wanted: Optional[Sequence[str]] = None,
+) -> dict[str, Column]:
+    """Stream a CSV into columnar form via the native scanner.
+
+    ``schema`` types every column to materialize; ``wanted`` restricts
+    which columns are materialized (all schema'd columns by default).
+    Raises RuntimeError when the native path is unavailable - callers
+    (CSVReader) fall back to the python reader.
+    """
+    if not fast_path_available():
+        raise RuntimeError("native CSV kernels unavailable")
+    header = list(headers) if headers else (
+        _parse_header(path) if has_header else None
+    )
+    first = True
+    num_parts: dict[str, list] = {}
+    mask_parts: dict[str, list] = {}
+    text_parts: dict[str, list] = {}
+    col_idx: dict[str, int] = {}
+    is_num: Optional[np.ndarray] = None
+    names: list[str] = []
+    for chunk in _aligned_chunks(path, chunk_bytes):
+        if first and has_header:
+            nl = chunk.find(b"\n")
+            # nl == -1: header-only file with no trailing newline
+            chunk = chunk[nl + 1 :] if nl >= 0 else b""
+        if first:
+            if header is None:
+                ncols = chunk.split(b"\n", 1)[0].count(b",") + 1
+                header = [f"c{i}" for i in range(ncols)]
+            names = [n for n in (wanted or list(schema)) if n in schema]
+            missing = [n for n in names if n not in header]
+            if missing:
+                raise KeyError(f"columns {missing} not in CSV {path}")
+            col_idx = {n: header.index(n) for n in names}
+            is_num = np.zeros(len(header), dtype=np.uint8)
+            for n in names:
+                if issubclass(schema[n], OPNumeric):
+                    is_num[col_idx[n]] = 1
+            first = False
+        if not chunk:
+            continue
+        res = native.csv_scan(chunk, len(header), is_num)
+        if res is None:
+            raise RuntimeError("native CSV kernels unavailable")
+        nrows, num_vals, num_mask, cb, ce = res
+        if nrows == 0:
+            continue
+        for n in names:
+            c = col_idx[n]
+            if is_num[c]:
+                num_parts.setdefault(n, []).append(num_vals[c].copy())
+                mask_parts.setdefault(n, []).append(num_mask[c].copy())
+            else:
+                text_parts.setdefault(n, []).append(
+                    _decode_text_column(chunk, cb[c], ce[c])
+                )
+    out: dict[str, Column] = {}
+    for n in names:
+        t = schema[n]
+        if issubclass(t, OPNumeric):
+            vals = (np.concatenate(num_parts[n]) if n in num_parts
+                    else np.zeros(0))
+            mask = (np.concatenate(mask_parts[n]) if n in mask_parts
+                    else np.zeros(0, bool))
+            out[n] = NumericColumn(vals, mask, t)
+        elif issubclass(t, Text):
+            vals = (np.concatenate(text_parts[n]) if n in text_parts
+                    else np.empty(0, object))
+            out[n] = TextColumn(vals, t)
+        else:
+            raise TypeError(
+                f"fast CSV path supports numeric/text columns; {n} is "
+                f"{t.__name__}"
+            )
+    return out
+
+
+class DeviceCSVIngest:
+    """CSV -> device-resident [n, d] float32 design matrix with the parse
+    of chunk i+1 overlapping the device transfer of chunk i.
+
+    A background thread runs the C++ scanner over aligned byte chunks and
+    feeds a bounded queue (depth 2 = classic double buffer); the consumer
+    issues ``jax.device_put`` per chunk - JAX transfers are async, so the
+    next parse starts while DMA is in flight - and concatenates on device.
+    """
+
+    def __init__(self, path: str, columns: Sequence[str],
+                 schema: Mapping[str, Type[FeatureType]],
+                 has_header: bool = True,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> None:
+        self.path = path
+        self.columns = list(columns)
+        self.schema = dict(schema)
+        self.has_header = has_header
+        self.chunk_bytes = chunk_bytes
+
+    def _parse_worker(self, q: queue.Queue) -> None:
+        try:
+            header: Optional[list[str]] = None
+            idx: Optional[list[int]] = None
+            is_num: Optional[np.ndarray] = None
+            first = True
+            for chunk in _aligned_chunks(self.path, self.chunk_bytes):
+                if first:
+                    if self.has_header:
+                        nl = chunk.find(b"\n")
+                        header = _parse_header(self.path)
+                        chunk = chunk[nl + 1 :] if nl >= 0 else b""
+                    else:
+                        n = chunk.split(b"\n", 1)[0].count(b",") + 1
+                        header = [f"c{i}" for i in range(n)]
+                    idx = [header.index(c) for c in self.columns]
+                    is_num = np.zeros(len(header), dtype=np.uint8)
+                    is_num[idx] = 1
+                    first = False
+                if not chunk:
+                    continue
+                res = native.csv_scan(chunk, len(header), is_num)
+                if res is None:
+                    raise RuntimeError("native CSV kernels unavailable")
+                nrows, num_vals, num_mask, _, _ = res
+                if nrows == 0:
+                    continue
+                block = np.ascontiguousarray(
+                    num_vals[idx].T, dtype=np.float32
+                )  # [rows, d]
+                mask = num_mask[idx].T  # [rows, d]
+                q.put((block, mask))
+            q.put(None)
+        except BaseException as e:  # surface parse errors to the consumer
+            q.put(e)
+
+    def to_device(self):
+        """Returns (X_device [n, d] float32, valid_mask_device [n, d]
+        bool, rows).  Missing numeric cells are 0 with mask False (the
+        NumericColumn contract, device-side)."""
+        import jax
+        import jax.numpy as jnp
+
+        q: queue.Queue = queue.Queue(maxsize=2)
+        t = threading.Thread(target=self._parse_worker, args=(q,),
+                             daemon=True)
+        t.start()
+        dev_blocks, dev_masks, total = [], [], 0
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            block, mask = item
+            total += block.shape[0]
+            # async dispatch: DMA overlaps the worker's next C++ parse
+            dev_blocks.append(jax.device_put(block))
+            dev_masks.append(jax.device_put(mask))
+        t.join()
+        if not dev_blocks:
+            d = len(self.columns)
+            return (jnp.zeros((0, d), jnp.float32),
+                    jnp.zeros((0, d), bool), 0)
+        X = jnp.concatenate(dev_blocks, axis=0)
+        M = jnp.concatenate(dev_masks, axis=0)
+        return X, M, total

@@ -268,6 +268,11 @@ def column_from_list(
     """Build the right Column variant for a feature type from python values."""
     kind = feature_type.kind
     if kind == "numeric":
+        if isinstance(data, np.ndarray) and data.dtype.kind in "fiub":
+            vals = np.asarray(data, np.float64)
+            mask = ~np.isnan(vals)
+            return NumericColumn(np.where(mask, vals, 0.0), mask,
+                                 feature_type)
         return NumericColumn.from_list(data, feature_type)
     if kind == "text":
         return TextColumn.from_list(data, feature_type)

@@ -73,6 +73,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
+        try:  # CSV scan kernels (may be absent in a stale lib)
+            lib.tx_csv_index.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ]
+            lib.tx_csv_index.restype = ctypes.c_int64
+            lib.tx_csv_cells.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+        except AttributeError:
+            pass
         try:  # tree learner entry points (native/txtrees.cpp)
             lib.tx_fit_forest_hist.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -157,6 +170,41 @@ def tokenize_hash_tf(
         np.int32(1 if binary else 0), out.ctypes.data,
     )
     return out
+
+
+def csv_scan(
+    buf: bytes, ncols: int, is_num: np.ndarray
+) -> Optional[tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Quote-aware CSV scan of one byte chunk via the C++ kernels.
+
+    Returns (nrows, num_vals [ncols, nrows] f64, num_mask [ncols, nrows]
+    bool, cell_begin [ncols, nrows] i64, cell_end) - column-major so each
+    column is a contiguous slice - or None when the native lib (or the CSV
+    symbols) is unavailable.
+    """
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "tx_csv_index"):
+        return None
+    data = np.frombuffer(buf, dtype=np.uint8)
+    if data.size == 0:
+        z = np.zeros((ncols, 0))
+        return 0, z, z.astype(bool), z.astype(np.int64), z.astype(np.int64)
+    cap = int(np.count_nonzero(data == 0x0A)) + 1
+    row_starts = np.zeros(cap, dtype=np.int64)
+    nrows = int(
+        lib.tx_csv_index(data.ctypes.data, data.size, row_starts.ctypes.data)
+    )
+    is_num8 = np.ascontiguousarray(is_num, dtype=np.uint8)
+    num_vals = np.zeros((ncols, nrows), dtype=np.float64)
+    num_mask = np.zeros((ncols, nrows), dtype=np.uint8)
+    cell_begin = np.zeros((ncols, nrows), dtype=np.int64)
+    cell_end = np.zeros((ncols, nrows), dtype=np.int64)
+    lib.tx_csv_cells(
+        data.ctypes.data, data.size, row_starts.ctypes.data, nrows,
+        np.int32(ncols), is_num8.ctypes.data, num_vals.ctypes.data,
+        num_mask.ctypes.data, cell_begin.ctypes.data, cell_end.ctypes.data,
+    )
+    return nrows, num_vals, num_mask.astype(bool), cell_begin, cell_end
 
 
 def parse_doubles(values: Sequence[Optional[str]]) -> Optional[tuple[np.ndarray, np.ndarray]]:

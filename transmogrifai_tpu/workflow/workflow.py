@@ -45,6 +45,11 @@ def _as_dataset(data: Any, raw_features: Sequence[Feature]) -> Dataset:
             if f.name not in data.columns:
                 raise KeyError(f"raw feature {f.name!r} missing from input data")
             series = data[f.name]
+            if f.ftype.kind == "numeric" and series.dtype.kind in "fiub":
+                # vectorized: values + isna mask, no per-value python loop
+                vals = series.to_numpy(dtype=np.float64, na_value=np.nan)
+                cols[f.name] = column_from_list(vals, f.ftype)
+                continue
             vals = [
                 None
                 if (v is None or (isinstance(v, float) and np.isnan(v)) or v is np.nan)
