@@ -179,10 +179,50 @@ int64_t tx_csv_index(const uint8_t* buf, int64_t len, int64_t* row_starts) {
 
 namespace {
 
-// Extract one row's cells into column-major outputs.
+// Parse one numeric cell with python float() semantics: optional
+// leading/trailing whitespace, NO other trailing garbage ("1 x" is
+// invalid like float("1 x")); cells of any length parse fully.
+inline void parse_num_cell(const uint8_t* buf, int64_t cb, int64_t ce,
+                           double* out, uint8_t* mask) {
+  const int64_t clen = ce - cb;
+  if (clen <= 0) {
+    *out = 0.0;
+    *mask = 0;
+    return;
+  }
+  char stack_buf[64];
+  std::vector<char> heap_buf;
+  char* tmp;
+  if (clen < 63) {
+    tmp = stack_buf;
+  } else {  // rare long cell: parse in full, never a truncated prefix
+    heap_buf.resize(static_cast<size_t>(clen) + 1);
+    tmp = heap_buf.data();
+  }
+  std::memcpy(tmp, buf + cb, clen);
+  tmp[clen] = 0;
+  char* end = nullptr;
+  const double v = std::strtod(tmp, &end);
+  if (end == tmp) {
+    *out = 0.0;
+    *mask = 0;
+    return;
+  }
+  while (*end != 0 && std::isspace(static_cast<unsigned char>(*end))) end++;
+  if (*end != 0) {  // trailing non-space garbage: invalid
+    *out = 0.0;
+    *mask = 0;
+  } else {
+    *out = v;
+    *mask = 1;
+  }
+}
+
+// Extract one row's cells into column-major outputs.  col_mode per
+// column: 0 = skip entirely, 1 = numeric parse, 2 = text offsets.
 inline void csv_row_cells(const uint8_t* buf, int64_t row_begin,
                           int64_t row_end, int64_t row, int64_t nrows,
-                          int32_t ncols, const uint8_t* is_num,
+                          int32_t ncols, const uint8_t* col_mode,
                           double* num_out, uint8_t* num_mask,
                           int64_t* cell_begin, int64_t* cell_end) {
   int64_t i = row_begin;
@@ -209,30 +249,15 @@ inline void csv_row_cells(const uint8_t* buf, int64_t row_begin,
       ce = i;
       if (i < row_end) i++;       // skip comma
     }
+    const uint8_t mode = col_mode[col];
+    if (mode == 0) continue;      // unwanted column: no writes at all
     if (ce > cb && buf[ce - 1] == '\r') ce--;  // CRLF tail on last cell
     const int64_t slot = static_cast<int64_t>(col) * nrows + row;
-    cell_begin[slot] = cb;
-    cell_end[slot] = ce;
-    if (is_num[col]) {
-      const int64_t clen = ce - cb;
-      if (clen <= 0) {
-        num_out[slot] = 0.0;
-        num_mask[slot] = 0;
-      } else {
-        char tmp[64];
-        const int64_t m = clen < 63 ? clen : 63;
-        std::memcpy(tmp, buf + cb, m);
-        tmp[m] = 0;
-        char* end = nullptr;
-        const double v = std::strtod(tmp, &end);
-        if (end == tmp || (end && *end != 0 && !std::isspace(*end))) {
-          num_out[slot] = 0.0;
-          num_mask[slot] = 0;
-        } else {
-          num_out[slot] = v;
-          num_mask[slot] = 1;
-        }
-      }
+    if (mode == 2) {
+      cell_begin[slot] = cb;
+      cell_end[slot] = ce;
+    } else {
+      parse_num_cell(buf, cb, ce, num_out + slot, num_mask + slot);
     }
   }
 }
@@ -241,9 +266,11 @@ inline void csv_row_cells(const uint8_t* buf, int64_t row_begin,
 
 // Cell extraction + numeric parse, threaded over row ranges.  Outputs are
 // COLUMN-major ([ncols, nrows]) so each parsed column is a contiguous
-// slice on the python side.  `row_starts` comes from tx_csv_index.
+// slice on the python side.  `row_starts` comes from tx_csv_index;
+// `col_mode` selects per-column work (0 skip / 1 numeric / 2 text) so
+// unwanted columns cost nothing beyond the delimiter walk.
 void tx_csv_cells(const uint8_t* buf, int64_t len, const int64_t* row_starts,
-                  int64_t nrows, int32_t ncols, const uint8_t* is_num,
+                  int64_t nrows, int32_t ncols, const uint8_t* col_mode,
                   double* num_out, uint8_t* num_mask, int64_t* cell_begin,
                   int64_t* cell_end) {
   const unsigned hw = std::thread::hardware_concurrency();
@@ -256,7 +283,7 @@ void tx_csv_cells(const uint8_t* buf, int64_t len, const int64_t* row_starts,
       // trim the row terminator (tx_csv_index row starts follow '\n')
       if (re > rb && r + 1 < nrows) re--;           // the '\n' itself
       else if (re > rb && buf[re - 1] == '\n') re--; // last row w/ newline
-      csv_row_cells(buf, rb, re, r, nrows, ncols, is_num, num_out,
+      csv_row_cells(buf, rb, re, r, nrows, ncols, col_mode, num_out,
                     num_mask, cell_begin, cell_end);
     }
   };

@@ -87,6 +87,34 @@ def test_crlf_and_no_trailing_newline(tmp_path):
     assert list(cols["b"].values) == ["x", "y"]
 
 
+def test_numeric_parse_python_float_semantics(tmp_path):
+    """The native parser must match float(raw): whitespace-padded numbers
+    parse, trailing garbage invalidates, long cells parse in full."""
+    long_num = "1." + "1" * 80  # 82-char cell: no silent 63-byte prefix
+    path = _write(
+        tmp_path,
+        "a\n 1.5 \n1 x\n" + long_num + "\nnan\n2e3\n",
+    )
+    cols = fast_csv.read_csv_columnar(path, {"a": ft.Real})
+    vals, mask = cols["a"].values, cols["a"].mask
+    assert mask[0] and vals[0] == 1.5        # "  1.5  " ok like float()
+    assert not mask[1]                        # "1 x" invalid like float()
+    assert mask[2] and vals[2] == float(long_num)
+    assert not mask[3] and vals[3] == 0.0  # "nan" -> missing (python parity)
+    assert mask[4] and vals[4] == 2000.0
+
+
+def test_empty_and_header_only_files(tmp_path):
+    import pytest as _pytest
+
+    empty = _write(tmp_path, "", name="empty.csv")
+    with _pytest.raises(KeyError):
+        fast_csv.read_csv_columnar(empty, {"a": ft.Real})
+    header_only = _write(tmp_path, "a,b,c", name="h.csv")  # no newline
+    cols = fast_csv.read_csv_columnar(header_only, {"a": ft.Real})
+    assert len(cols["a"]) == 0
+
+
 def test_short_rows_pad_missing(tmp_path):
     path = _write(tmp_path, "a,b,c\n1,x\n2,y,3\n")
     cols = fast_csv.read_csv_columnar(

@@ -1,0 +1,166 @@
+"""Preemption re-dispatch harness (workflow/supervisor.py + validator
+heartbeats).  SURVEY §5.3: detect a dead/hung CV step, restore from the
+checkpoint, re-dispatch; the restarted run must reach the IDENTICAL final
+selection an uninterrupted run reaches."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import _backend_guard
+_backend_guard.ensure_cpu_mesh(1)
+import numpy as np
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+from transmogrifai_tpu.selector.validator import OpCrossValidation, OpValidator
+
+ckpt = {ckpt!r}
+marker = {marker!r}
+mode = {mode!r}
+
+class SlowGLM(OpGeneralizedLinearRegression):
+    model_type = "SlowGLM"
+    def fit_arrays_folds(self, X, y, W):
+        time.sleep(0.05)
+        return super().fit_arrays_folds(X, y, W)
+
+if mode == "die" and not os.path.exists(marker):
+    # first attempt: SIGKILL-style death after the 3rd checkpointed row
+    open(marker, "w").close()
+    orig = OpValidator._ckpt_save
+    state = {{"n": 0}}
+    def dying(self, done):
+        orig(self, done)
+        state["n"] += 1
+        if state["n"] >= 3:
+            os._exit(9)
+    OpValidator._ckpt_save = dying
+elif mode == "hang" and not os.path.exists(marker):
+    # first attempt: wedge before any heartbeat
+    open(marker, "w").close()
+    time.sleep(600)
+
+rng = np.random.RandomState(0)
+n = 400
+X = rng.randn(n, 5)
+y = X @ np.linspace(1.0, -1.0, 5) + 0.3 * rng.randn(n)
+grid = [{{"reg_param": r}} for r in (0.0, 0.001, 0.01, 0.1, 0.3, 1.0)]
+cv = OpCrossValidation(num_folds=3, evaluator=OpRegressionEvaluator(),
+                       seed=0, checkpoint_path=ckpt)
+res = cv.validate([(SlowGLM(max_iter=8), grid)], X, y)
+with open({out!r}, "w") as f:
+    json.dump({{"best_params": res.best_params,
+               "best_metric": res.best_metric,
+               "all": [(r["params"], r["fold_metrics"])
+                        for r in res.all_results]}}, f)
+"""
+
+
+def _write_worker(tmp_path, name, mode):
+    ckpt = str(tmp_path / f"{name}.ckpt.json")
+    marker = str(tmp_path / f"{name}.died")
+    out = str(tmp_path / f"{name}.result.json")
+    script = tmp_path / f"{name}.py"
+    script.write_text(
+        WORKER.format(repo=REPO, ckpt=ckpt, marker=marker, mode=mode,
+                      out=out)
+    )
+    return str(script), ckpt, marker, out
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def test_supervisor_redispatches_after_mid_cv_death(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    # uninterrupted baseline
+    script_b, _, _, out_b = _write_worker(tmp_path, "baseline", "never")
+    subprocess.run([sys.executable, script_b], check=True, env=_env(),
+                   timeout=300)
+    baseline = json.load(open(out_b))
+
+    # supervised run that dies after 3 checkpointed rows
+    script, ckpt, marker, out = _write_worker(tmp_path, "dying", "die")
+    res = supervise(
+        [sys.executable, script],
+        heartbeat_path=ckpt + ".heartbeat",
+        stale_after_s=120.0,
+        max_restarts=2,
+        env=_env(),
+    )
+    assert res.returncode == 0
+    assert res.attempts == 2, res.restarts  # died once, resumed once
+    assert os.path.exists(marker)
+
+    got = json.load(open(out))
+    assert got["best_params"] == baseline["best_params"]
+    assert got["best_metric"] == pytest.approx(baseline["best_metric"])
+    for (p1, m1), (p2, m2) in zip(got["all"], baseline["all"]):
+        assert p1 == p2
+        assert np.allclose(m1, m2)
+
+    # the resumed run restored rows 1-3 from the checkpoint (keys exist)
+    done = json.load(open(ckpt))
+    assert len(done) == 6
+
+
+def test_supervisor_kills_hung_worker_and_redispatches(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    script, ckpt, marker, out = _write_worker(tmp_path, "hung", "hang")
+    t0 = time.time()
+    res = supervise(
+        [sys.executable, script],
+        heartbeat_path=ckpt + ".heartbeat",
+        stale_after_s=8.0,
+        max_restarts=1,
+        poll_s=0.2,
+        env=_env(),
+    )
+    assert res.returncode == 0
+    assert res.attempts == 2
+    assert "no heartbeat" in res.restarts[0][1]
+    assert time.time() - t0 < 300
+    assert os.path.exists(out)
+
+
+def test_supervisor_exhausts_restarts(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    hb = str(tmp_path / "hb")
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        supervise(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            heartbeat_path=hb, stale_after_s=30.0, max_restarts=1,
+            poll_s=0.1, env=_env(),
+        )
+
+
+def test_legacy_checkpoint_keys_migrate(tmp_path):
+    """Pre-mode-suffix checkpoint files restore as ':exact' rows instead of
+    silently retraining everything (advisor finding)."""
+    from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    ckpt = tmp_path / "legacy.json"
+    legacy_key = 'OpLinearRegression:{"reg_param": 0.1}'
+    ckpt.write_text(json.dumps({legacy_key: [0.5, 0.6, 0.7]}))
+    cv = OpCrossValidation(num_folds=3, evaluator=OpRegressionEvaluator(),
+                           checkpoint_path=str(ckpt))
+    done = cv._ckpt_load()
+    assert legacy_key + ":exact" in done
+    assert done[legacy_key + ":exact"] == [0.5, 0.6, 0.7]

@@ -78,3 +78,154 @@ def test_workflow_cv_close_to_plain_cv(rng):
     v1 = sel1.validation_result.best_metric
     v2 = sel2.validation_result.best_metric
     assert abs(v1 - v2) < 0.05  # same data, same models -> similar metric
+
+
+def _chained_workflow(rng, n=300):
+    """DAG with a chained estimator stack upstream of the selector:
+    scaler (label-free) -> supervised bucketizer (label-touching) ->
+    vectorize -> sanity check -> selector.  The reference cut includes
+    EVERYTHING from the first label-touching layer down (transformers and
+    label-free estimators included), transitively - not just the
+    selector's direct estimator parents."""
+    from transmogrifai_tpu.ops.bucketizers import DecisionTreeNumericBucketizer
+    from transmogrifai_tpu.ops.scalers import OpScalarStandardScaler
+
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "b": rng.randn(n).tolist(),
+    }
+    data["a"] = [ai + 2 * yi for ai, yi in zip(data["a"], data["y"])]
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    scaled = OpScalarStandardScaler().set_input(a).get_output()
+    bucketed = (
+        DecisionTreeNumericBucketizer(max_depth=2)
+        .set_input(y, scaled)
+        .get_output()
+    )
+    vec = transmogrify([bucketed, b])
+    checked = y.sanity_check(vec, remove_bad_features=False)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[
+            (OpLogisticRegression(), [{"reg_param": r} for r in (0.001, 0.1)])
+        ],
+        splitter=DataSplitter(reserve_test_fraction=0.1),
+    )
+    pred = selector.set_input(y, checked).get_output()
+    return data, y, selector, pred, scaled, bucketed
+
+
+def test_cut_dag_transitive_from_first_label_touching_layer(rng):
+    from transmogrifai_tpu.ops.bucketizers import DecisionTreeNumericBucketizer
+    from transmogrifai_tpu.ops.scalers import OpScalarStandardScaler
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+
+    data, y, selector, pred, scaled, bucketed = _chained_workflow(rng)
+    dag = compute_dag([pred])
+    before, during, after = cut_dag(dag, [selector])
+    d_types = {type(s).__name__ for s in during}
+    # first label-touching layer = the supervised bucketizer; everything
+    # from there to the selector is in 'during' - including the
+    # transmogrifier vectorizers (transformers/estimators alike)
+    assert "DecisionTreeNumericBucketizer" in d_types
+    assert "SanityChecker" in d_types
+    assert selector in during
+    # the label-free scaler ABOVE the first label-touching layer stays out
+    b_types = {type(s).__name__ for l in before for s in l}
+    assert "OpScalarStandardScaler" in b_types
+    assert not after
+    # execution order within 'during' respects dependencies
+    pos = {s.uid: i for i, s in enumerate(during)}
+    assert pos[bucketed.origin_stage.uid] < pos[selector.uid]
+
+
+def test_workflow_cv_chained_trains_and_matches_plain(rng):
+    """Property check (reference OpWorkflowCVTest semantics): on a chained
+    DAG, workflow-CV must train end-to-end and select the same model family
+    with a similar metric as the plain-CV path."""
+    data, y, selector, pred, *_ = _chained_workflow(rng)
+    wf = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data)
+        .with_workflow_cv()
+    )
+    model = wf.train()
+    assert selector.best_override is not None
+    md = model.stages[-1].metadata["model_selector_summary"]
+    assert md["best_model_type"] == "OpLogisticRegression"
+
+    rng2 = np.random.RandomState(7)
+    data2, y2, sel2, pred2, *_ = _chained_workflow(rng2)
+    wf2 = OpWorkflow().set_result_features(pred2).set_input_dataset(data2)
+    m2 = wf2.train()
+    v1 = selector.validation_result.best_metric
+    v2 = sel2.validation_result.best_metric
+    assert abs(v1 - v2) < 0.08
+
+
+def test_workflow_cv_two_parallel_selectors(rng):
+    """Extension beyond the reference (which forbids >1 selector): two
+    parallel selectors each run their own leakage-free workflow CV."""
+    n = 300
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "b": rng.randn(n).tolist(),
+    }
+    data["a"] = [ai + 2 * yi for ai, yi in zip(data["a"], data["y"])]
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([a, b])
+    checked = y.sanity_check(vec, remove_bad_features=False)
+
+    def mk_selector():
+        return BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3,
+            models_and_parameters=[
+                (OpLogisticRegression(), [{"reg_param": 0.01}])
+            ],
+            splitter=DataSplitter(reserve_test_fraction=0.1),
+        )
+
+    sel1, sel2 = mk_selector(), mk_selector()
+    p1 = sel1.set_input(y, checked).get_output()
+    p2 = sel2.set_input(y, checked).get_output()
+    wf = (
+        OpWorkflow().set_result_features(p1, p2).set_input_dataset(data)
+        .with_workflow_cv()
+    )
+    model = wf.train()
+    assert sel1.best_override is not None
+    assert sel2.best_override is not None
+    scored = model.score(data)
+    assert p1.name in scored and p2.name in scored
+
+
+def test_cut_dag_nested_selectors_error(rng):
+    from transmogrifai_tpu.workflow.dag import cut_dag_during
+
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    vec = transmogrify([a])
+
+    def mk_selector():
+        return BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models_and_parameters=[
+                (OpLogisticRegression(), [{"reg_param": 0.01}])
+            ],
+        )
+
+    inner, outer = mk_selector(), mk_selector()
+    p_in = inner.set_input(y, vec).get_output()
+    # force nesting by wiring the inner selector's output into the outer's
+    # input graph directly (bypasses the type gate - the cut walk must
+    # still detect the nested selector in the cone)
+    outer.input_features = (y, p_in)
+    p_out = outer.get_output()
+    dag = compute_dag([p_out])
+    with pytest.raises(ValueError, match="nested"):
+        cut_dag_during(dag, [inner, outer])

@@ -91,14 +91,18 @@ def _decode_text_column(
 def _parse_header(path: str) -> list[str]:
     with open(path, "rb") as f:
         line = f.readline()
-    res = native.csv_scan(line, line.count(b",") + 1,
-                          np.zeros(line.count(b",") + 1, np.uint8))
+    if not line.strip():
+        return []
+    ncols = line.count(b",") + 1
+    res = native.csv_scan(line, ncols, np.full(ncols, 2, np.uint8))
     if res is None:  # pure-python fallback
         import csv as _csv
         import io
 
         return next(_csv.reader(io.StringIO(line.decode("utf-8"))))
-    _, _, _, cb, ce = res
+    nrows, _, _, cb, ce = res
+    if nrows == 0:
+        return []
     return [line[cb[c][0]:ce[c][0]].decode("utf-8").replace('""', '"')
             for c in range(cb.shape[0])]
 
@@ -132,7 +136,7 @@ def read_csv_columnar(
     mask_parts: dict[str, list] = {}
     text_parts: dict[str, list] = {}
     col_idx: dict[str, int] = {}
-    is_num: Optional[np.ndarray] = None
+    modes: Optional[np.ndarray] = None
     names: list[str] = []
     for chunk in _aligned_chunks(path, chunk_bytes):
         if first and has_header:
@@ -148,14 +152,17 @@ def read_csv_columnar(
             if missing:
                 raise KeyError(f"columns {missing} not in CSV {path}")
             col_idx = {n: header.index(n) for n in names}
-            is_num = np.zeros(len(header), dtype=np.uint8)
+            # per-column scan mode: 0 skip / 1 numeric / 2 text offsets -
+            # unmaterialized columns cost only the delimiter walk
+            modes = np.zeros(len(header), dtype=np.uint8)
             for n in names:
-                if issubclass(schema[n], OPNumeric):
-                    is_num[col_idx[n]] = 1
+                modes[col_idx[n]] = (
+                    1 if issubclass(schema[n], OPNumeric) else 2
+                )
             first = False
         if not chunk:
             continue
-        res = native.csv_scan(chunk, len(header), is_num)
+        res = native.csv_scan(chunk, len(header), modes)
         if res is None:
             raise RuntimeError("native CSV kernels unavailable")
         nrows, num_vals, num_mask, cb, ce = res
@@ -163,13 +170,20 @@ def read_csv_columnar(
             continue
         for n in names:
             c = col_idx[n]
-            if is_num[c]:
+            if modes[c] == 1:
                 num_parts.setdefault(n, []).append(num_vals[c].copy())
                 mask_parts.setdefault(n, []).append(num_mask[c].copy())
             else:
                 text_parts.setdefault(n, []).append(
                     _decode_text_column(chunk, cb[c], ce[c])
                 )
+    if first:
+        # zero-byte file: the chunk loop never ran - surface the same
+        # missing-column error the python path gives
+        names = [n for n in (wanted or list(schema)) if n in schema]
+        missing = [n for n in names if n not in (header or [])]
+        if missing:
+            raise KeyError(f"columns {missing} not in CSV {path}")
     out: dict[str, Column] = {}
     for n in names:
         t = schema[n]
@@ -178,7 +192,10 @@ def read_csv_columnar(
                     else np.zeros(0))
             mask = (np.concatenate(mask_parts[n]) if n in mask_parts
                     else np.zeros(0, bool))
-            out[n] = NumericColumn(vals, mask, t)
+            # literal "nan" cells parse as NaN; the python path treats NaN
+            # as missing (NumericColumn contract: masked slots hold 0.0)
+            nan = np.isnan(vals)
+            out[n] = NumericColumn(np.where(nan, 0.0, vals), mask & ~nan, t)
         elif issubclass(t, Text):
             vals = (np.concatenate(text_parts[n]) if n in text_parts
                     else np.empty(0, object))
@@ -215,7 +232,7 @@ class DeviceCSVIngest:
         try:
             header: Optional[list[str]] = None
             idx: Optional[list[int]] = None
-            is_num: Optional[np.ndarray] = None
+            modes: Optional[np.ndarray] = None
             first = True
             for chunk in _aligned_chunks(self.path, self.chunk_bytes):
                 if first:
@@ -227,12 +244,12 @@ class DeviceCSVIngest:
                         n = chunk.split(b"\n", 1)[0].count(b",") + 1
                         header = [f"c{i}" for i in range(n)]
                     idx = [header.index(c) for c in self.columns]
-                    is_num = np.zeros(len(header), dtype=np.uint8)
-                    is_num[idx] = 1
+                    modes = np.zeros(len(header), dtype=np.uint8)
+                    modes[idx] = 1  # wanted numerics; everything else skips
                     first = False
                 if not chunk:
                     continue
-                res = native.csv_scan(chunk, len(header), is_num)
+                res = native.csv_scan(chunk, len(header), modes)
                 if res is None:
                     raise RuntimeError("native CSV kernels unavailable")
                 nrows, num_vals, num_mask, _, _ = res
@@ -242,6 +259,10 @@ class DeviceCSVIngest:
                     num_vals[idx].T, dtype=np.float32
                 )  # [rows, d]
                 mask = num_mask[idx].T  # [rows, d]
+                nan = np.isnan(block)  # literal "nan" cells -> missing
+                if nan.any():
+                    block = np.where(nan, np.float32(0.0), block)
+                    mask = mask & ~nan
                 q.put((block, mask))
             q.put(None)
         except BaseException as e:  # surface parse errors to the consumer

@@ -92,9 +92,16 @@ class OpValidator:
             return {}
         try:
             with open(self.checkpoint_path) as f:
-                return json.load(f)
+                done = json.load(f)
         except (OSError, ValueError):
             return {}
+        # migrate pre-mode-suffix checkpoints: un-suffixed keys were
+        # produced by the exact host metrics path, so restarting after an
+        # upgrade must not silently retrain every candidate
+        return {
+            (k if k.endswith((":exact", ":approx")) else k + ":exact"): v
+            for k, v in done.items()
+        }
 
     def _ckpt_save(self, done: dict) -> None:
         if not self.checkpoint_path:
@@ -107,6 +114,17 @@ class OpValidator:
         with open(tmp, "w") as f:
             json.dump(done, f)
         os.replace(tmp, self.checkpoint_path)
+        self._beat()
+
+    def _beat(self) -> None:
+        """Progress heartbeat for the preemption supervisor (workflow/
+        supervisor.py): liveness == CV progress, so a wedged dispatch or a
+        killed host stops the beat and triggers re-dispatch."""
+        if not self.checkpoint_path:
+            return
+        from ..workflow.supervisor import beat
+
+        beat(self.checkpoint_path + ".heartbeat")
 
     def train_masks(self, y: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -146,6 +164,7 @@ class OpValidator:
         )
 
         ckpt = self._ckpt_load()
+        self._beat()  # validation started: open the liveness window
         metric_name = getattr(self.evaluator, "metric_name", "")
 
         def _est_mode(est, grid) -> str:

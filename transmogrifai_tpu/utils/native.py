@@ -173,18 +173,23 @@ def tokenize_hash_tf(
 
 
 def csv_scan(
-    buf: bytes, ncols: int, is_num: np.ndarray
+    buf: bytes, ncols: int, modes: np.ndarray
 ) -> Optional[tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Quote-aware CSV scan of one byte chunk via the C++ kernels.
 
-    Returns (nrows, num_vals [ncols, nrows] f64, num_mask [ncols, nrows]
-    bool, cell_begin [ncols, nrows] i64, cell_end) - column-major so each
-    column is a contiguous slice - or None when the native lib (or the CSV
+    ``modes`` [ncols] uint8 selects per-column work: 0 = skip, 1 =
+    numeric parse, 2 = text cell offsets (bool arrays are accepted as
+    numeric-vs-skip for convenience).  Returns (nrows, num_vals
+    [ncols, nrows] f64, num_mask [ncols, nrows] bool, cell_begin
+    [ncols, nrows] i64, cell_end) - column-major so each column is a
+    contiguous slice; the offset arrays are 0-row dummies when no text
+    column was requested - or None when the native lib (or the CSV
     symbols) is unavailable.
     """
     lib = get_lib()
     if lib is None or not hasattr(lib, "tx_csv_index"):
         return None
+    modes8 = np.ascontiguousarray(modes, dtype=np.uint8)
     data = np.frombuffer(buf, dtype=np.uint8)
     if data.size == 0:
         z = np.zeros((ncols, 0))
@@ -194,14 +199,18 @@ def csv_scan(
     nrows = int(
         lib.tx_csv_index(data.ctypes.data, data.size, row_starts.ctypes.data)
     )
-    is_num8 = np.ascontiguousarray(is_num, dtype=np.uint8)
+    any_text = bool((modes8 == 2).any())
     num_vals = np.zeros((ncols, nrows), dtype=np.float64)
     num_mask = np.zeros((ncols, nrows), dtype=np.uint8)
-    cell_begin = np.zeros((ncols, nrows), dtype=np.int64)
-    cell_end = np.zeros((ncols, nrows), dtype=np.int64)
+    off_rows = nrows if any_text else 0
+    # the kernel never touches offset slots of non-text columns, but slot
+    # indexing is col*nrows - so the buffer must be full-shape when any
+    # text column exists, and can be an empty dummy otherwise
+    cell_begin = np.zeros((ncols, off_rows), dtype=np.int64)
+    cell_end = np.zeros((ncols, off_rows), dtype=np.int64)
     lib.tx_csv_cells(
         data.ctypes.data, data.size, row_starts.ctypes.data, nrows,
-        np.int32(ncols), is_num8.ctypes.data, num_vals.ctypes.data,
+        np.int32(ncols), modes8.ctypes.data, num_vals.ctypes.data,
         num_mask.ctypes.data, cell_begin.ctypes.data, cell_end.ctypes.data,
     )
     return nrows, num_vals, num_mask.astype(bool), cell_begin, cell_end

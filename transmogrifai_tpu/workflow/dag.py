@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from ..features.feature import Feature
-from ..stages.base import Estimator, PipelineStage
+from ..stages.base import PipelineStage
 from ..stages.feature_generator import FeatureGeneratorStage
 
 Layer = list[PipelineStage]
@@ -84,25 +84,84 @@ def validate_dag(dag: Sequence[Layer]) -> None:
             ) from e
 
 
+def _label_touching(stage: PipelineStage) -> bool:
+    """Reference CVTS trigger (FitStagesUtil.scala:334-337): a stage whose
+    inputs mix a response with a non-response feature sees label-dependent
+    state and must be refit inside every CV fold."""
+    ins = stage.input_features
+    return any(f.is_response for f in ins) and any(
+        not f.is_response for f in ins
+    )
+
+
+def cut_dag_during(
+    dag: Sequence[Layer], model_selectors: Sequence[PipelineStage]
+) -> dict[str, list[PipelineStage]]:
+    """Per-selector 'during' sets for workflow-level CV, with the
+    reference's exact semantics (FitStagesUtil.cutDAG:305-358): walk the
+    selector's upstream cone farthest-first and cut at the FIRST layer
+    containing a label-touching stage; every cone stage from that layer
+    down to the selector - transformers included - refits inside each fold.
+    Returns {selector_uid: [during stages in execution order] + [selector]}
+    (empty stage list when no label-touching upstream exists, meaning the
+    selector's own plain CV is already leakage-free).
+
+    Extension over the reference, which errors on >1 selector
+    (FitStagesUtil.scala:311-317): PARALLEL selectors each get their own
+    independent cut; a selector nested in another's upstream cone is still
+    an error.
+    """
+    from ..stages.feature_generator import FeatureGeneratorStage
+
+    selector_set = set(model_selectors)
+    out: dict[str, list[PipelineStage]] = {}
+    for sel in model_selectors:
+        cone: dict[PipelineStage, int] = {}
+        for st, d in sel.get_output().parent_stages().items():
+            if st is sel or isinstance(st, FeatureGeneratorStage):
+                continue
+            if cone.get(st, -1) < d:
+                cone[st] = d
+        nested = [s for s in cone if s in selector_set]
+        if nested:
+            raise ValueError(
+                f"model selector {sel.uid} has other model selectors in its "
+                f"upstream cone ({[s.uid for s in nested]}); nested "
+                "selectors are not supported (reference: at most one "
+                "selector, FitStagesUtil.scala:311-317)"
+            )
+        by_dist: dict[int, list[PipelineStage]] = {}
+        for st, d in cone.items():
+            by_dist.setdefault(d, []).append(st)
+        # farthest-first = execution order within the cone
+        dists = sorted(by_dist, reverse=True)
+        first_idx = next(
+            (i for i, d in enumerate(dists)
+             if any(_label_touching(s) for s in by_dist[d])),
+            None,
+        )
+        during: list[PipelineStage] = []
+        if first_idx is not None:
+            for d in dists[first_idx:]:
+                during.extend(sorted(by_dist[d], key=lambda s: s.uid))
+        out[sel.uid] = during + [sel]
+    return out
+
+
 def cut_dag(
     dag: Sequence[Layer], model_selectors: Sequence[PipelineStage]
 ) -> tuple[list[Layer], list[PipelineStage], list[Layer]]:
-    """Split into (before, during, after) around the given model selectors for
-    workflow-level CV (reference: FitStagesUtil.cutDAG:305-358).
-
-    'during' = the model selectors plus every estimator strictly between the
-    last upstream *estimator* and the selector (those see label-dependent
-    state, so they must be refit inside each fold); 'before' = everything
-    upstream of that; 'after' = everything downstream of the selectors.
-    """
+    """Split into (before, during, after) around the given model selectors
+    (reference: FitStagesUtil.cutDAG:305-358).  'during' is the union of
+    the per-selector cuts from :func:`cut_dag_during`; 'after' is every
+    stage transitively downstream of a selector; 'before' is the rest."""
     if not model_selectors:
         return list(dag), [], []
     selector_set = set(model_selectors)
-    # features produced by selectors
     downstream: set[PipelineStage] = set()
     produced = {s.get_output().uid for s in selector_set}
-    changed = True
     all_stages = flatten(dag)
+    changed = True
     while changed:
         changed = False
         for s in all_stages:
@@ -113,45 +172,22 @@ def cut_dag(
                 produced.add(s.get_output().uid)
                 changed = True
 
-    before: list[Layer] = []
-    during: list[PipelineStage] = list(model_selectors)
-    after: list[Layer] = []
-    # walk layers; estimator layers between last estimator and selector move
-    # into 'during'
-    pending_transform_layers: list[Layer] = []
-    for layer in dag:
-        l_before = [s for s in layer if s not in selector_set and s not in downstream]
-        l_after = [s for s in layer if s in downstream]
-        if l_before:
-            before.append(l_before)
-        if l_after:
-            after.append(l_after)
-    # move trailing estimator-containing layers of 'before' into 'during':
-    # any estimator whose output reaches a selector without passing another
-    # estimator must be refit per fold.  Conservative approximation used
-    # here: keep 'before' as-is when its trailing layers are transformers
-    # only; otherwise move trailing estimator layers into 'during'.
-    moved: list[PipelineStage] = []
-    while before:
-        tail = before[-1]
-        ests = [s for s in tail if isinstance(s, Estimator)]
-        if not ests:
-            break
-        # only move if some estimator output feeds a selector (directly or
-        # through transformers already moved)
-        feeds = set()
-        sel_inputs = {p.uid for sel in selector_set for p in sel.input_features}
-        target_uids = sel_inputs | {p.uid for m in moved for p in m.input_features}
-        for s in tail:
-            if s.get_output().uid in target_uids:
-                feeds.add(s)
-        est_feeding = [s for s in ests if s in feeds]
-        if not est_feeding:
-            break
-        before[-1] = [s for s in tail if s not in est_feeding]
-        moved.extend(est_feeding)
-        if not before[-1]:
-            before.pop()
-        break  # single hop like the reference (direct upstream estimators)
-    during = moved + during
+    during_map = cut_dag_during(dag, model_selectors)
+    during_set = {s for lst in during_map.values() for s in lst}
+    during: list[PipelineStage] = []
+    seen: set[str] = set()
+    for layer in dag:  # union in execution order, deduped
+        for s in layer:
+            if s in during_set and s.uid not in seen:
+                during.append(s)
+                seen.add(s.uid)
+    before = [
+        [s for s in layer
+         if s not in selector_set and s not in downstream
+         and s not in during_set]
+        for layer in dag
+    ]
+    before = [l for l in before if l]
+    after = [[s for s in layer if s in downstream] for layer in dag]
+    after = [l for l in after if l]
     return before, during, after

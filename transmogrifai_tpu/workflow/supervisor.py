@@ -1,0 +1,97 @@
+"""Preemption detection + re-dispatch for long training runs.
+
+SURVEY §5.3: the reference leaned on Spark's task retry for preempted
+executors; on TPU pods that failure-detection gap is owned here.  Two
+halves:
+
+* **Heartbeat emission** - OpValidator touches ``<checkpoint>.heartbeat``
+  at validation start and after every completed (model, grid-point) row
+  (see validator._ckpt_save), so liveness == progress: a wedged device
+  dispatch or a SIGKILLed host stops the beat.
+* **Supervision** - :func:`supervise` runs the training command as a child
+  process, polls the heartbeat, kills the child when the beat goes stale,
+  and re-dispatches.  The restarted run restores the completed CV rows
+  from the checkpoint (validator._ckpt_load skip-completed semantics) and
+  continues, so the final selection is identical to an uninterrupted run.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+def beat(heartbeat_path: str) -> None:
+    """Touch the heartbeat file (creates it on first beat)."""
+    try:
+        with open(heartbeat_path, "a"):
+            os.utime(heartbeat_path, None)
+    except OSError:
+        pass  # a missed beat must never kill the training step itself
+
+
+def staleness(heartbeat_path: str) -> Optional[float]:
+    """Seconds since the last beat; None when no beat has happened yet."""
+    try:
+        return time.time() - os.path.getmtime(heartbeat_path)
+    except OSError:
+        return None
+
+
+@dataclass
+class SuperviseResult:
+    returncode: int
+    attempts: int
+    restarts: list = field(default_factory=list)  # (attempt, reason)
+
+
+def supervise(
+    cmd: Sequence[str],
+    heartbeat_path: str,
+    stale_after_s: float = 300.0,
+    max_restarts: int = 2,
+    poll_s: float = 0.5,
+    grace_s: Optional[float] = None,
+    env: Optional[dict] = None,
+) -> SuperviseResult:
+    """Run ``cmd`` under heartbeat supervision.
+
+    A child that exits non-zero (crash/preemption) or whose heartbeat goes
+    stale for ``stale_after_s`` (hang) is killed and re-dispatched, up to
+    ``max_restarts`` times.  ``grace_s`` bounds the no-beat-yet startup
+    window (defaults to stale_after_s).  Returns the final returncode and
+    the restart log; raises RuntimeError when restarts are exhausted.
+    """
+    grace = stale_after_s if grace_s is None else grace_s
+    restarts: list = []
+    for attempt in range(max_restarts + 1):
+        start = time.time()
+        proc = subprocess.Popen(list(cmd), env=env)
+        killed_reason = None
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            s = staleness(heartbeat_path)
+            age = time.time() - start
+            if s is None:
+                if age > grace:
+                    killed_reason = f"no heartbeat within {grace:.0f}s"
+            elif s > stale_after_s and age > stale_after_s:
+                killed_reason = f"heartbeat stale for {s:.0f}s"
+            if killed_reason:
+                proc.kill()
+                proc.wait()
+                break
+            time.sleep(poll_s)
+        if proc.returncode == 0 and killed_reason is None:
+            return SuperviseResult(0, attempt + 1, restarts)
+        restarts.append(
+            (attempt, killed_reason or f"exit code {proc.returncode}")
+        )
+    raise RuntimeError(
+        f"command failed after {max_restarts + 1} attempts; restart log: "
+        f"{restarts}"
+    )

@@ -72,11 +72,25 @@ def fit_and_transform_dag(
     train: Dataset,
     holdout: Optional[Dataset] = None,
     metrics=None,
+    cv_during: Optional[dict[str, list[PipelineStage]]] = None,
 ) -> tuple[list[PipelineStage], Dataset, Optional[Dataset]]:
     """Fold layers fit->transform (reference: FitStagesUtil.
     fitAndTransformDAG:213-240, fitAndTransformLayer:254-293).  ``metrics``
     (utils.tracing.AppMetrics) records per-stage wall clock like the
-    reference's OpSparkListener."""
+    reference's OpSparkListener.
+
+    ``cv_during`` ({selector_uid: [during stages..., selector]}, from
+    dag.cut_dag_during) enables workflow-level CV inline: when a selector
+    is reached, its ``find_best_estimator`` runs against the CURRENT
+    dataset, refitting the during stages per fold from scratch - their
+    full-data output columns are simply overwritten inside each fold.
+    This is leakage-free because every column a during-refit READS is
+    either a label-free 'before' output (by the first-label-touching-layer
+    cut, nothing above the cut touches the label) or an earlier during
+    stage's output, which the fold refit has already replaced in
+    dependency order.  Mirrors the reference's nonCVTS/CVTS split
+    (FitStagesUtil.cutDAG:305-358).
+    """
     import contextlib
 
     def timed(stage, phase, n):
@@ -89,6 +103,19 @@ def fit_and_transform_dag(
         layer_models: list[Transformer] = []
         for stage in layer:
             if isinstance(stage, Estimator):
+                if (
+                    cv_during
+                    and getattr(stage, "is_model_selector", False)
+                    and len(cv_during.get(stage.uid, [])) > 1
+                ):
+                    # leakage-free workflow CV: candidates scored with the
+                    # during stages refit inside each fold; the winner is
+                    # installed via best_override and refit on full data by
+                    # the stage.fit below
+                    with timed(stage, "workflow_cv", len(train)):
+                        stage.find_best_estimator(
+                            train, cv_during[stage.uid]
+                        )
                 with timed(stage, "fit", len(train)):
                     model = stage.fit(train)
                 if stage.has_test_eval and holdout is not None and len(holdout):
@@ -242,8 +269,8 @@ class OpWorkflow:
         holdout: Optional[Dataset] = None
         train_data = raw
         frac = float(self.parameters.get("reserve_test_fraction", 0.0))
-        selector = self._find_selector(dag)
-        if selector is not None:
+        selectors = self._find_selectors(dag)
+        for selector in selectors:
             sp = getattr(selector, "splitter", None)
             if sp is not None:
                 frac = max(frac, getattr(sp, "reserve_test_fraction", 0.0))
@@ -256,25 +283,19 @@ class OpWorkflow:
             test_idx, train_idx = perm[:n_test], perm[n_test:]
             train_data, holdout = raw.take(np.sort(train_idx)), raw.take(np.sort(test_idx))
 
-        if self._workflow_cv and selector is not None:
-            from .dag import cut_dag
+        cv_during = None
+        if self._workflow_cv and selectors:
+            from .dag import cut_dag_during
 
-            before, during, after = cut_dag(dag, [selector])
-            fitted_before, train_mid, holdout_mid = fit_and_transform_dag(
-                before, train_data, holdout, metrics=app_metrics
-            )
-            selector.find_best_estimator(train_mid, during)
-            # 'during' stages execute as sequential single-stage layers:
-            # moved upstream estimators feed the selector within the cut
-            fitted_rest, train_out, holdout_out = fit_and_transform_dag(
-                [[s] for s in during] + [list(l) for l in after],
-                train_mid, holdout_mid, metrics=app_metrics,
-            )
-            fitted = fitted_before + fitted_rest
-        else:
-            fitted, train_out, holdout_out = fit_and_transform_dag(
-                dag, train_data, holdout, metrics=app_metrics
-            )
+            # per-selector cut (reference: FitStagesUtil.cutDAG:305-358,
+            # extended to parallel selectors); execution stays one pass -
+            # fit_and_transform_dag snapshots the pre-'during' dataset and
+            # runs each selector's fold-refit CV inline
+            cv_during = cut_dag_during(dag, selectors)
+        fitted, train_out, holdout_out = fit_and_transform_dag(
+            dag, train_data, holdout, metrics=app_metrics,
+            cv_during=cv_during,
+        )
         model = OpWorkflowModel(
             result_features=self.result_features,
             raw_features=self.raw_features,
@@ -289,11 +310,14 @@ class OpWorkflow:
         model.app_metrics = app_metrics
         return model
 
+    def _find_selectors(self, dag: Sequence[Layer]) -> list:
+        return [
+            s for s in flatten(dag) if getattr(s, "is_model_selector", False)
+        ]
+
     def _find_selector(self, dag: Sequence[Layer]):
-        for s in flatten(dag):
-            if getattr(s, "is_model_selector", False):
-                return s
-        return None
+        sels = self._find_selectors(dag)
+        return sels[0] if sels else None
 
     def with_model_stages(self, model: "OpWorkflowModel") -> "OpWorkflow":
         """Warm start: swap already-fitted stages into this workflow so only
