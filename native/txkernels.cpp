@@ -199,8 +199,32 @@ inline void parse_num_cell(const uint8_t* buf, int64_t cb, int64_t ce,
     heap_buf.resize(static_cast<size_t>(clen) + 1);
     tmp = heap_buf.data();
   }
-  std::memcpy(tmp, buf + cb, clen);
-  tmp[clen] = 0;
+  // python float() parity: no C99 hex floats; '_' allowed only between
+  // digits (PEP 515) and stripped before parsing
+  int64_t w = 0;
+  for (int64_t k = 0; k < clen; k++) {
+    const char c = static_cast<char>(buf[cb + k]);
+    if (c == 'x' || c == 'X') {
+      *out = 0.0;
+      *mask = 0;
+      return;
+    }
+    if (c == '_') {
+      const bool prev_digit =
+          k > 0 && std::isdigit(static_cast<unsigned char>(buf[cb + k - 1]));
+      const bool next_digit =
+          k + 1 < clen &&
+          std::isdigit(static_cast<unsigned char>(buf[cb + k + 1]));
+      if (!prev_digit || !next_digit) {
+        *out = 0.0;
+        *mask = 0;
+        return;
+      }
+      continue;  // strip the separator
+    }
+    tmp[w++] = c;
+  }
+  tmp[w] = 0;
   char* end = nullptr;
   const double v = std::strtod(tmp, &end);
   if (end == tmp) {

@@ -104,6 +104,20 @@ def test_numeric_parse_python_float_semantics(tmp_path):
     assert mask[4] and vals[4] == 2000.0
 
 
+def test_numeric_parse_hex_and_underscores(tmp_path):
+    """float() parity corners: no C99 hex floats; PEP-515 underscores
+    strip only between digits."""
+    path = _write(tmp_path, "a\n0x10\n1_000\n_1\n1_\n1__0\ninf\n-2.5\n")
+    cols = fast_csv.read_csv_columnar(path, {"a": ft.Real})
+    vals, mask = cols["a"].values, cols["a"].mask
+    assert not mask[0]                      # float("0x10") raises
+    assert mask[1] and vals[1] == 1000.0    # float("1_000") == 1000.0
+    assert not mask[2] and not mask[3]      # leading/trailing underscore
+    assert not mask[4]                      # doubled underscore
+    assert mask[5] and np.isinf(vals[5])
+    assert mask[6] and vals[6] == -2.5
+
+
 def test_empty_and_header_only_files(tmp_path):
     import pytest as _pytest
 

@@ -182,7 +182,10 @@ def test_text_len_lang_ner_mime_phone():
     col = list(out.columns().values())[-1]
     assert col.values[0] == 11.0
 
-    assert is_valid_phone("(650) 123-4567") is True
+    # NANP: exchange code must be [2-9]XX, so "123" is invalid (matches
+    # libphonenumber's judgment) while "253" passes
+    assert is_valid_phone("(650) 253-4567") is True
+    assert is_valid_phone("(650) 123-4567") is False
     assert is_valid_phone("123") is False
     assert is_valid_phone(None) is None
     assert is_valid_phone("+44 7911 123456", "GB") is True

@@ -1,0 +1,272 @@
+"""Measured accuracy for the text-analysis stack.
+
+The reference leans on Optimaize/Tika/libphonenumber; the self-contained
+equivalents must prove themselves on fixtures: >=90% on a ~100-sample
+multilingual language-identification set (sentences DISTINCT from the
+profile seed corpora), exact MIME magics, and per-region phone rules.
+"""
+import base64
+import struct
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.text_analysis import (
+    detect_language,
+    detect_mime_type,
+    is_valid_phone,
+)
+
+# -- language identification fixtures ---------------------------------------
+# held-out sentences; none appear in ops/lang_data.py CORPORA
+LANG_SAMPLES = [
+    ("en", "My brother bought a new car last month and he drives it to work every day."),
+    ("en", "Please remember to close the windows before you leave the office tonight."),
+    ("en", "The restaurant on the corner serves the best coffee in the whole neighborhood."),
+    ("en", "After the meeting we decided to change the plan completely."),
+    ("fr", "Mon frère a acheté une nouvelle voiture le mois dernier et il la conduit tous les jours."),
+    ("fr", "N'oubliez pas de fermer les fenêtres avant de quitter le bureau ce soir."),
+    ("fr", "Le restaurant au coin de la rue sert le meilleur café du quartier."),
+    ("fr", "Après la réunion, nous avons décidé de changer complètement le plan."),
+    ("es", "Mi hermano compró un coche nuevo el mes pasado y lo conduce al trabajo todos los días."),
+    ("es", "Por favor, recuerda cerrar las ventanas antes de salir de la oficina esta noche."),
+    ("es", "El restaurante de la esquina sirve el mejor café de todo el barrio."),
+    ("es", "Después de la reunión decidimos cambiar el plan por completo."),
+    ("de", "Mein Bruder hat letzten Monat ein neues Auto gekauft und fährt damit jeden Tag zur Arbeit."),
+    ("de", "Bitte denken Sie daran, die Fenster zu schließen, bevor Sie heute Abend das Büro verlassen."),
+    ("de", "Das Restaurant an der Ecke serviert den besten Kaffee im ganzen Viertel."),
+    ("de", "Nach der Besprechung haben wir beschlossen, den Plan komplett zu ändern."),
+    ("it", "Mio fratello ha comprato una macchina nuova il mese scorso e la guida ogni giorno per andare al lavoro."),
+    ("it", "Per favore, ricordati di chiudere le finestre prima di lasciare l'ufficio stasera."),
+    ("it", "Il ristorante all'angolo serve il miglior caffè di tutto il quartiere."),
+    ("it", "Dopo la riunione abbiamo deciso di cambiare completamente il piano."),
+    ("pt", "O meu irmão comprou um carro novo no mês passado e conduz todos os dias para o trabalho."),
+    ("pt", "Por favor, lembre-se de fechar as janelas antes de sair do escritório esta noite."),
+    ("pt", "O restaurante da esquina serve o melhor café de todo o bairro."),
+    ("pt", "Depois da reunião decidimos mudar o plano completamente."),
+    ("nl", "Mijn broer heeft vorige maand een nieuwe auto gekocht en rijdt er elke dag mee naar zijn werk."),
+    ("nl", "Vergeet niet de ramen te sluiten voordat je vanavond het kantoor verlaat."),
+    ("nl", "Het restaurant op de hoek serveert de beste koffie van de hele buurt."),
+    ("nl", "Na de vergadering hebben we besloten het plan helemaal te veranderen."),
+    ("sv", "Min bror köpte en ny bil förra månaden och han kör den till jobbet varje dag."),
+    ("sv", "Kom ihåg att stänga fönstren innan du lämnar kontoret i kväll."),
+    ("sv", "Restaurangen på hörnet serverar det bästa kaffet i hela kvarteret."),
+    ("sv", "Efter mötet bestämde vi oss för att ändra planen helt och hållet."),
+    ("da", "Min bror købte en ny bil i sidste måned, og han kører i den på arbejde hver dag."),
+    ("da", "Husk at lukke vinduerne, før du forlader kontoret i aften."),
+    ("da", "Restauranten på hjørnet serverer den bedste kaffe i hele kvarteret."),
+    ("da", "Efter mødet besluttede vi at ændre planen fuldstændigt."),
+    ("pl", "Mój brat kupił nowy samochód w zeszłym miesiącu i jeździ nim codziennie do pracy."),
+    ("pl", "Proszę pamiętać o zamknięciu okien przed wyjściem z biura dziś wieczorem."),
+    ("pl", "Restauracja na rogu serwuje najlepszą kawę w całej okolicy."),
+    ("pl", "Po spotkaniu postanowiliśmy całkowicie zmienić plan."),
+    ("cs", "Můj bratr si minulý měsíc koupil nové auto a každý den jím jezdí do práce."),
+    ("cs", "Nezapomeňte prosím zavřít okna, než dnes večer odejdete z kanceláře."),
+    ("cs", "Restaurace na rohu podává nejlepší kávu v celé čtvrti."),
+    ("cs", "Po schůzce jsme se rozhodli plán úplně změnit."),
+    ("ro", "Fratele meu a cumpărat o mașină nouă luna trecută și o conduce în fiecare zi la serviciu."),
+    ("ro", "Vă rugăm să nu uitați să închideți ferestrele înainte de a pleca din birou diseară."),
+    ("ro", "Restaurantul din colț servește cea mai bună cafea din tot cartierul."),
+    ("ro", "După ședință am hotărât să schimbăm planul complet."),
+    ("tr", "Kardeşim geçen ay yeni bir araba aldı ve her gün işe onunla gidiyor."),
+    ("tr", "Lütfen bu akşam ofisten çıkmadan önce pencereleri kapatmayı unutmayın."),
+    ("tr", "Köşedeki restoran bütün mahalledeki en iyi kahveyi servis ediyor."),
+    ("tr", "Toplantıdan sonra planı tamamen değiştirmeye karar verdik."),
+    ("fi", "Veljeni osti uuden auton viime kuussa ja ajaa sillä töihin joka päivä."),
+    ("fi", "Muista sulkea ikkunat ennen kuin lähdet toimistolta tänä iltana."),
+    ("fi", "Kulman ravintola tarjoilee koko kaupunginosan parasta kahvia."),
+    ("fi", "Kokouksen jälkeen päätimme muuttaa suunnitelmaa kokonaan."),
+    ("id", "Kakak saya membeli mobil baru bulan lalu dan mengendarainya ke kantor setiap hari."),
+    ("id", "Tolong ingat untuk menutup jendela sebelum meninggalkan kantor malam ini."),
+    ("id", "Restoran di sudut jalan menyajikan kopi terbaik di seluruh lingkungan."),
+    ("id", "Setelah rapat kami memutuskan untuk mengubah rencana sepenuhnya."),
+    ("hu", "A bátyám múlt hónapban vett egy új autót, és minden nap azzal jár dolgozni."),
+    ("hu", "Kérlek, ne felejtsd el becsukni az ablakokat, mielőtt ma este elhagyod az irodát."),
+    ("hu", "A sarki étterem a legjobb kávét szolgálja fel az egész környéken."),
+    ("hu", "A megbeszélés után úgy döntöttünk, hogy teljesen megváltoztatjuk a tervet."),
+    ("ru", "Мой брат купил новую машину в прошлом месяце и каждый день ездит на ней на работу."),
+    ("ru", "Пожалуйста, не забудьте закрыть окна, прежде чем уйти из офиса сегодня вечером."),
+    ("ru", "Ресторан на углу подаёт лучший кофе во всём районе."),
+    ("ru", "После совещания мы решили полностью изменить план."),
+    ("uk", "Мій брат купив нову машину минулого місяця і щодня їздить нею на роботу."),
+    ("uk", "Будь ласка, не забудьте зачинити вікна, перш ніж піти з офісу сьогодні ввечері."),
+    ("uk", "Ресторан на розі подає найкращу каву в усьому районі."),
+    ("uk", "Після наради ми вирішили повністю змінити план."),
+    ("bg", "Брат ми купи нова кола миналия месец и всеки ден кара с нея на работа."),
+    ("bg", "Моля, не забравяйте да затворите прозорците, преди да напуснете офиса тази вечер."),
+    ("bg", "Ресторантът на ъгъла сервира най-хубавото кафе в целия квартал."),
+    ("bg", "След срещата решихме да променим плана изцяло."),
+    # script-decided languages
+    ("el", "Ο αδελφός μου αγόρασε καινούργιο αυτοκίνητο τον περασμένο μήνα."),
+    ("el", "Το εστιατόριο στη γωνία σερβίρει τον καλύτερο καφέ της γειτονιάς."),
+    ("he", "אחי קנה מכונית חדשה בחודש שעבר והוא נוסע בה לעבודה כל יום."),
+    ("he", "המסעדה בפינה מגישה את הקפה הטוב ביותר בשכונה."),
+    ("ar", "اشترى أخي سيارة جديدة الشهر الماضي ويقودها إلى العمل كل يوم."),
+    ("ar", "يقدم المطعم في الزاوية أفضل قهوة في الحي كله."),
+    ("hi", "मेरे भाई ने पिछले महीने एक नई कार खरीदी और वह हर दिन उसे काम पर चलाता है।"),
+    ("hi", "कोने का रेस्तरां पूरे मोहल्ले की सबसे अच्छी कॉफी परोसता है।"),
+    ("th", "พี่ชายของฉันซื้อรถใหม่เมื่อเดือนที่แล้วและขับไปทำงานทุกวัน"),
+    ("th", "ร้านอาหารตรงหัวมุมเสิร์ฟกาแฟที่ดีที่สุดในละแวกนี้"),
+    ("ja", "兄は先月新しい車を買って、毎日それで仕事に行きます。"),
+    ("ja", "角のレストランはこの辺りで一番おいしいコーヒーを出します。"),
+    ("zh", "我哥哥上个月买了一辆新车，每天开车去上班。"),
+    ("zh", "拐角处的餐厅供应整个街区最好的咖啡。"),
+    ("ko", "우리 형은 지난달에 새 차를 샀고 매일 그 차로 출근합니다."),
+    ("ko", "모퉁이에 있는 식당은 동네에서 가장 맛있는 커피를 제공합니다."),
+    ("ka", "ჩემმა ძმამ გასულ თვეში ახალი მანქანა იყიდა და ყოველდღე სამსახურში დადის."),
+    ("ka", "კუთხის რესტორანი მთელ უბანში საუკეთესო ყავას აწვდის."),
+    ("bn", "আমার ভাই গত মাসে একটি নতুন গাড়ি কিনেছে এবং প্রতিদিন সেটি চালিয়ে কাজে যায়।"),
+    ("bn", "কোণার রেস্তোরাঁটি পুরো পাড়ার সেরা কফি পরিবেশন করে।"),
+    ("en", "She has been studying medicine at the university for almost six years now."),
+    ("fr", "Nous avons passé nos vacances au bord de la mer avec toute la famille."),
+    ("de", "Im Winter fahren wir oft in die Berge, um Ski zu fahren und zu wandern."),
+    ("es", "Los estudiantes presentaron sus proyectos delante de toda la clase ayer."),
+]
+
+
+def test_lang_detect_accuracy_at_least_90pct():
+    assert len(LANG_SAMPLES) >= 100
+    correct, misses = 0, []
+    for lang, text in LANG_SAMPLES:
+        scores = detect_language(text)
+        got = next(iter(scores), None)
+        if got == lang:
+            correct += 1
+        else:
+            misses.append((lang, got, text[:40]))
+    acc = correct / len(LANG_SAMPLES)
+    assert acc >= 0.90, f"accuracy {acc:.2%}; misses: {misses}"
+
+
+def test_lang_detect_confidences_are_normalized():
+    scores = detect_language("The quick brown fox jumps over the lazy dog "
+                             "while the children watch from the garden.")
+    assert next(iter(scores)) == "en"
+    assert abs(sum(scores.values()) - 1.0) < 1e-6
+
+
+# -- MIME fixtures -----------------------------------------------------------
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode("ascii")
+
+
+MIME_FIXTURES = [
+    (b"\x89PNG\r\n\x1a\n" + b"\x00" * 16, "image/png"),
+    (b"\xff\xd8\xff\xe0\x00\x10JFIF", "image/jpeg"),
+    (b"GIF89a" + b"\x00" * 10, "image/gif"),
+    (b"%PDF-1.7\n%\xe2\xe3", "application/pdf"),
+    (b"PK\x03\x04\x14\x00", "application/zip"),
+    (b"\x1f\x8b\x08\x00", "application/gzip"),
+    (b"BZh91AY&SY", "application/x-bzip2"),
+    (b"7z\xbc\xaf\x27\x1c\x00\x04", "application/x-7z-compressed"),
+    (b"RIFF\x24\x00\x00\x00WAVEfmt ", "audio/wav"),
+    (b"RIFF\x24\x00\x00\x00WEBPVP8 ", "image/webp"),
+    (b"\x00\x00\x00\x18ftypmp42\x00\x00", "video/mp4"),
+    (b"\x00\x00\x00\x20ftypheic\x00\x00", "image/heic"),
+    (b"OggS\x00\x02", "audio/ogg"),
+    (b"ID3\x03\x00", "audio/mpeg"),
+    (b"wOF2\x00\x01", "font/woff2"),
+    (b"\x7fELF\x02\x01", "application/x-executable"),
+    (b"SQLite format 3\x00", "application/x-sqlite3"),
+    (b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1" + b"\x00" * 8,
+     "application/x-ole-storage"),
+    (b"II*\x00\x08\x00", "image/tiff"),
+    (b"<!DOCTYPE html><html><body>", "text/html"),
+    (b"  <svg xmlns='http://www.w3.org/2000/svg'>", "image/svg+xml"),
+    (b'{"key": "value"}', "application/json"),
+    (b"<?xml version='1.0'?><root/>", "application/xml"),
+    (b"plain old text content here", "text/plain"),
+    (b"\x00" * 200 + b"\xfe\xfe\xfe", "application/octet-stream"),
+]
+
+
+def test_mime_fixtures_all_exact():
+    wrong = []
+    for raw, expect in MIME_FIXTURES:
+        got = detect_mime_type(_b64(raw))
+        if got != expect:
+            wrong.append((expect, got))
+    assert not wrong, wrong
+
+
+def test_mime_tar_at_offset():
+    raw = b"\x00" * 257 + b"ustar\x0000" + b"\x00" * 200
+    assert detect_mime_type(_b64(raw)) == "application/x-tar"
+
+
+def test_mime_handles_garbage():
+    assert detect_mime_type("!!!not-base64!!!") is None
+    assert detect_mime_type(None) is None
+    assert detect_mime_type("") is None
+
+
+# -- phone fixtures -----------------------------------------------------------
+PHONE_FIXTURES = [
+    ("650-253-0000", "US", True),
+    ("(212) 555-2368", "US", True),
+    ("+1 650 253 0000", "US", True),
+    ("1-800-466-4411", "US", True),
+    ("123-456-7890", "US", False),     # area code starts with 1
+    ("650-053-0000", "US", False),     # exchange starts with 0
+    ("65-0253-000", "US", False),      # too short
+    ("+44 20 7946 0958", "GB", True),
+    ("020 7946 0958", "GB", True),
+    ("+33 1 42 68 53 00", "FR", True),
+    ("01 42 68 53 00", "FR", True),
+    ("+33 0 12 34", "FR", False),
+    ("+49 30 901820", "DE", True),
+    ("+91 98765 43210", "IN", True),
+    ("+91 12345 67890", "IN", False),  # mobile must start 6-9
+    ("+61 2 9374 4000", "AU", True),
+    ("+55 11 91234 5678", "BR", True),
+    ("+34 612 345 678", "ES", True),
+    ("+34 112 345 678", "ES", False),  # must start 6-9
+    ("+86 138 0013 8000", "CN", True),
+    ("", "US", None),
+    (None, "US", None),
+    ("not a phone", "US", False),
+]
+
+
+def test_phone_fixtures():
+    wrong = []
+    for phone, region, expect in PHONE_FIXTURES:
+        got = is_valid_phone(phone, region)
+        if got is not expect and got != expect:
+            wrong.append((phone, region, expect, got))
+    assert not wrong, wrong
+
+
+# -- stopword-aware tokenizer -------------------------------------------------
+def test_tokenizer_stopword_removal_explicit_language():
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.ops.text import TextTokenizer
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.types.columns import TextColumn
+    from transmogrifai_tpu.types.dataset import Dataset
+
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    ds = Dataset({"t": TextColumn(
+        np.array(["the cat sat on the mat", None], dtype=object))})
+    tok = TextTokenizer(remove_stopwords=True, language="en").set_input(f)
+    out = tok.transform(ds)[tok.output_name]
+    assert out.values[0] == ("cat", "sat", "mat")
+    assert out.values[1] == ()
+
+
+def test_tokenizer_stopword_removal_auto_detects_language():
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.ops.text import TextTokenizer
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.types.columns import TextColumn
+    from transmogrifai_tpu.types.dataset import Dataset
+
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    fr = ("Le chat dort dans la cuisine et le chien joue dans le jardin "
+          "avec les enfants de la maison voisine")
+    ds = Dataset({"t": TextColumn(np.array([fr], dtype=object))})
+    tok = TextTokenizer(remove_stopwords=True, language="auto").set_input(f)
+    out = tok.transform(ds)[tok.output_name]
+    toks = set(out.values[0])
+    assert "chat" in toks and "jardin" in toks
+    assert "le" not in toks and "dans" not in toks and "avec" not in toks

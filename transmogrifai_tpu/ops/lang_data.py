@@ -1,0 +1,359 @@
+"""Language identification data: seed corpora + rank-order trigram profiles.
+
+Counterpart of the reference's Optimaize language-detector profiles
+(reference: core/.../impl/feature/LangDetector.scala + the optimaize
+language-profile resources).  Self-contained equivalent: per-language
+character-trigram profiles in Cavnar-Trenkle rank order, built at import
+time from the embedded seed corpora below (a few hundred bytes per
+language of everyday-register text), plus Unicode-script routing for
+languages whose script is decisive on its own (Cyrillic/Greek/Arabic/CJK/
+Hangul/Thai/Devanagari/Hebrew...).
+
+The corpora are deliberately generic prose - greetings, weather, family,
+work, travel - so the profiles capture function-word trigrams (the
+Cavnar-Trenkle signal) rather than topical vocabulary.
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+PROFILE_SIZE = 300
+
+# -- Latin-script seed corpora ----------------------------------------------
+CORPORA: dict[str, str] = {
+    "en": (
+        "The weather is very nice today and we are going to the park with "
+        "the children. I would like to know what time the train leaves in "
+        "the morning. She said that they have been working on this project "
+        "for three years. There is a small house near the river where my "
+        "grandmother used to live. Could you please tell me where the "
+        "nearest station is? We should have dinner together some time next "
+        "week. The government announced new measures to support local "
+        "businesses. Most people think that the city has changed a lot over "
+        "the last ten years. He was reading a book about the history of the "
+        "country when I arrived. It is important to drink enough water "
+        "every day, especially in the summer."
+    ),
+    "fr": (
+        "Le temps est très beau aujourd'hui et nous allons au parc avec les "
+        "enfants. Je voudrais savoir à quelle heure part le train demain "
+        "matin. Elle a dit qu'ils travaillent sur ce projet depuis trois "
+        "ans. Il y a une petite maison près de la rivière où ma grand-mère "
+        "habitait. Pouvez-vous me dire où se trouve la gare la plus proche? "
+        "Nous devrions dîner ensemble la semaine prochaine. Le gouvernement "
+        "a annoncé de nouvelles mesures pour soutenir les entreprises "
+        "locales. La plupart des gens pensent que la ville a beaucoup "
+        "changé au cours des dix dernières années. Il lisait un livre sur "
+        "l'histoire du pays quand je suis arrivé. Il est important de boire "
+        "assez d'eau chaque jour, surtout en été."
+    ),
+    "es": (
+        "El tiempo está muy agradable hoy y vamos al parque con los niños. "
+        "Me gustaría saber a qué hora sale el tren mañana por la mañana. "
+        "Ella dijo que llevan tres años trabajando en este proyecto. Hay "
+        "una casa pequeña cerca del río donde vivía mi abuela. ¿Puede "
+        "decirme dónde está la estación más cercana? Deberíamos cenar "
+        "juntos la próxima semana. El gobierno anunció nuevas medidas para "
+        "apoyar a las empresas locales. La mayoría de la gente piensa que "
+        "la ciudad ha cambiado mucho en los últimos diez años. Él estaba "
+        "leyendo un libro sobre la historia del país cuando llegué. Es "
+        "importante beber suficiente agua todos los días, sobre todo en "
+        "verano."
+    ),
+    "de": (
+        "Das Wetter ist heute sehr schön und wir gehen mit den Kindern in "
+        "den Park. Ich möchte wissen, um wie viel Uhr der Zug morgen früh "
+        "abfährt. Sie sagte, dass sie seit drei Jahren an diesem Projekt "
+        "arbeiten. Es gibt ein kleines Haus in der Nähe des Flusses, wo "
+        "meine Großmutter gewohnt hat. Können Sie mir sagen, wo der nächste "
+        "Bahnhof ist? Wir sollten nächste Woche zusammen zu Abend essen. "
+        "Die Regierung hat neue Maßnahmen zur Unterstützung der lokalen "
+        "Unternehmen angekündigt. Die meisten Leute denken, dass sich die "
+        "Stadt in den letzten zehn Jahren stark verändert hat. Er las ein "
+        "Buch über die Geschichte des Landes, als ich ankam. Es ist "
+        "wichtig, jeden Tag genug Wasser zu trinken, besonders im Sommer."
+    ),
+    "it": (
+        "Il tempo è molto bello oggi e andiamo al parco con i bambini. "
+        "Vorrei sapere a che ora parte il treno domani mattina. Ha detto "
+        "che lavorano a questo progetto da tre anni. C'è una piccola casa "
+        "vicino al fiume dove viveva mia nonna. Può dirmi dove si trova la "
+        "stazione più vicina? Dovremmo cenare insieme la prossima "
+        "settimana. Il governo ha annunciato nuove misure per sostenere le "
+        "imprese locali. La maggior parte delle persone pensa che la città "
+        "sia cambiata molto negli ultimi dieci anni. Stava leggendo un "
+        "libro sulla storia del paese quando sono arrivato. È importante "
+        "bere abbastanza acqua ogni giorno, soprattutto in estate."
+    ),
+    "pt": (
+        "O tempo está muito bom hoje e vamos ao parque com as crianças. "
+        "Gostaria de saber a que horas parte o comboio amanhã de manhã. "
+        "Ela disse que eles trabalham neste projeto há três anos. Há uma "
+        "casa pequena perto do rio onde a minha avó morava. Pode dizer-me "
+        "onde fica a estação mais próxima? Devíamos jantar juntos na "
+        "próxima semana. O governo anunciou novas medidas para apoiar as "
+        "empresas locais. A maioria das pessoas acha que a cidade mudou "
+        "muito nos últimos dez anos. Ele estava a ler um livro sobre a "
+        "história do país quando eu cheguei. É importante beber água "
+        "suficiente todos os dias, sobretudo no verão."
+    ),
+    "nl": (
+        "Het weer is vandaag erg mooi en we gaan met de kinderen naar het "
+        "park. Ik zou graag willen weten hoe laat de trein morgenochtend "
+        "vertrekt. Ze zei dat ze al drie jaar aan dit project werken. Er "
+        "staat een klein huis bij de rivier waar mijn grootmoeder woonde. "
+        "Kunt u mij vertellen waar het dichtstbijzijnde station is? We "
+        "zouden volgende week samen moeten eten. De regering heeft nieuwe "
+        "maatregelen aangekondigd om lokale bedrijven te steunen. De meeste "
+        "mensen denken dat de stad de afgelopen tien jaar veel veranderd "
+        "is. Hij las een boek over de geschiedenis van het land toen ik "
+        "aankwam. Het is belangrijk om elke dag genoeg water te drinken, "
+        "vooral in de zomer."
+    ),
+    "sv": (
+        "Vädret är mycket fint idag och vi går till parken med barnen. Jag "
+        "skulle vilja veta när tåget går i morgon bitti. Hon sa att de har "
+        "arbetat med det här projektet i tre år. Det finns ett litet hus "
+        "nära floden där min mormor bodde. Kan du säga mig var närmaste "
+        "station ligger? Vi borde äta middag tillsammans nästa vecka. "
+        "Regeringen har meddelat nya åtgärder för att stödja lokala "
+        "företag. De flesta människor tycker att staden har förändrats "
+        "mycket under de senaste tio åren. Han läste en bok om landets "
+        "historia när jag kom fram. Det är viktigt att dricka tillräckligt "
+        "med vatten varje dag, särskilt på sommaren."
+    ),
+    "da": (
+        "Vejret er meget fint i dag, og vi går i parken med børnene. Jeg "
+        "vil gerne vide, hvornår toget kører i morgen tidlig. Hun sagde, "
+        "at de har arbejdet på dette projekt i tre år. Der ligger et lille "
+        "hus nær floden, hvor min bedstemor boede. Kan du fortælle mig, "
+        "hvor den nærmeste station ligger? Vi burde spise middag sammen i "
+        "næste uge. Regeringen har annonceret nye tiltag for at støtte "
+        "lokale virksomheder. De fleste mennesker synes, at byen har "
+        "ændret sig meget i løbet af de sidste ti år. Han læste en bog om "
+        "landets historie, da jeg ankom. Det er vigtigt at drikke nok vand "
+        "hver dag, især om sommeren."
+    ),
+    "pl": (
+        "Pogoda jest dzisiaj bardzo ładna i idziemy z dziećmi do parku. "
+        "Chciałbym wiedzieć, o której godzinie odjeżdża pociąg jutro rano. "
+        "Powiedziała, że pracują nad tym projektem od trzech lat. Nad "
+        "rzeką stoi mały dom, w którym mieszkała moja babcia. Czy może mi "
+        "pan powiedzieć, gdzie jest najbliższa stacja? Powinniśmy zjeść "
+        "razem kolację w przyszłym tygodniu. Rząd ogłosił nowe środki "
+        "wsparcia dla lokalnych firm. Większość ludzi uważa, że miasto "
+        "bardzo się zmieniło w ciągu ostatnich dziesięciu lat. Czytał "
+        "książkę o historii kraju, kiedy przyjechałem. Ważne jest, aby "
+        "pić wystarczająco dużo wody każdego dnia, zwłaszcza latem."
+    ),
+    "cs": (
+        "Počasí je dnes velmi pěkné a jdeme s dětmi do parku. Chtěl bych "
+        "vědět, v kolik hodin zítra ráno odjíždí vlak. Řekla, že na tomto "
+        "projektu pracují už tři roky. U řeky stojí malý dům, kde bydlela "
+        "moje babička. Můžete mi říct, kde je nejbližší nádraží? Měli "
+        "bychom spolu příští týden povečeřet. Vláda oznámila nová opatření "
+        "na podporu místních podniků. Většina lidí si myslí, že se město "
+        "za posledních deset let hodně změnilo. Četl knihu o historii "
+        "země, když jsem přijel. Je důležité pít každý den dostatek vody, "
+        "zvláště v létě."
+    ),
+    "ro": (
+        "Vremea este foarte frumoasă astăzi și mergem în parc cu copiii. "
+        "Aș vrea să știu la ce oră pleacă trenul mâine dimineață. Ea a "
+        "spus că lucrează la acest proiect de trei ani. Lângă râu este o "
+        "casă mică unde locuia bunica mea. Puteți să-mi spuneți unde este "
+        "cea mai apropiată gară? Ar trebui să luăm cina împreună "
+        "săptămâna viitoare. Guvernul a anunțat noi măsuri pentru a "
+        "sprijini afacerile locale. Cei mai mulți oameni cred că orașul "
+        "s-a schimbat mult în ultimii zece ani. El citea o carte despre "
+        "istoria țării când am ajuns. Este important să bei destulă apă "
+        "în fiecare zi, mai ales vara."
+    ),
+    "tr": (
+        "Bugün hava çok güzel ve çocuklarla parka gidiyoruz. Trenin yarın "
+        "sabah saat kaçta kalktığını öğrenmek istiyorum. Üç yıldır bu "
+        "proje üzerinde çalıştıklarını söyledi. Nehrin yakınında "
+        "büyükannemin yaşadığı küçük bir ev var. En yakın istasyonun "
+        "nerede olduğunu söyleyebilir misiniz? Gelecek hafta birlikte "
+        "yemek yemeliyiz. Hükümet yerel işletmeleri desteklemek için yeni "
+        "önlemler açıkladı. Çoğu insan şehrin son on yılda çok değiştiğini "
+        "düşünüyor. Ben geldiğimde ülkenin tarihi hakkında bir kitap "
+        "okuyordu. Her gün yeterince su içmek önemlidir, özellikle yazın."
+    ),
+    "fi": (
+        "Sää on tänään oikein kaunis ja menemme lasten kanssa puistoon. "
+        "Haluaisin tietää, mihin aikaan juna lähtee huomenna aamulla. Hän "
+        "sanoi, että he ovat työskennelleet tämän projektin parissa kolme "
+        "vuotta. Joen lähellä on pieni talo, jossa isoäitini asui. "
+        "Voitteko kertoa, missä lähin asema on? Meidän pitäisi syödä "
+        "yhdessä ensi viikolla. Hallitus ilmoitti uusista toimista "
+        "paikallisten yritysten tukemiseksi. Useimmat ihmiset ajattelevat, "
+        "että kaupunki on muuttunut paljon viimeisten kymmenen vuoden "
+        "aikana. Hän luki kirjaa maan historiasta, kun saavuin. On "
+        "tärkeää juoda tarpeeksi vettä joka päivä, varsinkin kesällä."
+    ),
+    "id": (
+        "Cuaca hari ini sangat bagus dan kami pergi ke taman bersama "
+        "anak-anak. Saya ingin tahu jam berapa kereta berangkat besok "
+        "pagi. Dia mengatakan bahwa mereka telah mengerjakan proyek ini "
+        "selama tiga tahun. Ada sebuah rumah kecil di dekat sungai tempat "
+        "nenek saya dulu tinggal. Bisakah Anda memberi tahu saya di mana "
+        "stasiun terdekat? Kita harus makan malam bersama minggu depan. "
+        "Pemerintah mengumumkan langkah-langkah baru untuk mendukung "
+        "usaha lokal. Kebanyakan orang berpikir bahwa kota ini telah "
+        "banyak berubah selama sepuluh tahun terakhir. Dia sedang membaca "
+        "buku tentang sejarah negara ketika saya tiba. Penting untuk "
+        "minum cukup air setiap hari, terutama di musim panas."
+    ),
+    "hu": (
+        "Ma nagyon szép az idő, és a gyerekekkel a parkba megyünk. "
+        "Szeretném tudni, hogy holnap reggel hánykor indul a vonat. Azt "
+        "mondta, hogy három éve dolgoznak ezen a projekten. A folyó "
+        "közelében van egy kis ház, ahol a nagymamám lakott. Meg tudná "
+        "mondani, hol van a legközelebbi állomás? Jövő héten együtt "
+        "kellene vacsoráznunk. A kormány új intézkedéseket jelentett be a "
+        "helyi vállalkozások támogatására. A legtöbb ember úgy gondolja, "
+        "hogy a város sokat változott az elmúlt tíz évben. Egy könyvet "
+        "olvasott az ország történelméről, amikor megérkeztem. Fontos, "
+        "hogy minden nap elég vizet igyunk, különösen nyáron."
+    ),
+    # Cyrillic-script languages get their own trigram profiles too (script
+    # routing narrows to the Cyrillic family, profiles pick the language)
+    "ru": (
+        "Сегодня очень хорошая погода, и мы идём в парк с детьми. Я хотел "
+        "бы узнать, во сколько завтра утром отправляется поезд. Она "
+        "сказала, что они работают над этим проектом уже три года. Возле "
+        "реки стоит маленький дом, где жила моя бабушка. Не могли бы вы "
+        "сказать, где находится ближайшая станция? Нам следует поужинать "
+        "вместе на следующей неделе. Правительство объявило о новых мерах "
+        "поддержки местных предприятий. Большинство людей считают, что "
+        "город сильно изменился за последние десять лет. Он читал книгу "
+        "об истории страны, когда я приехал. Важно пить достаточно воды "
+        "каждый день, особенно летом."
+    ),
+    "uk": (
+        "Сьогодні дуже гарна погода, і ми йдемо до парку з дітьми. Я "
+        "хотів би дізнатися, о котрій годині завтра вранці відправляється "
+        "потяг. Вона сказала, що вони працюють над цим проєктом уже три "
+        "роки. Біля річки стоїть маленький будинок, де жила моя бабуся. "
+        "Чи не могли б ви сказати, де знаходиться найближча станція? Нам "
+        "варто повечеряти разом наступного тижня. Уряд оголосив про нові "
+        "заходи підтримки місцевих підприємств. Більшість людей вважає, "
+        "що місто дуже змінилося за останні десять років. Він читав "
+        "книжку про історію країни, коли я приїхав. Важливо пити "
+        "достатньо води щодня, особливо влітку."
+    ),
+    "bg": (
+        "Днес времето е много хубаво и отиваме в парка с децата. Бих "
+        "искал да знам в колко часа тръгва влакът утре сутринта. Тя каза, "
+        "че работят по този проект от три години. Близо до реката има "
+        "малка къща, където живееше баба ми. Можете ли да ми кажете къде "
+        "е най-близката гара? Трябва да вечеряме заедно следващата "
+        "седмица. Правителството обяви нови мерки в подкрепа на местния "
+        "бизнес. Повечето хора смятат, че градът се е променил много през "
+        "последните десет години. Той четеше книга за историята на "
+        "страната, когато пристигнах. Важно е да се пие достатъчно вода "
+        "всеки ден, особено през лятото."
+    ),
+}
+
+# -- script routing -----------------------------------------------------------
+# (start, end, result): result is a language code when the script decides
+# the language outright, or a family name when profiles disambiguate
+SCRIPT_RANGES = [
+    (0x0370, 0x03FF, "el"),
+    (0x0400, 0x04FF, "cyrillic"),   # ru/uk/bg via profiles
+    (0x0530, 0x058F, "hy"),
+    (0x0590, 0x05FF, "he"),
+    (0x0600, 0x06FF, "ar"),
+    (0x0900, 0x097F, "hi"),
+    (0x0980, 0x09FF, "bn"),
+    (0x0A80, 0x0AFF, "gu"),
+    (0x0B80, 0x0BFF, "ta"),
+    (0x0C00, 0x0C7F, "te"),
+    (0x0E00, 0x0E7F, "th"),
+    (0x10A0, 0x10FF, "ka"),
+    (0x3040, 0x309F, "ja"),          # hiragana is decisive vs chinese
+    (0x30A0, 0x30FF, "ja"),          # katakana
+    (0x4E00, 0x9FFF, "zh"),          # han without kana -> chinese
+    (0xAC00, 0xD7AF, "ko"),
+]
+
+
+def _trigram_ranks(text: str, top: int = PROFILE_SIZE) -> dict[str, int]:
+    """Cavnar-Trenkle profile: top character trigrams by frequency, mapped
+    to their rank.  Text is lowercased; runs of non-letters collapse to a
+    single space so punctuation never contributes."""
+    import re as _re
+
+    t = _re.sub(r"[^\w]+", " ", text.lower(), flags=_re.UNICODE)
+    t = _re.sub(r"[\d_]+", " ", t)
+    t = f" {t.strip()} "
+    counts: Counter = Counter(
+        t[i : i + 3] for i in range(len(t) - 2)
+    )
+    ranked = [g for g, _ in counts.most_common(top)]
+    return {g: r for r, g in enumerate(ranked)}
+
+
+PROFILES: dict[str, dict[str, int]] = {
+    lang: _trigram_ranks(text) for lang, text in CORPORA.items()
+}
+
+_CYRILLIC_LANGS = ("ru", "uk", "bg")
+_LATIN_LANGS = tuple(
+    lang for lang in CORPORA if lang not in _CYRILLIC_LANGS
+)
+
+
+def dominant_script(text: str) -> str:
+    """'latin', a family name, or a decisive language code."""
+    votes: Counter = Counter()
+    for ch in text:
+        cp = ord(ch)
+        if cp < 0x250:  # basic latin + latin-1 + extended
+            if ch.isalpha():
+                votes["latin"] += 1
+            continue
+        for lo, hi, result in SCRIPT_RANGES:
+            if lo <= cp <= hi:
+                votes[result] += 1
+                break
+    if not votes:
+        return "latin"
+    # hiragana/katakana decide japanese even when han dominates raw counts
+    if votes.get("ja") and votes.get("zh"):
+        return "ja"
+    return votes.most_common(1)[0][0]
+
+
+def rank_distance(doc_ranks: dict[str, int], profile: dict[str, int]) -> float:
+    """Cavnar-Trenkle out-of-place distance, normalized to [0, 1] (0 =
+    identical rank order)."""
+    if not doc_ranks:
+        return 1.0
+    max_out = PROFILE_SIZE
+    total = 0.0
+    for g, r in doc_ranks.items():
+        pr = profile.get(g)
+        total += abs(r - pr) if pr is not None else max_out
+    return total / (len(doc_ranks) * max_out)
+
+
+def detect(text: str) -> dict[str, float]:
+    """Language -> confidence, best first.  Script routing first; trigram
+    rank profiles within the Latin and Cyrillic families."""
+    script = dominant_script(text)
+    if script == "latin":
+        cands = _LATIN_LANGS
+    elif script == "cyrillic":
+        cands = _CYRILLIC_LANGS
+    else:
+        return {script: 1.0}
+    doc = _trigram_ranks(text, top=PROFILE_SIZE)
+    dists = {lang: rank_distance(doc, PROFILES[lang]) for lang in cands}
+    # confidence: softmax-ish inversion of distances
+    sims = {k: max(1.0 - v, 0.0) for k, v in dists.items()}
+    total = sum(sims.values()) or 1.0
+    out = {k: v / total for k, v in sims.items() if v > 0}
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))

@@ -46,21 +46,54 @@ def tokenize(
 
 
 class TextTokenizer(Transformer):
+    """``remove_stopwords`` drops per-language function words like the
+    reference's per-language Lucene analyzers (LuceneTextAnalyzer.scala);
+    ``language`` is an ISO code or 'auto' (per-row detection via
+    ops.lang_data, the TextTokenizer.scala languageDetection option)."""
+
     input_types = [Text]
     output_type = TextList
 
-    def __init__(self, min_token_length: int = 1, to_lowercase: bool = True, **kw):
+    def __init__(self, min_token_length: int = 1, to_lowercase: bool = True,
+                 remove_stopwords: bool = False, language: str = "auto",
+                 **kw):
         super().__init__(**kw)
         self.min_token_length = min_token_length
         self.to_lowercase = to_lowercase
+        self.remove_stopwords = remove_stopwords
+        self.language = language
+
+    def _stop_set(self, text: Optional[str]):
+        from .stopwords import stopwords_for
+
+        lang = self.language
+        if lang == "auto":
+            from .lang_data import detect
+
+            scores = detect(text or "")
+            lang = next(iter(scores), "en")
+        return stopwords_for(lang)
 
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         (col,) = cols
         assert isinstance(col, TextColumn)
-        toks = [
-            tuple(tokenize(v, self.to_lowercase, self.min_token_length))
-            for v in col.values
-        ]
+        if not self.remove_stopwords:
+            toks = [
+                tuple(tokenize(v, self.to_lowercase, self.min_token_length))
+                for v in col.values
+            ]
+        else:
+            shared = (
+                self._stop_set(None) if self.language != "auto" else None
+            )
+            toks = []
+            for v in col.values:
+                stop = shared if shared is not None else self._stop_set(v)
+                toks.append(tuple(
+                    t for t in tokenize(v, self.to_lowercase,
+                                        self.min_token_length)
+                    if t.lower() not in stop
+                ))
         return ListColumn(toks, TextList)
 
 

@@ -53,30 +53,20 @@ class TextLenTransformer(Transformer):
 
 
 # -- Language detection ------------------------------------------------------
-# tiny trigram profiles for common languages; enough to route tokenization
-_LANG_PROFILES = {
-    "en": ["the", "and", "ing", "ion", "tio", "ent", "ati", " th", "he ", "er "],
-    "fr": ["les", "ent", "de ", " de", "ion", "es ", "la ", " la", "et ", "que"],
-    "es": ["de ", " de", "la ", " la", "que", "el ", " el", "ión", "os ", "ent"],
-    "de": ["en ", "er ", "ch ", "der", "ein", "sch", "ie ", "die", "und", " un"],
-    "it": ["di ", " di", "la ", " la", "che", "re ", "to ", "no ", "ell", "one"],
-    "pt": ["de ", " de", "ão ", "os ", "da ", " da", "que", "em ", "ar ", "ent"],
-    "nl": ["en ", "de ", " de", "van", " va", "het", " he", "een", " ee", "er "],
-}
 
 
 def detect_language(text: Optional[str]) -> dict[str, float]:
-    """Language -> confidence scores (reference: LangDetector.scala)."""
+    """Language -> confidence scores (reference: LangDetector.scala via
+    the Optimaize profiles).  Unicode-script routing decides non-Latin
+    scripts outright; Latin- and Cyrillic-script text is identified by
+    Cavnar-Trenkle rank-order trigram profiles built from the embedded
+    seed corpora in ops.lang_data (17 profiled + 13 script-decided
+    languages; accuracy pinned by tests/test_text_accuracy.py)."""
     if not text:
         return {}
-    t = text.lower()
-    scores = {}
-    for lang, grams in _LANG_PROFILES.items():
-        hits = sum(t.count(g) for g in grams)
-        if hits:
-            scores[lang] = hits
-    total = sum(scores.values())
-    return {k: v / total for k, v in sorted(scores.items(), key=lambda kv: -kv[1])}
+    from .lang_data import detect
+
+    return detect(text)
 
 
 class LangDetector(Transformer):
@@ -127,31 +117,73 @@ class NameEntityRecognizer(Transformer):
 
 # -- MIME type detection -----------------------------------------------------
 _MAGIC = [
-    (b"\x89PNG", "image/png"),
+    (b"\x89PNG\r\n\x1a\n", "image/png"),
     (b"\xff\xd8\xff", "image/jpeg"),
-    (b"GIF8", "image/gif"),
+    (b"GIF87a", "image/gif"),
+    (b"GIF89a", "image/gif"),
     (b"%PDF", "application/pdf"),
     (b"PK\x03\x04", "application/zip"),
+    (b"PK\x05\x06", "application/zip"),   # empty archive
     (b"\x1f\x8b", "application/gzip"),
+    (b"BZh", "application/x-bzip2"),
+    (b"7z\xbc\xaf\x27\x1c", "application/x-7z-compressed"),
+    (b"\xfd7zXZ\x00", "application/x-xz"),
     (b"BM", "image/bmp"),
+    (b"II*\x00", "image/tiff"),
+    (b"MM\x00*", "image/tiff"),
     (b"{\\rtf", "application/rtf"),
     (b"<?xml", "application/xml"),
-    (b"<html", "text/html"),
+    (b"OggS", "audio/ogg"),
+    (b"fLaC", "audio/flac"),
+    (b"ID3", "audio/mpeg"),
+    (b"\xff\xfb", "audio/mpeg"),
+    (b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1", "application/x-ole-storage"),
+    (b"wOFF", "font/woff"),
+    (b"wOF2", "font/woff2"),
+    (b"\x7fELF", "application/x-executable"),
+    (b"MZ", "application/x-msdownload"),
+    (b"SQLite format 3\x00", "application/x-sqlite3"),
+    (b"\x00\x00\x01\x00", "image/x-icon"),
 ]
+
+# container formats keyed off an inner tag, not the first bytes
+_RIFF_SUBTYPES = {b"WAVE": "audio/wav", b"AVI ": "video/x-msvideo",
+                  b"WEBP": "image/webp"}
 
 
 def detect_mime_type(b64: Optional[str]) -> Optional[str]:
-    """(reference: MimeTypeDetector.scala via Tika magic bytes)"""
+    """(reference: MimeTypeDetector.scala via Tika magic bytes; Tika's
+    most common magics reproduced incl. offset-based containers)"""
     if not b64:
         return None
+    head = b64[:700]
     try:
-        raw = base64.b64decode(b64[:64] + "=" * (-len(b64[:64]) % 4))
+        raw = base64.b64decode(head + "=" * (-len(head) % 4))
     except (binascii.Error, ValueError):
         return None
     for magic, mime in _MAGIC:
         if raw.startswith(magic):
             return mime
-    if raw[:1] in (b"{", b"["):
+    if raw[:4] == b"RIFF" and len(raw) >= 12:
+        return _RIFF_SUBTYPES.get(raw[8:12], "application/octet-stream")
+    if len(raw) >= 12 and raw[4:8] == b"ftyp":  # ISO-BMFF: mp4/mov/heic
+        brand = raw[8:12]
+        if brand.startswith(b"qt"):
+            return "video/quicktime"
+        if brand in (b"heic", b"heix", b"mif1"):
+            return "image/heic"
+        if brand.startswith(b"M4A"):
+            return "audio/mp4"
+        return "video/mp4"
+    if len(raw) > 262 and raw[257:262] == b"ustar":
+        return "application/x-tar"
+    stripped = raw.lstrip()
+    low = stripped[:64].lower()
+    if low.startswith((b"<!doctype html", b"<html")):
+        return "text/html"
+    if low.startswith(b"<svg"):
+        return "image/svg+xml"
+    if stripped[:1] in (b"{", b"["):
         return "application/json"
     try:
         raw.decode("utf-8")
@@ -172,27 +204,49 @@ class MimeTypeDetector(Transformer):
 
 
 # -- Phone parsing -----------------------------------------------------------
-_PHONE_LENGTHS = {"US": 10, "CA": 10, "GB": 10, "FR": 9, "DE": 10, "IN": 10,
-                  "AU": 9, "JP": 10, "BR": 10, "MX": 10}
-_COUNTRY_CODES = {"US": "1", "CA": "1", "GB": "44", "FR": "33", "DE": "49",
-                  "IN": "91", "AU": "61", "JP": "81", "BR": "55", "MX": "52"}
+# national-number rules per region: (country code, (min_len, max_len),
+# regex the national number must match).  NANP regions get the real
+# area-code/exchange constraints; others get length + leading-digit rules
+# (libphonenumber's metadata, coarsened - PhoneNumberParser.scala).
+_NANP = ("1", (10, 10), re.compile(r"^[2-9]\d{2}[2-9]\d{6}$"))
+_PHONE_RULES: dict[str, tuple] = {
+    "US": _NANP,
+    "CA": _NANP,
+    "GB": ("44", (9, 10), re.compile(r"^[1-9]\d{8,9}$")),
+    "FR": ("33", (9, 9), re.compile(r"^[1-9]\d{8}$")),
+    "DE": ("49", (6, 11), re.compile(r"^[1-9]\d{5,10}$")),
+    "IN": ("91", (10, 10), re.compile(r"^[6-9]\d{9}$")),
+    "AU": ("61", (9, 9), re.compile(r"^[2-478]\d{8}$")),
+    "JP": ("81", (9, 10), re.compile(r"^[1-9]\d{8,9}$")),
+    "BR": ("55", (10, 11), re.compile(r"^[1-9]\d{9,10}$")),
+    "MX": ("52", (10, 10), re.compile(r"^[1-9]\d{9}$")),
+    "ES": ("34", (9, 9), re.compile(r"^[6-9]\d{8}$")),
+    "IT": ("39", (6, 11), re.compile(r"^\d{6,11}$")),
+    "NL": ("31", (9, 9), re.compile(r"^[1-9]\d{8}$")),
+    "CN": ("86", (10, 11), re.compile(r"^[1-9]\d{9,10}$")),
+}
 
 
 def is_valid_phone(phone: Optional[str], region: str = "US") -> Optional[bool]:
-    """(reference: PhoneNumberParser.scala via libphonenumber)"""
+    """(reference: PhoneNumberParser.scala via libphonenumber - country
+    code stripping, national trunk prefix, per-region number patterns)"""
     if not phone:
         return None
     digits = re.sub(r"[^\d+]", "", phone)
-    if not digits:
+    if not digits or "+" in digits[1:]:
         return False
-    cc = _COUNTRY_CODES.get(region, "1")
+    cc, (lo, hi), pattern = _PHONE_RULES.get(region, _NANP)
     if digits.startswith("+"):
         if not digits[1:].startswith(cc):
             return False
         digits = digits[1 + len(cc):]
-    elif digits.startswith(cc) and len(digits) > _PHONE_LENGTHS.get(region, 10):
+    elif digits.startswith(cc) and len(digits) > hi:
         digits = digits[len(cc):]
-    return len(digits) == _PHONE_LENGTHS.get(region, 10)
+    if region not in ("US", "CA") and digits.startswith("0"):
+        digits = digits[1:]  # national trunk prefix outside NANP
+    if not (lo <= len(digits) <= hi):
+        return False
+    return bool(pattern.match(digits))
 
 
 class PhoneNumberParser(Transformer):

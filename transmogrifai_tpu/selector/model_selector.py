@@ -120,6 +120,7 @@ class ModelSelector(Estimator):
         larger = self.validator.evaluator.larger_better
         non_selector = [s for s in during_stages if s is not self]
         results: dict[int, list[dict]] = {}
+        self.validator._beat()  # liveness for the preemption supervisor
         for f in range(masks.shape[0]):
             tr_idx = np.nonzero(masks[f])[0]
             val_idx = np.nonzero(~masks[f])[0]
@@ -150,6 +151,7 @@ class ModelSelector(Estimator):
                          "params": dict(pmap), "metric": m}
                     )
                     gi += 1
+            self.validator._beat()  # one beat per completed fold
         all_results = []
         best = None
         for gi, fold_results in results.items():

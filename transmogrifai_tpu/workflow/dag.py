@@ -111,8 +111,6 @@ def cut_dag_during(
     independent cut; a selector nested in another's upstream cone is still
     an error.
     """
-    from ..stages.feature_generator import FeatureGeneratorStage
-
     selector_set = set(model_selectors)
     out: dict[str, list[PipelineStage]] = {}
     for sel in model_selectors:

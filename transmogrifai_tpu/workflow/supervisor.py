@@ -76,6 +76,10 @@ def supervise(
                 break
             s = staleness(heartbeat_path)
             age = time.time() - start
+            # a beat older than this attempt's start is a leftover from a
+            # previous attempt/run - it must not void the startup grace
+            if s is not None and s > age:
+                s = None
             if s is None:
                 if age > grace:
                     killed_reason = f"no heartbeat within {grace:.0f}s"
