@@ -1,0 +1,196 @@
+"""DSL enrichment surface (reference: core/.../dsl/Rich*Feature.scala).
+
+The README experience: per-type .vectorize(...), numeric/scaling/bucketize
+math, text/email/url/phone/base64 enrichments, set/vector/map methods -
+all as Feature methods, executed through real workflows.
+"""
+import base64
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - patches Feature
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.types.columns import (
+    ListColumn,
+    NumericColumn,
+    TextColumn,
+    VectorColumn,
+)
+
+
+def _train(out_features, data):
+    wf = OpWorkflow().set_result_features(*out_features)
+    wf.set_input_dataset(data)
+    model = wf.train()
+    return model.score(data)
+
+
+def test_vectorize_dispatches_per_type(rng):
+    n = 60
+    data = {
+        "r": rng.randn(n).tolist(),
+        "i": [int(v) for v in rng.randint(0, 9, n)],
+        "b": [bool(v) for v in rng.rand(n) > 0.5],
+        "d": [int(1.5e12 + v) for v in rng.randint(0, 10**9, n)],
+        "p": [("a", "b", "c")[i % 3] for i in range(n)],
+        "m": [{"k1": float(rng.randn())} for _ in range(n)],
+        "g": [(37.7, -122.4, 5.0)] * n,
+        "tl": [["red", "blue"][: (i % 3)] for i in range(n)],
+    }
+    r = FeatureBuilder(ft.Real, "r").as_predictor()
+    i = FeatureBuilder(ft.Integral, "i").as_predictor()
+    b = FeatureBuilder(ft.Binary, "b").as_predictor()
+    d = FeatureBuilder(ft.Date, "d").as_predictor()
+    p = FeatureBuilder(ft.PickList, "p").as_predictor()
+    m = FeatureBuilder(ft.RealMap, "m").as_predictor()
+    g = FeatureBuilder(ft.Geolocation, "g").as_predictor()
+    tl = FeatureBuilder(ft.TextList, "tl").as_predictor()
+
+    outs = [
+        r.vectorize(), i.vectorize(), b.vectorize(), d.vectorize(),
+        p.vectorize(top_k=5, min_support=1), m.vectorize(min_support=1),
+        g.vectorize(), tl.vectorize(hash_dims=8),
+    ]
+    scored = _train(outs, data)
+    for out in outs:
+        col = scored[out.name]
+        assert isinstance(col, VectorColumn), out.name
+        assert col.width > 0
+        assert col.metadata.size == col.width
+
+
+def test_numeric_enrichments_bucketize_scale_percentile(rng):
+    n = 80
+    x = rng.randn(n)
+    y = (x + 0.3 * rng.randn(n) > 0).astype(float)
+    data = {"x": x.tolist(), "y": y.tolist()}
+    xf = FeatureBuilder(ft.Real, "x").as_predictor()
+    yf = FeatureBuilder(ft.RealNN, "y").as_response()
+
+    bucketed = xf.bucketize(splits=[-np.inf, 0.0, np.inf])
+    auto = xf.auto_bucketize(yf, max_depth=2)
+    scaled = xf.scale(slope=2.0, intercept=1.0)
+    descaled = scaled.descale(scaled)
+    pct = xf.to_percentile(buckets=10)
+    iso = xf.to_isotonic_calibrated(yf)
+
+    scored = _train([bucketed, auto, scaled, descaled, pct, iso], data)
+    assert isinstance(scored[bucketed.name], VectorColumn)
+    assert isinstance(scored[auto.name], VectorColumn)
+    s = scored[scaled.name]
+    assert np.allclose(s.values[s.mask], 2.0 * x[s.mask] + 1.0)
+    ds = scored[descaled.name]
+    assert np.allclose(ds.values[ds.mask], x[ds.mask], atol=1e-12)
+    pv = scored[pct.name].values
+    assert pv.min() >= 0.0 and pv.max() <= 100.0
+    iv = scored[iso.name].values
+    assert np.all(np.diff(iv[np.argsort(x)]) >= -1e-9)  # monotone in x
+
+
+def test_text_enrichments_end_to_end(rng):
+    n = 40
+    data = {
+        "t": ["Mr. John Smith went to Paris last spring"] * n,
+        "e": ["alice@example.com" if i % 2 else None for i in range(n)],
+        "u": ["https://docs.example.org/page"] * n,
+        "ph": ["650-253-0000"] * n,
+        "b64": [base64.b64encode(b"%PDF-1.7 more").decode()] * n,
+        "other": ["Mr John Smyth visited Paris"] * n,
+    }
+    t = FeatureBuilder(ft.Text, "t").as_predictor()
+    e = FeatureBuilder(ft.Email, "e").as_predictor()
+    u = FeatureBuilder(ft.URL, "u").as_predictor()
+    ph = FeatureBuilder(ft.Phone, "ph").as_predictor()
+    b64 = FeatureBuilder(ft.Base64, "b64").as_predictor()
+    other = FeatureBuilder(ft.Text, "other").as_predictor()
+
+    outs = {
+        "lang": t.detect_languages(),
+        "ents": t.recognize_entities(),
+        "len": t.text_len(),
+        "sim": t.to_ngram_similarity(other),
+        "edom": e.to_email_domain(),
+        "epre": e.to_email_prefix(),
+        "udom": u.to_domain(),
+        "uproto": u.to_protocol(),
+        "uvalid": u.is_valid_url(),
+        "phv": ph.is_valid_phone("US"),
+        "mime": b64.detect_mime_types(),
+        "idx": t.indexed(),
+        "toks": t.tokenize(remove_stopwords=True, language="en"),
+    }
+    scored = _train(list(outs.values()), data)
+    assert scored[outs["lang"].name].values[0] == "en"
+    assert "smith" in scored[outs["ents"].name].values[0]
+    assert scored[outs["len"].name].values[0] == len(data["t"][0])
+    assert 0.0 < scored[outs["sim"].name].values[0] < 1.0
+    assert scored[outs["edom"].name].values[1] == "example.com"
+    assert scored[outs["epre"].name].values[1] == "alice"
+    assert scored[outs["edom"].name].values[0] is None
+    assert scored[outs["udom"].name].values[0] == "docs.example.org"
+    assert scored[outs["uproto"].name].values[0] == "https"
+    assert scored[outs["uvalid"].name].values[0] == 1.0
+    assert scored[outs["phv"].name].values[0] == 1.0
+    assert scored[outs["mime"].name].values[0] == "application/pdf"
+    assert isinstance(scored[outs["idx"].name], NumericColumn)
+    toks = scored[outs["toks"].name].values[0]
+    assert "paris" in toks and "to" not in toks
+
+
+def test_set_vector_map_enrichments(rng):
+    n = 30
+    data = {
+        "s1": [frozenset(["a", "b"])] * n,
+        "s2": [frozenset(["b", "c"])] * n,
+        "a": rng.randn(n).tolist(),
+        "bcol": rng.randn(n).tolist(),
+        "m": [{"keep": 1.0, "drop": 2.0}] * n,
+        "txt": ["hello world", None] * (n // 2),
+    }
+    s1 = FeatureBuilder(ft.MultiPickList, "s1").as_predictor()
+    s2 = FeatureBuilder(ft.MultiPickList, "s2").as_predictor()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "bcol").as_predictor()
+    m = FeatureBuilder(ft.RealMap, "m").as_predictor()
+    txt = FeatureBuilder(ft.Text, "txt").as_predictor()
+
+    jac = s1.jaccard_similarity(s2)
+    combined = a.vectorize().combine(b.vectorize())
+    dropped = combined.drop_indices_by(_is_null_ind)
+    filtered = m.filter_map(block_keys=["drop"])
+    occ = txt.to_occur()
+
+    scored = _train([jac, combined, dropped, filtered, occ], data)
+    assert scored[jac.name].values[0] == pytest.approx(1 / 3)
+    cw = scored[combined.name].width
+    assert scored[dropped.name].width < cw
+    assert all(
+        not c.is_null_indicator
+        for c in scored[dropped.name].metadata.columns
+    )
+    assert list(scored[filtered.name].values[0]) == ["keep"]
+    assert scored[occ.name].values[1] == 0.0
+    assert scored[occ.name].values[0] == 1.0
+
+
+def _is_null_ind(meta):
+    return meta.is_null_indicator
+
+
+def test_examples_are_dsl_only():
+    """The example apps must read like the reference README: no direct
+    ops-class imports (selector factories and DSL only)."""
+    import os
+
+    ex_dir = os.path.join(
+        os.path.dirname(__file__), "..", "transmogrifai_tpu", "examples"
+    )
+    for fname in os.listdir(ex_dir):
+        if not fname.endswith(".py") or fname == "__init__.py":
+            continue
+        src = open(os.path.join(ex_dir, fname)).read()
+        assert "from ..ops." not in src.replace(
+            "from ..ops.transmogrifier import transmogrify", ""
+        ), f"{fname} imports ops classes directly"

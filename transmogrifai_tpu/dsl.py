@@ -111,13 +111,240 @@ def _patch_feature() -> None:
         stage = LambdaTransformer(col_fn, output_type, operation_name="map")
         return stage.set_input(self).get_output()
 
-    def vectorize_defaults(self: Feature, **kw) -> Feature:
-        return transmogrify([self])
-
     def alias(self: Feature, name: str) -> Feature:
         from .ops.combiner import AliasTransformer
 
         return AliasTransformer(name).set_input(self).get_output()
+
+    # -- per-type .vectorize(...) (reference: Rich*Feature.vectorize) -------
+    def vectorize(self: Feature, *, others: Sequence[Feature] = (),
+                  **kw) -> Feature:
+        """Type-dispatched default vectorizer for this feature (and
+        optional same-type ``others`` sharing one stage), with the
+        reference's per-type parameter surfaces (reference:
+        RichNumericFeature.vectorize:325, RichTextFeature.vectorize:130,
+        RichDateFeature/RichMapFeature/RichSetFeature/.vectorize)."""
+        from .ops.categorical import OneHotVectorizer as _OneHot
+        from .ops.dates import DateVectorizer
+        from .ops.geo import GeolocationVectorizer
+        from .ops.maps import MapVectorizer
+        from .ops.numeric import (
+            BinaryVectorizer,
+            IntegralVectorizer,
+            RealNNVectorizer,
+            RealVectorizer,
+        )
+        from .ops.text import SmartTextVectorizer, TextListHashingVectorizer
+
+        t = self.ftype
+        if issubclass(t, ft.OPMap):
+            stage = MapVectorizer(**kw)
+        elif issubclass(t, ft.Geolocation):
+            stage = GeolocationVectorizer(**kw)
+        elif issubclass(t, ft.Date):  # Date/DateTime (subtype of Integral)
+            stage = DateVectorizer(**kw)
+        elif issubclass(t, ft.Binary):
+            stage = BinaryVectorizer(**kw)
+        elif issubclass(t, ft.Integral):
+            stage = IntegralVectorizer(**kw)
+        elif issubclass(t, ft.RealNN):
+            stage = RealNNVectorizer(**kw)
+        elif issubclass(t, ft.Real):
+            stage = RealVectorizer(**kw)
+        elif issubclass(t, (ft.MultiPickList,)) or (
+            issubclass(t, ft.Text) and t.is_categorical
+        ):
+            stage = _OneHot(**kw)
+        elif issubclass(t, ft.TextList):
+            stage = TextListHashingVectorizer(**kw)
+        elif issubclass(t, ft.Text):
+            stage = SmartTextVectorizer(**kw)
+        elif issubclass(t, ft.OPVector):
+            return self.combine(*others) if others else self
+        else:
+            raise TypeError(f"no default vectorizer for {t.__name__}")
+        return stage.set_input(self, *others).get_output()
+
+    def smart_vectorize(self: Feature, *, others: Sequence[Feature] = (),
+                        **kw) -> Feature:
+        """(reference: RichTextFeature.smartVectorize:214)"""
+        from .ops.text import SmartTextVectorizer
+
+        return SmartTextVectorizer(**kw).set_input(self, *others).get_output()
+
+    # -- numeric enrichments (reference: RichNumericFeature) ----------------
+    def bucketize(self: Feature, splits: Sequence[float],
+                  track_nulls: bool = True) -> Feature:
+        from .ops.bucketizers import NumericBucketizer
+
+        return (
+            NumericBucketizer(splits=list(splits), track_nulls=track_nulls)
+            .set_input(self)
+            .get_output()
+        )
+
+    def auto_bucketize(self: Feature, label: Feature, track_nulls: bool = True,
+                       **kw) -> Feature:
+        """(reference: RichNumericFeature.autoBucketize:298 - supervised
+        decision-tree split points)"""
+        from .ops.bucketizers import DecisionTreeNumericBucketizer
+
+        return (
+            DecisionTreeNumericBucketizer(track_nulls=track_nulls, **kw)
+            .set_input(label, self)
+            .get_output()
+        )
+
+    def scale(self: Feature, scaling_type: str = "linear", slope: float = 1.0,
+              intercept: float = 0.0) -> Feature:
+        from .ops.collections import ScalerTransformer
+
+        return (
+            ScalerTransformer(scaling_type=scaling_type, slope=slope,
+                              intercept=intercept)
+            .set_input(self)
+            .get_output()
+        )
+
+    def descale(self: Feature, scaled_feature: Feature) -> Feature:
+        """(reference: RichNumericFeature.descale:372 - reads the scaler
+        args from the scaled feature's metadata)"""
+        from .ops.collections import DescalerTransformer
+
+        return (
+            DescalerTransformer().set_input(self, scaled_feature).get_output()
+        )
+
+    def to_percentile(self: Feature, buckets: int = 100) -> Feature:
+        from .ops.scalers import PercentileCalibrator
+
+        return (
+            PercentileCalibrator(buckets=buckets).set_input(self).get_output()
+        )
+
+    def to_isotonic_calibrated(self: Feature, label: Feature,
+                               is_isotonic: bool = True) -> Feature:
+        from .ops.collections import IsotonicRegressionCalibrator
+
+        return (
+            IsotonicRegressionCalibrator(isotonic=is_isotonic)
+            .set_input(label, self)
+            .get_output()
+        )
+
+    # -- text enrichments (reference: RichTextFeature) ----------------------
+    def indexed(self: Feature) -> Feature:
+        from .ops.categorical import StringIndexer
+
+        return StringIndexer().set_input(self).get_output()
+
+    def deindexed(self: Feature, labels: Sequence[str]) -> Feature:
+        from .ops.categorical import IndexToString
+
+        return IndexToString(labels=list(labels)).set_input(self).get_output()
+
+    def to_ngram_similarity(self: Feature, that: Feature,
+                            n_gram_size: int = 3) -> Feature:
+        from .ops.text_analysis import NGramSimilarity
+
+        return (
+            NGramSimilarity(n=n_gram_size).set_input(self, that).get_output()
+        )
+
+    def detect_languages(self: Feature) -> Feature:
+        from .ops.text_analysis import LangDetector
+
+        return LangDetector().set_input(self).get_output()
+
+    def recognize_entities(self: Feature) -> Feature:
+        from .ops.text_analysis import NameEntityRecognizer
+
+        return NameEntityRecognizer().set_input(self).get_output()
+
+    def text_len(self: Feature) -> Feature:
+        from .ops.text_analysis import TextLenTransformer
+
+        return TextLenTransformer().set_input(self).get_output()
+
+    def to_email_domain(self: Feature) -> Feature:
+        from .ops.text_analysis import EmailToPickList
+
+        return EmailToPickList().set_input(self).get_output()
+
+    def to_email_prefix(self: Feature) -> Feature:
+        return map_values(
+            self,
+            lambda v: (v.split("@", 1)[0] if v and "@" in v else None),
+            ft.Text,
+        )
+
+    def to_domain(self: Feature) -> Feature:
+        from .ops.text_analysis import UrlToDomain
+
+        return UrlToDomain().set_input(self).get_output()
+
+    def to_protocol(self: Feature) -> Feature:
+        return map_values(
+            self,
+            lambda v: (v.split("://", 1)[0].lower()
+                       if v and "://" in v else None),
+            ft.Text,
+        )
+
+    def is_valid_url(self: Feature) -> Feature:
+        import re as _re
+
+        url_re = _re.compile(r"^(https?|ftp)://[^/\s:]+", _re.IGNORECASE)
+        return map_values(
+            self,
+            lambda v: None if v is None else bool(url_re.match(v)),
+            ft.Binary,
+        )
+
+    def is_valid_phone(self: Feature, region: str = "US") -> Feature:
+        from .ops.text_analysis import PhoneNumberParser
+
+        return PhoneNumberParser(region=region).set_input(self).get_output()
+
+    def detect_mime_types(self: Feature) -> Feature:
+        from .ops.text_analysis import MimeTypeDetector
+
+        return MimeTypeDetector().set_input(self).get_output()
+
+    # -- set/list/vector/map enrichments ------------------------------------
+    def jaccard_similarity(self: Feature, that: Feature) -> Feature:
+        from .ops.text_analysis import JaccardSimilarity
+
+        return JaccardSimilarity().set_input(self, that).get_output()
+
+    def combine(self: Feature, *others: Feature) -> Feature:
+        """(reference: RichVectorFeature.combine)"""
+        from .ops.combiner import VectorsCombiner
+
+        return VectorsCombiner().set_input(self, *others).get_output()
+
+    def drop_indices_by(self: Feature, predicate) -> Feature:
+        from .ops.combiner import DropIndicesByTransformer
+
+        return (
+            DropIndicesByTransformer(predicate).set_input(self).get_output()
+        )
+
+    def filter_map(self: Feature, allow_keys=None, block_keys=(),
+                   clean_keys: bool = True) -> Feature:
+        from .ops.collections import FilterMap
+
+        return (
+            FilterMap(allow_keys=allow_keys, block_keys=block_keys,
+                      clean_keys=clean_keys)
+            .set_input(self)
+            .get_output()
+        )
+
+    def to_occur(self: Feature, matches=None) -> Feature:
+        from .ops.collections import ToOccurTransformer
+
+        return ToOccurTransformer(matches=matches).set_input(self).get_output()
 
     F.fill_missing_with_mean = fill_missing_with_mean
     F.z_normalize = z_normalize
@@ -125,8 +352,33 @@ def _patch_feature() -> None:
     F.tokenize = tokenize_f
     F.sanity_check = sanity_check
     F.map_values = map_values
-    F.vectorize = vectorize_defaults
+    F.vectorize = vectorize
+    F.smart_vectorize = smart_vectorize
     F.alias = alias
+    F.bucketize = bucketize
+    F.auto_bucketize = auto_bucketize
+    F.scale = scale
+    F.descale = descale
+    F.to_percentile = to_percentile
+    F.to_isotonic_calibrated = to_isotonic_calibrated
+    F.indexed = indexed
+    F.deindexed = deindexed
+    F.to_ngram_similarity = to_ngram_similarity
+    F.detect_languages = detect_languages
+    F.recognize_entities = recognize_entities
+    F.text_len = text_len
+    F.to_email_domain = to_email_domain
+    F.to_email_prefix = to_email_prefix
+    F.to_domain = to_domain
+    F.to_protocol = to_protocol
+    F.is_valid_url = is_valid_url
+    F.is_valid_phone = is_valid_phone
+    F.detect_mime_types = detect_mime_types
+    F.jaccard_similarity = jaccard_similarity
+    F.combine = combine
+    F.drop_indices_by = drop_indices_by
+    F.filter_map = filter_map
+    F.to_occur = to_occur
 
 
 _patch_feature()

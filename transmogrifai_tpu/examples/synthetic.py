@@ -18,6 +18,60 @@ from ..types.dataset import Dataset
 from ..types.vector_metadata import VectorColumnMeta, VectorMetadata
 
 _GENDERS = np.array(["male", "female", "other"])
+
+# -- planted ground truth -----------------------------------------------------
+# The label is Bernoulli(sigmoid(f + 0.5*eps)) with
+#   f = 0.03*(age-45) - 0.02*(height-170) + {female: +1.2, else: -0.4}
+# The 0.5*eps gaussian is unobservable label noise; the Bayes-optimal score
+# over the OBSERVED features (age mean-imputed at 10% missingness) is
+# monotone in f_obs, giving an analytically-pinned ceiling, estimated by
+# 5x4M-draw Monte Carlo (std 3e-4):
+BAYES_AUROC_OBSERVED = 0.7493
+# logistic fits see coefficients attenuated by the eps convolution
+# (~ 1/sqrt(1 + (0.5/1.7)^2) ~ 0.96) plus imputation bias on age; the
+# recovery gates below use ratio windows that cover it
+PLANTED = {
+    "age": 0.03,
+    "height": -0.02,
+    "female_vs_male": 1.6,   # +1.2 - (-0.4)
+    "other_vs_male": 0.0,
+    "weight": 0.0,           # pure correlated nuisance (0.3*height noise)
+}
+
+
+def planted_truth_report(beta, meta, auroc: float) -> dict:
+    """Recovery report for a raw-scale linear/logistic coefficient vector
+    fitted on synthetic_design_matrix output: planted-vs-learned
+    coefficients and the gap to the observable Bayes AuROC.  ``ok`` is the
+    scale-correctness gate the bench records (VERDICT r2 #9: turns the
+    scale bench from 'runs' into 'correct')."""
+    names = meta.column_names()
+    idx = {n.rsplit("_", 1)[0]: i for i, n in enumerate(names)}
+    beta = np.asarray(beta, np.float64)
+    age = float(beta[idx["age"]])
+    height = float(beta[idx["height"]])
+    fm = float(beta[idx["gender_female"]] - beta[idx["gender_male"]])
+    om = float(beta[idx["gender_other"]] - beta[idx["gender_male"]])
+    weight = float(beta[idx["weight"]])
+    gap = BAYES_AUROC_OBSERVED - float(auroc)
+    ok = (
+        0.024 <= age <= 0.033
+        and -0.023 <= height <= -0.015
+        and 1.30 <= fm <= 1.70
+        and abs(om) <= 0.08
+        and abs(weight) <= 0.006
+        and abs(gap) <= 0.012
+    )
+    return {
+        "age_coef": round(age, 5),
+        "height_coef": round(height, 5),
+        "female_vs_male": round(fm, 4),
+        "other_vs_male": round(om, 4),
+        "weight_coef": round(weight, 5),
+        "bayes_auroc": BAYES_AUROC_OBSERVED,
+        "auroc_gap": round(gap, 4),
+        "ok": bool(ok),
+    }
 _WORDS = np.array(
     "travel cabin deck ticket luxury economy family solo crew port starboard "
     "breakfast dinner storm calm ocean liner voyage captain steward".split()

@@ -188,7 +188,23 @@ def detect_mime_type(b64: Optional[str]) -> Optional[str]:
     try:
         raw.decode("utf-8")
         return "text/plain"
-    except UnicodeDecodeError:
+    except UnicodeDecodeError as e:
+        # the decode window is a truncation of the payload, so a cut
+        # multi-byte sequence at the very end is still text - but only
+        # when the tail is a genuine incomplete UTF-8 sequence (valid
+        # lead byte + continuations), not arbitrary binary
+        tail = raw[e.start:]
+        if (
+            e.start >= len(raw) - 3
+            and tail
+            and 0xC2 <= tail[0] <= 0xF4
+            and all(0x80 <= b <= 0xBF for b in tail[1:])
+        ):
+            try:
+                raw[: e.start].decode("utf-8")
+                return "text/plain"
+            except UnicodeDecodeError:
+                pass
         return "application/octet-stream"
 
 
