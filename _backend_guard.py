@@ -89,7 +89,17 @@ def ensure_cpu_mesh(n_devices: int, force_cpu: bool = True) -> bool:
             from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
         except Exception:
             pass
-        for _name in list(getattr(_xb, "_backend_factories", {})):
+        # fail LOUDLY if a jax upgrade renames this internal: a silent
+        # no-op here would let entry points hang on the wedged axon
+        # plugin again (advisor r2 finding).  JAX_PLATFORMS=cpu above is
+        # the first line of defense; the purge is the belt-and-braces.
+        if not hasattr(_xb, "_backend_factories"):
+            raise RuntimeError(
+                "jax._src.xla_bridge._backend_factories is gone (jax "
+                "upgrade?); update _backend_guard.ensure_cpu_mesh's "
+                "factory purge for this jax version"
+            )
+        for _name in list(_xb._backend_factories):
             if _name != "cpu":
                 _xb._backend_factories.pop(_name, None)
     try:

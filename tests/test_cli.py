@@ -45,3 +45,80 @@ def test_generate_and_run_project(tmp_path, csv_file):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "Selected model" in proc.stdout
+    assert "model saved" in proc.stdout
+
+    # the scaffold's scorer loads the saved model and scores the CSV
+    proc2 = subprocess.run(
+        [sys.executable, str(out / "score.py")], capture_output=True,
+        text=True, timeout=500, env=env, cwd=str(out),
+    )
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert "scored 200 rows" in proc2.stdout
+
+
+def test_generate_multiclass_text_labels(tmp_path, rng):
+    """A string-labeled response infers multiclass + label indexing
+    (ProblemKind semantics)."""
+    n = 150
+    path = tmp_path / "iris_like.csv"
+    with open(path, "w") as f:
+        f.write("species,a,b\n")
+        for i in range(n):
+            k = i % 3
+            f.write(
+                f"{['setosa', 'versicolor', 'virginica'][k]},"
+                f"{rng.randn() + k:.4f},{rng.randn() - k:.4f}\n"
+            )
+    out = tmp_path / "proj_mc"
+    main_py = generate(str(path), response="species", name="McApp",
+                       output=str(out))
+    src = open(main_py).read()
+    assert "MultiClassificationModelSelector" in src
+    assert "LABELS = ['setosa', 'versicolor', 'virginica']" in src
+    assert "map_values" in src
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu"})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, main_py], capture_output=True, text=True,
+        timeout=500, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Selected model" in proc.stdout
+
+
+def test_generate_overrides_idcol_and_type_refinement(tmp_path, rng):
+    n = 120
+    path = tmp_path / "refine.csv"
+    with open(path, "w") as f:
+        f.write("rowid,y,email,freeform,x\n")
+        for i in range(n):
+            f.write(
+                f"{i},{i % 2},user{i}@example.com,"
+                f"word{i % 50} text {i},{rng.randn():.4f}\n"
+            )
+    out = tmp_path / "proj_ref"
+    main_py = generate(
+        str(path), response="y", name="RefApp", output=str(out),
+        overrides={"freeform": __import__(
+            "transmogrifai_tpu.types.feature_types", fromlist=["TextArea"]
+        ).TextArea},
+        id_col="rowid",
+    )
+    src = open(main_py).read()
+    assert "ft.Email, 'email'" in src
+    assert "ft.TextArea, 'freeform'" in src
+    assert "rowid" not in src.split("def build_workflow")[0].replace(
+        "# --", "")
+
+
+def test_infer_problem_kind():
+    from transmogrifai_tpu.cli import infer_problem_kind
+
+    assert infer_problem_kind([0, 1, 1, 0]) == ("binary", [])
+    assert infer_problem_kind([0.0, 1.0, 2.0] * 10) == ("multiclass", [])
+    assert infer_problem_kind([1.5, 2.7, 3.14, 9.9]) == ("regression", [])
+    assert infer_problem_kind(["yes", "no"]) == ("binary", ["no", "yes"])
+    k, labels = infer_problem_kind(["a", "b", "c", None])
+    assert k == "multiclass" and labels == ["a", "b", "c"]

@@ -156,6 +156,7 @@ def detect_mime_type(b64: Optional[str]) -> Optional[str]:
     most common magics reproduced incl. offset-based containers)"""
     if not b64:
         return None
+    truncated = len(b64) > 700
     head = b64[:700]
     try:
         raw = base64.b64decode(head + "=" * (-len(head) % 4))
@@ -189,13 +190,14 @@ def detect_mime_type(b64: Optional[str]) -> Optional[str]:
         raw.decode("utf-8")
         return "text/plain"
     except UnicodeDecodeError as e:
-        # the decode window is a truncation of the payload, so a cut
-        # multi-byte sequence at the very end is still text - but only
-        # when the tail is a genuine incomplete UTF-8 sequence (valid
-        # lead byte + continuations), not arbitrary binary
+        # when the decode window TRUNCATED the payload, a cut multi-byte
+        # sequence at the very end is still text - but only then, and
+        # only when the tail is a genuine incomplete UTF-8 sequence
+        # (valid lead byte + continuations), not arbitrary binary
         tail = raw[e.start:]
         if (
-            e.start >= len(raw) - 3
+            truncated
+            and e.start >= len(raw) - 3
             and tail
             and 0xC2 <= tail[0] <= 0xF4
             and all(0x80 <= b <= 0xBF for b in tail[1:])

@@ -229,9 +229,27 @@ def fused_moments_sharded(x, y, mesh):
     above _CHUNK_ROWS the pass chunks with float64-combined partials like
     fused_moments, so multi-device stats are never less accurate than the
     single-device path.
+
+    MULTI-HOST CONTRACT (advisor r2): this path device_puts host-resident
+    arrays onto a global mesh, which is only correct when every process
+    holds the identical full array (replicated host input).  On a
+    multi-process runtime with per-host-sharded data, callers must build
+    global arrays themselves (jax.make_array_from_process_local_data) and
+    pass them in device-resident; a host-resident input in that setting
+    raises here rather than silently computing per-host statistics.
     """
+    import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if _jax.process_count() > 1 and not (
+        isinstance(x, _jax.Array) and isinstance(y, _jax.Array)
+    ):
+        raise ValueError(
+            "fused_moments_sharded received a host-resident array (x or y) "
+            "on a multi-process runtime; assemble global jax.Arrays with "
+            "jax.make_array_from_process_local_data (host inputs are only "
+            "valid when replicated on every process)"
+        )
     n = x.shape[0]
     if n > _CHUNK_ROWS:
         acc = _combine_moments_f64(

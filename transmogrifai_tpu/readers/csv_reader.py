@@ -97,8 +97,13 @@ class CSVReader:
             out[feat.name] = column_from_list(parsed, feat.ftype)
         return Dataset(out)
 
-    def infer_schema(self) -> dict[str, Type[FeatureType]]:
-        raw = self.read_raw()
+    def infer_schema(
+        self, raw: Optional[dict[str, list]] = None
+    ) -> dict[str, Type[FeatureType]]:
+        """``raw`` lets callers that already read the file (cli.generate)
+        skip a second full parse."""
+        if raw is None:
+            raw = self.read_raw()
         schema = {}
         for name, vals in raw.items():
             typed = []
