@@ -122,3 +122,41 @@ def test_infer_problem_kind():
     assert infer_problem_kind(["yes", "no"]) == ("binary", ["no", "yes"])
     k, labels = infer_problem_kind(["a", "b", "c", None])
     assert k == "multiclass" and labels == ["a", "b", "c"]
+    # non-canonical numeric classes must be re-indexed, not fed raw
+    assert infer_problem_kind([1, 2, 1, 2]) == ("binary", [1.0, 2.0])
+    assert infer_problem_kind([1, 3, 7] * 5) == ("multiclass",
+                                                 [1.0, 3.0, 7.0])
+    # textual nan placeholders count as missing, not as a class
+    assert infer_problem_kind(["0", "1", "nan", "0"]) == ("binary", [])
+
+
+def test_generate_handles_label_column_and_nonidentifiers(tmp_path, rng):
+    """Response named 'label' (template-local collision), a column that
+    sanitizes to a non-identifier, numeric {1,2} classes, and a bad
+    --id-col must all be handled."""
+    n = 120
+    path = tmp_path / "tricky.csv"
+    with open(path, "w") as f:
+        f.write("label,1st col,x\n")
+        for i in range(n):
+            f.write(f"{1 + (i % 2)},{rng.randn():.4f},{rng.randn():.4f}\n")
+    out = tmp_path / "proj_tricky"
+    main_py = generate(str(path), response="label", name="TrickyApp",
+                       output=str(out))
+    src = open(main_py).read()
+    assert "LABELS = [1.0, 2.0]" in src  # {1,2} re-indexed to 0/1
+    compile(src, main_py, "exec")  # sanitized names must be valid python
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu"})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, main_py], capture_output=True, text=True,
+        timeout=500, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Selected model" in proc.stdout
+
+    with pytest.raises(KeyError, match="id column"):
+        generate(str(path), response="label", name="X",
+                 output=str(tmp_path / "nope"), id_col="typo")
