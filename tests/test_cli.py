@@ -128,6 +128,33 @@ def test_infer_problem_kind():
                                                  [1.0, 3.0, 7.0])
     # textual nan placeholders count as missing, not as a class
     assert infer_problem_kind(["0", "1", "nan", "0"]) == ("binary", [])
+    # an all-missing response cannot infer anything
+    with pytest.raises(ValueError, match="no usable values"):
+        infer_problem_kind(["nan", "nan"])
+
+
+def test_generate_rejects_dirty_response_and_bad_idcol(tmp_path, rng):
+    path = tmp_path / "dirty.csv"
+    with open(path, "w") as f:
+        f.write("y,x\nnan,1.0\n1,2.0\n0,3.0\n")
+    with pytest.raises(ValueError, match="missing/non-finite"):
+        generate(str(path), response="y", name="X",
+                 output=str(tmp_path / "p1"))
+
+    clean = tmp_path / "clean.csv"
+    with open(clean, "w") as f:
+        f.write("y,x\n1,2.0\n0,3.0\n")
+    with pytest.raises(ValueError, match="cannot be the same"):
+        generate(str(clean), response="y", name="X",
+                 output=str(tmp_path / "p2"), id_col="y")
+
+    # unicode header that is alnum but not identifier-legal still compiles
+    uni = tmp_path / "uni.csv"
+    with open(uni, "w", encoding="utf-8") as f:
+        f.write("y,x²\n1,2.0\n0,3.0\n1,4.0\n0,5.0\n")
+    main_py = generate(str(uni), response="y", name="U",
+                       output=str(tmp_path / "p3"))
+    compile(open(main_py, encoding="utf-8").read(), main_py, "exec")
 
 
 def test_generate_handles_label_column_and_nonidentifiers(tmp_path, rng):

@@ -194,6 +194,96 @@ class RandomVector(RandomGenerator):
         return self.rng.randn(self.dim).tolist()
 
 
+class RandomDate(RandomGenerator):
+    """Epoch-millis dates (reference: RandomIntegral.dates)."""
+
+    def __init__(self, start_ms: int = 1_400_000_000_000,
+                 span_ms: int = 100_000_000_000, seed: int = 42,
+                 probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.start_ms, self.span_ms = start_ms, span_ms
+
+    def _value(self) -> int:
+        # explicit int64: the default randint dtype is np.int_ which is
+        # 32-bit on some platforms and cannot hold a 1e11 span
+        return self.start_ms + int(
+            self.rng.randint(0, self.span_ms, dtype=np.int64)
+        )
+
+
+class RandomGeolocation(RandomGenerator):
+    def _value(self) -> tuple:
+        return (
+            float(self.rng.uniform(-60, 60)),
+            float(self.rng.uniform(-180, 180)),
+            float(self.rng.randint(1, 10)),
+        )
+
+
+class RandomMultiPickList(RandomGenerator):
+    def __init__(self, domain: Sequence[str], min_len=0, max_len=3,
+                 seed: int = 42, probability_of_empty: float = 0.0) -> None:
+        super().__init__(seed, probability_of_empty)
+        self.domain = list(domain)
+        self.min_len, self.max_len = min_len, max_len
+
+    def _value(self) -> frozenset:
+        k = self.rng.randint(self.min_len, self.max_len + 1)
+        return frozenset(
+            self.domain[self.rng.randint(len(self.domain))] for _ in range(k)
+        )
+
+
+def default_generator(
+    t: Type[ft.FeatureType], seed: int = 42, probability_of_empty: float = 0.0
+) -> RandomGenerator:
+    """A sensible generator for any feature type - the glue that lets
+    stress tests sweep the whole type lattice (reference: the testkit's
+    per-type Random* companions)."""
+    p = probability_of_empty
+    if issubclass(t, ft.OPMap):
+        vt = t.value_type or ft.Text
+        return RandomMap(default_generator(vt, seed + 1), ["k1", "k2", "k3"],
+                         seed=seed, probability_of_empty=p)
+    if issubclass(t, ft.Binary):
+        return RandomBinary(seed=seed, probability_of_empty=p)
+    if issubclass(t, ft.Date):
+        return RandomDate(seed=seed, probability_of_empty=p)
+    if issubclass(t, ft.Integral):
+        return RandomIntegral(seed=seed, probability_of_empty=p)
+    if issubclass(t, ft.Real):
+        return RandomReal(seed=seed,
+                          probability_of_empty=0.0 if t.non_nullable else p)
+    if issubclass(t, ft.PickList) or issubclass(t, ft.ComboBox):
+        return RandomText.picklists(
+            ["red", "green", "blue"], seed=seed
+        ).with_probability_of_empty(p)
+    if issubclass(t, ft.Email):
+        return RandomText.emails(seed=seed).with_probability_of_empty(p)
+    if issubclass(t, ft.Phone):
+        return RandomText.phones(seed=seed).with_probability_of_empty(p)
+    if issubclass(t, ft.URL):
+        return RandomText.urls(seed=seed).with_probability_of_empty(p)
+    if issubclass(t, ft.ID):
+        return RandomText.ids(seed=seed).with_probability_of_empty(p)
+    if issubclass(t, ft.Text):
+        return RandomText.words(seed=seed).with_probability_of_empty(p)
+    if issubclass(t, ft.MultiPickList):
+        return RandomMultiPickList(["a", "b", "c", "d"], seed=seed,
+                                   probability_of_empty=p)
+    if issubclass(t, ft.Geolocation):
+        return RandomGeolocation(seed=seed, probability_of_empty=p)
+    if issubclass(t, ft.TextList):
+        return RandomList(RandomText.words(seed=seed + 1), seed=seed,
+                          probability_of_empty=p)
+    if issubclass(t, ft.DateList):
+        return RandomList(RandomDate(seed=seed + 1), max_len=3, seed=seed,
+                          probability_of_empty=p)
+    if issubclass(t, ft.OPVector):
+        return RandomVector(4, seed=seed)
+    raise TypeError(f"no default generator for {t.__name__}")
+
+
 def random_dataset(
     generators: dict[str, tuple[RandomGenerator, Type[ft.FeatureType]]],
     n: int,
@@ -206,3 +296,27 @@ def random_dataset(
             for name, (gen, t) in generators.items()
         }
     )
+
+
+class InfiniteStream:
+    """Endless Dataset batches from named generators (reference:
+    testkit InfiniteStream): drives streaming-score paths and soak tests.
+    Deterministic: each batch continues the generators' seeded streams."""
+
+    def __init__(
+        self,
+        generators: dict[str, tuple[RandomGenerator, Type[ft.FeatureType]]],
+        batch_size: int = 100,
+    ) -> None:
+        self.generators = generators
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[Dataset]:
+        while True:
+            yield self.next_batch()
+
+    def next_batch(self) -> Dataset:
+        return random_dataset(self.generators, self.batch_size)
+
+    def take(self, n_batches: int) -> list[Dataset]:
+        return [self.next_batch() for _ in range(n_batches)]
