@@ -229,3 +229,20 @@ def test_cut_dag_nested_selectors_error(rng):
     dag = compute_dag([p_out])
     with pytest.raises(ValueError, match="nested"):
         cut_dag_during(dag, [inner, outer])
+
+
+def test_train_rejects_missing_nonnullable_response(rng):
+    """Reference parity: .toRealNN throws on empty labels at extraction;
+    here train() errors instead of silently treating masked labels as 0."""
+    n = 50
+    data = {
+        "y": [None if i == 7 else float(i % 2) for i in range(n)],
+        "a": rng.randn(n).tolist(),
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    vec = transmogrify([a])
+    pred = OpLogisticRegression(max_iter=3).set_input(y, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    with pytest.raises(ValueError, match="non-nullable"):
+        wf.train()

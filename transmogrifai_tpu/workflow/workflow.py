@@ -264,6 +264,22 @@ class OpWorkflow:
         dag = compute_dag(self.result_features)
         validate_dag(dag)
 
+        # non-nullable response gate (reference: .toRealNN throws on empty
+        # values at extraction): a missing label must fail loudly here, not
+        # silently train as class 0.0 behind its validity mask
+        for f in self.raw_features:
+            if f.is_response and f.ftype.non_nullable and f.name in raw:
+                mask = getattr(raw[f.name], "mask", None)
+                if mask is not None:
+                    n_bad = int((~np.asarray(mask)).sum())
+                    if n_bad:
+                        raise ValueError(
+                            f"response feature {f.name!r} is "
+                            f"{f.ftype.__name__} (non-nullable) but has "
+                            f"{n_bad} missing values; drop or impute those "
+                            "rows before training"
+                        )
+
         # reserve a holdout for test-eval stages (reference: Splitter
         # reserveTestFraction, tuning/Splitter.scala:57)
         holdout: Optional[Dataset] = None
