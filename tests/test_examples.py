@@ -50,9 +50,13 @@ def test_iris_multiclass_end_to_end():
             (OpNaiveBayes(), [{}]),
         ],
     )
-    wf, label, prediction, labels = iris_workflow(selector=selector)
+    wf, label, prediction, deindexed, labels = iris_workflow(
+        selector=selector
+    )
     assert labels == ["Iris-setosa", "Iris-versicolor", "Iris-virginica"]
     model = wf.train()
+    # no-argument evaluate must resolve the label to the model's own
+    # (indexed) label input, not the raw STRING response column
     metrics = model.evaluate(OpMultiClassificationEvaluator())
     assert metrics.F1 > 0.90, metrics
     # threshold metrics present (reference: OpMultiClassificationEvaluator
@@ -62,3 +66,40 @@ def test_iris_multiclass_end_to_end():
     assert len(tm["thresholds"]) == 101
     holdout = model.evaluate_holdout(OpMultiClassificationEvaluator())
     assert holdout.Error < 0.2, holdout
+    # the de-indexed prediction round-trips numeric classes back to the
+    # ORIGINAL label strings (reference OpIris deindexed flow)
+    scored = model.score(wf.generate_raw_data())
+    de = scored[deindexed.name].values
+    raw = scored["irisClass"].values
+    agree = sum(a == b for a, b in zip(de, raw)) / len(de)
+    assert set(v for v in de if v is not None) <= set(labels)
+    assert agree > 0.9, agree
+
+
+@pytest.mark.skipif(not os.path.exists(IRIS_DATA), reason="no iris data")
+def test_indexed_label_with_missing_value_fails_loudly():
+    """A missing string label must not become a phantom class through the
+    StringIndexer: the predictor fit gate rejects masked labels."""
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+    import transmogrifai_tpu.dsl  # noqa: F401
+
+    n = 60
+    data = {
+        "cls": [None if i == 5 else ("a" if i % 2 else "b")
+                for i in range(n)],
+        "x": [float(i % 7) for i in range(n)],
+    }
+    cls = FeatureBuilder(ft.PickList, "cls").as_response()
+    x = FeatureBuilder(ft.Real, "x").as_predictor()
+    label = cls.indexed()
+    pred = (
+        OpLogisticRegression(max_iter=3)
+        .set_input(label, transmogrify([x]))
+        .get_output()
+    )
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    with pytest.raises(ValueError, match="missing values"):
+        wf.train()

@@ -276,7 +276,14 @@ def test_string_indexer_and_email_domain():
     f = FeatureBuilder(ft.Text, "t").as_predictor()
     model = StringIndexer().set_input(f).fit(ds)
     col = list(model.transform(ds).columns().values())[-1]
-    assert col.values.tolist() == [0.0, 1.0, 0.0, 2.0]  # b most frequent
+    # b most frequent -> 0; None stays MISSING (masked), never a phantom
+    # class; an unseen non-null string would get the tail index instead
+    assert col.values.tolist() == [0.0, 1.0, 0.0, 0.0]
+    assert col.mask.tolist() == [True, True, True, False]
+    ds_unseen = _ds(t=(["b", "zz"], ft.Text))
+    col_u = list(model.transform(ds_unseen).columns().values())[-1]
+    assert col_u.values.tolist() == [0.0, 2.0]  # 'zz' -> tail bucket
+    assert col_u.mask.tolist() == [True, True]
 
     ds2 = _ds(e=(["joe@corp.COM", "bad"], ft.Email))
     f2 = FeatureBuilder(ft.Email, "e").as_predictor()

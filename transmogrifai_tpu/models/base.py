@@ -28,6 +28,20 @@ from ..types.dataset import Dataset
 from ..types.feature_types import OPVector, Prediction, RealNN
 
 
+def _check_label_mask(label: NumericColumn, stage) -> None:
+    """Missing labels must fail loudly at EVERY predictor fit - raw
+    responses are gated at train() time, but a derived label (e.g. a
+    string response through StringIndexer) reaches here with its own
+    mask."""
+    if not bool(label.mask.all()):
+        n_bad = int((~label.mask).sum())
+        raise ValueError(
+            f"label input of {type(stage).__name__} ({stage.uid}) has "
+            f"{n_bad} missing values; labels cannot be imputed - drop "
+            "those rows before training"
+        )
+
+
 class PredictorModel(Transformer):
     """Fitted predictor: holds opaque params + the predict function."""
 
@@ -104,6 +118,7 @@ class PredictorEstimator(Estimator):
         assert isinstance(vec, VectorColumn)
         if len(label) == 0:
             raise ValueError("cannot fit on empty dataset")
+        _check_label_mask(label, self)
         params = self.fit_arrays(
             np.asarray(vec.values, dtype=np.float64),
             np.asarray(label.values, dtype=np.float64),

@@ -21,6 +21,7 @@ from ..types.feature_types import (
     Integral,
     MultiPickList,
     Real,
+    RealNN,
     Text,
 )
 from ..types.vector_metadata import (
@@ -166,9 +167,12 @@ class OneHotVectorizer(SequenceVectorizer):
 
 class StringIndexerModel(Transformer):
     """value -> index; unseen values map to n_labels (NoFilter semantics,
-    reference: OpStringIndexerNoFilter)."""
+    reference: OpStringIndexerNoFilter).  Output is RealNN like the
+    reference's OpStringIndexer: every row gets an index (unseen/null ->
+    the reserved tail slot), so the indexed label feeds selectors whose
+    label input is RealNN directly."""
 
-    output_type = Real
+    output_type = RealNN
 
     def __init__(self, labels: list[str], **kw) -> None:
         super().__init__(**kw)
@@ -179,10 +183,15 @@ class StringIndexerModel(Transformer):
         assert isinstance(col, TextColumn)
         idx = {v: float(j) for j, v in enumerate(self.labels)}
         unseen = float(len(self.labels))
+        # UNSEEN strings get the reserved tail index (NoFilter scoring
+        # semantics); a MISSING value stays missing (masked) - it must not
+        # silently become a phantom class when the indexed feature is a
+        # training label (the predictor fit gate rejects masked labels)
         vals = np.array(
-            [unseen if v is None else idx.get(v, unseen) for v in col.values]
+            [0.0 if v is None else idx.get(v, unseen) for v in col.values]
         )
-        return NumericColumn(vals, np.ones(len(col), dtype=bool), Real)
+        mask = np.array([v is not None for v in col.values], dtype=bool)
+        return NumericColumn(vals, mask, RealNN)
 
 
 class StringIndexer(Estimator):
@@ -190,7 +199,7 @@ class StringIndexer(Estimator):
     OpStringIndexer.scala wrapping Spark StringIndexer semantics)."""
 
     input_types = [Text]
-    output_type = Real
+    output_type = RealNN
 
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
         (col,) = cols

@@ -180,9 +180,12 @@ class ModelSelector(Estimator):
         return result
 
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        from ..models.base import _check_label_mask
+
         label, vec = cols
         assert isinstance(label, NumericColumn)
         assert isinstance(vec, VectorColumn)
+        _check_label_mask(label, self)
         y = np.asarray(label.values, dtype=np.float64)
         X = np.asarray(vec.values, dtype=np.float64)
         if len(y) == 0:

@@ -417,10 +417,24 @@ class OpWorkflowModel:
         return fn
 
     def _label_and_pred(self, label, prediction):
+        prediction = prediction or self.result_features[0].name
+        if label is None:
+            # resolve the label from the prediction stage's own label
+            # input: with a DERIVED label (e.g. a string response through
+            # StringIndexer) the raw response column is text and unusable
+            # for metrics, while the stage input is the actual numeric
+            # label the model trained on
+            pred_f = next(
+                (f for f in self.result_features if f.name == prediction),
+                None,
+            )
+            st = pred_f.origin_stage if pred_f is not None else None
+            ins = getattr(st, "input_features", ()) if st else ()
+            if len(ins) >= 2 and ins[0].is_response:
+                label = ins[0].name
         label = label or next(
             (f.name for f in self.raw_features if f.is_response), None
         )
-        prediction = prediction or self.result_features[0].name
         return label, prediction
 
     def evaluate(self, evaluator, data: Any = None, label: Optional[str] = None,
