@@ -75,3 +75,40 @@ def test_titanic_scoring_roundtrip():
     assert len(scored) == len(raw)
     probs = scored[prediction.name].probability
     assert probs is not None and np.all(probs >= 0) and np.all(probs <= 1)
+
+
+def test_score_and_evaluate_api(rng):
+    """Reference parity: model.scoreAndEvaluate returns (scores, metrics)
+    in one pass (OpTitanicSimple's final step)."""
+    import transmogrifai_tpu.dsl  # noqa: F401
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 200
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+    }
+    data["a"] = [ai + 2 * yi for ai, yi in zip(data["a"], data["y"])]
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    pred = (
+        OpLogisticRegression(max_iter=10)
+        .set_input(y, transmogrify([a]))
+        .get_output()
+    )
+    model = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    )
+    scored, metrics = model.score_and_evaluate(
+        OpBinaryClassificationEvaluator(), data=data
+    )
+    assert pred.name in scored and len(scored) == n
+    assert float(metrics.AuROC) > 0.85

@@ -439,9 +439,20 @@ class OpWorkflowModel:
 
     def evaluate(self, evaluator, data: Any = None, label: Optional[str] = None,
                  prediction: Optional[str] = None):
-        scored = self.score(data) if data is not None else self.score()
+        return self.score_and_evaluate(evaluator, data, label, prediction)[1]
+
+    def score_and_evaluate(self, evaluator, data: Any = None,
+                           label: Optional[str] = None,
+                           prediction: Optional[str] = None):
+        """Score then evaluate in one pass over the same transformed data
+        (reference: OpWorkflowModel.scoreAndEvaluate, used by the
+        helloworld apps).  Returns (scored Dataset, metrics)."""
+        scored = self.score(data)
         label, prediction = self._label_and_pred(label, prediction)
-        return evaluator.evaluate(scored, label_col=label, pred_col=prediction)
+        metrics = evaluator.evaluate(
+            scored, label_col=label, pred_col=prediction
+        )
+        return scored, metrics
 
     def evaluate_holdout(self, evaluator, label: Optional[str] = None,
                          prediction: Optional[str] = None):
