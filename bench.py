@@ -302,6 +302,44 @@ def _default_grid_section(result: dict) -> None:
     )
 
 
+def _boston_iris_sections(result: dict) -> None:
+    """BASELINE configs 3 + 4: Boston RegressionModelSelector (LinReg +
+    GBT) and Iris MultiClassificationModelSelector (RF + NB) end-to-end -
+    the reference publishes no numbers for these, so completion + quality
+    + wall are recorded for cross-round tracking."""
+    try:
+        from transmogrifai_tpu.evaluators.regression import (
+            OpRegressionEvaluator,
+        )
+        from transmogrifai_tpu.examples.boston import boston_workflow
+
+        wf, medv, pred = boston_workflow()
+        t0 = time.time()
+        model = wf.train()
+        result["boston_train_wall_s"] = round(time.time() - t0, 3)
+        m = model.evaluate_holdout(OpRegressionEvaluator())
+        result["boston_holdout_rmse"] = round(
+            float(m.RootMeanSquaredError), 4
+        )
+    except Exception as e:
+        result["boston_error"] = f"{type(e).__name__}: {e}"
+    try:
+        from transmogrifai_tpu.evaluators.multiclass import (
+            OpMultiClassificationEvaluator,
+        )
+        from transmogrifai_tpu.examples.iris import iris_workflow
+
+        wf, label, pred, deindexed, labels = iris_workflow()
+        t0 = time.time()
+        model = wf.train()
+        result["iris_train_wall_s"] = round(time.time() - t0, 3)
+        m = model.evaluate_holdout(OpMultiClassificationEvaluator())
+        result["iris_holdout_f1"] = round(float(m.F1), 4)
+        result["iris_holdout_error_rate"] = round(float(m.Error), 4)
+    except Exception as e:
+        result["iris_error"] = f"{type(e).__name__}: {e}"
+
+
 def main() -> None:
     _ensure_working_backend()
     t_start = time.time()
@@ -364,6 +402,7 @@ def main() -> None:
         _default_grid_section(result)
     except Exception as e:
         result["default_grid_error"] = f"{type(e).__name__}: {e}"
+    _boston_iris_sections(result)
     try:
         _synth_section(result)
     except Exception as e:  # synth is best-effort; Titanic is THE metric
