@@ -104,14 +104,19 @@ def test_local_scorer_titanic_parity_and_latency():
     scorer = score_function(model)
     assert isinstance(scorer, LocalScorer)
 
-    # batch parity vs the engine path
+    # batch parity vs the device engine path (model.score on the same
+    # records); model.score_function() is now the LocalScorer itself
+    assert isinstance(model.score_function(), LocalScorer)
     local_out = scorer.score_batch(records)
-    engine_fn = model.score_function()
-    for rec, loc in zip(records[:10], local_out[:10]):
-        eng = engine_fn(rec)
-        le, ee = loc[prediction.name], eng[prediction.name]
-        assert le["prediction"] == ee["prediction"]
-        assert abs(le["probability_1"] - ee["probability_1"]) < 1e-5
+    batch_data = {
+        f.name: [r.get(f.name) for r in records]
+        for f in model.raw_features
+    }
+    engine_out = model.score(batch_data)[prediction.name].to_list()
+    for eng, loc in zip(engine_out[:10], local_out[:10]):
+        le = loc[prediction.name]
+        assert le["prediction"] == eng["prediction"]
+        assert abs(le["probability_1"] - eng["probability_1"]) < 1e-5
 
     # per-record call works and is fast enough for serving loops
     t0 = time.perf_counter()
