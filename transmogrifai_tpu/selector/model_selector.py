@@ -102,7 +102,6 @@ class ModelSelector(Estimator):
         rows.  Eliminates leakage from label-aware upstream estimators."""
         import numpy as np
 
-        from ..stages.base import Estimator as _Est
         from ..workflow.workflow import fit_and_transform_dag
 
         label_f, vec_f = self.input_features

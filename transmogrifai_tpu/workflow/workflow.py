@@ -24,8 +24,7 @@ import numpy as np
 
 from ..features.feature import Feature
 from ..stages.base import Estimator, PipelineStage, Transformer
-from ..stages.feature_generator import FeatureGeneratorStage
-from ..types.columns import Column, NumericColumn, column_from_list
+from ..types.columns import Column, column_from_list
 from ..types.dataset import Dataset
 from .dag import Layer, compute_dag, flatten, validate_dag
 
