@@ -81,3 +81,72 @@ def test_serializability_gate_rejects_bad_params_and_metadata():
     s2.metadata["handle"] = object()
     with pytest.raises(ValueError, match="cannot serialize|holds state"):
         validate_dag([[s2]])
+
+
+def test_smart_text_map_hashing_dispatch(rng):
+    """SmartTextMapVectorizer semantics: high-cardinality free-text map
+    keys hash into a shared per-feature space (key-salted tokens) while
+    low-cardinality keys still pivot; PickListMap never hashes."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.types.columns import MapColumn
+    from transmogrifai_tpu.types.dataset import Dataset
+
+    n = 120
+    rows = []
+    for i in range(n):
+        rows.append({
+            "freeform": f"unique text value number {i} with words",
+            "status": ("open", "closed")[i % 2],
+        })
+    ds = Dataset({"m": MapColumn(rows, ft.TextMap)})
+    f = FeatureBuilder(ft.TextMap, "m").as_predictor()
+    stage = MapVectorizer(max_cardinality=10, hash_dims=16, min_support=1,
+                          track_nulls=True).set_input(f)
+    model = stage.fit(ds)
+    out = model.transform(ds)[stage.output_name]
+    # 'freeform' (120 distinct) -> 16 hash dims; 'status' (2) -> pivot
+    hash_cols = [c for c in out.metadata.columns
+                 if c.descriptor_value and c.descriptor_value.startswith("hash_")]
+    assert len(hash_cols) == 16
+    assert any(c.grouping == "status" and c.indicator_value == "open"
+               for c in out.metadata.columns)
+    # hashed block carries signal (non-zero TF counts)
+    hash_idx = [i for i, c in enumerate(out.metadata.columns)
+                if c.descriptor_value and c.descriptor_value.startswith("hash_")]
+    assert np.asarray(out.values[:, hash_idx]).sum() > 0
+    # key salting: the SAME word hashed under two different fit-time keys
+    # must land on different slots of the shared space
+    srows = [{"k1": "signalword", "k2": "other stuff"}
+             if i % 2 else {"k1": "filler text", "k2": "signalword"}
+             for i in range(100)]
+    # force both keys past max_cardinality so both hash
+    for i, r in enumerate(srows):
+        for k in r:
+            r[k] = r[k] + f" unique{i}"
+    sds = Dataset({"m": MapColumn(srows, ft.TextMap)})
+    sstage = MapVectorizer(max_cardinality=10, hash_dims=32,
+                           min_support=1).set_input(f)
+    sout = sstage.fit(sds).transform(sds)[sstage.output_name]
+    sh_idx = [i for i, c in enumerate(sout.metadata.columns)
+              if c.descriptor_value and c.descriptor_value.startswith("hash_")]
+    row_k1 = np.asarray(sout.values[0, sh_idx])   # signalword under k1
+    row_k2 = np.asarray(sout.values[1, sh_idx])   # signalword under k2
+    # without salting, 'signalword' would activate the SAME slot in both
+    # rows; with key-salted tokens the activated slots differ
+    both_active = (row_k1 > 0) & (row_k2 > 0)
+    assert not both_active.any() or not np.array_equal(
+        np.nonzero(row_k1)[0].tolist(), np.nonzero(row_k2)[0].tolist()
+    )
+
+    # categorical map values never hash, regardless of cardinality
+    prows = [{"k": f"cat{i}"} for i in range(n)]
+    pds = Dataset({"p": MapColumn(prows, ft.PickListMap)})
+    pf = FeatureBuilder(ft.PickListMap, "p").as_predictor()
+    pstage = MapVectorizer(max_cardinality=10, min_support=1).set_input(pf)
+    pout = pstage.fit(pds).transform(pds)[pstage.output_name]
+    assert not any(c.descriptor_value and c.descriptor_value.startswith("hash_")
+                   for c in pout.metadata.columns)

@@ -2,12 +2,17 @@
 
 Counterparts of the OPMapVectorizer family (reference: core/.../impl/
 feature/OPMapVectorizer.scala, TextMapPivotVectorizer.scala,
-MultiPickListMapVectorizer.scala, DateMapToUnitCircleVectorizer.scala,
-GeolocationMapVectorizer.scala): the key set of each map feature is
-discovered at fit time (sorted, optionally filtered by white/blacklists);
-each key becomes a pseudo-column vectorized by the value type's default
-strategy (impute+null-track for numerics, top-K pivot for categorical text,
-circular encoding for dates, geo-mean fill for geolocations).
+SmartTextMapVectorizer.scala, MultiPickListMapVectorizer.scala,
+DateMapToUnitCircleVectorizer.scala, GeolocationMapVectorizer.scala): the
+key set of each map feature is discovered at fit time (sorted, optionally
+filtered by white/blacklists); each key becomes a pseudo-column vectorized
+by the value type's default strategy (impute+null-track for numerics,
+top-K pivot for categorical text, circular encoding for dates, geo-mean
+fill for geolocations).  Free-text keys get the SmartTextMapVectorizer
+treatment: keys whose cardinality exceeds ``max_cardinality`` are
+tokenize+hashed into ONE shared hash space per map feature (tokens salted
+with the key name - the reference's shared HashSpaceStrategy), instead of
+degrading to a top-K pivot's OTHER bucket.
 """
 from __future__ import annotations
 
@@ -128,6 +133,42 @@ class MapVectorizerModel(SequenceVectorizerModel):
                         parent_feature_name=feat.name, parent_feature_type=tname,
                         grouping=key, descriptor_value=d))
                 null_block(mask, key)
+            elif kind == "hash":
+                # shared hash block for this feature's high-cardinality
+                # free-text keys (SmartTextMapVectorizer.scala semantics):
+                # tokens salted by key so identical words under different
+                # keys occupy distinct slots in the shared space
+                from .text import tokenize
+                from ..utils.hashing import hashing_tf
+
+                dims = int(plan["dims"])
+                docs = []
+                any_mask = np.zeros(len(col), dtype=bool)
+                for r, d in enumerate(col.values):
+                    toks: list[str] = []
+                    for key in plan["keys"]:
+                        v = d.get(key)
+                        if v is None:
+                            continue
+                        any_mask[r] = True
+                        toks.extend(
+                            f"{key}={t}" for t in tokenize(str(v))
+                        )
+                    docs.append(toks)
+                blocks.append(hashing_tf(docs, dims, seed=plan["seed"]))
+                metas.extend(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        descriptor_value=f"hash_{j}")
+                    for j in range(dims)
+                )
+                if self.track_nulls:
+                    blocks.append((~any_mask).astype(np.float64)[:, None])
+                    metas.append(VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        indicator_value=NULL_STRING))
             else:  # pragma: no cover
                 raise ValueError(kind)
         if not blocks:
@@ -150,6 +191,9 @@ class MapVectorizer(SequenceVectorizer):
         allow_keys: Optional[Sequence[str]] = None,
         block_keys: Optional[Sequence[str]] = None,
         date_periods: Sequence[str] = ("HourOfDay", "DayOfWeek", "DayOfMonth", "WeekOfYear"),
+        max_cardinality: int = 30,
+        hash_dims: int = 512,
+        seed: int = 42,
         **kw,
     ) -> None:
         super().__init__(**kw)
@@ -161,6 +205,9 @@ class MapVectorizer(SequenceVectorizer):
         self.allow_keys = set(allow_keys) if allow_keys else None
         self.block_keys = set(block_keys or ())
         self.date_periods = tuple(date_periods)
+        self.max_cardinality = max_cardinality
+        self.hash_dims = hash_dims
+        self.seed = seed
 
     def _keys_of(self, col: MapColumn) -> list[str]:
         keys = [k for k in col.all_keys() if k not in self.block_keys]
@@ -174,6 +221,7 @@ class MapVectorizer(SequenceVectorizer):
             assert isinstance(col, MapColumn)
             vt = self.input_features[i].ftype.value_type or ft.Real
             feature_plans = []
+            hash_keys: list[str] = []
             for key in self._keys_of(col):
                 if issubclass(vt, ft.Date):
                     feature_plans.append(
@@ -194,7 +242,7 @@ class MapVectorizer(SequenceVectorizer):
                         else masked_mean(arr, mask)
                     )
                     feature_plans.append({"key": key, "kind": "numeric", "fill": fill})
-                else:  # text-ish -> pivot
+                else:  # text-ish -> pivot, or hash when high-cardinality
                     counts: Counter = Counter()
                     for v in _key_values(col, key):
                         if v is None:
@@ -203,8 +251,24 @@ class MapVectorizer(SequenceVectorizer):
                             counts.update(_clean_value(x, self.clean_text) for x in v)
                         else:
                             counts[_clean_value(str(v), self.clean_text)] += 1
+                    # SmartTextMapVectorizer dispatch: FREE text (never
+                    # categorical picklist-style values) whose cardinality
+                    # blows past max_cardinality hashes instead of losing
+                    # everything beyond top-K to the OTHER bucket
+                    free_text = (
+                        issubclass(vt, ft.Text) and not vt.is_categorical
+                    )
+                    if free_text and len(counts) > self.max_cardinality:
+                        hash_keys.append(key)
+                        continue
                     labels = top_k_labels(counts, self.top_k, self.min_support)
                     feature_plans.append({"key": key, "kind": "pivot", "labels": labels})
+            if hash_keys:
+                feature_plans.append({
+                    "key": "|".join(hash_keys), "kind": "hash",
+                    "keys": hash_keys, "dims": self.hash_dims,
+                    "seed": self.seed,
+                })
             plans.append(feature_plans)
         return MapVectorizerModel(plans, self.track_nulls, self.clean_text)
 
@@ -216,5 +280,7 @@ def transmogrify_map_group(feats: Sequence[Feature], defaults) -> Feature:
         track_nulls=defaults.track_nulls,
         clean_text=defaults.clean_text,
         date_periods=defaults.date_periods,
+        max_cardinality=defaults.max_categorical_cardinality,
+        hash_dims=defaults.hash_dims,
     )
     return stage.set_input(*feats).get_output()
