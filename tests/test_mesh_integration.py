@@ -124,3 +124,49 @@ def test_sanity_checker_accepts_device_resident_vector(rng):
     want_mean = Xh.mean(axis=0)
     for j, c in enumerate(stats):
         np.testing.assert_allclose(c["mean"], want_mean[j], rtol=1e-4, atol=1e-5)
+
+
+def test_tree_fold_fits_sharded_equals_unsharded(rng, monkeypatch):
+    """The tree CV fan-out now rides the product 'data' mesh: row-sharded
+    fold fits (8-device CPU mesh, zero-weight row padding) must reproduce
+    the unsharded fits exactly for both forests and GBT."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier,
+        OpRandomForestClassifier,
+    )
+    from transmogrifai_tpu.selector.validator import stratified_kfold_masks
+
+    n = 403  # deliberately NOT a multiple of 8: padding path must engage
+    X = rng.randn(n, 6)
+    y = (X @ np.linspace(1, -1, 6) + 0.4 * rng.randn(n) > 0).astype(float)
+    W = stratified_kfold_masks(y, 3, seed=0, stratify=True).astype(float)
+
+    for est in (
+        OpRandomForestClassifier(num_trees=5, max_depth=3, backend="jax"),
+        OpGBTClassifier(num_trees=4, max_depth=3, backend="jax"),
+    ):
+        monkeypatch.setenv("TX_PRODUCT_MESH", "1")
+        sharded = est.fit_arrays_folds(X, y, W)
+        monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+        plain = est.fit_arrays_folds(X, y, W)
+        for f in range(len(W)):
+            _, _, prob_s = est.predict_arrays(sharded[f], X)
+            _, _, prob_p = est.predict_arrays(plain[f], X)
+            assert np.allclose(prob_s, prob_p, atol=1e-5), (
+                type(est).__name__, f)
+
+    # whole-grid batching too
+    est = OpRandomForestClassifier(num_trees=4, max_depth=3, backend="jax")
+    grid = [{"min_info_gain": 0.0}, {"min_info_gain": 0.1}]
+    monkeypatch.setenv("TX_PRODUCT_MESH", "1")
+    g_sh = est.fit_arrays_folds_grid(X, y, W, grid)
+    monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+    g_pl = est.fit_arrays_folds_grid(X, y, W, grid)
+    for j in range(len(grid)):
+        cand = est.with_params(**grid[j])
+        for f in range(len(W)):
+            _, _, ps = cand.predict_arrays(g_sh[j][f], X)
+            _, _, pp = cand.predict_arrays(g_pl[j][f], X)
+            assert np.allclose(ps, pp, atol=1e-5), (j, f)

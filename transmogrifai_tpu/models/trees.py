@@ -86,6 +86,66 @@ def _bin_for_backend(X, edges):
     return bin_data(np.asarray(X), edges)
 
 
+def _pad_cols_to_multiple(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad axis 1 (the row axis of [F, n] / [T, n] weight matrices)."""
+    rem = (-arr.shape[1]) % multiple
+    if rem == 0:
+        return arr
+    return np.concatenate(
+        [arr, np.zeros((arr.shape[0], rem), arr.dtype)], axis=1
+    )
+
+
+def _shard_fold_inputs(bins, stats_or_y, W, boot=None):
+    """Row-shard the fold-fit inputs over the product 'data' mesh when more
+    than one device is attached (the Spark-partition analog for the tree
+    CV fan-out; LR's batched path already does this in the validator).
+    Rows pad to the shard multiple; padded rows carry ZERO fold weight, so
+    they touch no histogram statistic (stats are weighted by W inside
+    fit_tree).  Without a mesh the inputs pass through jnp.asarray
+    untouched - a device-resident pallas-binned matrix stays in HBM.
+
+    stats_or_y: [n, C] per-row stat channels (forest) or [n] labels (GBT).
+
+    Same multi-host contract as fused_moments_sharded: host-resident
+    inputs are only valid when replicated on every process, so a
+    multi-process runtime rejects them loudly rather than crashing inside
+    device_put on non-addressable devices.
+    """
+    from ..parallel.mesh import data_mesh_or_none, pad_rows_to_multiple, shard_rows
+
+    mesh = data_mesh_or_none()
+    if mesh is None:
+        return (
+            jnp.asarray(bins), jnp.asarray(stats_or_y), jnp.asarray(W),
+            None if boot is None else jnp.asarray(boot),
+        )
+    if jax.process_count() > 1:
+        raise ValueError(
+            "tree fold fits received host-resident arrays on a "
+            "multi-process runtime; assemble global jax.Arrays with "
+            "jax.make_array_from_process_local_data before fitting "
+            "(host inputs are only valid when replicated on every process)"
+        )
+    nd = mesh.shape["data"]
+    bins, _ = pad_rows_to_multiple(np.asarray(bins), nd)
+    stats_or_y, _ = pad_rows_to_multiple(np.asarray(stats_or_y), nd)
+    W = _pad_cols_to_multiple(np.asarray(W), nd)
+    if boot is not None:
+        boot = _pad_cols_to_multiple(np.asarray(boot), nd)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cols = NamedSharding(mesh, P(None, "data"))
+    return (
+        shard_rows(np.ascontiguousarray(bins), mesh),
+        shard_rows(np.ascontiguousarray(stats_or_y), mesh),
+        jax.device_put(np.ascontiguousarray(W), cols),
+        None if boot is None else jax.device_put(
+            np.ascontiguousarray(boot), cols
+        ),
+    )
+
+
 def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:
     if strategy == "all":
         return 1.0
@@ -241,10 +301,11 @@ class _RandomForest(_TreeEnsembleBase):
             if len(out) == len(W):
                 return out
         keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seed_ints))
+        bins_d, stats_d, W_d, boot_d = _shard_fold_inputs(
+            bins, stats, np.asarray(W, np.float32), boot
+        )
         heaps = fit_forest_folds(
-            jnp.asarray(bins), jnp.asarray(stats),
-            jnp.asarray(np.asarray(W, np.float32)),
-            jnp.asarray(boot), jnp.asarray(feat_masks), keys,
+            bins_d, stats_d, W_d, boot_d, jnp.asarray(feat_masks), keys,
             max_depth=depth, max_bins=int(p["max_bins"]),
             impurity_kind=imp, n_stats=C,
             min_instances_per_node=float(p["min_instances_per_node"]),
@@ -292,7 +353,7 @@ class _RandomForest(_TreeEnsembleBase):
             )
             groups.setdefault(key, []).append(j)
         results: list = [None] * len(grid)
-        W32 = jnp.asarray(np.asarray(W, np.float32))
+        W32 = np.asarray(W, np.float32)
         for key, js in groups.items():
             rep = cands[js[0]]
             (edges, bins, stats, C, imp, classes, boot, feat_masks,
@@ -307,9 +368,11 @@ class _RandomForest(_TreeEnsembleBase):
                 jnp.float32,
             )
             keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seed_ints))
+            bins_d, stats_d, W_d, boot_d = _shard_fold_inputs(
+                bins, stats, W32, boot
+            )
             heaps = fit_forest_folds_grid(
-                jnp.asarray(bins), jnp.asarray(stats), W32,
-                jnp.asarray(boot), jnp.asarray(feat_masks), keys,
+                bins_d, stats_d, W_d, boot_d, jnp.asarray(feat_masks), keys,
                 minipn_g, minig_g,
                 max_depth=depth, max_bins=int(rep.params["max_bins"]),
                 impurity_kind=imp, n_stats=C,
@@ -540,9 +603,13 @@ class _GBT(_TreeEnsembleBase):
             if len(out) == len(W):
                 return out
         depth = self._gbt_depth(n, d)
-        bins = jnp.asarray(_bin_for_backend(X, edges))
+        # no host materialization here: a pallas-binned device matrix
+        # passes straight through when no mesh resharding is needed
+        bins_d, y_d, W_d, _ = _shard_fold_inputs(
+            _bin_for_backend(X, edges), np.asarray(y, np.float32), W
+        )
         f0s, heaps = fit_gbt_folds(
-            bins, jnp.asarray(y, jnp.float32), jnp.asarray(W),
+            bins_d, y_d, W_d,
             num_trees=int(p["num_trees"]), max_depth=depth,
             max_bins=int(p["max_bins"]),
             is_classification=self.is_classification,
@@ -584,8 +651,8 @@ class _GBT(_TreeEnsembleBase):
                    int(p["seed"]))
             groups.setdefault(key, []).append(j)
         results: list = [None] * len(grid)
-        W32 = jnp.asarray(np.asarray(W, np.float32))
-        yj = jnp.asarray(y, jnp.float32)
+        W32 = np.asarray(W, np.float32)
+        y32 = np.asarray(y, np.float32)
         edges_cache: dict[tuple, np.ndarray] = {}
         for key, js in groups.items():
             depth, max_bins, num_trees, seed = key
@@ -593,7 +660,9 @@ class _GBT(_TreeEnsembleBase):
             if ekey not in edges_cache:
                 edges_cache[ekey] = _sampled_bin_edges(X, max_bins, seed)
             edges = edges_cache[ekey]
-            bins = jnp.asarray(_bin_for_backend(X, edges))
+            bins, yj, W_d, _ = _shard_fold_inputs(
+                _bin_for_backend(X, edges), y32, W32
+            )
             step_g = jnp.asarray(
                 [float(cands[j].params["step_size"]) for j in js], jnp.float32)
             minipn_g = jnp.asarray(
@@ -603,7 +672,7 @@ class _GBT(_TreeEnsembleBase):
                 [float(cands[j].params["min_info_gain"]) for j in js],
                 jnp.float32)
             f0s, heaps = fit_gbt_folds_grid(
-                bins, yj, W32, step_g, minipn_g, minig_g,
+                bins, yj, W_d, step_g, minipn_g, minig_g,
                 num_trees=num_trees, max_depth=depth, max_bins=max_bins,
                 is_classification=self.is_classification,
             )
