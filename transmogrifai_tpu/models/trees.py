@@ -86,14 +86,53 @@ def _bin_for_backend(X, edges):
     return bin_data(np.asarray(X), edges)
 
 
-def _pad_cols_to_multiple(arr: np.ndarray, multiple: int) -> np.ndarray:
-    """Zero-pad axis 1 (the row axis of [F, n] / [T, n] weight matrices)."""
-    rem = (-arr.shape[1]) % multiple
+def _pad_axis_to_multiple(arr, multiple: int, axis: int):
+    """Zero-pad ``axis`` to the shard multiple.  Device-resident arrays
+    pad with jnp (stays in HBM); host arrays with numpy."""
+    rem = (-arr.shape[axis]) % multiple
     if rem == 0:
         return arr
+    pad_shape = list(arr.shape)
+    pad_shape[axis] = rem
+    if isinstance(arr, jax.Array):
+        return jnp.concatenate(
+            [arr, jnp.zeros(tuple(pad_shape), arr.dtype)], axis=axis
+        )
+    arr = np.asarray(arr)
     return np.concatenate(
-        [arr, np.zeros((arr.shape[0], rem), arr.dtype)], axis=1
+        [arr, np.zeros(tuple(pad_shape), arr.dtype)], axis=axis
     )
+
+
+def _tree_cv_mesh():
+    """The product 'data' mesh for tree fold fits, or None.  Same
+    multi-host contract as fused_moments_sharded: on a multi-process
+    runtime, callers must pass device-resident global jax.Arrays (the
+    per-array guard lives in _place)."""
+    from ..parallel.mesh import data_mesh_or_none
+
+    return data_mesh_or_none()
+
+
+def _place(arr, mesh, row_axis: int):
+    """Pad ``row_axis`` to the shard multiple and place the array with
+    that axis sharded over 'data' (device-resident arrays reshard
+    device-to-device; host arrays upload directly into their shards)."""
+    if jax.process_count() > 1 and not isinstance(arr, jax.Array):
+        raise ValueError(
+            "tree fold fits received a host-resident array on a "
+            "multi-process runtime; assemble global jax.Arrays with "
+            "jax.make_array_from_process_local_data before fitting "
+            "(host inputs are only valid when replicated on every process)"
+        )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = _pad_axis_to_multiple(arr, mesh.shape["data"], row_axis)
+    spec = [None] * np.ndim(arr)
+    spec[row_axis] = "data"
+    if not isinstance(arr, jax.Array):
+        arr = np.ascontiguousarray(arr)
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
 def _shard_fold_inputs(bins, stats_or_y, W, boot=None):
@@ -103,46 +142,22 @@ def _shard_fold_inputs(bins, stats_or_y, W, boot=None):
     Rows pad to the shard multiple; padded rows carry ZERO fold weight, so
     they touch no histogram statistic (stats are weighted by W inside
     fit_tree).  Without a mesh the inputs pass through jnp.asarray
-    untouched - a device-resident pallas-binned matrix stays in HBM.
+    untouched; with one, device-resident arrays (e.g. a pallas-binned
+    matrix) pad and reshard WITHOUT a host round-trip.
 
     stats_or_y: [n, C] per-row stat channels (forest) or [n] labels (GBT).
-
-    Same multi-host contract as fused_moments_sharded: host-resident
-    inputs are only valid when replicated on every process, so a
-    multi-process runtime rejects them loudly rather than crashing inside
-    device_put on non-addressable devices.
     """
-    from ..parallel.mesh import data_mesh_or_none, pad_rows_to_multiple, shard_rows
-
-    mesh = data_mesh_or_none()
+    mesh = _tree_cv_mesh()
     if mesh is None:
         return (
             jnp.asarray(bins), jnp.asarray(stats_or_y), jnp.asarray(W),
             None if boot is None else jnp.asarray(boot),
         )
-    if jax.process_count() > 1:
-        raise ValueError(
-            "tree fold fits received host-resident arrays on a "
-            "multi-process runtime; assemble global jax.Arrays with "
-            "jax.make_array_from_process_local_data before fitting "
-            "(host inputs are only valid when replicated on every process)"
-        )
-    nd = mesh.shape["data"]
-    bins, _ = pad_rows_to_multiple(np.asarray(bins), nd)
-    stats_or_y, _ = pad_rows_to_multiple(np.asarray(stats_or_y), nd)
-    W = _pad_cols_to_multiple(np.asarray(W), nd)
-    if boot is not None:
-        boot = _pad_cols_to_multiple(np.asarray(boot), nd)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    cols = NamedSharding(mesh, P(None, "data"))
     return (
-        shard_rows(np.ascontiguousarray(bins), mesh),
-        shard_rows(np.ascontiguousarray(stats_or_y), mesh),
-        jax.device_put(np.ascontiguousarray(W), cols),
-        None if boot is None else jax.device_put(
-            np.ascontiguousarray(boot), cols
-        ),
+        _place(bins, mesh, 0),
+        _place(stats_or_y, mesh, 0),
+        _place(W, mesh, 1),
+        None if boot is None else _place(boot, mesh, 1),
     )
 
 
@@ -651,8 +666,15 @@ class _GBT(_TreeEnsembleBase):
                    int(p["seed"]))
             groups.setdefault(key, []).append(j)
         results: list = [None] * len(grid)
-        W32 = np.asarray(W, np.float32)
+        # y/W are identical for every static-shape group: pad + place once
+        # (only bins varies per group, via the edges)
+        mesh = _tree_cv_mesh()
         y32 = np.asarray(y, np.float32)
+        W32 = np.asarray(W, np.float32)
+        if mesh is None:
+            yj, W_d = jnp.asarray(y32), jnp.asarray(W32)
+        else:
+            yj, W_d = _place(y32, mesh, 0), _place(W32, mesh, 1)
         edges_cache: dict[tuple, np.ndarray] = {}
         for key, js in groups.items():
             depth, max_bins, num_trees, seed = key
@@ -660,8 +682,10 @@ class _GBT(_TreeEnsembleBase):
             if ekey not in edges_cache:
                 edges_cache[ekey] = _sampled_bin_edges(X, max_bins, seed)
             edges = edges_cache[ekey]
-            bins, yj, W_d, _ = _shard_fold_inputs(
-                _bin_for_backend(X, edges), y32, W32
+            bins_raw = _bin_for_backend(X, edges)
+            bins = (
+                jnp.asarray(bins_raw) if mesh is None
+                else _place(bins_raw, mesh, 0)
             )
             step_g = jnp.asarray(
                 [float(cands[j].params["step_size"]) for j in js], jnp.float32)
