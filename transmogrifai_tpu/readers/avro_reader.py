@@ -217,13 +217,31 @@ class ParquetReader:
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
     ) -> Dataset:
+        import numpy as np
         import pyarrow.parquet as pq
+        import pyarrow.types as pat
 
         table = pq.read_table(
             self.path, columns=[f.name for f in raw_features]
         )
         cols = {}
         for f in raw_features:
-            vals = [_coerce(v, f) for v in table.column(f.name).to_pylist()]
+            col = table.column(f.name)
+            arrow_numeric = (
+                pat.is_integer(col.type) or pat.is_floating(col.type)
+                or pat.is_boolean(col.type) or pat.is_decimal(col.type)
+            )
+            if f.ftype.kind == "numeric" and arrow_numeric:
+                # vectorized Arrow decode (string-typed numerics hit the
+                # fallback): nulls surface as NaN after the float cast,
+                # and column_from_list's ndarray branch owns the
+                # NaN->masked NumericColumn contract
+                cols[f.name] = column_from_list(
+                    np.asarray(col.to_numpy(zero_copy_only=False),
+                               np.float64),
+                    f.ftype,
+                )
+                continue
+            vals = [_coerce(v, f) for v in col.to_pylist()]
             cols[f.name] = column_from_list(vals, f.ftype)
         return Dataset(cols)

@@ -208,6 +208,40 @@ def read_csv_columnar(
     return out
 
 
+def double_buffered_to_device(producer, n_cols: int) -> tuple:
+    """Shared double-buffered host→device pump: ``producer(queue)`` runs in
+    a background thread pushing (values_block [rows, d] float32, mask_block
+    [rows, d] bool) tuples, then None; exceptions are forwarded.  The
+    consumer issues async ``jax.device_put`` per block - the next parse
+    overlaps the DMA in flight - and concatenates on device.  Returns
+    (X_device [n, n_cols], mask_device, rows); empty input yields correct-
+    width zero-row arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    q: queue.Queue = queue.Queue(maxsize=2)
+    t = threading.Thread(target=producer, args=(q,), daemon=True)
+    t.start()
+    dev_blocks, dev_masks, total = [], [], 0
+    while True:
+        item = q.get()
+        if item is None:
+            break
+        if isinstance(item, BaseException):
+            raise item
+        block, mask = item
+        total += block.shape[0]
+        dev_blocks.append(jax.device_put(block))
+        dev_masks.append(jax.device_put(mask))
+    t.join()
+    if not dev_blocks:
+        return (jnp.zeros((0, n_cols), jnp.float32),
+                jnp.zeros((0, n_cols), bool), 0)
+    X = jnp.concatenate(dev_blocks, axis=0)
+    M = jnp.concatenate(dev_masks, axis=0)
+    return X, M, total
+
+
 class DeviceCSVIngest:
     """CSV -> device-resident [n, d] float32 design matrix with the parse
     of chunk i+1 overlapping the device transfer of chunk i.
@@ -272,30 +306,6 @@ class DeviceCSVIngest:
         """Returns (X_device [n, d] float32, valid_mask_device [n, d]
         bool, rows).  Missing numeric cells are 0 with mask False (the
         NumericColumn contract, device-side)."""
-        import jax
-        import jax.numpy as jnp
-
-        q: queue.Queue = queue.Queue(maxsize=2)
-        t = threading.Thread(target=self._parse_worker, args=(q,),
-                             daemon=True)
-        t.start()
-        dev_blocks, dev_masks, total = [], [], 0
-        while True:
-            item = q.get()
-            if item is None:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            block, mask = item
-            total += block.shape[0]
-            # async dispatch: DMA overlaps the worker's next C++ parse
-            dev_blocks.append(jax.device_put(block))
-            dev_masks.append(jax.device_put(mask))
-        t.join()
-        if not dev_blocks:
-            d = len(self.columns)
-            return (jnp.zeros((0, d), jnp.float32),
-                    jnp.zeros((0, d), bool), 0)
-        X = jnp.concatenate(dev_blocks, axis=0)
-        M = jnp.concatenate(dev_masks, axis=0)
-        return X, M, total
+        return double_buffered_to_device(
+            self._parse_worker, len(self.columns)
+        )
