@@ -260,6 +260,42 @@ def _ingest_section(result: dict) -> None:
         )
     finally:
         os.unlink(path)
+    # the Arrow/Parquet half of the ingest story (readers/arrow_ingest.py)
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from transmogrifai_tpu.readers.arrow_ingest import DeviceParquetIngest
+
+        with tempfile.NamedTemporaryFile(suffix=".parquet",
+                                         delete=False) as f:
+            ppath = f.name
+        try:
+            # stream the repeated block through ParquetWriter: host memory
+            # stays at block size even at 10M+ target rows (mirrors the
+            # CSV section's repeated-block file write)
+            block_tbl = pa.table(
+                {f"x{i}": rng.randn(block_rows) for i in range(d)}
+            )
+            with pq.ParquetWriter(ppath, block_tbl.schema) as w:
+                for _ in range(reps):
+                    w.write_table(block_tbl)
+            t0 = time.time()
+            Xp, mp, prows = DeviceParquetIngest(
+                ppath, [f"x{i}" for i in range(d)]
+            ).to_device()
+            jax.block_until_ready(Xp)
+            t_par = time.time() - t0
+            assert prows == rows, (prows, rows)
+            result.update(
+                ingest_parquet_rows=prows,
+                ingest_parquet_wall_s=round(t_par, 3),
+                ingest_parquet_rows_per_s=round(prows / t_par, 1),
+            )
+        finally:
+            os.unlink(ppath)
+    except Exception as e:
+        result["ingest_parquet_error"] = f"{type(e).__name__}: {e}"
 
 
 def _default_grid_section(result: dict) -> None:
