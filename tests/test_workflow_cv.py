@@ -246,3 +246,35 @@ def test_train_rejects_missing_nonnullable_response(rng):
     wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
     with pytest.raises(ValueError, match="non-nullable"):
         wf.train()
+
+
+def test_fit_fold_candidates_batched_matches_loop(rng):
+    """Workflow-CV's per-fold candidate training must produce the same
+    models whether it takes the batched grid dispatch or the per-candidate
+    loop."""
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    from transmogrifai_tpu.selector.model_selector import ModelSelector
+
+    n = 300
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + 0.4 * rng.randn(n) > 0).astype(float)
+    w = np.ones(n)
+
+    # LR-style grid -> fit_arrays_batched
+    lr = OpLogisticRegression(max_iter=8)
+    grid = [{"reg_param": 0.001}, {"reg_param": 0.1}]
+    batched = ModelSelector._fit_fold_candidates(lr, grid, X, y, w)
+    for pmap, params in zip(grid, batched):
+        single = lr.with_params(**pmap).fit_arrays(X, y, w)
+        assert np.allclose(params["beta"], single["beta"], atol=1e-5)
+
+    # tree grid -> fit_arrays_folds_grid single-fold row
+    rf = OpRandomForestClassifier(num_trees=4, max_depth=3, backend="jax")
+    tgrid = [{"min_info_gain": 0.0}, {"min_info_gain": 0.1}]
+    tb = ModelSelector._fit_fold_candidates(rf, tgrid, X, y, w)
+    for pmap, params in zip(tgrid, tb):
+        cand = rf.with_params(**pmap)
+        single = cand.fit_arrays(X, y, w)
+        _, _, pb = cand.predict_arrays(params, X)
+        _, _, ps = cand.predict_arrays(single, X)
+        assert np.allclose(pb, ps, atol=1e-5)

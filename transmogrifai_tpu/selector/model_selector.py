@@ -140,9 +140,12 @@ class ModelSelector(Estimator):
             wt = weights[tr_idx]
             gi = 0
             for est, grid in self.models:
-                for pmap in (list(grid) or [{}]):
+                grid = list(grid) or [{}]
+                fold_params = self._fit_fold_candidates(
+                    est, grid, Xt, yt, wt
+                )
+                for pmap, params in zip(grid, fold_params):
                     cand = est.with_params(**pmap)
-                    params = cand.fit_arrays(Xt, yt, wt)
                     pred, raw, prob = cand.predict_arrays(params, Xv)
                     m = self.validator._metric_of(yv, pred, raw, prob)
                     results.setdefault(gi, []).append(
@@ -177,6 +180,44 @@ class ModelSelector(Estimator):
         )
         self.best_override = result
         return result
+
+    @staticmethod
+    def _fit_fold_candidates(est, grid, Xt, yt, wt) -> list:
+        """Train one estimator's whole grid on one fold's train split with
+        the SAME batched dispatches the plain validator uses (folds differ
+        in data under workflow CV, so only the grid axis batches here):
+        LR-style grids ride fit_arrays_batched, tree grids ride
+        fit_arrays_folds_grid with a single fold row.  Falls back to
+        per-candidate fits for estimators with no batched path."""
+        from .validator import _lr_style_grid, lr_grid_scalars
+
+        g = len(grid)
+        if g > 1 and hasattr(est, "fit_arrays_batched") and _lr_style_grid(
+            grid
+        ):
+            import jax.numpy as jnp
+
+            # tile the [n] weight vector ON DEVICE: one transfer, not g
+            # identical host copies (same move as validator.py's batched
+            # branch)
+            W = jnp.repeat(
+                jnp.asarray(wt, jnp.float32)[None, :], g, axis=0
+            )
+            regs, ens = lr_grid_scalars(est, grid)
+            betas, b0s = est.fit_arrays_batched(Xt, yt, W, regs, ens)
+            return [
+                {"beta": betas[j], "intercept": float(b0s[j])}
+                for j in range(g)
+            ]
+        if g > 1 and hasattr(est, "fit_arrays_folds_grid"):
+            by_grid = est.fit_arrays_folds_grid(
+                Xt, yt, np.asarray(wt, np.float64)[None, :], grid
+            )
+            if by_grid is not None:
+                return [by_grid[j][0] for j in range(g)]
+        return [
+            est.with_params(**pmap).fit_arrays(Xt, yt, wt) for pmap in grid
+        ]
 
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
         from ..models.base import _check_label_mask

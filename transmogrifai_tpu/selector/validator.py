@@ -206,15 +206,9 @@ class OpValidator:
                 # [B, n] per-candidate weight matrix is tiled ON DEVICE
                 # (at 10M rows x 24 candidates that tiling is ~1 GB the
                 # tunnel never has to carry).
-                regs = np.array(
-                    [grid[j].get("reg_param", est.params.get("reg_param", 0.0))
-                     for f in range(k) for j in range(g)]
-                )
-                ens = np.array(
-                    [grid[j].get("elastic_net_param",
-                                 est.params.get("elastic_net_param", 0.0))
-                     for f in range(k) for j in range(g)]
-                )
+                regs_g, ens_g = lr_grid_scalars(est, grid)
+                regs = np.tile(regs_g, k)  # fold-major [k*g] replicas
+                ens = np.tile(ens_g, k)
                 Xj = jnp.asarray(X, jnp.float32)
                 trainj = jnp.asarray(masks).astype(jnp.float32)  # [k, n]
                 if weights is None:
@@ -376,6 +370,20 @@ def _lr_style_grid(grid: Sequence[dict]) -> bool:
     """Batched path applies when every grid key is a batched-fit scalar."""
     ok = {"reg_param", "elastic_net_param"}
     return all(set(p) <= ok for p in grid)
+
+
+def lr_grid_scalars(est, grid: Sequence[dict]) -> tuple[np.ndarray, np.ndarray]:
+    """Per-grid-point (regs, ens) for fit_arrays_batched, defaulting from
+    the estimator's params - the single source of the batched-LR grid
+    contract (shared by validate() and workflow-CV's per-fold path)."""
+    regs = np.array(
+        [p.get("reg_param", est.params.get("reg_param", 0.0)) for p in grid]
+    )
+    ens = np.array(
+        [p.get("elastic_net_param", est.params.get("elastic_net_param", 0.0))
+         for p in grid]
+    )
+    return regs, ens
 
 
 class OpCrossValidation(OpValidator):
