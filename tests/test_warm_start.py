@@ -1,0 +1,115 @@
+"""Warm start (reference: OpWorkflow.withModelStages:457): fitted stages
+swap into an extended workflow so only NEW estimators train."""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _fit_uids(model):
+    return {
+        m["stage_uid"] for m in model.app_metrics.to_json()["stages"]
+        if m["phase"] == "fit"
+    }
+
+
+def test_warm_start_skips_already_fitted_stages(rng):
+    n = 250
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "c": [("u", "v")[i % 2] for i in range(n)],
+    }
+    data["a"] = [ai + 2 * yi for ai, yi in zip(data["a"], data["y"])]
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a, c])
+    checked = y.sanity_check(vec, remove_bad_features=False)
+    lr_pred = (
+        OpLogisticRegression(max_iter=8, reg_param=0.01).set_input(y, checked).get_output()
+    )
+
+    wf1 = OpWorkflow().set_result_features(lr_pred).set_input_dataset(data)
+    m1 = wf1.train()
+    fitted_once = _fit_uids(m1)
+    assert fitted_once  # vectorizers + sanity checker + LR all fit
+
+    # extend the SAME feature graph with a new estimator and warm start
+    rf_pred = (
+        OpRandomForestClassifier(num_trees=5, max_depth=3)
+        .set_input(y, checked)
+        .get_output()
+    )
+    wf2 = (
+        OpWorkflow()
+        .set_result_features(lr_pred, rf_pred)
+        .set_input_dataset(data)
+        .with_model_stages(m1)
+    )
+    m2 = wf2.train()
+    refit = _fit_uids(m2)
+    # every previously fitted stage was warm: ONLY the new RF fit
+    assert not (refit & fitted_once), refit & fitted_once
+    assert len(refit) == 1
+    # warm LR predictions identical to the first training
+    p1 = m1.score(data)[lr_pred.name].probability
+    p2 = m2.score(data)[lr_pred.name].probability
+    assert np.allclose(p1, p2)
+    # and the new head actually works
+    assert rf_pred.name in m2.score(data)
+
+
+def test_warm_start_does_not_disable_workflow_cv_fold_refits(rng, monkeypatch):
+    """Warm substitution must never bypass with_workflow_cv's leakage
+    protection: the 'during' set comes from the FEATURE graph (original
+    estimators), so label-aware stages still refit inside every fold even
+    when their fitted counterparts were warmed into the main pass."""
+    from transmogrifai_tpu.preparators import sanity_checker as sc_mod
+    from transmogrifai_tpu.selector.factories import (
+        BinaryClassificationModelSelector,
+    )
+    from transmogrifai_tpu.selector.splitters import DataSplitter
+
+    n = 300
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "b": rng.randn(n).tolist(),
+    }
+    data["a"] = [ai + 2 * yi for ai, yi in zip(data["a"], data["y"])]
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([a, b])
+    checked = y.sanity_check(vec, remove_bad_features=True)
+    lr = OpLogisticRegression(reg_param=0.01).set_input(y, checked).get_output()
+    m1 = OpWorkflow().set_result_features(lr).set_input_dataset(data).train()
+
+    calls = {"n": 0}
+    orig_fit = sc_mod.SanityChecker.fit_model
+
+    def counting(self, cols, ds):
+        calls["n"] += 1
+        return orig_fit(self, cols, ds)
+
+    monkeypatch.setattr(sc_mod.SanityChecker, "fit_model", counting)
+
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models_and_parameters=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+        splitter=DataSplitter(reserve_test_fraction=0.1),
+    )
+    pred = sel.set_input(y, checked).get_output()
+    wf2 = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data)
+        .with_workflow_cv().with_model_stages(m1)
+    )
+    wf2.train()
+    assert calls["n"] == 3  # one leakage-free refit per fold
+    assert sel.best_override is not None

@@ -162,6 +162,7 @@ class OpWorkflow:
         self.parameters: dict[str, Any] = {}
         self._raw_feature_filter = None
         self._workflow_cv = False
+        self._warm_stages: dict[str, PipelineStage] = {}
         self.blacklisted_features: list[Feature] = []
         self.blacklisted_map_keys: dict[str, list[str]] = {}
         self.rff_results: Optional[dict] = None
@@ -263,6 +264,20 @@ class OpWorkflow:
         dag = compute_dag(self.result_features)
         validate_dag(dag)
 
+        if self._warm_stages:
+            # warm start: swap already-fitted stages (by uid) into the
+            # freshly computed layers, adopting the current wiring - they
+            # are Transformers, so fit_and_transform_dag will not refit
+            def _warm_sub(s):
+                w = self._warm_stages.get(s.uid)
+                if w is None or w is s:
+                    return s
+                w.input_features = s.input_features
+                w._output = s.get_output()
+                return w
+
+            dag = [[_warm_sub(s) for s in layer] for layer in dag]
+
         # non-nullable response gate (reference: .toRealNN throws on empty
         # values at extraction): a missing label must fail loudly here, not
         # silently train as class 0.0 behind its validity mask
@@ -335,16 +350,13 @@ class OpWorkflow:
         return sels[0] if sels else None
 
     def with_model_stages(self, model: "OpWorkflowModel") -> "OpWorkflow":
-        """Warm start: swap already-fitted stages into this workflow so only
-        new estimators retrain (reference: OpWorkflow.withModelStages:457)."""
-        fitted_by_uid = {s.uid: s for s in model.stages}
-        dag = compute_dag(self.result_features)
-        for layer in dag:
-            for i, stage in enumerate(layer):
-                if stage.uid in fitted_by_uid:
-                    repl = fitted_by_uid[stage.uid]
-                    repl.input_features = stage.input_features
-                    repl._output = stage._output
+        """Warm start: fitted stages from ``model`` replace their unfitted
+        counterparts (matched by uid) when this workflow trains, so only
+        NEW estimators fit (reference: OpWorkflow.withModelStages:457).
+        The substitution happens at train() time - compute_dag rebuilds
+        layers from the features on every call, so recording the uids here
+        and swapping inside train() is the only wiring that sticks."""
+        self._warm_stages = {s.uid: s for s in model.stages}
         return self
 
 
