@@ -213,6 +213,45 @@ def _build_descaler(n, rng):
     return out, {"a": _values(ft.Real, n, rng)}
 
 
+def _build_prediction_descaler(n, rng):
+    from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+    from transmogrifai_tpu.ops.collections import (
+        PredictionDescaler,
+        ScalerTransformer,
+    )
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+
+    data = _predictor_data(n, rng, "reg")
+    y = _raw("y", ft.RealNN, response=True)
+    xs = [_raw(f"x{i}", ft.Real) for i in (1, 2, 3)]
+    vec = RealVectorizer().set_input(*xs).get_output()
+    scaled = ScalerTransformer(scaling_type="linear", slope=2.0,
+                               intercept=1.0).set_input(y).get_output()
+    pred = OpLinearRegression().set_input(scaled, vec).get_output()
+    out = PredictionDescaler().set_input(pred, scaled).get_output()
+    return out, data
+
+
+def _build_dt_map_bucketizer(n, rng):
+    from transmogrifai_tpu.ops.bucketizers import (
+        DecisionTreeNumericMapBucketizer,
+    )
+
+    maps, ys = [], []
+    for _ in range(n):
+        v = float(rng.randn())
+        m = {"k1": v}
+        if rng.rand() < 0.7:
+            m["k2"] = float(rng.randn())
+        maps.append(m)
+        ys.append(float(v + 0.3 * rng.randn() > 0))
+    lab = _raw("y", ft.RealNN, response=True)
+    xf = _raw("m", ft.RealMap)
+    out = (DecisionTreeNumericMapBucketizer(max_depth=2)
+           .set_input(lab, xf).get_output())
+    return out, {"y": ys, "m": maps}
+
+
 def _build_drop_indices(n, rng):
     from transmogrifai_tpu.ops.combiner import DropIndicesByTransformer
     from transmogrifai_tpu.ops.numeric import RealVectorizer
@@ -321,6 +360,7 @@ def _specs():
     from transmogrifai_tpu.ops import text_analysis as ta
     from transmogrifai_tpu.ops.bucketizers import (
         DecisionTreeNumericBucketizer,
+        DecisionTreeNumericMapBucketizer,
         NumericBucketizer,
     )
     from transmogrifai_tpu.ops.categorical import (
@@ -337,7 +377,11 @@ def _specs():
     from transmogrifai_tpu.ops.combiner import AliasTransformer
     from transmogrifai_tpu.ops.dates import DateVectorizer
     from transmogrifai_tpu.ops.geo import GeolocationVectorizer
-    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.ops.maps import (
+        MapVectorizer,
+        TextMapLenEstimator,
+        TextMapNullEstimator,
+    )
     from transmogrifai_tpu.ops.numeric import (
         BinaryVectorizer,
         IntegralVectorizer,
@@ -350,8 +394,10 @@ def _specs():
         PercentileCalibrator,
     )
     from transmogrifai_tpu.ops.text import (
+        OpCountVectorizer,
         SmartTextVectorizer,
         TextListHashingVectorizer,
+        TextListNullTransformer,
         TextTokenizer,
     )
 
@@ -386,6 +432,15 @@ def _specs():
         "LangDetector": _wire_simple(ta.LangDetector, [ft.Text]),
         "MimeTypeDetector": _wire_simple(ta.MimeTypeDetector, [ft.Base64]),
         "NGramSimilarity": _wire_simple(ta.NGramSimilarity, [ft.Text, ft.Text]),
+        "SetNGramSimilarity": _wire_simple(
+            ta.SetNGramSimilarity, [ft.MultiPickList, ft.MultiPickList]),
+        "IsValidPhoneMapDefaultCountry": _wire_simple(
+            ta.IsValidPhoneMapDefaultCountry, [ft.PhoneMap]),
+        "MimeTypeMapDetector": _wire_simple(
+            ta.MimeTypeMapDetector, [ft.Base64Map]),
+        "TextListNullTransformer": _wire_vectorizer(
+            TextListNullTransformer, ft.TextList),
+        "PredictionDescaler": _build_prediction_descaler,
         "NameEntityRecognizer": _wire_simple(ta.NameEntityRecognizer, [ft.Text]),
         "PhoneNumberParser": _wire_simple(ta.PhoneNumberParser, [ft.Phone]),
         "TextLenTransformer": _wire_simple(ta.TextLenTransformer, [ft.Text]),
@@ -425,6 +480,14 @@ def _specs():
         "DecisionTreeNumericBucketizer": _wire_labeled(
             DecisionTreeNumericBucketizer, ft.Real,
             ctor=lambda: DecisionTreeNumericBucketizer(max_depth=2)),
+        "DecisionTreeNumericMapBucketizer": _build_dt_map_bucketizer,
+        "TextMapLenEstimator": _wire_vectorizer(TextMapLenEstimator,
+                                                ft.TextMap),
+        "TextMapNullEstimator": _wire_vectorizer(TextMapNullEstimator,
+                                                 ft.TextMap),
+        "OpCountVectorizer": _wire_simple(
+            OpCountVectorizer, [ft.TextList],
+            ctor=lambda: OpCountVectorizer(vocab_size=10)),
         "IsotonicRegressionCalibrator": _wire_labeled(
             IsotonicRegressionCalibrator, ft.Real),
         "SanityChecker": _build_sanity_checker,

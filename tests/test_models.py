@@ -224,3 +224,48 @@ def test_grid_batched_forest_matches_per_config(rng):
             predb = cand.predict_arrays(pb, X)[0]
             preds = cand.predict_arrays(ps, X)[0]
             np.testing.assert_array_equal(predb, preds)
+
+
+def test_watchdog_chunked_dispatch_parity(rng, monkeypatch):
+    """The host chunking that keeps each device program under the runtime
+    watchdog (tree_kernel.fits_per_dispatch; the tunneled TPU runtime
+    kills ~2-minute programs) must be bit-identical to one big dispatch:
+    trees/grid points/folds are independent, and GBT chunks carry the
+    boosting margin."""
+    n, d = 240, 5
+    X = rng.randn(n, d)
+    y = ((X[:, 0] - X[:, 2]) > 0).astype(np.float64)
+    W = np.stack([np.r_[np.ones(160), np.zeros(80)],
+                  np.r_[np.zeros(80), np.ones(160)]]).astype(np.float32)
+    grid = [
+        {"min_info_gain": 0.0, "min_instances_per_node": 1},
+        {"min_info_gain": 0.02, "min_instances_per_node": 4},
+        {"min_info_gain": 0.1, "min_instances_per_node": 1},
+    ]
+
+    def run_all():
+        rf = OpRandomForestClassifier(num_trees=5, max_depth=4, backend="jax")
+        rf_grid = rf.fit_arrays_folds_grid(X, y, W, grid)
+        rf_single = rf.fit_arrays(X, y)
+        gbt = OpGBTClassifier(num_trees=6, max_depth=3, backend="jax")
+        gbt_grid = gbt.fit_arrays_folds_grid(X, y, W, grid)
+        gbt_single = gbt.fit_arrays(X, y)
+        return rf_grid, rf_single, gbt_grid, gbt_single
+
+    monkeypatch.setenv("TX_TREE_FITS_PER_DISPATCH", "100000")
+    big = run_all()
+    monkeypatch.setenv("TX_TREE_FITS_PER_DISPATCH", "3")
+    small = run_all()
+
+    for b, s in zip(big, small):
+        if isinstance(b, dict):  # single-fit params
+            for hb, hs in zip(b["heaps"], s["heaps"]):
+                np.testing.assert_array_equal(np.asarray(hb), np.asarray(hs))
+            if "f0" in b:
+                assert b["f0"] == pytest.approx(s["f0"], abs=1e-7)
+        else:  # grid results: [G][F] param dicts
+            for cb, cs in zip(b, s):
+                for fb, fs in zip(cb, cs):
+                    for hb, hs in zip(fb["heaps"], fs["heaps"]):
+                        np.testing.assert_array_equal(
+                            np.asarray(hb), np.asarray(hs))

@@ -23,11 +23,56 @@ weight vector, like the linear models).
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Watchdog-safe dispatch sizing
+# ---------------------------------------------------------------------------
+def _tree_fit_work(depth: int, n: int, d: int, max_bins: int,
+                   n_stats: int) -> float:
+    """Estimated device work units for ONE tree fit: the per-level
+    (row, feature) scatter plus the exponentially-growing split search."""
+    scatter = (depth + 1.0) * float(n) * d * (n_stats + 1.0)
+    split = (2.0 ** depth) * d * max_bins * (6.0 * n_stats + 3.0)
+    return scatter + split
+
+
+def fits_per_dispatch(depth: int, n: int, d: int, max_bins: int,
+                      n_stats: int) -> int:
+    """How many tree fits may share one device program.
+
+    The tunneled TPU runtime kills device programs that run longer than
+    ~2 minutes ("TPU worker crashed or restarted" - observed twice on v5e
+    2026-07-30: a 1800-fit depth-12 grid dispatch died at ~120 s).  The
+    batched CV fan-outs therefore chunk on the host so each program stays
+    well under that; results are bit-identical because trees/grid points/
+    folds are independent (and boosting chunks carry the margin).
+    ``TX_TREE_FITS_PER_DISPATCH`` overrides the cap directly;
+    ``TX_TREE_DISPATCH_BUDGET_S`` adjusts the target seconds (default 30,
+    calibrated at ~2e9 work units/s: 0.12-0.35 s per depth-12
+    Titanic-width fit on v5e)."""
+    override = int(os.environ.get("TX_TREE_FITS_PER_DISPATCH", "0"))
+    if override > 0:
+        return override
+    budget_s = float(os.environ.get("TX_TREE_DISPATCH_BUDGET_S", "30"))
+    rate = 2.0e9
+    per_fit = _tree_fit_work(depth, n, d, max_bins, n_stats)
+    return max(1, int(budget_s * rate / max(per_fit, 1.0)))
+
+
+def _concat_heaps(parts: list, axis: int):
+    if len(parts) == 1:
+        return parts[0]
+    return tuple(
+        jnp.concatenate([p[i] for p in parts], axis=axis)
+        for i in range(len(parts[0]))
+    )
 
 
 def quantile_bin_edges(X: np.ndarray, max_bins: int) -> np.ndarray:
@@ -205,20 +250,14 @@ def predict_tree(
 # ---------------------------------------------------------------------------
 # Forest = vmap over trees; fit + predict batched
 # ---------------------------------------------------------------------------
-@partial(
-    jax.jit,
-    static_argnames=(
-        "max_depth", "max_bins", "impurity_kind", "n_stats", "feature_subset_p"
-    ),
-)
-def fit_forest(
+def _fit_forest_core(
     bins, stats_row, w_row,
     boot_w,       # [T, n] bootstrap weights per tree
     feat_masks,   # [T, d]
     rng_keys,     # [T, 2] uint32 per-tree keys
     max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
-    min_instances_per_node: float = 1.0,
-    min_info_gain: float = 0.0,
+    min_instances_per_node=1.0,
+    min_info_gain=0.0,
     feature_subset_p: float = 1.0,
 ):
     def one(args):
@@ -237,27 +276,50 @@ def fit_forest(
     return jax.lax.map(one, (boot_w, feat_masks, rng_keys))
 
 
-@partial(
+_fit_forest_jit = partial(
     jax.jit,
     static_argnames=(
         "max_depth", "max_bins", "impurity_kind", "n_stats", "feature_subset_p"
     ),
-)
-def fit_forest_folds(
-    bins, stats_row, w_rows,  # w_rows [F, n]: one weight vector per CV fold
-    boot_w, feat_masks, rng_keys,
+)(_fit_forest_core)
+
+
+def fit_forest(
+    bins, stats_row, w_row, boot_w, feat_masks, rng_keys,
     max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
     min_instances_per_node: float = 1.0,
     min_info_gain: float = 0.0,
     feature_subset_p: float = 1.0,
 ):
-    """CV fan-out for forests: folds ride the weight axis exactly like the
-    linear models' vmapped Newton fits - binning and the design matrix are
-    shared, only the [F, n] weight masks differ.  (Replaces the reference's
-    per-fold Spark jobs, OpValidator.scala:289-306.)"""
+    """Forest fit, host-chunked over trees so one device program stays
+    under the runtime watchdog (see fits_per_dispatch)."""
+    T = boot_w.shape[0]
+    n, d = bins.shape
+    cap = fits_per_dispatch(max_depth, n, d, max_bins, n_stats)
+    parts = []
+    for t0 in range(0, T, cap):
+        t1 = min(t0 + cap, T)
+        parts.append(_fit_forest_jit(
+            bins, stats_row, w_row,
+            boot_w[t0:t1], feat_masks[t0:t1], rng_keys[t0:t1],
+            max_depth=max_depth, max_bins=max_bins,
+            impurity_kind=impurity_kind, n_stats=n_stats,
+            min_instances_per_node=min_instances_per_node,
+            min_info_gain=min_info_gain,
+            feature_subset_p=feature_subset_p,
+        ))
+    return _concat_heaps(parts, axis=0)
 
+
+def _fit_forest_folds_core(
+    bins, stats_row, w_rows, boot_w, feat_masks, rng_keys,
+    max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
+    min_instances_per_node=1.0,
+    min_info_gain=0.0,
+    feature_subset_p: float = 1.0,
+):
     def one_fold(w):
-        return fit_forest(
+        return _fit_forest_core(
             bins, stats_row, w, boot_w, feat_masks, rng_keys,
             max_depth, max_bins, impurity_kind, n_stats,
             min_instances_per_node, min_info_gain, feature_subset_p,
@@ -266,33 +328,56 @@ def fit_forest_folds(
     return jax.vmap(one_fold)(w_rows)
 
 
-@partial(
+_fit_forest_folds_jit = partial(
     jax.jit,
     static_argnames=(
         "max_depth", "max_bins", "impurity_kind", "n_stats", "feature_subset_p"
     ),
-)
-def fit_forest_folds_grid(
-    bins, stats_row, w_rows,      # w_rows [F, n] fold weights
+)(_fit_forest_folds_core)
+
+
+def fit_forest_folds(
+    bins, stats_row, w_rows,  # w_rows [F, n]: one weight vector per CV fold
     boot_w, feat_masks, rng_keys,
+    max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
+    min_instances_per_node=1.0,
+    min_info_gain=0.0,
+    feature_subset_p: float = 1.0,
+):
+    """CV fan-out for forests: folds ride the weight axis exactly like the
+    linear models' vmapped Newton fits - binning and the design matrix are
+    shared, only the [F, n] weight masks differ.  (Replaces the reference's
+    per-fold Spark jobs, OpValidator.scala:289-306.)  Host-chunked over
+    trees so F x T' fits per device program stay under the watchdog."""
+    F = w_rows.shape[0]
+    T = boot_w.shape[0]
+    n, d = bins.shape
+    cap = fits_per_dispatch(max_depth, n, d, max_bins, n_stats)
+    t_cap = max(1, cap // max(F, 1))
+    parts = []
+    for t0 in range(0, T, t_cap):
+        t1 = min(t0 + t_cap, T)
+        parts.append(_fit_forest_folds_jit(
+            bins, stats_row, w_rows,
+            boot_w[t0:t1], feat_masks[t0:t1], rng_keys[t0:t1],
+            max_depth=max_depth, max_bins=max_bins,
+            impurity_kind=impurity_kind, n_stats=n_stats,
+            min_instances_per_node=min_instances_per_node,
+            min_info_gain=min_info_gain,
+            feature_subset_p=feature_subset_p,
+        ))
+    return _concat_heaps(parts, axis=1)
+
+
+def _fit_forest_folds_grid_core(
+    bins, stats_row, w_rows, boot_w, feat_masks, rng_keys,
     min_instances_g, min_info_gain_g,  # [G] per-grid-point TRACED scalars
     max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
     feature_subset_p: float = 1.0,
 ):
-    """Grid x fold forest fan-out in ONE dispatch.
-
-    min_instances_per_node / min_info_gain are traced scalars in fit_tree,
-    so every grid point sharing the static shape params (depth, bins,
-    trees, subset strategy) batches along a sequential lax.map axis over
-    the fold-vmapped fit - a 16-config RF grid x 3 folds compiles once and
-    dispatches once instead of 16 host-loop iterations (reference
-    counterpart: the Future pool training all paramMap variants
-    concurrently, OpValidator.scala:289-306).  Returns heaps with leading
-    axes [G, F, T, ...]."""
-
     def one_cfg(args):
         minipn, minig = args
-        return fit_forest_folds(
+        return _fit_forest_folds_core(
             bins, stats_row, w_rows, boot_w, feat_masks, rng_keys,
             max_depth, max_bins, impurity_kind, n_stats,
             minipn, minig, feature_subset_p,
@@ -303,33 +388,86 @@ def fit_forest_folds_grid(
     return jax.lax.map(one_cfg, (min_instances_g, min_info_gain_g))
 
 
-@partial(
+_fit_forest_folds_grid_jit = partial(
     jax.jit,
-    static_argnames=("num_trees", "max_depth", "max_bins", "is_classification"),
-)
-def fit_gbt_folds(
-    bins, y, w_rows,           # w_rows [F, n]: one weight vector per CV fold
+    static_argnames=(
+        "max_depth", "max_bins", "impurity_kind", "n_stats", "feature_subset_p"
+    ),
+)(_fit_forest_folds_grid_core)
+
+
+def fit_forest_folds_grid(
+    bins, stats_row, w_rows,      # w_rows [F, n] fold weights
+    boot_w, feat_masks, rng_keys,
+    min_instances_g, min_info_gain_g,  # [G] per-grid-point TRACED scalars
+    max_depth: int, max_bins: int, impurity_kind: str, n_stats: int,
+    feature_subset_p: float = 1.0,
+):
+    """Grid x fold forest fan-out.
+
+    min_instances_per_node / min_info_gain are traced scalars in fit_tree,
+    so every grid point sharing the static shape params (depth, bins,
+    trees, subset strategy) batches along a sequential lax.map axis over
+    the fold-vmapped fit - a 16-config RF grid x 3 folds compiles once
+    instead of 16 host-loop iterations (reference counterpart: the Future
+    pool training all paramMap variants concurrently,
+    OpValidator.scala:289-306).  The G x F x T fit product is host-chunked
+    over grid points (and, for deep trees, over trees) so each device
+    program stays under the runtime watchdog.  Returns heaps with leading
+    axes [G, F, T, ...]."""
+    G = int(min_instances_g.shape[0])
+    F = w_rows.shape[0]
+    T = boot_w.shape[0]
+    n, d = bins.shape
+    cap = fits_per_dispatch(max_depth, n, d, max_bins, n_stats)
+    if F * T <= cap:
+        g_cap = max(1, cap // max(F * T, 1))
+        parts = []
+        for g0 in range(0, G, g_cap):
+            g1 = min(g0 + g_cap, G)
+            parts.append(_fit_forest_folds_grid_jit(
+                bins, stats_row, w_rows, boot_w, feat_masks, rng_keys,
+                min_instances_g[g0:g1], min_info_gain_g[g0:g1],
+                max_depth=max_depth, max_bins=max_bins,
+                impurity_kind=impurity_kind, n_stats=n_stats,
+                feature_subset_p=feature_subset_p,
+            ))
+        return _concat_heaps(parts, axis=0)
+    # deep/expensive trees: one grid point at a time, trees chunked inside
+    g_parts = []
+    for g in range(G):
+        heaps = fit_forest_folds(
+            bins, stats_row, w_rows, boot_w, feat_masks, rng_keys,
+            max_depth=max_depth, max_bins=max_bins,
+            impurity_kind=impurity_kind, n_stats=n_stats,
+            min_instances_per_node=min_instances_g[g],
+            min_info_gain=min_info_gain_g[g],
+            feature_subset_p=feature_subset_p,
+        )
+        g_parts.append(tuple(h[None] for h in heaps))
+    return _concat_heaps(g_parts, axis=0)
+
+
+@partial(jax.jit, static_argnames=("is_classification",))
+def _gbt_f0(y, w_rows, is_classification: bool):
+    """Per-fold initial margin [F] (weighted base rate / mean)."""
+    wsum = jnp.maximum(w_rows.sum(axis=1), 1e-12)
+    ybar = (w_rows * y[None, :]).sum(axis=1) / wsum
+    if is_classification:
+        pbar = jnp.clip(ybar, 1e-6, 1 - 1e-6)
+        return jnp.log(pbar / (1.0 - pbar))
+    return ybar
+
+
+def _gbt_folds_scan_core(
+    bins, y, w_rows, margins,  # margins [F, n]: boosting state carried in
     num_trees: int, max_depth: int, max_bins: int, is_classification: bool,
     step_size, min_instances_per_node, min_info_gain,  # traced scalars
 ):
-    """GBT CV fan-out: folds ride the weight axis through the boosting
-    scan, exactly like fit_forest_folds - binning and the design matrix
-    are shared, only the [F, n] fold masks differ.  step_size /
-    min_instances / min_info_gain are traced, so grid points sharing the
-    static shape params (num_trees, depth, bins) can batch over them too
-    (fit_gbt_folds_grid).  Returns (f0 [F], heaps with leading [F, T]).
-    """
     n, d = bins.shape
     feat_mask = jnp.ones((d,), dtype=bool)
 
-    def one_fold(w):
-        wsum = jnp.maximum(w.sum(), 1e-12)
-        if is_classification:
-            pbar = jnp.clip((w * y).sum() / wsum, 1e-6, 1 - 1e-6)
-            f0 = jnp.log(pbar / (1.0 - pbar))
-        else:
-            f0 = (w * y).sum() / wsum
-
+    def one_fold(w, m):
         def body(F, _):
             if is_classification:
                 pr = jax.nn.sigmoid(F)
@@ -349,36 +487,113 @@ def fit_gbt_folds(
             leaf_val = out[:, 1] / jnp.maximum(out[:, 3], 1e-12)
             return F + step_size * leaf_val, heap
 
-        _, heaps = jax.lax.scan(
-            body, jnp.full((n,), f0), None, length=num_trees
-        )
-        return f0, heaps
+        return jax.lax.scan(body, m, None, length=num_trees)
 
-    return jax.vmap(one_fold)(w_rows)
+    return jax.vmap(one_fold)(w_rows, margins)
 
 
-@partial(
+_gbt_folds_scan = partial(
     jax.jit,
     static_argnames=("num_trees", "max_depth", "max_bins", "is_classification"),
-)
+)(_gbt_folds_scan_core)
+
+
+def fit_gbt_folds(
+    bins, y, w_rows,           # w_rows [F, n]: one weight vector per CV fold
+    num_trees: int, max_depth: int, max_bins: int, is_classification: bool,
+    step_size, min_instances_per_node, min_info_gain,  # traced scalars
+):
+    """GBT CV fan-out: folds ride the weight axis through the boosting
+    scan, exactly like fit_forest_folds - binning and the design matrix
+    are shared, only the [F, n] fold masks differ.  step_size /
+    min_instances / min_info_gain are traced, so grid points sharing the
+    static shape params (num_trees, depth, bins) can batch over them too
+    (fit_gbt_folds_grid).  The sequential boosting scan is host-chunked
+    with the margin carried between chunks (bit-identical to one scan) so
+    each device program stays under the runtime watchdog.  Returns
+    (f0 [F], heaps with leading [F, T])."""
+    F = w_rows.shape[0]
+    n, d = bins.shape
+    y = jnp.asarray(y, jnp.float32)
+    f0s = _gbt_f0(y, w_rows, is_classification)
+    cap = fits_per_dispatch(max_depth, n, d, max_bins, 4)
+    t_cap = max(1, cap // max(F, 1))
+    margins = jnp.broadcast_to(f0s[:, None], (F, n))
+    parts = []
+    for t0 in range(0, num_trees, t_cap):
+        ln = min(t_cap, num_trees - t0)
+        margins, heaps = _gbt_folds_scan(
+            bins, y, w_rows, margins,
+            num_trees=ln, max_depth=max_depth, max_bins=max_bins,
+            is_classification=is_classification,
+            step_size=step_size,
+            min_instances_per_node=min_instances_per_node,
+            min_info_gain=min_info_gain,
+        )
+        parts.append(heaps)
+    return f0s, _concat_heaps(parts, axis=1)
+
+
+def _gbt_grid_scan_core(
+    bins, y, w_rows, margins_g,  # margins_g [G, F, n]
+    step_g, min_instances_g, min_info_gain_g,
+    num_trees: int, max_depth: int, max_bins: int, is_classification: bool,
+):
+    def one_cfg(args):
+        m_g, ss, mi, mg = args
+        return _gbt_folds_scan_core(
+            bins, y, w_rows, m_g, num_trees, max_depth, max_bins,
+            is_classification, ss, mi, mg,
+        )
+
+    return jax.lax.map(
+        one_cfg, (margins_g, step_g, min_instances_g, min_info_gain_g)
+    )
+
+
+_gbt_grid_scan = partial(
+    jax.jit,
+    static_argnames=("num_trees", "max_depth", "max_bins", "is_classification"),
+)(_gbt_grid_scan_core)
+
+
 def fit_gbt_folds_grid(
     bins, y, w_rows,
     step_g, min_instances_g, min_info_gain_g,  # [G] traced per-grid-point
     num_trees: int, max_depth: int, max_bins: int, is_classification: bool,
 ):
-    """Grid x fold GBT fan-out in one dispatch: sequential lax.map over the
-    traced grid scalars around the fold-vmapped boosting scan (same shape
-    discipline as fit_forest_folds_grid).  Returns (f0 [G, F], heaps with
-    leading [G, F, T])."""
-
-    def one_cfg(args):
-        ss, mi, mg = args
-        return fit_gbt_folds(
-            bins, y, w_rows, num_trees, max_depth, max_bins,
-            is_classification, ss, mi, mg,
-        )
-
-    return jax.lax.map(one_cfg, (step_g, min_instances_g, min_info_gain_g))
+    """Grid x fold GBT fan-out: sequential lax.map over the traced grid
+    scalars around the fold-vmapped boosting scan (same shape discipline
+    as fit_forest_folds_grid), host-chunked over grid points and boosting
+    segments (margins carried) to stay under the runtime watchdog.
+    Returns (f0 [G, F], heaps with leading [G, F, T])."""
+    G = int(step_g.shape[0])
+    F = w_rows.shape[0]
+    n, d = bins.shape
+    y = jnp.asarray(y, jnp.float32)
+    f0s = _gbt_f0(y, w_rows, is_classification)          # same for all g
+    cap = fits_per_dispatch(max_depth, n, d, max_bins, 4)
+    g_cap = max(1, cap // max(F * num_trees, 1))
+    t_cap = max(1, cap // max(F, 1))
+    g_parts = []
+    for g0 in range(0, G, g_cap):
+        g1 = min(g0 + g_cap, G)
+        margins = jnp.broadcast_to(f0s[None, :, None], (g1 - g0, F, n))
+        t_parts = []
+        for t0 in range(0, num_trees, t_cap):
+            ln = min(t_cap, num_trees - t0)
+            margins, heaps = _gbt_grid_scan(
+                bins, y, w_rows, margins,
+                step_g[g0:g1], min_instances_g[g0:g1],
+                min_info_gain_g[g0:g1],
+                num_trees=ln, max_depth=max_depth, max_bins=max_bins,
+                is_classification=is_classification,
+            )
+            t_parts.append(heaps)
+        g_parts.append(_concat_heaps(t_parts, axis=2))
+    heaps = _concat_heaps(g_parts, axis=0)
+    f0_gf = jnp.broadcast_to(f0s[None, :], (G, F))
+    return f0_gf, heaps
 
 
 def effective_max_depth(

@@ -29,7 +29,6 @@ from .tree_kernel import (
     fit_forest_folds_grid,
     fit_gbt_folds,
     fit_gbt_folds_grid,
-    fit_tree,
     heap_impurity_importances,
     predict_forest,
     predict_tree,
@@ -38,11 +37,18 @@ from .tree_kernel import (
 )
 
 
-def _resolve_backend(requested: str) -> str:
+_NATIVE_ROWS_CUTOFF = 50_000  # below this, host C++ beats device round-trips
+
+
+def _resolve_backend(requested: str, n_rows: int | None = None) -> str:
     """'jax' | 'native' | 'auto'.  auto = the C++ host learner when no
-    accelerator is attached (local/CPU runs - the Spark-local analog) and
-    the device histogram kernels when a TPU is; TX_TREE_BACKEND overrides.
-    """
+    accelerator is attached (local/CPU runs - the Spark-local analog) OR
+    when the dataset is small enough (< TX_TREE_NATIVE_ROWS, default 50k)
+    that per-dispatch latency + compile dominates any device win - a
+    712-row Titanic grid takes ~16 s through the C++ learner vs minutes
+    of chunked device dispatches; the device histogram kernels take over
+    at the row counts where the one-segment-sum scatter actually pays.
+    TX_TREE_BACKEND overrides."""
     requested = os.environ.get("TX_TREE_BACKEND", requested)
     if requested == "native":
         return "native" if native_trees.available() else "jax"
@@ -51,7 +57,13 @@ def _resolve_backend(requested: str) -> str:
             on_cpu = jax.default_backend() == "cpu"
         except Exception:
             on_cpu = True
-        return "native" if (on_cpu and native_trees.available()) else "jax"
+        cutoff = int(os.environ.get("TX_TREE_NATIVE_ROWS",
+                                    _NATIVE_ROWS_CUTOFF))
+        small = n_rows is not None and n_rows < cutoff
+        return (
+            "native" if ((on_cpu or small) and native_trees.available())
+            else "jax"
+        )
     return "jax"
 
 
@@ -254,7 +266,7 @@ class _RandomForest(_TreeEnsembleBase):
         w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
         (edges, bins, stats, C, imp, classes, boot, feat_masks,
          seed_ints, subset_p, depth) = self._forest_inputs(X, y)
-        backend = _resolve_backend(str(p.get("backend", "auto")))
+        backend = _resolve_backend(str(p.get("backend", "auto")), n)
         if backend == "native":
             heaps = native_trees.fit_forest(
                 bins, stats, w, boot, feat_masks,
@@ -291,7 +303,7 @@ class _RandomForest(_TreeEnsembleBase):
         p = self.params
         (edges, bins, stats, C, imp, classes, boot, feat_masks,
          seed_ints, subset_p, depth) = self._forest_inputs(X, y)
-        backend = _resolve_backend(str(p.get("backend", "auto")))
+        backend = _resolve_backend(str(p.get("backend", "auto")), X.shape[0])
         if backend == "native":
             W = np.asarray(W, np.float32)
             out = []
@@ -348,7 +360,8 @@ class _RandomForest(_TreeEnsembleBase):
         active (its per-config C++ loop is already the fast path there).
         """
         p0 = self.params
-        if _resolve_backend(str(p0.get("backend", "auto"))) == "native":
+        if _resolve_backend(str(p0.get("backend", "auto")),
+                            X.shape[0]) == "native":
             return None
         n, d = X.shape
         cands = [self.with_params(**pmap) for pmap in grid]
@@ -407,12 +420,16 @@ class _RandomForest(_TreeEnsembleBase):
         return results
 
     def predict_arrays(self, params: Any, X: np.ndarray):
-        bins = _bin_for_backend(np.asarray(X, np.float32), params["edges"])
         out = None
-        if _resolve_backend(str(self.params.get("backend", "auto"))) == "native":
+        if _resolve_backend(str(self.params.get("backend", "auto")),
+                            X.shape[0]) == "native":
+            bins = bin_data(np.asarray(X, np.float32), params["edges"])
             out = native_trees.predict_forest(
                 bins, params["heaps"], params["max_depth"]
             )
+        else:
+            bins = _bin_for_backend(np.asarray(X, np.float32),
+                                    params["edges"])
         if out is None:
             out = np.asarray(
                 predict_forest(
@@ -532,7 +549,7 @@ class _GBT(_TreeEnsembleBase):
         p = self.params
         w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
         edges = _sampled_bin_edges(X, int(p["max_bins"]), int(p["seed"]))
-        backend = _resolve_backend(str(p.get("backend", "auto")))
+        backend = _resolve_backend(str(p.get("backend", "auto")), n)
         if backend == "native":
             result = self._fit_native(X, y, w, edges)
             if result is not None:
@@ -547,45 +564,24 @@ class _GBT(_TreeEnsembleBase):
             d, int(p["max_bins"]), 4, cap=str(p.get("depth_cap", "auto")),
         )
         max_bins = int(p["max_bins"])
-        minipn = float(p["min_instances_per_node"])
-        minig = float(p["min_info_gain"])
-        is_cls = self.is_classification
-        feat_mask = jnp.ones((d,), dtype=bool)
-
-        wsum = jnp.maximum(wj.sum(), 1e-12)
-        if is_cls:
-            pbar = jnp.clip((wj * yj).sum() / wsum, 1e-6, 1 - 1e-6)
-            f0 = jnp.log(pbar / (1.0 - pbar))
-        else:
-            f0 = (wj * yj).sum() / wsum
-
-        def body(F, _):
-            if is_cls:
-                pr = jax.nn.sigmoid(F)
-                g = yj - pr               # negative gradient of logloss
-                h = jnp.maximum(pr * (1.0 - pr), 1e-6)  # hessian
-            else:
-                g = yj - F
-                h = jnp.ones_like(g)
-            # channels: [w, wg, wgg, wh]; impurity uses the first three
-            # (variance of g, Friedman-style), leaf value is the Newton step
-            # sum(wg)/sum(wh)
-            stats = jnp.stack([jnp.ones_like(g), g, g * g, h], axis=1)
-            heap = fit_tree(
-                bins, stats, wj, feat_mask,
-                max_depth, max_bins, "variance", 4, minipn, minig,
-            )
-            hf, ht, hl, hv = heap
-            out = predict_tree(bins, hf, ht, hl, hv, max_depth)
-            leaf_val = out[:, 1] / jnp.maximum(out[:, 3], 1e-12)
-            return F + lr * leaf_val, heap
-
-        F0 = jnp.full((n,), f0)
-        _, heaps = jax.lax.scan(body, F0, None, length=T)
+        # one-fold ride through the chunked boosting kernel: the margin-
+        # carried host chunking keeps each device program under the
+        # runtime watchdog (tree_kernel.fits_per_dispatch), and the
+        # channel semantics live in one place ([w, wg, wgg, wh] stats,
+        # Friedman variance impurity, Newton leaf sum(wg)/sum(wh))
+        f0s, heaps = fit_gbt_folds(
+            bins, yj, wj[None, :],
+            num_trees=T, max_depth=max_depth, max_bins=max_bins,
+            is_classification=self.is_classification,
+            step_size=jnp.asarray(lr),
+            min_instances_per_node=jnp.asarray(
+                float(p["min_instances_per_node"])),
+            min_info_gain=jnp.asarray(float(p["min_info_gain"])),
+        )
         return {
             "edges": edges,
-            "heaps": tuple(np.asarray(h) for h in heaps),
-            "f0": float(f0),
+            "heaps": tuple(np.asarray(h[0]) for h in heaps),
+            "f0": float(np.asarray(f0s)[0]),
             "max_depth": max_depth,
             "step_size": lr,
         }
@@ -606,7 +602,7 @@ class _GBT(_TreeEnsembleBase):
         p = self.params
         W = np.asarray(W, np.float32)
         edges = _sampled_bin_edges(X, int(p["max_bins"]), int(p["seed"]))
-        backend = _resolve_backend(str(p.get("backend", "auto")))
+        backend = _resolve_backend(str(p.get("backend", "auto")), n)
         if backend == "native":
             bins_host = bin_data(np.asarray(X, np.float32), edges)
             out = []
@@ -654,7 +650,8 @@ class _GBT(_TreeEnsembleBase):
         variants concurrently on its Future pool, OpValidator.scala:
         289-306).  None on the native host backend."""
         p0 = self.params
-        if _resolve_backend(str(p0.get("backend", "auto"))) == "native":
+        if _resolve_backend(str(p0.get("backend", "auto")),
+                            X.shape[0]) == "native":
             return None
         n, d = X.shape
         cands = [self.with_params(**pmap) for pmap in grid]

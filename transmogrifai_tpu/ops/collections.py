@@ -18,6 +18,7 @@ from ..types.columns import (
     ListColumn,
     MapColumn,
     NumericColumn,
+    PredictionColumn,
     TextColumn,
 )
 from ..types.dataset import Dataset
@@ -25,6 +26,7 @@ from ..types.feature_types import (
     Binary,
     FeatureType,
     OPMap,
+    Prediction,
     Real,
     RealNN,
 )
@@ -132,6 +134,25 @@ class ScalerTransformer(Transformer):
         return NumericColumn(np.where(col.mask, vals, 0.0), col.mask, RealNN)
 
 
+def _descale(values: np.ndarray, info: dict) -> np.ndarray:
+    """Inverse of ScalerTransformer's forward map, from its recorded
+    metadata - shared by DescalerTransformer and PredictionDescaler."""
+    if info["scaling_type"] == "linear":
+        slope = info["slope"] or 1.0
+        return (values - info["intercept"]) / slope
+    if info["scaling_type"] == "log":
+        return np.exp(values)
+    raise ValueError(f"unknown scaling_type {info['scaling_type']!r}")
+
+
+def _scaler_info(feature, what: str) -> dict:
+    origin = feature.origin_stage
+    info = (origin.metadata if origin else {}).get("scaler")
+    if info is None:
+        raise ValueError(f"{what} input has no scaler metadata")
+    return info
+
+
 class DescalerTransformer(Transformer):
     """Inverse of ScalerTransformer: reads the scaler args from the scaled
     feature's origin stage metadata (reference: DescalerTransformer.scala).
@@ -143,17 +164,8 @@ class DescalerTransformer(Transformer):
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         val, _ = cols
         assert isinstance(val, NumericColumn)
-        origin = self.input_features[1].origin_stage
-        info = (origin.metadata if origin else {}).get("scaler")
-        if info is None:
-            raise ValueError("descaler input has no scaler metadata")
-        if info["scaling_type"] == "linear":
-            slope = info["slope"] or 1.0
-            vals = (val.values - info["intercept"]) / slope
-        elif info["scaling_type"] == "log":
-            vals = np.exp(val.values)
-        else:
-            raise ValueError(f"unknown scaling_type {info['scaling_type']!r}")
+        info = _scaler_info(self.input_features[1], "descaler")
+        vals = _descale(val.values, info)
         return NumericColumn(np.where(val.mask, vals, 0.0), val.mask, RealNN)
 
 
@@ -229,3 +241,21 @@ class _IsotonicModel(Transformer):
             )
             vals = self.predictions[idx]
         return NumericColumn(vals, np.ones(len(score), bool), RealNN)
+
+
+class PredictionDescaler(Transformer):
+    """Applies the inverse of the scaling recorded on the 2nd input's
+    origin ScalerTransformer to the Prediction's predicted value — the
+    regression-on-scaled-label round-trip (reference:
+    DescalerTransformer.scala:92 PredictionDescaler).
+    Inputs: (prediction, scaled_feature_carrying_metadata)."""
+
+    input_types = [Prediction, Real]
+    output_type = RealNN
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        pred, _ = cols
+        assert isinstance(pred, PredictionColumn)
+        info = _scaler_info(self.input_features[1], "prediction descaler")
+        out = _descale(np.asarray(pred.prediction, dtype=np.float64), info)
+        return NumericColumn(out, np.ones(len(out), bool), RealNN)

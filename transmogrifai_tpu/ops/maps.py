@@ -284,3 +284,119 @@ def transmogrify_map_group(feats: Sequence[Feature], defaults) -> Feature:
         hash_dims=defaults.hash_dims,
     )
     return stage.set_input(*feats).get_output()
+
+
+class TextMapLenModel(SequenceVectorizerModel):
+    """Fitted text-map length vectorizer: one column per fitted key holding
+    the summed token lengths of that key's value (reference:
+    TextMapLenEstimator.scala TextMapLenModel — tokenize then sum lengths)."""
+
+    input_types = [ft.OPMap, ...]
+
+    def __init__(self, all_keys: Sequence[Sequence[str]],
+                 clean_keys: bool = True, **kw) -> None:
+        super().__init__(**kw)
+        self.all_keys = [list(ks) for ks in all_keys]
+        self.clean_keys = clean_keys
+
+    def blocks_for(self, col: Column, i: int):
+        from .text import tokenize
+
+        assert isinstance(col, MapColumn)
+        feat = self.input_features[i]
+        keys = self.all_keys[i] if i < len(self.all_keys) else []
+        arr = np.zeros((len(col), len(keys)), dtype=np.float32)
+        for r, m in enumerate(col.values):
+            cleaned = {_clean_key(k, self.clean_keys): v for k, v in m.items()}
+            for j, k in enumerate(keys):
+                v = cleaned.get(k)
+                if v is not None:
+                    arr[r, j] = float(sum(len(t) for t in tokenize(str(v))))
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                grouping=k,
+                descriptor_value="TextLen",
+            )
+            for k in keys
+        ]
+        return arr, metas
+
+
+class TextMapLenEstimator(SequenceVectorizer):
+    """Per-key text lengths for text-valued maps; tokenization happens here
+    because there is no map-of-TextList type (reference:
+    TextMapLenEstimator.scala:44)."""
+
+    input_types = [ft.OPMap, ...]
+
+    def __init__(self, clean_keys: bool = True, **kw) -> None:
+        super().__init__(**kw)
+        self.clean_keys = clean_keys
+
+    def _fit_keys(self, cols: Sequence[Column]) -> list[list[str]]:
+        all_keys: list[list[str]] = []
+        for col in cols:
+            assert isinstance(col, MapColumn)
+            keys: dict[str, None] = {}
+            for m in col.values:
+                for k in m:
+                    keys.setdefault(_clean_key(k, self.clean_keys))
+            all_keys.append(sorted(keys))
+        return all_keys
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        all_keys = self._fit_keys(cols)
+        model = TextMapLenModel(all_keys, self.clean_keys)
+        model.metadata = {"keys": all_keys}
+        self.metadata = model.metadata
+        return model
+
+
+class TextMapNullModel(SequenceVectorizerModel):
+    """Fitted per-key null indicators for maps (reference:
+    TextMapNullEstimator.scala TextMapNullModel)."""
+
+    input_types = [ft.OPMap, ...]
+
+    def __init__(self, all_keys: Sequence[Sequence[str]],
+                 clean_keys: bool = True, **kw) -> None:
+        super().__init__(**kw)
+        self.all_keys = [list(ks) for ks in all_keys]
+        self.clean_keys = clean_keys
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, MapColumn)
+        feat = self.input_features[i]
+        keys = self.all_keys[i] if i < len(self.all_keys) else []
+        arr = np.zeros((len(col), len(keys)), dtype=np.float32)
+        for r, m in enumerate(col.values):
+            present = {_clean_key(k, self.clean_keys)
+                       for k, v in m.items() if v is not None}
+            for j, k in enumerate(keys):
+                if k not in present:
+                    arr[r, j] = 1.0
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                grouping=k,
+                indicator_value=NULL_STRING,
+            )
+            for k in keys
+        ]
+        return arr, metas
+
+
+class TextMapNullEstimator(TextMapLenEstimator):
+    """Per-key null-indicator columns for maps — the standalone null
+    tracking used alongside shared-hash-space text-map hashing (reference:
+    TextMapNullEstimator.scala:47)."""
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        all_keys = self._fit_keys(cols)
+        model = TextMapNullModel(all_keys, self.clean_keys)
+        model.metadata = {"keys": all_keys}
+        self.metadata = model.metadata
+        return model

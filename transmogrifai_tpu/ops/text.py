@@ -268,3 +268,108 @@ class SmartTextVectorizer(SequenceVectorizer):
             ]
         }
         return model
+
+
+class TextListNullTransformer(SequenceVectorizerModel):
+    """One null-indicator column per input TextList: 1.0 when the row's
+    list is empty — the standalone null-tracking stage the hashing
+    vectorizers rely on for shared hash spaces (reference:
+    TextListNullTransformer.scala:48)."""
+
+    input_types = [TextList, ...]
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, ListColumn)
+        feat = self.input_features[i]
+        arr = np.array(
+            [[0.0 if v else 1.0] for v in col.values], dtype=np.float32
+        )
+        meta = VectorColumnMeta(
+            parent_feature_name=feat.name,
+            parent_feature_type=feat.ftype.type_name(),
+            grouping=feat.name,
+            indicator_value=NULL_STRING,
+        )
+        return arr, [meta]
+
+
+class CountVectorizerModel(SequenceVectorizerModel):
+    """Fitted vocabulary term counter (reference: OpCountVectorizer.scala
+    wrapping spark ml CountVectorizerModel)."""
+
+    input_types = [TextList]
+
+    def __init__(self, vocabulary: Sequence[str], min_tf: float = 1.0,
+                 binary: bool = False, **kw) -> None:
+        super().__init__(**kw)
+        self.vocabulary = list(vocabulary)
+        self.min_tf = min_tf
+        self.binary = binary
+
+    def blocks_for(self, col: Column, i: int):
+        assert isinstance(col, ListColumn)
+        feat = self.input_features[i]
+        index = {t: j for j, t in enumerate(self.vocabulary)}
+        arr = np.zeros((len(col), len(self.vocabulary)), dtype=np.float32)
+        for r, toks in enumerate(col.values):
+            if not toks:
+                continue
+            counts = Counter(t for t in toks if t in index)
+            # min_tf: int >= 1 is an absolute count; fraction is of the
+            # row's token count (spark CountVectorizer minTF contract)
+            thr = self.min_tf if self.min_tf >= 1.0 \
+                else self.min_tf * len(toks)
+            for t, c in counts.items():
+                if c >= thr:
+                    arr[r, index[t]] = 1.0 if self.binary else float(c)
+        metas = [
+            VectorColumnMeta(
+                parent_feature_name=feat.name,
+                parent_feature_type=feat.ftype.type_name(),
+                grouping=feat.name,
+                indicator_value=term,
+            )
+            for term in self.vocabulary
+        ]
+        return arr, metas
+
+
+class OpCountVectorizer(SequenceVectorizer):
+    """Vocabulary-based term-count vectorizer for TextList: the top
+    ``vocab_size`` corpus terms appearing in >= min_df documents become
+    count columns (reference: OpCountVectorizer.scala wrapping spark ml
+    CountVectorizer — minDF/minTF int-is-count, fraction-is-ratio)."""
+
+    input_types = [TextList]
+
+    def __init__(self, vocab_size: int = 1 << 18, min_df: float = 1.0,
+                 min_tf: float = 1.0, binary: bool = False, **kw) -> None:
+        super().__init__(**kw)
+        self.vocab_size = vocab_size
+        self.min_df = min_df
+        self.min_tf = min_tf
+        self.binary = binary
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        (col,) = cols
+        assert isinstance(col, ListColumn)
+        df_counts: Counter = Counter()
+        tf_counts: Counter = Counter()
+        # fractional min_df is of ALL rows, empty documents included
+        # (spark CountVectorizer minDF counts against the dataset size)
+        n_docs = len(col)
+        for toks in col.values:
+            if not toks:
+                continue
+            tf_counts.update(toks)
+            df_counts.update(set(toks))
+        min_df = self.min_df if self.min_df >= 1.0 else self.min_df * n_docs
+        # vocabulary: top vocab_size by corpus term frequency, ties and
+        # order made deterministic by (-tf, term)
+        eligible = [t for t, c in df_counts.items() if c >= min_df]
+        eligible.sort(key=lambda t: (-tf_counts[t], t))
+        vocab = eligible[: self.vocab_size]
+        model = CountVectorizerModel(vocab, self.min_tf, self.binary)
+        model.metadata = {"vocabulary": list(vocab)}
+        self.metadata = model.metadata
+        return model

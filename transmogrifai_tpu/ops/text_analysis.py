@@ -19,15 +19,19 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..stages.base import Transformer
-from ..types.columns import Column, ListColumn, NumericColumn, TextColumn
+from ..types.columns import Column, ListColumn, MapColumn, NumericColumn, TextColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import (
     Base64,
+    Base64Map,
+    BinaryMap,
     Email,
     Integral,
     MultiPickList,
     Phone,
+    PhoneMap,
     PickList,
+    PickListMap,
     Real,
     RealNN,
     Text,
@@ -374,3 +378,75 @@ class JaccardSimilarity(Transformer):
             else:
                 out.append(len(sx & sy) / max(len(sx | sy), 1))
         return NumericColumn(np.array(out), np.ones(len(a), bool), RealNN)
+
+
+class SetNGramSimilarity(NGramSimilarity):
+    """Character n-gram similarity of two MultiPickList features: the set's
+    elements join (sorted, space-separated — deterministic where the
+    reference's set iteration order was not) into one string scored by the
+    same n-gram distance (reference: NGramSimilarity.scala:46
+    SetNGramSimilarity, convertFn = _.v.mkString(" "))."""
+
+    input_types = [MultiPickList, MultiPickList]
+    output_type = RealNN
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        a, b = cols
+        assert isinstance(a, ListColumn) and isinstance(b, ListColumn)
+
+        def joined(values):
+            return TextColumn(
+                [" ".join(sorted(v)) if v else None for v in values], Text
+            )
+
+        return super().transform_columns(
+            [joined(a.values), joined(b.values)], ds
+        )
+
+
+class IsValidPhoneMapDefaultCountry(Transformer):
+    """PhoneMap -> BinaryMap validity per key; unparseable-to-none values are
+    dropped from the output map (reference: PhoneNumberParser.scala:241
+    IsValidPhoneMapDefaultCountry)."""
+
+    input_types = [PhoneMap]
+    output_type = BinaryMap
+
+    def __init__(self, region: str = "US", **kw) -> None:
+        super().__init__(**kw)
+        self.region = region
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, MapColumn)
+        out = []
+        for m in col.values:
+            row = {}
+            for k, p in m.items():
+                v = is_valid_phone(p, self.region)
+                if v is not None:
+                    row[k] = bool(v)
+            out.append(row)
+        return MapColumn(out, BinaryMap)
+
+
+class MimeTypeMapDetector(Transformer):
+    """Base64Map -> PickListMap of detected MIME types; undetectable values
+    are dropped from the output map (reference: MimeTypeDetector.scala:61
+    MimeTypeMapDetector)."""
+
+    input_types = [Base64Map]
+    output_type = PickListMap
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (col,) = cols
+        assert isinstance(col, MapColumn)
+        out = []
+        for m in col.values:
+            row = {}
+            for k, b64 in m.items():
+                mime = detect_mime_type(b64)
+                if mime is not None:
+                    row[k] = mime
+            out.append(row)
+        return MapColumn(out, PickListMap)

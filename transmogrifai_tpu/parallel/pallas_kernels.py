@@ -331,8 +331,25 @@ def bin_matrix(x, edges, force_pallas: bool | None = None):
     if use_pallas and HAS_PALLAS:
         interpret = not _on_tpu()
         return _bin_pallas(x, edges.T, interpret=interpret)
-    # jnp fallback: vectorized comparison count (same semantics)
-    lt = edges[None, :, :] < x[:, :, None]  # [n, d, E]
-    acc = lt.sum(axis=-1).astype(jnp.int32)
+    # jnp fallback: vectorized comparison count (same semantics), chunked
+    # over rows so the [n, d, E] broadcast never materializes — at
+    # 1M x 512 x 63 the one-shot broadcast is a ~30 GB intermediate,
+    # which OOMs a 16 GB v5e chip (observed on hardware 2026-07-30).
+    n, d = x.shape
+    n_edges = edges.shape[1]
     nan_edges = (~jnp.isnan(edges)).sum(axis=1).astype(jnp.int32)
-    return jnp.where(jnp.isnan(x), nan_edges[None, :], acc)
+
+    def _block(xb):
+        lt = edges[None, :, :] < xb[:, :, None]  # [b, d, E]
+        acc = lt.sum(axis=-1).astype(jnp.int32)
+        return jnp.where(jnp.isnan(xb), nan_edges[None, :], acc)
+
+    # cap the bool intermediate at ~128M elements per block
+    block = max(1, min(n, (1 << 27) // max(d * n_edges, 1)))
+    if n <= block:
+        return _block(x)
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    xp = jnp.pad(x, ((0, pad), (0, 0)), constant_values=jnp.nan)
+    out = jax.lax.map(_block, xp.reshape(n_blocks, block, d))
+    return out.reshape(n_blocks * block, d)[:n]
