@@ -269,3 +269,29 @@ def test_watchdog_chunked_dispatch_parity(rng, monkeypatch):
                     for hb, hs in zip(fb["heaps"], fs["heaps"]):
                         np.testing.assert_array_equal(
                             np.asarray(hb), np.asarray(hs))
+
+
+def test_row_chunked_histogram_parity(rng, monkeypatch):
+    """The row-chunked level-histogram accumulation (tree_kernel._level_hist
+    - avoids the [n, d, C] scatter broadcast that OOMs at 10M rows) must
+    be bit-identical to the one-shot scatter."""
+    import jax
+
+    n, d = 501, 7  # deliberately non-round: exercises the padded tail
+    X = rng.randn(n, d)
+    y = ((X[:, 1] + X[:, 4]) > 0).astype(np.float64)
+
+    def fit():
+        est = OpRandomForestClassifier(num_trees=3, max_depth=4,
+                                       backend="jax")
+        return est.fit_arrays(X, y)
+
+    big = fit()
+    # force chunking (block of ~6 rows); fresh traces so the env is seen
+    monkeypatch.setenv("TX_TREE_HIST_SCATTER_ELEMS", "128")
+    jax.clear_caches()
+    small = fit()
+    monkeypatch.delenv("TX_TREE_HIST_SCATTER_ELEMS")
+    jax.clear_caches()
+    for hb, hs in zip(big["heaps"], small["heaps"]):
+        np.testing.assert_array_equal(np.asarray(hb), np.asarray(hs))
