@@ -74,3 +74,30 @@ def test_corr_insights_agree_with_loco_direction(fitted):
         if "strong" in max(r, key=lambda k: abs(r[k]))
     )
     assert n_dominant > len(out.values) * 0.7
+
+
+def test_loco_detailed_format_round_trips(fitted):
+    """detailed=True emits the reference's serialized insight map
+    ({column-history-json: [[pred_idx, delta]] json}, RecordInsightsParser
+    contract) and parse_insights recovers structure + values."""
+    from transmogrifai_tpu.insights.loco import parse_insights
+
+    model, vec, pred = fitted
+    pred_stage = next(
+        s for s in model.stages if hasattr(s, "model_params")
+    )
+    scored = model.score()
+    loco_plain = RecordInsightsLOCO(pred_stage, top_k=3).set_input(vec)
+    loco_det = RecordInsightsLOCO(pred_stage, top_k=3,
+                                  detailed=True).set_input(vec)
+    plain = loco_plain.transform(scored)[loco_plain.output_name].values
+    det = loco_det.transform(scored)[loco_det.output_name].values
+    for row_plain, row_det in zip(plain, det):
+        parsed = parse_insights(row_det)
+        assert len(parsed) == len(row_plain)
+        for history, scores in parsed:
+            assert "columnName" in history
+            assert len(scores) == 1 and scores[0][0] == 0
+            # same delta as the plain format, keyed by the same column
+            assert scores[0][1] == pytest.approx(
+                row_plain[history["columnName"]])

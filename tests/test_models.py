@@ -274,24 +274,69 @@ def test_watchdog_chunked_dispatch_parity(rng, monkeypatch):
 def test_row_chunked_histogram_parity(rng, monkeypatch):
     """The row-chunked level-histogram accumulation (tree_kernel._level_hist
     - avoids the [n, d, C] scatter broadcast that OOMs at 10M rows) must
-    be bit-identical to the one-shot scatter."""
+    match the one-shot scatter: bit-identical on the classifier path
+    (gini counts are exact integers), and identical tree STRUCTURE with
+    summation-order-tolerant leaf stats on the variance (regression)
+    path, whose wy/wyy float channels accumulate per block.
+    The cap env var is read at trace time, hence the clear_caches."""
     import jax
 
     n, d = 501, 7  # deliberately non-round: exercises the padded tail
     X = rng.randn(n, d)
-    y = ((X[:, 1] + X[:, 4]) > 0).astype(np.float64)
+    y_cls = ((X[:, 1] + X[:, 4]) > 0).astype(np.float64)
+    y_reg = (2.0 * X[:, 1] - X[:, 4] + 0.05 * rng.randn(n))
 
-    def fit():
+    def fit_cls():
         est = OpRandomForestClassifier(num_trees=3, max_depth=4,
                                        backend="jax")
-        return est.fit_arrays(X, y)
+        return est.fit_arrays(X, y_cls)
 
-    big = fit()
+    def fit_reg():
+        est = OpRandomForestRegressor(num_trees=3, max_depth=4,
+                                      backend="jax")
+        return est.fit_arrays(X, y_reg)
+
+    big_c, big_r = fit_cls(), fit_reg()
     # force chunking (block of ~6 rows); fresh traces so the env is seen
     monkeypatch.setenv("TX_TREE_HIST_SCATTER_ELEMS", "128")
     jax.clear_caches()
-    small = fit()
+    small_c, small_r = fit_cls(), fit_reg()
     monkeypatch.delenv("TX_TREE_HIST_SCATTER_ELEMS")
     jax.clear_caches()
-    for hb, hs in zip(big["heaps"], small["heaps"]):
+    for hb, hs in zip(big_c["heaps"], small_c["heaps"]):
         np.testing.assert_array_equal(np.asarray(hb), np.asarray(hs))
+    hf_b, ht_b, hl_b, hv_b = (np.asarray(h) for h in big_r["heaps"])
+    hf_s, ht_s, hl_s, hv_s = (np.asarray(h) for h in small_r["heaps"])
+    np.testing.assert_array_equal(hf_b, hf_s)
+    np.testing.assert_array_equal(ht_b, ht_s)
+    np.testing.assert_array_equal(hl_b, hl_s)
+    np.testing.assert_allclose(hv_b, hv_s, rtol=1e-5, atol=1e-4)
+
+
+def test_bf16_hessian_same_fixed_point(rng, monkeypatch):
+    """The TPU-mode bf16 Hessian Gram (TX_LR_HESSIAN_BF16) must converge
+    to the SAME optimum as the f32 path: the gradient stays f32, so
+    approximate curvature changes the Newton path, not the fixed point."""
+    import jax
+
+    X = rng.randn(400, 8)
+    beta_t = rng.randn(8)
+    y = (X @ beta_t + 0.5 * rng.randn(400) > 0).astype(float)
+
+    def fit(cls, **kw):
+        return cls(**kw).fit_arrays(X, y)
+
+    monkeypatch.setenv("TX_LR_HESSIAN_BF16", "1")
+    jax.clear_caches()
+    lr_b = fit(OpLogisticRegression, reg_param=0.01, max_iter=30)
+    svc_b = fit(OpLinearSVC, reg_param=0.01, max_iter=30)
+    monkeypatch.setenv("TX_LR_HESSIAN_BF16", "0")
+    jax.clear_caches()
+    lr_f = fit(OpLogisticRegression, reg_param=0.01, max_iter=30)
+    svc_f = fit(OpLinearSVC, reg_param=0.01, max_iter=30)
+    monkeypatch.delenv("TX_LR_HESSIAN_BF16")
+    jax.clear_caches()
+    for b, f in ((lr_b, lr_f), (svc_b, svc_f)):
+        err = np.max(np.abs(b["beta"] - f["beta"])
+                     / (np.abs(f["beta"]) + 1e-3))
+        assert err < 5e-3, err

@@ -77,3 +77,57 @@ def test_sanity_checker_spearman_invariant_to_monotone_transform(rng):
 def test_sanity_checker_rejects_unknown_correlation_type():
     with pytest.raises(ValueError, match="correlation_type"):
         SanityChecker(correlation_type="kendall")
+
+
+def test_correlation_exclusion_hashed_text(rng):
+    """correlation_exclusion='hashed_text' skips label correlation for
+    hashed text dims (no grouping/indicator, Text-family parent) so
+    max-corr dropping cannot fire on them, while pivoted/numeric columns
+    keep their correlations (reference: SanityChecker.scala:595)."""
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.ops.text import SmartTextVectorizer
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 120
+    words = ["alpha", "beta", "gamma", "delta", "epsi", "zeta"]
+    texts = [" ".join(rng.choice(words, 3)) for _ in range(n)]
+    x = rng.randn(n)
+    y = (x + 0.2 * rng.randn(n) > 0).astype(float)
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    ftxt = FeatureBuilder(ft.Text, "t").as_predictor()
+    fx = FeatureBuilder(ft.Real, "x").as_predictor()
+    tvec = SmartTextVectorizer(max_cardinality=2, hash_dims=8,
+                               track_nulls=False).set_input(ftxt).get_output()
+    xvec = RealVectorizer(track_nulls=False).set_input(fx).get_output()
+    vec = VectorsCombiner().set_input(tvec, xvec).get_output()
+    checked = SanityChecker(
+        remove_bad_features=False, correlation_exclusion="hashed_text"
+    ).set_input(fy, vec).get_output()
+    wf = OpWorkflow().set_result_features(checked).set_input_dataset(
+        {"y": y.tolist(), "t": texts, "x": x.tolist()})
+    model = wf.train()
+    summary = next(
+        s.metadata["sanity_checker_summary"] for s in model.stages
+        if "sanity_checker_summary" in s.metadata
+    )
+    assert summary["correlation_excluded_columns"] == 8
+    stats = summary["column_stats"]
+    hashed = [c for c in stats if "hash" in c["name"]]
+    assert len(hashed) == 8
+    assert all(c["corr_label"] is None for c in hashed)
+    numeric = [c for c in stats if c["parent"] == "x"]
+    assert any(c["corr_label"] is not None for c in numeric)
+    # default: no exclusion recorded, hashed columns DO get correlations
+    with_corr = SanityChecker(remove_bad_features=False).set_input(
+        fy, vec).get_output()
+    wf2 = OpWorkflow().set_result_features(with_corr).set_input_dataset(
+        {"y": y.tolist(), "t": texts, "x": x.tolist()})
+    m2 = wf2.train()
+    summary2 = next(
+        s.metadata["sanity_checker_summary"] for s in m2.stages
+        if "sanity_checker_summary" in s.metadata
+    )
+    assert summary2["correlation_excluded_columns"] == 0

@@ -67,15 +67,21 @@ class RecordInsightsCorr(Transformer):
 
 class RecordInsightsLOCO(Transformer):
     """Input: the feature vector; carries a fitted predictor model.  Output:
-    per-row {column_name: delta} map of the top-K largest prediction moves."""
+    per-row {column_name: delta} map of the top-K largest prediction moves.
+    With ``detailed=True`` the map uses the reference's serialized format
+    instead: {column-history-json: [[prediction_index, delta]] json}
+    (RecordInsightsLOCO.scala + RecordInsightsParser.scala), parseable
+    back to structure with :func:`parse_insights`."""
 
     input_types = [OPVector]
     output_type = TextMap
 
-    def __init__(self, model: PredictorModel, top_k: int = 20, **kw) -> None:
+    def __init__(self, model: PredictorModel, top_k: int = 20,
+                 detailed: bool = False, **kw) -> None:
         super().__init__(**kw)
         self.model = model
         self.top_k = top_k
+        self.detailed = detailed
 
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         (vec,) = cols
@@ -104,8 +110,58 @@ class RecordInsightsLOCO(Transformer):
         out = []
         # top-k by |delta| per row (the reference's bounded priority queue)
         top_idx = np.argsort(-np.abs(deltas), axis=1)[:, :k]
+        if self.detailed:
+            import json
+
+            histories = (
+                vec.metadata.column_history()
+                if vec.metadata.size == d
+                else [{"columnName": nm} for nm in names]
+            )
+            # serialize each column's history ONCE, not once per (row, k)
+            keys = [json.dumps(h, sort_keys=True) for h in histories]
+            for i in range(n):
+                out.append({
+                    keys[j]: json.dumps([[0, float(deltas[i, j])]])
+                    for j in top_idx[i]
+                })
+            return MapColumn(out, TextMap)
         for i in range(n):
             out.append(
                 {names[j]: float(deltas[i, j]) for j in top_idx[i]}
             )
         return MapColumn(out, TextMap)
+
+
+# -- RecordInsightsParser -----------------------------------------------------
+# (reference: core/.../impl/insights/RecordInsightsParser.scala - converts
+# the record-insight TextMap {column-history-json: [[idx, score]...]} to and
+# from structured form so downstream consumers can parse per-column
+# provenance together with the score deltas)
+def insights_to_text_map(
+    insights: Sequence[tuple[dict, Sequence[tuple[int, float]]]],
+) -> dict:
+    """[(column_history, [(prediction_index, delta), ...]), ...] -> the
+    serialized {history_json: scores_json} map of one record's insights."""
+    import json
+
+    out = {}
+    for history, scores in insights:
+        key = json.dumps(history, sort_keys=True)
+        out[key] = json.dumps([[int(i), float(s)] for i, s in scores])
+    return out
+
+
+def parse_insights(
+    text_map: dict,
+) -> list[tuple[dict, list[tuple[int, float]]]]:
+    """Inverse of insights_to_text_map: the record-insight TextMap back to
+    [(column_history, [(prediction_index, delta), ...])]."""
+    import json
+
+    out = []
+    for key, val in text_map.items():
+        history = json.loads(key)
+        scores = [(int(i), float(s)) for i, s in json.loads(val)]
+        out.append((history, scores))
+    return out

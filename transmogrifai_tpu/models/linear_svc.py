@@ -30,6 +30,12 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
     wsum = jnp.maximum(w.sum(), 1e-12)
     mu = (w @ X) / wsum
     sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu**2, 1e-12))
+    # bf16 Hessian Gram on TPU, f32 gradient/active set: same fixed-point
+    # argument as logistic_regression (curvature steers the path only)
+    from .logistic_regression import _hessian_bf16
+
+    hess_bf16 = _hessian_bf16()
+    Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
 
     def step(carry, _):
         beta, b0 = carry  # beta in standardized space
@@ -40,13 +46,24 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
         r = active * (margin - 1.0) * ypm
         sr = r.sum()
         g = (X.T @ r - mu * sr) / sd / wsum + 2.0 * reg * beta
-        XtAX = X.T @ (X * active[:, None])
+        if hess_bf16:
+            XtAX = jnp.matmul(
+                Xh.T, Xh * active.astype(jnp.bfloat16)[:, None],
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            XtAX = X.T @ (X * active[:, None])
         a = active @ X
         s = active.sum()
         Hs = (
             XtAX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
         ) / jnp.outer(sd, sd) / wsum
-        H = Hs + jnp.diag(jnp.full((d,), 2.0 * reg + 1e-8))
+        # trace-scaled jitter when the Gram is bf16-quantized (same
+        # PD-safety argument as logistic_regression: curvature-only)
+        jitter = 1e-8 + (
+            1e-3 * jnp.trace(Hs) / d if hess_bf16 else 0.0
+        )
+        H = Hs + jnp.diag(jnp.full((d,), 2.0 * reg)) + jitter * jnp.eye(d)
         g0 = sr / wsum
         h0 = s / wsum + 1e-8
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")

@@ -27,6 +27,20 @@ import numpy as np
 from .base import PredictorEstimator
 
 
+def _hessian_bf16() -> bool:
+    """bf16 Hessian Gram on TPU (MXU rate), f32 elsewhere.  Trace-time
+    decision; TX_LR_HESSIAN_BF16=0/1 overrides."""
+    import os
+
+    override = os.environ.get("TX_LR_HESSIAN_BF16")
+    if override is not None:
+        return override.strip().lower() not in ("0", "false", "")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 @partial(jax.jit, static_argnames=("iters",))
 def _lr_fit_kernel(
     X: jnp.ndarray,
@@ -60,6 +74,14 @@ def _lr_fit_kernel(
     lam_l2 = reg * (1.0 - elastic_net)
     lam_l1 = reg * elastic_net
     eps = 1e-8
+    # the Hessian Gram X^T W X is the FLOPs hot spot (n d^2 per step per
+    # replica) and only steers the Newton DIRECTION - the converged fixed
+    # point is where the f32 gradient vanishes, so approximate curvature
+    # changes the path, not the answer.  On TPU the MXU runs bf16 matmuls
+    # ~4x the f32 rate: compute the Gram from a bf16 view of X with f32
+    # accumulation there, keep every gradient quantity f32.
+    hess_bf16 = _hessian_bf16()
+    Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
 
     def step(carry, _):
         beta, b0 = carry  # beta in standardized space
@@ -72,13 +94,27 @@ def _lr_fit_kernel(
         Xr = X.T @ resid
         sr = resid.sum()
         g = (Xr - mu * sr) / sd / wsum + (lam_l2 + l1_diag) * beta
-        XtWX = X.T @ (X * wt[:, None])
+        if hess_bf16:
+            XtWX = jnp.matmul(
+                Xh.T, Xh * wt.astype(jnp.bfloat16)[:, None],
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            XtWX = X.T @ (X * wt[:, None])
         a = wt @ X
         s = wt.sum()
         Hs = (
             XtWX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
         ) / jnp.outer(sd, sd) / wsum
-        H = Hs + jnp.diag(lam_l2 + l1_diag + jnp.full((d,), 1e-9))
+        # bf16 Gram error (~0.4% relative) can push a near-singular H
+        # indefinite past the tiny base jitter and NaN the pos-assumed
+        # solve; scale the jitter with the curvature magnitude when the
+        # quantized Gram is in play (jitter is curvature-only - the f32
+        # gradient still defines the fixed point)
+        jitter = 1e-9 + (
+            1e-3 * jnp.trace(Hs) / d if hess_bf16 else 0.0
+        )
+        H = Hs + jnp.diag(lam_l2 + l1_diag) + jitter * jnp.eye(d)
         g0 = sr / wsum
         h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")

@@ -111,7 +111,14 @@ def _level_hist(bins, node_of_row, stats_w, L: int, B: int):
     elements: at 10M x 39 x 3 the one-shot broadcast is a 4.7 GB
     intermediate per tree (observed as a 46 GB compile-time allocation
     under the fold vmap on a 16 GB v5e, 2026-07-30); chunks accumulate
-    into the [L*d*B, C] histogram under lax.scan instead."""
+    into the [L*d*B, C] histogram under lax.scan instead.
+
+    TX_TREE_HIST_SCATTER_ELEMS (the cap) is read at TRACE time — it is
+    baked into the jit cache for a given shape, so changing it mid-process
+    needs jax.clear_caches() to take effect (it is a sizing/test hook, not
+    a per-call knob).  Chunked accumulation sums float channels in
+    per-block order; gini counts are exact, variance channels (wy, wyy)
+    agree with the one-shot scatter up to f32 summation order."""
     n, d = bins.shape
     C = stats_w.shape[1]
 

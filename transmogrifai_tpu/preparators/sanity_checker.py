@@ -88,6 +88,7 @@ class SanityChecker(Estimator):
         max_label_classes: int = 100,
         seed: int = 42,
         correlation_type: str = "pearson",
+        correlation_exclusion: str = "none",
         **kw,
     ) -> None:
         super().__init__(**kw)
@@ -96,7 +97,13 @@ class SanityChecker(Estimator):
                 f"correlation_type must be 'pearson' or 'spearman', "
                 f"got {correlation_type!r}"
             )
+        if correlation_exclusion not in ("none", "hashed_text"):
+            raise ValueError(
+                f"correlation_exclusion must be 'none' or 'hashed_text', "
+                f"got {correlation_exclusion!r}"
+            )
         self.correlation_type = correlation_type
+        self.correlation_exclusion = correlation_exclusion
         self.check_sample = check_sample
         self.sample_upper_limit = sample_upper_limit
         self.min_variance = min_variance
@@ -191,6 +198,23 @@ class SanityChecker(Estimator):
             corr = pearson_correlation(
                 xs, xss, xys, float(ys), float(yss), float(n)
             )
+
+        if self.correlation_exclusion == "hashed_text":
+            # hashed text dims (Text/TextArea + their maps, no grouping or
+            # indicator - i.e. not pivoted by SmartTextVectorizer) carry no
+            # per-column meaning: exclude them from label correlation so
+            # min/max-corr dropping never fires on them (reference:
+            # SanityChecker.scala:595 CorrelationExclusion.HashedText)
+            _hashed_types = {"Text", "TextArea", "TextMap", "TextAreaMap"}
+            excluded = [
+                i for i, c in enumerate(meta.columns)
+                if c.grouping is None and c.indicator_value is None
+                and c.parent_feature_type in _hashed_types
+            ]
+            corr[excluded] = np.nan
+            n_corr_excluded = len(excluded)
+        else:
+            n_corr_excluded = 0
 
         # contingency tables per categorical group
         classes = np.unique(y)
@@ -294,6 +318,8 @@ class SanityChecker(Estimator):
             cramers_v_by_group={f"{p}/{g}": v for (p, g), v in cramers.items()},
         )
         model = SanityCheckerModel(keep)
-        model.metadata = {"sanity_checker_summary": summary.to_json()}
+        summary_json = summary.to_json()
+        summary_json["correlation_excluded_columns"] = n_corr_excluded
+        model.metadata = {"sanity_checker_summary": summary_json}
         self.metadata = model.metadata
         return model
