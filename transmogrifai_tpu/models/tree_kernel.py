@@ -131,7 +131,13 @@ def _level_hist(bins, node_of_row, stats_w, L: int, B: int):
             flat, seg.reshape(-1), num_segments=L * d * B
         )
 
-    cap = int(os.environ.get("TX_TREE_HIST_SCATTER_ELEMS", 1 << 27))
+    # default sized for the OBSERVED buffer-assignment behavior on v5e:
+    # the compile-time HBM bound held ~57 live instances of the per-block
+    # [F, block, d, C] broadcast across a depth-6 fit's level scans (one
+    # 91.6 GB allocation at block=2^27/(d*C) under a 3-fold vmap), so the
+    # per-block footprint must stay ~2 orders under the chip's 16 GB:
+    # 2^23 elements x 4 B x F=3 x ~57 ~= 7.6 GB worst case.
+    cap = int(os.environ.get("TX_TREE_HIST_SCATTER_ELEMS", 1 << 23))
     if n * d * C <= cap:
         return block_hist(node_of_row, bins, stats_w).reshape(L, d, B, C)
     block = max(1, cap // max(d * C, 1))
