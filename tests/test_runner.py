@@ -107,3 +107,37 @@ def test_streaming_score(tmp_path, rng):
     outs = list(runner2.streaming_score(batches, params))
     assert len(outs) == 4
     assert all(len(o) == 50 for o in outs)
+
+
+def test_runner_avro_score_output(tmp_path, rng):
+    """write_format='avro' saves scores as an Avro OCF (the reference's
+    saveScores/saveAvro contract) that our own reader decodes back to the
+    same prediction values."""
+    from transmogrifai_tpu.readers.avro_reader import read_avro_records
+
+    wf, data, pred = _build(rng)
+    runner = OpWorkflowRunner(wf, evaluator=OpBinaryClassificationEvaluator())
+    params = OpParams(
+        model_location=str(tmp_path / "model"),
+        write_location=str(tmp_path / "scores"),
+        write_format="avro",
+    )
+    runner.run("train", params)
+    result = runner.run("score", params)
+    path = str(tmp_path / "scores" / "scores.avro")
+    assert os.path.exists(path)
+    schema, records = read_avro_records(path)
+    assert len(records) == len(data["y"])
+    # field names are sanitized to the avro name spec; the original
+    # column name rides in the field doc
+    field = next(
+        f for f in schema["fields"] if f.get("doc") == pred.name
+        or f["name"] == pred.name
+    )
+    import re
+    assert re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", field["name"])
+    scored_pred = result.scores[pred.name]
+    for i in (0, 7, len(records) - 1):
+        rec_map = records[i][field["name"]]
+        assert rec_map["prediction"] == pytest.approx(
+            float(scored_pred.prediction[i]))

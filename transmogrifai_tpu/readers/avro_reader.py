@@ -105,7 +105,12 @@ def _decode_value(dec: _Decoder, schema: Any) -> Any:
                     dec.read_long()
                     n = -n
                 for _ in range(n):
-                    out[dec.read_string()] = _decode_value(dec, schema["values"])
+                    # key MUST be read before the value: python evaluates
+                    # the RHS of `out[k()] = v()` first, which silently
+                    # decoded value-then-key and scrambled every non-empty
+                    # map (caught by the writer round-trip test)
+                    key = dec.read_string()
+                    out[key] = _decode_value(dec, schema["values"])
             return out
         return _decode_value(dec, t)  # {"type": "string"} style
     # primitive
@@ -245,3 +250,277 @@ class ParquetReader:
             vals = [_coerce(v, f) for v in col.to_pylist()]
             cols[f.name] = column_from_list(vals, f.ftype)
         return Dataset(cols)
+
+
+# ---------------------------------------------------------------------------
+# Avro OCF WRITER (inverse of the reader above; reference counterparts:
+# utils/.../io/avro/AvroInOut.scala saveAvro and utils/.../io/csv/
+# CSVToAvro.scala).  Encodes the same subset the decoder reads: null,
+# boolean, int, long, float, double, bytes, string, enum, fixed, array,
+# map, union, nested record; codec null or deflate.
+# ---------------------------------------------------------------------------
+class _Encoder:
+    def __init__(self) -> None:
+        self.parts: list[bytes] = []
+
+    def write(self, b: bytes) -> None:
+        self.parts.append(b)
+
+    def write_long(self, v: int) -> None:
+        v = (v << 1) ^ (v >> 63)  # zigzag (python ints: arithmetic shift)
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        self.parts.append(bytes(out))
+
+    write_int = write_long
+
+    def write_bytes(self, b: bytes) -> None:
+        self.write_long(len(b))
+        self.write(b)
+
+    def write_string(self, s: str) -> None:
+        self.write_bytes(s.encode("utf-8"))
+
+    def write_float(self, v: float) -> None:
+        self.write(struct.pack("<f", v))
+
+    def write_double(self, v: float) -> None:
+        self.write(struct.pack("<d", v))
+
+    def write_boolean(self, v: bool) -> None:
+        self.write(b"\x01" if v else b"\x00")
+
+    def getvalue(self) -> bytes:
+        return b"".join(self.parts)
+
+
+def _union_branch(schema_list: list, value: Any) -> int:
+    """Pick the union branch for a value (null -> 'null', else the first
+    non-null branch - the ['null', T] optional-field pattern)."""
+    names = [s if isinstance(s, str) else s.get("type") for s in schema_list]
+    if value is None:
+        if "null" in names:
+            return names.index("null")
+        raise ValueError("None for a union without a null branch")
+    for i, nm in enumerate(names):
+        if nm != "null":
+            return i
+    raise ValueError("union has only a null branch")
+
+
+def _encode_value(enc: _Encoder, schema: Any, value: Any) -> None:
+    if isinstance(schema, list):  # union
+        idx = _union_branch(schema, value)
+        enc.write_long(idx)
+        _encode_value(enc, schema[idx], value)
+        return
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _encode_value(enc, f["type"], (value or {}).get(f["name"]))
+            return
+        if t == "enum":
+            enc.write_long(schema["symbols"].index(value))
+            return
+        if t == "fixed":
+            if len(value) != schema["size"]:
+                raise ValueError("fixed value has wrong size")
+            enc.write(bytes(value))
+            return
+        if t == "array":
+            items = list(value or [])
+            if items:
+                enc.write_long(len(items))
+                for it in items:
+                    _encode_value(enc, schema["items"], it)
+            enc.write_long(0)
+            return
+        if t == "map":
+            entries = dict(value or {})
+            if entries:
+                enc.write_long(len(entries))
+                for k, v in entries.items():
+                    enc.write_string(k)
+                    _encode_value(enc, schema["values"], v)
+            enc.write_long(0)
+            return
+        _encode_value(enc, t, value)  # {"type": "string"} style
+        return
+    if schema == "null":
+        if value is not None:
+            raise ValueError(f"non-null value {value!r} for null schema")
+        return
+    if schema == "boolean":
+        enc.write_boolean(bool(value))
+        return
+    if schema in ("int", "long"):
+        enc.write_long(int(value))
+        return
+    if schema == "float":
+        enc.write_float(float(value))
+        return
+    if schema == "double":
+        enc.write_double(float(value))
+        return
+    if schema == "bytes":
+        enc.write_bytes(bytes(value))
+        return
+    if schema == "string":
+        enc.write_string(str(value))
+        return
+    raise ValueError(f"unsupported avro type: {schema!r}")
+
+
+def write_avro_records(
+    path: str,
+    schema: dict,
+    records: Sequence[dict],
+    codec: str = "deflate",
+    block_records: int = 4096,
+) -> int:
+    """Write records to an Avro Object Container File; returns the count.
+    The layout mirrors read_avro_records: magic, metadata map (schema JSON
+    + codec), random sync marker, then blocks of (count, byte-length,
+    payload, sync)."""
+    import os as _os
+
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"codec must be 'null' or 'deflate', got {codec!r}")
+    sync = _os.urandom(16)
+    head = _Encoder()
+    head.write(MAGIC)
+    meta = {
+        "avro.schema": json.dumps(schema).encode(),
+        "avro.codec": codec.encode(),
+    }
+    head.write_long(len(meta))
+    for k, v in meta.items():
+        head.write_string(k)
+        head.write_bytes(v)
+    head.write_long(0)
+    head.write(sync)
+    out = [head.getvalue()]
+    n = 0
+    for start in range(0, len(records), block_records):
+        chunk = records[start : start + block_records]
+        body = _Encoder()
+        for rec in chunk:
+            _encode_value(body, schema, rec)
+        payload = body.getvalue()
+        if codec == "deflate":
+            # raw deflate (no zlib header), per the avro spec
+            comp = zlib.compressobj(wbits=-15)
+            payload = comp.compress(payload) + comp.flush()
+        blk = _Encoder()
+        blk.write_long(len(chunk))
+        blk.write_bytes(payload)
+        blk.write(sync)
+        out.append(blk.getvalue())
+        n += len(chunk)
+    with open(path, "wb") as f:
+        f.write(b"".join(out))
+    return n
+
+
+def _avro_field_name(name: str, seen: set) -> str:
+    """Sanitize to the Avro name spec [A-Za-z_][A-Za-z0-9_]* - generated
+    feature names contain '-' and would make the file unreadable by
+    spec-conforming Avro implementations (java avro, spark, fastavro)."""
+    import re as _re
+
+    s = _re.sub(r"[^A-Za-z0-9_]", "_", name)
+    if not s or not (s[0].isalpha() or s[0] == "_"):
+        s = "_" + s
+    base, k = s, 2
+    while s in seen:
+        s = f"{base}_{k}"
+        k += 1
+    seen.add(s)
+    return s
+
+
+def schema_for_dataset(ds: Dataset, name: str = "Row") -> dict:
+    """An optional-field record schema for a Dataset's columns (every field
+    ['null', T] - the reference's nullable-by-design contract).  Field
+    names are sanitized to the Avro name spec; when renamed, the original
+    column name is kept in the field's ``doc``."""
+    from ..types.columns import (
+        GeolocationColumn,
+        ListColumn,
+        MapColumn,
+        NumericColumn,
+        PredictionColumn,
+        TextColumn,
+    )
+    from ..types import feature_types as ft
+
+    fields = []
+    seen: set = set()
+    for col_name in ds.column_names():
+        col = ds[col_name]
+        if isinstance(col, NumericColumn):
+            t = "long" if issubclass(col.feature_type, ft.Integral) else "double"
+        elif isinstance(col, TextColumn):
+            t = "string"
+        elif isinstance(col, GeolocationColumn):
+            t = {"type": "array", "items": "double"}
+        elif isinstance(col, ListColumn):
+            items = (
+                "long"
+                if issubclass(col.feature_type, (ft.DateList,))
+                else "string"
+            )
+            t = {"type": "array", "items": items}
+        elif isinstance(col, PredictionColumn):
+            # Prediction rows serialize as {prediction, raw_i, prob_i}
+            t = {"type": "map", "values": "double"}
+        elif isinstance(col, MapColumn):
+            vt = col.feature_type.value_type
+            values = (
+                "double"
+                if vt is not None and issubclass(vt, ft.OPNumeric)
+                else "string"
+            )
+            t = {"type": "map", "values": values}
+        else:  # vectors -> array of doubles
+            t = {"type": "array", "items": "double"}
+        fname = _avro_field_name(col_name, seen)
+        field = {"name": fname, "type": ["null", t]}
+        if fname != col_name:
+            field["doc"] = col_name
+        fields.append(field)
+    return {"type": "record", "name": name, "fields": fields}
+
+
+def rows_from_dataset(ds: Dataset, schema: dict) -> list[dict]:
+    """Transpose a Dataset into row dicts keyed by the schema's (possibly
+    sanitized) field names; fields pair with columns positionally."""
+    cols = ds.to_pylists()
+    names = list(cols)
+    fnames = [f["name"] for f in schema["fields"]]
+    assert len(fnames) == len(names)
+    return [
+        {fn: cols[nm][i] for fn, nm in zip(fnames, names)}
+        for i in range(len(ds))
+    ]
+
+
+def csv_to_avro(csv_path: str, avro_path: str, features: Sequence[Feature],
+                codec: str = "deflate", **reader_kw) -> int:
+    """CSV -> Avro OCF conversion (reference: utils/.../io/csv/
+    CSVToAvro.scala): reads through CSVReader's typed columns and writes
+    an optional-field record file; returns the row count."""
+    from .csv_reader import CSVReader
+
+    ds = CSVReader(csv_path, **reader_kw).generate_dataset(features)
+    schema = schema_for_dataset(ds)
+    rows = rows_from_dataset(ds, schema)
+    return write_avro_records(avro_path, schema, rows, codec=codec)

@@ -18,6 +18,7 @@ class OpParams:
     reader_params: dict[str, dict[str, Any]] = field(default_factory=dict)
     model_location: Optional[str] = None
     write_location: Optional[str] = None
+    write_format: str = "json"  # "json" | "avro" (reference saves avro)
     metrics_location: Optional[str] = None
     custom_params: dict[str, Any] = field(default_factory=dict)
 
@@ -33,6 +34,7 @@ class OpParams:
             reader_params=d.get("readerParams", d.get("reader_params", {})),
             model_location=d.get("modelLocation", d.get("model_location")),
             write_location=d.get("writeLocation", d.get("write_location")),
+            write_format=d.get("writeFormat", d.get("write_format", "json")),
             metrics_location=d.get("metricsLocation", d.get("metrics_location")),
             custom_params=d.get("customParams", d.get("custom_params", {})),
         )
@@ -43,6 +45,7 @@ class OpParams:
             "readerParams": self.reader_params,
             "modelLocation": self.model_location,
             "writeLocation": self.write_location,
+            "writeFormat": self.write_format,
             "metricsLocation": self.metrics_location,
             "customParams": self.custom_params,
         }

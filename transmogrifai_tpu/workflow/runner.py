@@ -108,7 +108,8 @@ class OpWorkflowRunner:
         model = self._load_model(params)
         scored = self._scored_data(params, model)
         if params.write_location:
-            _write_scores(scored, model, params.write_location)
+            _write_scores(scored, model, params.write_location,
+                          params.write_format)
         return OpWorkflowRunnerResult(run_type="score", model=model, scores=scored)
 
     def _features(self, params: OpParams) -> OpWorkflowRunnerResult:
@@ -155,15 +156,35 @@ class OpWorkflowRunner:
             yield scored
 
 
-def _write_scores(scored: Dataset, model: OpWorkflowModel, location: str) -> None:
+def _write_scores(scored: Dataset, model: OpWorkflowModel, location: str,
+                  write_format: str = "json") -> None:
     """Column-pruned score output (reference: OpWorkflowModel.saveScores:
-    375-420 - keep result features + response)."""
+    375-420 - keep result features + response; avro like the reference's
+    saveAvro, or json)."""
     os.makedirs(location, exist_ok=True)
     keep = [f.name for f in model.result_features if f.name in scored]
     keep += [
         f.name for f in model.raw_features if f.is_response and f.name in scored
     ]
-    out = scored.select(keep).to_pylists()
+    if write_format not in ("json", "avro"):
+        raise ValueError(
+            f"write_format must be 'json' or 'avro', got {write_format!r}"
+        )
+    pruned = scored.select(keep)
+    if write_format == "avro":
+        from ..readers.avro_reader import (
+            rows_from_dataset,
+            schema_for_dataset,
+            write_avro_records,
+        )
+
+        schema = schema_for_dataset(pruned, name="Score")
+        write_avro_records(
+            os.path.join(location, "scores.avro"),
+            schema, rows_from_dataset(pruned, schema),
+        )
+        return
+    out = pruned.to_pylists()
     with open(os.path.join(location, "scores.json"), "w") as f:
         json.dump(out, f, default=str)
 
