@@ -10,11 +10,10 @@ fixed, array, map, union, and nested record schemas.
 """
 from __future__ import annotations
 
-import io
 import json
 import struct
 import zlib
-from typing import Any, BinaryIO, Iterator, Optional, Sequence
+from typing import Any, Optional, Sequence
 
 from ..features.feature import Feature
 from ..types.columns import column_from_list
