@@ -58,9 +58,17 @@ def main() -> int:
     result["rows"] = n
     result["dims"] = d
 
+    # -- bandwidth calibration: a pure one-pass read of x -----------------
+    # (sanity anchor: no moments measurement can beat this; if one does,
+    # the timing methodology is broken, not the kernel fast)
+    sum_fn = jax.jit(lambda a: jnp.sum(a, dtype=jnp.float32))
+    t_read = _timeit(sum_fn, x, reps=11)
+    result["read_sum_s"] = round(t_read, 6)
+    result["read_gbps"] = round(n * d * 4 / t_read / 1e9, 1)
+
     # -- fused_moments: pallas vs fused-jnp fallback ----------------------
-    t_pallas = _timeit(pk.fused_moments, x, y, True)
-    t_jnp = _timeit(pk.fused_moments, x, y, False)
+    t_pallas = _timeit(pk.fused_moments, x, y, True, reps=11)
+    t_jnp = _timeit(pk.fused_moments, x, y, False, reps=11)
     # parity check on device (sums agree to float32 tolerance)
     mp = pk.fused_moments(x, y, True)
     mj = pk.fused_moments(x, y, False)
