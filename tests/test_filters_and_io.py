@@ -136,3 +136,55 @@ def test_model_save_load_roundtrip(tmp_path, rng):
     scored2 = model2.score(data)
     p2 = scored2[pred2.name].probability
     assert np.allclose(p1, p2, atol=1e-6)
+
+
+def test_compute_data_up_to(tmp_path, rng):
+    """computeDataUpTo parity (reference: OpWorkflowCore.scala:273-284):
+    unfitted workflow fits only the upstream stages; fitted model reuses
+    fitted state; the path variant saves Avro."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.readers.avro_reader import read_avro_records
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 60
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "b": rng.randn(n).tolist(),
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = RealVectorizer().set_input(a, b).get_output()
+    pred = OpLogisticRegression(reg_param=0.1).set_input(y, vec).get_output()
+
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    up_to_pred = wf.compute_data_up_to(pred)
+    # the vector column exists, the prediction column does NOT
+    assert vec.name in up_to_pred
+    assert pred.name not in up_to_pred
+
+    avro_path = str(tmp_path / "upto.avro")
+    model = wf.train()
+    got = model.compute_data_up_to(pred, data=data, path=avro_path)
+    assert vec.name in got and pred.name not in got
+    np.testing.assert_allclose(
+        np.asarray(got[vec.name].values),
+        np.asarray(up_to_pred[vec.name].values), rtol=1e-6)
+    schema, records = read_avro_records(avro_path)
+    assert len(records) == n
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="needs data="):
+        model.compute_data_up_to(pred)
+
+    # a feature whose upstream stages the trained model never saw must
+    # error loudly, not silently return raw columns
+    vec2 = RealVectorizer().set_input(b, a).get_output()
+    pred2 = OpLogisticRegression().set_input(y, vec2).get_output()
+    with _pytest.raises(ValueError, match="not in .* DAG|not in"):
+        model.compute_data_up_to(pred2, data=data)

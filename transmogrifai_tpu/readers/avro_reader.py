@@ -520,6 +520,13 @@ def csv_to_avro(csv_path: str, avro_path: str, features: Sequence[Feature],
     from .csv_reader import CSVReader
 
     ds = CSVReader(csv_path, **reader_kw).generate_dataset(features)
-    schema = schema_for_dataset(ds)
-    rows = rows_from_dataset(ds, schema)
-    return write_avro_records(avro_path, schema, rows, codec=codec)
+    return save_dataset_avro(ds, avro_path, codec=codec)
+
+
+def save_dataset_avro(ds: Dataset, path: str, name: str = "Row",
+                      codec: str = "deflate") -> int:
+    """Save a Dataset as an Avro OCF (the reference's df.saveAvro analog);
+    returns the row count."""
+    schema = schema_for_dataset(ds, name)
+    return write_avro_records(path, schema, rows_from_dataset(ds, schema),
+                              codec=codec)

@@ -247,6 +247,39 @@ class OpWorkflow:
         )
 
     # ------------------------------------------------------------------
+    def compute_data_up_to(self, feature: Feature,
+                           path: Optional[str] = None) -> Dataset:
+        """Fit and transform only the stages strictly upstream of
+        ``feature`` and return the dataset of every column generated
+        before it - the feature-engineering debugging entry point
+        (reference: OpWorkflowCore.computeDataUpTo:273-284; ``path``
+        saves Avro like the reference's df.saveAvro)."""
+        raw = self.generate_raw_data()
+        dag = compute_dag([feature])
+        upto = [
+            [s for s in layer if s is not feature.origin_stage]
+            for layer in dag
+        ]
+        upto = [layer for layer in upto if layer]
+        if self._warm_stages:
+            # warm start must see the SAME fitted stages train() would use
+            # (with_model_stages semantics, OpWorkflow.scala:457)
+            def _warm_sub(s):
+                w = self._warm_stages.get(s.uid)
+                if w is None or w is s:
+                    return s
+                w.input_features = s.input_features
+                w._output = s.get_output()
+                return w
+
+            upto = [[_warm_sub(s) for s in layer] for layer in upto]
+        _, data, _ = fit_and_transform_dag(upto, raw)
+        if path is not None:
+            from ..readers.avro_reader import save_dataset_avro
+
+            save_dataset_avro(data, path)
+        return data
+
     def train(self) -> "OpWorkflowModel":
         """(reference: OpWorkflow.train:332-357)"""
         from ..parallel.distributed import initialize
@@ -404,6 +437,45 @@ class OpWorkflowModel:
             raise ValueError("no data to score: pass data=")
         raw = _as_dataset(data, self.raw_features)
         return apply_transformations_dag(self._dag(), raw)
+
+    def compute_data_up_to(self, feature: Feature, data: Any = None,
+                           path: Optional[str] = None) -> Dataset:
+        """All columns generated before ``feature`` using the FITTED
+        stages (reference: OpWorkflowModel side of computeDataUpTo);
+        ``path`` saves Avro."""
+        if data is None:
+            # the training cache holds fully-transformed columns, not raw
+            raise ValueError("compute_data_up_to on a fitted model needs data=")
+        raw = _as_dataset(data, self.raw_features)
+        keep = {
+            s.uid
+            for layer in compute_dag([feature])
+            for s in layer
+            if s is not feature.origin_stage
+        }
+        out = raw
+        applied: set[str] = set()
+        for layer in self._dag():
+            for stage in layer:
+                if stage.uid in keep:
+                    if not isinstance(stage, Transformer):
+                        raise ValueError(
+                            f"unfitted estimator {stage.uid}; train first"
+                        )
+                    out = stage.transform(out)
+                    applied.add(stage.uid)
+        missing = keep - applied
+        if missing:
+            raise ValueError(
+                "compute_data_up_to: the feature depends on stages not in "
+                f"this trained model's DAG (uids {sorted(missing)}); train "
+                "a workflow containing them first"
+            )
+        if path is not None:
+            from ..readers.avro_reader import save_dataset_avro
+
+            save_dataset_avro(out, path)
+        return out
 
     def score_function(self):
         """Spark-free row scorer analog (reference: local/.../
