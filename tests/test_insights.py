@@ -97,7 +97,9 @@ def test_loco_detailed_format_round_trips(fitted):
         assert len(parsed) == len(row_plain)
         for history, scores in parsed:
             assert "columnName" in history
-            assert len(scores) == 1 and scores[0][0] == 0
-            # same delta as the plain format, keyed by the same column
-            assert scores[0][1] == pytest.approx(
+            # the full per-class diff vector rides along (binary -> 2)
+            assert [c for c, _ in scores] == [0, 1]
+            # class-1 delta == the plain format's value; class 0 mirrors
+            assert scores[1][1] == pytest.approx(
                 row_plain[history["columnName"]])
+            assert scores[0][1] == pytest.approx(-scores[1][1], abs=1e-5)

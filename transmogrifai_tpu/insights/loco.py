@@ -90,18 +90,35 @@ class RecordInsightsLOCO(Transformer):
         n, d = X.shape
         est, params = self.model.estimator_ref, self.model.model_params
 
-        def score(Xm: np.ndarray) -> np.ndarray:
+        def score_all(Xm: np.ndarray) -> np.ndarray:
+            """Full score vector per row [n, C] (class probabilities, or
+            the prediction itself for regressors) - the reference's LOCO
+            diffs EVERY prediction index (RecordInsightsLOCO.scala:94)."""
             pred, raw, prob = est.predict_arrays(params, Xm)
             if prob is not None and prob.shape[1] > 1:
-                return prob[:, 1] if prob.shape[1] == 2 else prob.max(axis=1)
-            return pred
+                return np.asarray(prob)
+            return np.asarray(pred)[:, None]
 
-        base = score(X)
-        deltas = np.zeros((n, d))
+        base = score_all(X)                      # [n, C]
+        C = base.shape[1]
+        deltas = np.zeros((n, d, C))
         for j in range(d):  # d zero-out passes, each a full batched rescore
             Xj = X.copy()
             Xj[:, j] = 0.0
-            deltas[:, j] = base - score(Xj)
+            deltas[:, j, :] = base - score_all(Xj)
+
+        # scalar ranking value per (row, column): binary keeps the positive
+        # class' delta (prob sums to 1, so |delta| matches class 0);
+        # multiclass/regression takes the largest-|.| class diff
+        if C == 2:
+            scalar = deltas[:, :, 1]
+        elif C == 1:
+            scalar = deltas[:, :, 0]
+        else:
+            amax = np.argmax(np.abs(deltas), axis=2)  # [n, d]
+            scalar = np.take_along_axis(
+                deltas, amax[:, :, None], axis=2
+            )[:, :, 0]
 
         names = vec.metadata.column_names() if vec.metadata.size == d else [
             str(j) for j in range(d)
@@ -109,7 +126,7 @@ class RecordInsightsLOCO(Transformer):
         k = min(self.top_k, d)
         out = []
         # top-k by |delta| per row (the reference's bounded priority queue)
-        top_idx = np.argsort(-np.abs(deltas), axis=1)[:, :k]
+        top_idx = np.argsort(-np.abs(scalar), axis=1)[:, :k]
         if self.detailed:
             import json
 
@@ -122,13 +139,15 @@ class RecordInsightsLOCO(Transformer):
             keys = [json.dumps(h, sort_keys=True) for h in histories]
             for i in range(n):
                 out.append({
-                    keys[j]: json.dumps([[0, float(deltas[i, j])]])
+                    keys[j]: json.dumps(
+                        [[c, float(deltas[i, j, c])] for c in range(C)]
+                    )
                     for j in top_idx[i]
                 })
             return MapColumn(out, TextMap)
         for i in range(n):
             out.append(
-                {names[j]: float(deltas[i, j]) for j in top_idx[i]}
+                {names[j]: float(scalar[i, j]) for j in top_idx[i]}
             )
         return MapColumn(out, TextMap)
 
