@@ -770,16 +770,27 @@ class OpGBTRegressor(_GBT):
 class OpXGBoostClassifier(OpGBTClassifier):
     """Hist-mode XGBoost-equivalent params surface (reference: core/src/main/
     scala/ml/dmlc/xgboost4j/.../XGBoostParams.scala shim); same boosted-tree
-    kernel with XGBoost-flavored defaults (eta 0.3, numRound)."""
+    kernel with XGBoost-flavored names and defaults (eta 0.3, numRound,
+    gamma -> min split gain, minChildWeight -> min instances)."""
 
     model_type = "OpXGBoostClassifier"
 
-    def __init__(self, num_round: int = 100, eta: float = 0.3, **kw) -> None:
-        super().__init__(num_trees=num_round, step_size=eta, max_depth=6, **kw)
+    def __init__(self, num_round: int = 100, eta: float = 0.3,
+                 gamma: float = 0.0, min_child_weight: float = 1.0,
+                 **kw) -> None:
+        kw.setdefault("max_depth", 6)
+        kw.setdefault("min_info_gain", gamma)
+        kw.setdefault("min_instances_per_node", min_child_weight)
+        super().__init__(num_trees=num_round, step_size=eta, **kw)
 
 
 class OpXGBoostRegressor(OpGBTRegressor):
     model_type = "OpXGBoostRegressor"
 
-    def __init__(self, num_round: int = 100, eta: float = 0.3, **kw) -> None:
-        super().__init__(num_trees=num_round, step_size=eta, max_depth=6, **kw)
+    def __init__(self, num_round: int = 100, eta: float = 0.3,
+                 gamma: float = 0.0, min_child_weight: float = 1.0,
+                 **kw) -> None:
+        kw.setdefault("max_depth", 6)
+        kw.setdefault("min_info_gain", gamma)
+        kw.setdefault("min_instances_per_node", min_child_weight)
+        super().__init__(num_trees=num_round, step_size=eta, **kw)
