@@ -78,6 +78,56 @@ try:
 except ValueError as e:
     assert "multi-process" in str(e)
 
+# ---- tree fold fits over the cross-process mesh ------------------------
+# the forest CV kernel must give the single-process answer when its
+# row-sharded inputs span both processes (gini count channels are small
+# integers, so the sharded segment-sums are exact -> heaps bit-identical)
+from jax.sharding import NamedSharding, PartitionSpec as P
+from transmogrifai_tpu.models.tree_kernel import (
+    bin_data, fit_forest_folds, quantile_bin_edges)
+
+edges = quantile_bin_edges(X_full, 8)
+bins_full = bin_data(X_full, edges)
+classes = np.array([0.0, 1.0])
+onehot = (y_full[:, None] == classes[None, :]).astype(np.float32)
+stats_full = np.concatenate([np.ones((40, 1), np.float32), onehot], axis=1)
+W_full = np.stack([
+    np.r_[np.ones(30, np.float32), np.zeros(10, np.float32)],
+    np.r_[np.zeros(10, np.float32), np.ones(30, np.float32)],
+])
+T, d = 3, X_full.shape[1]
+boot_full = np.ones((T, 40), np.float32)
+feat_masks = jnp.ones((T, d), dtype=bool)
+keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(T))
+
+def to_global(local, spec):
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(*spec)), local)
+
+heaps_g = fit_forest_folds(
+    to_global(bins_full[lo:hi], ("data", None)),
+    to_global(stats_full[lo:hi], ("data", None)),
+    to_global(W_full[:, lo:hi], (None, "data")),
+    to_global(boot_full[:, lo:hi], (None, "data")),
+    feat_masks, keys,
+    max_depth=3, max_bins=8, impurity_kind="gini", n_stats=3,
+    min_instances_per_node=1.0, min_info_gain=0.0,
+)
+heaps_l = fit_forest_folds(
+    jnp.asarray(bins_full), jnp.asarray(stats_full), jnp.asarray(W_full),
+    jnp.asarray(boot_full), feat_masks, keys,
+    max_depth=3, max_bins=8, impurity_kind="gini", n_stats=3,
+    min_instances_per_node=1.0, min_info_gain=0.0,
+)
+for hg, hl in zip(heaps_g, heaps_l):
+    # replicate the (possibly sharded) global output so every process can
+    # materialize it: jitted identity with replicated out_shardings
+    rep = jax.jit(
+        lambda a: a, out_shardings=NamedSharding(mesh, P())
+    )(hg)
+    assert np.array_equal(np.asarray(rep), np.asarray(hl)), \
+        "sharded tree heaps differ"
+
 print(f"proc {{pid}} OK", flush=True)
 '''
 
