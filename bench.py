@@ -174,6 +174,29 @@ def _synth_section(result: dict) -> None:
     except Exception as e:
         result["synth_rf_error"] = f"{type(e).__name__}: {e}"
 
+    # gradient boosting at scale: the margin-carried chunked boosting scan
+    # (tree_kernel.fit_gbt_folds) on the same device-resident matrix
+    try:
+        from transmogrifai_tpu.models.trees import OpGBTClassifier
+
+        gbt = OpGBTClassifier(num_trees=8, max_depth=4, backend="jax")
+        t0 = time.time()
+        gbt_params = gbt.fit_arrays(X, np.asarray(y))
+        t_gbt = time.time() - t0
+        depth_g = gbt_params["max_depth"]
+        bins_g = int(gbt.params["max_bins"])
+        gbt_flops = sum(
+            2.0 * n * d * 5 + 3.0 * (2**l) * d * bins_g * 4
+            for l in range(depth_g)
+        ) * int(gbt.params["num_trees"])
+        result.update(
+            synth_gbt_wall_s=round(t_gbt, 3),
+            synth_gbt_tflops=round(gbt_flops / 1e12, 3),
+            synth_gbt_tflops_per_s=round(gbt_flops / t_gbt / 1e12, 3),
+        )
+    except Exception as e:
+        result["synth_gbt_error"] = f"{type(e).__name__}: {e}"
+
     # planted-truth gate (examples/synthetic.py PLANTED): one LR refit at
     # grid-typical regularization, coefficients checked against the
     # generator's known ground truth + Bayes AuROC ceiling - proves the
