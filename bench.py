@@ -176,6 +176,8 @@ def _synth_section(result: dict) -> None:
 
     # gradient boosting at scale: the margin-carried chunked boosting scan
     # (tree_kernel.fit_gbt_folds) on the same device-resident matrix
+    gbt_flops = 0.0
+    t_gbt = 0.0
     try:
         from transmogrifai_tpu.models.trees import OpGBTClassifier
 
@@ -219,9 +221,9 @@ def _synth_section(result: dict) -> None:
         # fan-out and the tree path
         peak = peak_chip * jax.device_count()
         t_rf_wall = float(result.get("synth_rf_wall_s", 0.0))
-        all_flops = total_flops + rf_flops
+        all_flops = total_flops + rf_flops + gbt_flops
         result["synth_cv_mfu"] = round(
-            all_flops / (t_cv + t_rf_wall) / peak, 5
+            all_flops / (t_cv + t_rf_wall + t_gbt) / peak, 5
         )
         result["mfu_peak_flops_assumed"] = peak
 
