@@ -291,3 +291,33 @@ def test_string_indexer_and_email_domain():
         EmailToPickList().set_input(f2).transform(ds2).columns().values()
     )[-1]
     assert col2.values[0] == "corp.com" and col2.values[1] is None
+
+
+def test_transmogrify_label_aware_bucketize(rng):
+    """transmogrify(label=...) adds per-numeric decision-tree bucket
+    columns alongside the filled vectorizer output (reference:
+    Transmogrifier.scala:155,175 -> RichNumericFeature.vectorize label
+    branch)."""
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 300
+    x = rng.randn(n)
+    y = (x > 0.3).astype(float)  # a clean split at 0.3
+    noise = rng.randn(n)
+    data = {"y": y.tolist(), "x": x.tolist(), "noise": noise.tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fx = FeatureBuilder(ft.Real, "x").as_predictor()
+    fn = FeatureBuilder(ft.Real, "noise").as_predictor()
+
+    plain = transmogrify([fx, fn])
+    labeled = transmogrify([fx, fn], label=fy)
+    wf = OpWorkflow().set_result_features(plain, labeled)
+    model = wf.set_input_dataset(data).train()
+    scored = model.score(data)
+    w_plain = scored[plain.name].width
+    w_lab = scored[labeled.name].width
+    assert w_lab > w_plain  # bucket columns appended
+    names = scored[labeled.name].metadata.column_names()
+    assert any("[" in nm and "x" in nm for nm in names)  # bucket ranges

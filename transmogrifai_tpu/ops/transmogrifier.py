@@ -41,6 +41,7 @@ class TransmogrifierDefaults:
     track_nulls: bool = True
     clean_text: bool = True
     date_periods: tuple = ("HourOfDay", "DayOfWeek", "DayOfMonth", "WeekOfYear")
+    min_info_gain: float = 0.01  # label-aware auto-bucketize threshold
 
 
 DEFAULTS = TransmogrifierDefaults()
@@ -82,9 +83,14 @@ def _group_key(t: Type[ft.FeatureType]) -> str:
 def transmogrify(
     features: Sequence[Feature],
     defaults: TransmogrifierDefaults = DEFAULTS,
+    label: Optional[Feature] = None,
 ) -> Feature:
     """Seq[Feature].transmogrify() (reference: Transmogrifier.transmogrify
-    via dsl/RichFeaturesCollection.scala:69)."""
+    via dsl/RichFeaturesCollection.scala:69).  With ``label``, scalar
+    numerics ALSO auto-bucketize against it - per-feature decision-tree
+    splits kept only when informative (reference:
+    Transmogrifier.scala:155,175 passing label through
+    RichNumericFeature.vectorize:339-347)."""
     if not features:
         raise ValueError("transmogrify needs at least one feature")
     groups: dict[str, list[Feature]] = {}
@@ -103,6 +109,18 @@ def transmogrify(
             continue
         stage = _stage_for(key, defaults)
         vector_features.append(stage.set_input(*feats).get_output())
+        if label is not None and key in ("real", "integral"):
+            from .bucketizers import DecisionTreeNumericBucketizer
+
+            for f in feats:
+                # filled vectorizer already tracks nulls (trackNulls=false
+                # in the reference's bucketize branch)
+                buck = DecisionTreeNumericBucketizer(
+                    min_info_gain=defaults.min_info_gain, track_nulls=False
+                )
+                vector_features.append(
+                    buck.set_input(label, f).get_output()
+                )
     if len(vector_features) == 1:
         out = vector_features[0]
         if out.ftype is ft.OPVector and len(features) > 1:
