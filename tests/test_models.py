@@ -340,3 +340,22 @@ def test_bf16_hessian_same_fixed_point(rng, monkeypatch):
         err = np.max(np.abs(b["beta"] - f["beta"])
                      / (np.abs(f["beta"]) + 1e-3))
         assert err < 5e-3, err
+
+
+def test_fits_per_dispatch_work_model(monkeypatch):
+    """The watchdog work model must shrink the per-program fit budget as
+    trees get more expensive (deeper, wider, more rows) and respect the
+    env overrides."""
+    from transmogrifai_tpu.models.tree_kernel import fits_per_dispatch
+
+    base = fits_per_dispatch(6, 10_000, 30, 32, 3)
+    assert base >= 1
+    assert fits_per_dispatch(12, 10_000, 30, 32, 3) < base      # deeper
+    assert fits_per_dispatch(6, 10_000_000, 30, 32, 3) < base   # more rows
+    assert fits_per_dispatch(6, 10_000, 500, 32, 3) < base      # wider
+    monkeypatch.setenv("TX_TREE_FITS_PER_DISPATCH", "7")
+    assert fits_per_dispatch(12, 10_000_000, 500, 32, 3) == 7
+    monkeypatch.delenv("TX_TREE_FITS_PER_DISPATCH")
+    monkeypatch.setenv("TX_TREE_DISPATCH_BUDGET_S", "60")
+    doubled = fits_per_dispatch(6, 10_000, 30, 32, 3)
+    assert abs(doubled - 2 * base) <= 2  # int truncation slack
