@@ -107,25 +107,28 @@ def _run_step(name: str, cmd: list[str], out_path: str, timeout: int,
     return False
 
 
-def capture(force: bool = False) -> None:
+def capture(force: bool = False) -> bool:
     """Run the evidence sequence against a healthy backend, cheapest and
-    most-diagnostic first; each artifact is written as soon as it exists."""
+    most-diagnostic first; each artifact is written as soon as it exists.
+    Returns True only when every step run THIS invocation succeeded."""
     env = dict(os.environ)
     env.pop("TX_BENCH_REEXEC", None)
     env.pop("TX_BENCH_FALLBACK_REASON", None)
+    ok = True
     if force or not os.path.exists(EV_PALLAS):
-        _run_step(
+        ok &= _run_step(
             "microbench",
             [sys.executable, os.path.join(ROOT, "tpu_microbench.py")],
             EV_PALLAS, timeout=1200, env=env,
         )
     if force or not os.path.exists(EV_BENCH):
         benv = dict(env, SYNTH_ROWS="10000000", TX_BENCH_TPU_RETRIES="1")
-        _run_step(
+        ok &= _run_step(
             "bench",
             [sys.executable, os.path.join(ROOT, "bench.py")],
             EV_BENCH, timeout=3600, env=benv,
         )
+    return ok
 
 
 def main() -> int:
@@ -144,14 +147,14 @@ def main() -> int:
             capture(force=args.force)
         return 0 if entry.get("ok") else 1
 
-    # watch mode: keep probing until both artifacts exist (or forever
-    # with --probe-only), logging every attempt
+    # watch mode: keep probing until a capture SUCCEEDS this run (or
+    # forever with --probe-only), logging every attempt.  Pre-existing
+    # artifacts must not end the watch when a forced re-capture failed.
     while True:
         entry = probe(args.timeout)
         print(json.dumps(entry), flush=True)
         if entry.get("ok") and not args.probe_only:
-            capture(force=args.force)
-            if os.path.exists(EV_PALLAS) and os.path.exists(EV_BENCH):
+            if capture(force=args.force):
                 _log({"event": "done", "ok": True})
                 return 0
         time.sleep(args.watch)
