@@ -41,6 +41,12 @@ from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
 
 N = 80  # rows per contract dataset
 
+# TX_CONTRACT_SEED offsets every generator seed so the whole harness can
+# sweep data variations (default 0 = the pinned CI seeds)
+import os as _os
+
+_SEED_OFFSET = int(_os.environ.get("TX_CONTRACT_SEED", "0"))
+
 # ---------------------------------------------------------------------------
 # testkit-style typed value generation
 # ---------------------------------------------------------------------------
@@ -585,7 +591,7 @@ def test_stage_contract(name, tmp_path):
 
     def mk():
         reset_uids()
-        rng = np.random.RandomState(7)
+        rng = np.random.RandomState(7 + _SEED_OFFSET)
         out, data = build(N, rng)
         wf = OpWorkflow().set_result_features(out)
         return wf, out, data
@@ -619,7 +625,7 @@ def test_stage_contract(name, tmp_path):
     # 5. round-trip equality must hold on UNSEEN data as well (catches
     #    fitted state that only looked right because training-data caches
     #    papered over it)
-    _, data_new = build(N, np.random.RandomState(11))
+    _, data_new = build(N, np.random.RandomState(11 + _SEED_OFFSET))
     col_n1 = model.score(data_new)[out.name]
     col_n2 = model2.score(data_new)[out2.name]
     assert _cols_equal(col_n1, col_n2), (

@@ -160,10 +160,23 @@ def _w2v_sgns_kernel(centers, contexts, negatives, vocab_emb, steps: int,
         grad_vc = g_pos * vo + (g_neg * vn).sum(axis=1)
         grad_vo = g_pos * vc
         grad_vn = g_neg * vc[:, None, :]
-        in_emb = in_emb.at[c].add(-lr * grad_vc)
-        out_emb = out_emb.at[ctx].add(-lr * grad_vo)
-        out_emb = out_emb.at[neg.reshape(-1)].add(
-            -lr * grad_vn.reshape(-1, grad_vn.shape[-1])
+
+        # summed per-index updates with a per-row step cap: a token
+        # repeated ~b/v times per batch on a tiny vocab summed into a
+        # k-times-larger step and diverged to NaN (caught by the
+        # contract-harness seed sweep); clipping the row update's L2 norm
+        # leaves normal-regime dynamics untouched and bounds every step
+        def scatter_clipped(tbl, ids, grads):
+            upd = jnp.zeros_like(tbl).at[ids].add(grads)
+            norm = jnp.linalg.norm(upd, axis=1, keepdims=True)
+            upd = upd * jnp.minimum(1.0, 1.0 / jnp.maximum(norm, 1e-12))
+            return tbl - lr * upd
+
+        in_emb = scatter_clipped(in_emb, c, grad_vc)
+        out_emb = scatter_clipped(out_emb, ctx, grad_vo)
+        out_emb = scatter_clipped(
+            out_emb, neg.reshape(-1),
+            grad_vn.reshape(-1, grad_vn.shape[-1]),
         )
         return (in_emb, out_emb), None
 
