@@ -131,6 +131,26 @@ def capture(force: bool = False) -> bool:
     return ok
 
 
+def _autocommit() -> None:
+    """Persist freshly captured evidence even when the watcher outlives
+    the session that armed it (the tunnel opens on its own schedule)."""
+    try:
+        subprocess.run(
+            ["git", "-C", ROOT, "add", EV_PALLAS, EV_BENCH, LOG],
+            check=True, capture_output=True, timeout=60,
+        )
+        subprocess.run(
+            ["git", "-C", ROOT, "commit", "-m",
+             "TPU evidence captured by the probe watcher on a healthy "
+             "tunnel window (microbench + full 10M bench, forced fresh)"],
+            check=True, capture_output=True, timeout=60,
+        )
+        _log({"event": "autocommit", "ok": True})
+    except Exception as e:
+        _log({"event": "autocommit", "ok": False,
+              "error": f"{type(e).__name__}: {e}"})
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true")
@@ -156,6 +176,7 @@ def main() -> int:
         if entry.get("ok") and not args.probe_only:
             if capture(force=args.force):
                 _log({"event": "done", "ok": True})
+                _autocommit()
                 return 0
         time.sleep(args.watch)
 
