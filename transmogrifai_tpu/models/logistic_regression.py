@@ -181,12 +181,24 @@ class OpLogisticRegression(PredictorEstimator):
 
     def fit_arrays_batched(self, X, y, W, regs, ens):
         """Batched fit: W [B, n] weight masks, regs/ens [B] -> stacked params.
-        One vmapped computation = the whole CV x grid fan-out."""
-        beta, b0 = _lr_fit_batched(
-            jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
-            jnp.asarray(regs), jnp.asarray(ens),
-            iters=int(self.params.get("max_iter", 25)),
-        )
+        One computation = the whole CV x grid fan-out.  Single-device
+        inputs ride the MXU-packed explicit batch (packed_newton.py, the
+        Gram packs all replicas into the matmul N dimension); multi-device
+        inputs keep the vmap kernel whose GSPMD sharding is proven."""
+        from .packed_newton import lr_fit_batched_packed, use_packed
+
+        iters = int(self.params.get("max_iter", 25))
+        if use_packed(X, W):
+            beta, b0 = lr_fit_batched_packed(
+                jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
+                jnp.asarray(regs), jnp.asarray(ens),
+                iters=iters, hess_bf16=_hessian_bf16(),
+            )
+        else:
+            beta, b0 = _lr_fit_batched(
+                jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
+                jnp.asarray(regs), jnp.asarray(ens), iters=iters,
+            )
         return np.asarray(beta), np.asarray(b0)
 
     def predict_arrays(self, params: Any, X: np.ndarray):

@@ -1,0 +1,269 @@
+"""MXU-packed batched Newton kernels for the linear-model CV fan-out.
+
+The CV fold x grid fan-out for LR / LinearSVC / LinearRegression was a
+``vmap`` of the per-replica kernel: its FLOPs hot spot, the weighted Gram
+``X^T diag(wt_b) X``, lowered to a [B, d, d] *batched* matmul whose d x d
+output tiles (d ~ 39 after vectorization) use ~9% of the 128x128 MXU
+(measured 0.45% MFU on a v5e, docs/performance.md).  These kernels are the
+explicitly-batched rewrite: every replica-indexed op keeps the replica
+axis B in a matmul's *N dimension*, so the machine sees a few LARGE
+matmuls instead of B small ones:
+
+* ``z = X @ Gamma^T``            [n, d] @ [d, B]      (tall)
+* ``Xr = X^T @ resid``           [d, n] @ [n, B]      (wide-contraction)
+* Gram: ``X^T @ Z``              [d, n] @ [n, B*d]    (packed, chunked)
+
+where ``Z[:, b*d+j] = wt[:, b] * X[:, j]`` packs ALL replica weightings
+into the N dimension - one matmul whose output tile rows are d/128 and
+whose columns fill full 128-lanes, ~3x the utilization of the [B, d, d]
+form.  Z is materialized in row chunks (``TX_PACKED_GRAM_ELEMS`` budget)
+so the temporary never exceeds a few hundred MB regardless of n.
+
+Replica-count note: B = folds x grid is 24 for the reference default LR
+grid (DefaultSelectorParams.scala:36-61) - B*d ~ 936 columns, 7+ full MXU
+lanes.
+
+The vmap path remains the multi-device route: these kernels scan over row
+chunks with ``dynamic_slice``, which would fight GSPMD's row sharding;
+``fit_arrays_batched`` routes here only when inputs live on a single
+device (see ``use_packed``).  Math per row is IDENTICAL to the vmapped
+per-replica kernels (same standardization-folded algebra, same bf16-view /
+f32-accumulate Hessian contract, same eps/jitter terms), so coefficients
+agree to f32 fixed-point tolerance - pinned by tests/test_packed_newton.py.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _gram_chunk_rows(n: int, B: int, d: int) -> int:
+    """Rows per Gram chunk: bound the [c, B*d] packed temporary by an
+    element budget (default 2^27 elements = 256 MiB bf16 / 512 MiB f32).
+    Trace-time decision like _hessian_bf16: TX_PACKED_GRAM_ELEMS changes
+    take effect for new (shape, dtype) compilations only - already-cached
+    executables keep the budget they were traced with."""
+    budget = int(os.environ.get("TX_PACKED_GRAM_ELEMS", 1 << 27))
+    c = max(128, budget // max(B * d, 1))
+    return min(n, c - (c % 8))
+
+
+def packed_weighted_gram(Xh, wt_nB):
+    """All-replica weighted Gram as packed matmuls: returns [B, d, d] f32
+    with G[b] = X^T diag(wt[:, b]) X.
+
+    Xh: [n, d] design matrix (bf16 view on TPU, f32 elsewhere - caller's
+    choice; accumulation is always f32).  wt_nB: [n, B] per-replica row
+    weights in the SAME dtype as Xh so the multiply stays in the matmul's
+    input precision.
+    """
+    n, d = Xh.shape
+    B = wt_nB.shape[1]
+    c = _gram_chunk_rows(n, B, d)
+    if c >= n:
+        Z = (wt_nB[:, :, None] * Xh[:, None, :]).reshape(n, B * d)
+        G = jnp.matmul(Xh.T, Z, preferred_element_type=jnp.float32)
+    else:
+        nc = -(-n // c)
+        pad = nc * c - n
+        # zero rows in BOTH operands contribute exactly zero to the Gram
+        Xp = jnp.pad(Xh, ((0, pad), (0, 0)))
+        Wp = jnp.pad(wt_nB, ((0, pad), (0, 0)))
+
+        def body(acc, i):
+            Xc = jax.lax.dynamic_slice_in_dim(Xp, i * c, c)
+            Wc = jax.lax.dynamic_slice_in_dim(Wp, i * c, c)
+            Zc = (Wc[:, :, None] * Xc[:, None, :]).reshape(c, B * d)
+            return (
+                acc + jnp.matmul(Xc.T, Zc, preferred_element_type=jnp.float32),
+                None,
+            )
+
+        G, _ = jax.lax.scan(
+            body, jnp.zeros((d, B * d), jnp.float32), jnp.arange(nc)
+        )
+    return G.reshape(d, B, d).transpose(1, 0, 2)
+
+
+def use_packed(*arrays) -> bool:
+    """Packed kernels are the single-device route (TX_PACKED_GRAM=0 forces
+    the vmap path, =1 forces packed).  Multi-device inputs keep the vmap
+    kernels, whose GSPMD row-sharding + psum lowering is already proven."""
+    override = os.environ.get("TX_PACKED_GRAM")
+    if override is not None:
+        return override.strip().lower() not in ("0", "false", "")
+    for a in arrays:
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
+            return False
+    return True
+
+
+def _batched_diag(v):
+    """[B, d] -> [B, d, d] with v on the diagonals."""
+    d = v.shape[-1]
+    return v[:, :, None] * jnp.eye(d, dtype=v.dtype)
+
+
+_psolve = jax.vmap(partial(jax.scipy.linalg.solve, assume_a="pos"))
+
+
+@partial(jax.jit, static_argnames=("iters", "hess_bf16"))
+def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
+    """Explicitly-batched weighted logistic IRLS: X [n, d], y [n],
+    W [B, n] per-replica sample weights, regs/ens [B].  Same per-row math
+    as logistic_regression._lr_fit_kernel under vmap; the Gram is packed.
+    Returns (beta [B, d] raw-scale, intercept [B])."""
+    n, d = X.shape
+    B = W.shape[0]
+    wsum = W.sum(axis=1)  # [B]
+    mu = (W @ X) / wsum[:, None]  # [B, d]
+    var = (W @ (X * X)) / wsum[:, None] - mu**2
+    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    lam_l2 = regs * (1.0 - ens)
+    lam_l1 = regs * ens
+    eps = 1e-8
+    Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
+    Wn = W.T  # [n, B]
+    eye = jnp.eye(d)
+
+    def step(carry, _):
+        beta, b0 = carry  # [B, d], [B]
+        gamma = beta / sd  # [B, d]
+        z = X @ gamma.T + (b0 - (mu * gamma).sum(axis=1))[None, :]  # [n, B]
+        p = jax.nn.sigmoid(z)
+        wt = Wn * p * (1.0 - p) + eps  # [n, B]
+        resid = Wn * (p - y[:, None])  # [n, B]
+        l1_diag = lam_l1[:, None] / (jnp.abs(beta) + 1e-3)  # [B, d]
+        Xr = X.T @ resid  # [d, B]
+        sr = resid.sum(axis=0)  # [B]
+        g = (Xr.T - mu * sr[:, None]) / sd / wsum[:, None] + (
+            lam_l2[:, None] + l1_diag
+        ) * beta
+        XtWX = packed_weighted_gram(Xh, wt.astype(Xh.dtype))  # [B, d, d] f32
+        a = (X.T @ wt).T  # [B, d]
+        s = wt.sum(axis=0)  # [B]
+        Hs = (
+            XtWX
+            - mu[:, :, None] * a[:, None, :]
+            - a[:, :, None] * mu[:, None, :]
+            + s[:, None, None] * (mu[:, :, None] * mu[:, None, :])
+        ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
+        # same trace-scaled PD-safety jitter as the vmap kernel
+        tr = jnp.trace(Hs, axis1=1, axis2=2)
+        jitter = 1e-9 + (1e-3 * tr / d if hess_bf16 else 0.0)
+        H = Hs + _batched_diag(lam_l2[:, None] + l1_diag) + (
+            jitter[:, None, None] * eye if hess_bf16 else 1e-9 * eye
+        )
+        g0 = sr / wsum
+        h0 = s / wsum
+        delta = _psolve(H, g)
+        return (beta - delta, b0 - g0 / h0), None
+
+    (beta_s, b0), _ = jax.lax.scan(
+        step, (jnp.zeros((B, d)), jnp.zeros((B,))), None, length=iters
+    )
+    beta = beta_s / sd
+    intercept = b0 - (mu * beta).sum(axis=1)
+    return beta, intercept
+
+
+@partial(jax.jit, static_argnames=("iters", "hess_bf16"))
+def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
+    """Explicitly-batched squared-hinge Newton (linear_svc._svc_fit_kernel
+    under vmap, Gram packed).  Returns (beta [B, d], intercept [B])."""
+    n, d = X.shape
+    B = W.shape[0]
+    ypm = 2.0 * y - 1.0
+    wsum = jnp.maximum(W.sum(axis=1), 1e-12)  # [B]
+    mu = (W @ X) / wsum[:, None]
+    sd = jnp.sqrt(
+        jnp.maximum((W @ (X * X)) / wsum[:, None] - mu**2, 1e-12)
+    )
+    Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
+    Wn = W.T  # [n, B]
+    eye = jnp.eye(d)
+
+    def step(carry, _):
+        beta, b0 = carry
+        gamma = beta / sd
+        margin = ypm[:, None] * (
+            X @ gamma.T + (b0 - (mu * gamma).sum(axis=1))[None, :]
+        )  # [n, B]
+        active = (margin < 1.0).astype(X.dtype) * Wn  # [n, B]
+        r = active * (margin - 1.0) * ypm[:, None]
+        sr = r.sum(axis=0)  # [B]
+        g = ((X.T @ r).T - mu * sr[:, None]) / sd / wsum[:, None] + (
+            2.0 * regs[:, None]
+        ) * beta
+        XtAX = packed_weighted_gram(Xh, active.astype(Xh.dtype))
+        a = (X.T @ active).T  # [B, d]
+        s = active.sum(axis=0)
+        Hs = (
+            XtAX
+            - mu[:, :, None] * a[:, None, :]
+            - a[:, :, None] * mu[:, None, :]
+            + s[:, None, None] * (mu[:, :, None] * mu[:, None, :])
+        ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
+        tr = jnp.trace(Hs, axis1=1, axis2=2)
+        jitter = (
+            (1e-8 + 1e-3 * tr / d)[:, None, None] * eye
+            if hess_bf16
+            else 1e-8 * eye
+        )
+        H = (
+            Hs
+            + _batched_diag(jnp.broadcast_to(2.0 * regs[:, None], (B, d)))
+            + jitter
+        )
+        g0 = sr / wsum
+        h0 = s / wsum + 1e-8
+        delta = _psolve(H, g)
+        return (beta - delta, b0 - g0 / h0), None
+
+    (beta_s, b0), _ = jax.lax.scan(
+        step, (jnp.zeros((B, d)), jnp.zeros((B,))), None, length=iters
+    )
+    beta = beta_s / sd
+    return beta, b0 - (mu * beta).sum(axis=1)
+
+
+@partial(jax.jit, static_argnames=("l1_iters",))
+def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8):
+    """Explicitly-batched weighted ridge / elastic-net (normal equations).
+    The Gram weights are the FIXED fold masks, so the packed Gram runs
+    ONCE - the l1 reweighting scan is [B, d, d] solves only.  The Gram
+    stays f32: unlike the Newton kernels it defines the answer, not just
+    the step direction.  Returns (beta [B, d], intercept [B])."""
+    n, d = X.shape
+    B = W.shape[0]
+    wsum = W.sum(axis=1)
+    mu = (W @ X) / wsum[:, None]
+    var = (W @ (X * X)) / wsum[:, None] - mu**2
+    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    ybar = (W @ y) / wsum
+    lam_l2 = regs * (1.0 - ens)
+    lam_l1 = regs * ens
+    XtWX = packed_weighted_gram(X, W.T)  # [B, d, d] f32
+    a = W @ X  # [B, d]
+    G = (
+        XtWX
+        - mu[:, :, None] * a[:, None, :]
+        - a[:, :, None] * mu[:, None, :]
+        + wsum[:, None, None] * (mu[:, :, None] * mu[:, None, :])
+    ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
+    r = W * (y[None, :] - ybar[:, None])  # [B, n]
+    c = ((X.T @ r.T).T - mu * r.sum(axis=1)[:, None]) / sd / wsum[:, None]
+
+    def step(beta, _):
+        l1_diag = lam_l1[:, None] / (jnp.abs(beta) + 1e-3)
+        H = G + _batched_diag(lam_l2[:, None] + l1_diag + 1e-9)
+        return _psolve(H, c), None
+
+    beta_s, _ = jax.lax.scan(step, jnp.zeros((B, d)), None, length=l1_iters)
+    beta = beta_s / sd
+    intercept = ybar - (mu * beta).sum(axis=1)
+    return beta, intercept
