@@ -1,0 +1,89 @@
+"""Cross-backend tree predict parity: native C++ vs jax predictors.
+
+predict_arrays routes by SCORING batch size (sub-TX_TREE_NATIVE_ROWS
+batches take the native predictor to skip device dispatch overhead), so
+the same fitted model may score through either backend depending on
+batch size.  That routing is only sound if the two predictors agree
+EXACTLY - including at bin-threshold ties and NaN feature values, the
+two places tree traversal could diverge (advisor r3 finding).  This pins
+the equivalence.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import native_trees
+from transmogrifai_tpu.models.tree_kernel import bin_data
+from transmogrifai_tpu.models.trees import (
+    OpGBTClassifier,
+    OpRandomForestClassifier,
+    OpRandomForestRegressor,
+)
+
+
+def _tricky_inputs(X_fit: np.ndarray, edges: np.ndarray, rng) -> np.ndarray:
+    """Scoring rows that land EXACTLY on bin edges, far outside the fitted
+    range, and NaN - the traversal tie/NaN cases the routing relies on."""
+    n, d = 64, X_fit.shape[1]
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    # rows 0..15: exact edge values (tie-breaking at the threshold)
+    for i in range(16):
+        j = i % d
+        e = edges[j]
+        X[i, j] = e[min(i % max(len(e), 1), len(e) - 1)] if len(e) else 0.0
+    # rows 16..23: +/- inf-ish extremes
+    X[16:20] = 1e30
+    X[20:24] = -1e30
+    # rows 24..31: NaNs scattered per-feature
+    for i in range(24, 32):
+        X[i, i % d] = np.nan
+    return X
+
+
+@pytest.mark.skipif(
+    not native_trees.available(), reason="native tree kernels unavailable"
+)
+@pytest.mark.parametrize(
+    "cls,kw",
+    [
+        (OpRandomForestClassifier, dict(num_trees=5, max_depth=4)),
+        (OpRandomForestRegressor, dict(num_trees=5, max_depth=4)),
+        (OpGBTClassifier, dict(num_trees=4, max_depth=3)),
+    ],
+)
+def test_native_and_jax_predict_agree(cls, kw, monkeypatch):
+    rng = np.random.default_rng(3)
+    n, d = 400, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    est = cls(backend="jax", **kw)
+    params = est.fit_arrays(X, y)
+    Xs = _tricky_inputs(X, params["edges"], rng)
+
+    monkeypatch.setitem(est.params, "backend", "native")
+    monkeypatch.setenv("TX_TREE_NATIVE_ROWS", str(10**9))
+    pred_n, raw_n, prob_n = est.predict_arrays(params, Xs)
+    monkeypatch.setitem(est.params, "backend", "jax")
+    pred_j, raw_j, prob_j = est.predict_arrays(params, Xs)
+
+    np.testing.assert_array_equal(pred_n, pred_j)
+    if prob_n is not None:
+        np.testing.assert_allclose(prob_n, prob_j, atol=1e-6)
+
+
+@pytest.mark.skipif(
+    not native_trees.available(), reason="native tree kernels unavailable"
+)
+def test_bin_data_agrees_native_vs_python():
+    """The two binners must assign identical bin ids, including exact-edge
+    and NaN values (NaN routes to the last bin in both)."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    edges = [np.sort(rng.normal(size=7)).astype(np.float32) for _ in range(4)]
+    X[0, 0] = edges[0][3]  # exact edge
+    X[1, 1] = np.nan
+    X[2, 2] = 1e30
+    X[3, 3] = -1e30
+    b_py = bin_data(X, edges)
+    b_nat = native_trees.bin_data(X, edges)
+    if b_nat is not None:
+        np.testing.assert_array_equal(b_py, b_nat)

@@ -135,12 +135,12 @@ def _autocommit() -> None:
     """Persist freshly captured evidence even when the watcher outlives
     the session that armed it (the tunnel opens on its own schedule)."""
     try:
+        # commit ONLY the evidence paths (-o): the watcher fires
+        # unattended, and anything another session staged in the meantime
+        # must not be swept into its commit (advisor r3 finding)
         subprocess.run(
-            ["git", "-C", ROOT, "add", EV_PALLAS, EV_BENCH, LOG],
-            check=True, capture_output=True, timeout=60,
-        )
-        subprocess.run(
-            ["git", "-C", ROOT, "commit", "-m",
+            ["git", "-C", ROOT, "commit", "-o", EV_PALLAS, EV_BENCH, LOG,
+             "-m",
              "TPU evidence captured by the probe watcher on a healthy "
              "tunnel window (microbench + full 10M bench, forced fresh)"],
             check=True, capture_output=True, timeout=60,

@@ -142,10 +142,17 @@ class OpLDA(Estimator):
 # ---------------------------------------------------------------------------
 # Word2Vec (skip-gram negative sampling)
 # ---------------------------------------------------------------------------
-@partial(jax.jit, static_argnames=("steps",))
+@partial(jax.jit, static_argnames=("steps", "clip_norm"))
 def _w2v_sgns_kernel(centers, contexts, negatives, vocab_emb, steps: int,
-                     lr: float = 0.05):
-    """One Adam-free SGD scan over precomputed index triples."""
+                     lr: float = 0.05, clip_norm: float = 1.0):
+    """One Adam-free SGD scan over precomputed index triples.
+
+    ``clip_norm`` caps each embedding row's summed per-batch update L2
+    norm.  The cap exists for the tiny-vocab regime where a token repeats
+    ~batch/vocab times per batch and the summed scatter diverges; the
+    caller scales it with batch size and embedding dim so legitimate
+    aggregate updates at larger configs are not silently altered
+    (advisor r3 finding)."""
 
     def step(emb, idx):
         c, ctx, neg = centers[idx], contexts[idx], negatives[idx]
@@ -169,7 +176,7 @@ def _w2v_sgns_kernel(centers, contexts, negatives, vocab_emb, steps: int,
         def scatter_clipped(tbl, ids, grads):
             upd = jnp.zeros_like(tbl).at[ids].add(grads)
             norm = jnp.linalg.norm(upd, axis=1, keepdims=True)
-            upd = upd * jnp.minimum(1.0, 1.0 / jnp.maximum(norm, 1e-12))
+            upd = upd * jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
             return tbl - lr * upd
 
         in_emb = scatter_clipped(in_emb, c, grad_vc)
@@ -289,8 +296,15 @@ class OpWord2Vec(Estimator):
             jnp.asarray(rng.randn(v, self.vector_size).astype(np.float32) * 0.1),
             jnp.asarray(np.zeros((v, self.vector_size), dtype=np.float32)),
         )
+        # clip scale: a legitimate aggregate row update grows ~sqrt(batch)
+        # in the summed scatter and ~sqrt(dim) in per-component count; 1.0
+        # is calibrated for the (256, 100) defaults, so scale from there
+        clip = max(
+            1.0,
+            float(np.sqrt((self.batch / 256.0) * (self.vector_size / 100.0))),
+        )
         in_emb, _ = _w2v_sgns_kernel(
             jnp.asarray(centers), jnp.asarray(contexts), jnp.asarray(negatives),
-            init, steps=min(self.steps, n_batches * 50),
+            init, steps=min(self.steps, n_batches * 50), clip_norm=clip,
         )
         return OpWord2VecModel(vocab, np.asarray(in_emb))

@@ -301,17 +301,44 @@ class _Encoder:
 
 
 def _union_branch(schema_list: list, value: Any) -> int:
-    """Pick the union branch for a value (null -> 'null', else the first
-    non-null branch - the ['null', T] optional-field pattern)."""
+    """Pick the union branch for a value.  ['null', T] optionals take the
+    single non-null branch; wider unions match the VALUE's python type
+    against the branch kinds (the reader supports arbitrary unions, so the
+    writer must not silently coerce - e.g. ['null','string','long'] with 5
+    picks 'long', not 'string'; advisor r3 finding)."""
     names = [s if isinstance(s, str) else s.get("type") for s in schema_list]
     if value is None:
         if "null" in names:
             return names.index("null")
         raise ValueError("None for a union without a null branch")
-    for i, nm in enumerate(names):
-        if nm != "null":
-            return i
-    raise ValueError("union has only a null branch")
+    non_null = [(i, nm) for i, nm in enumerate(names) if nm != "null"]
+    if not non_null:
+        raise ValueError("union has only a null branch")
+    if len(non_null) == 1:
+        return non_null[0][0]
+    if isinstance(value, bool):
+        prefs = ("boolean",)
+    elif isinstance(value, int):
+        prefs = ("long", "int", "double", "float")
+    elif isinstance(value, float):
+        prefs = ("double", "float")
+    elif isinstance(value, str):
+        prefs = ("string", "enum")
+    elif isinstance(value, (bytes, bytearray)):
+        prefs = ("bytes", "fixed")
+    elif isinstance(value, dict):
+        prefs = ("record", "map")
+    elif isinstance(value, (list, tuple)):
+        prefs = ("array",)
+    else:
+        prefs = ()
+    for p in prefs:
+        for i, nm in non_null:
+            if nm == p:
+                return i
+    raise ValueError(
+        f"no union branch matches {type(value).__name__} value: {names}"
+    )
 
 
 def _encode_value(enc: _Encoder, schema: Any, value: Any) -> None:
@@ -361,7 +388,12 @@ def _encode_value(enc: _Encoder, schema: Any, value: Any) -> None:
         enc.write_boolean(bool(value))
         return
     if schema in ("int", "long"):
-        enc.write_long(int(value))
+        iv = int(value)
+        if iv != value:
+            # a double landing in a long field must error, not silently
+            # round-trip with lost precision (advisor r3 finding)
+            raise ValueError(f"non-integral value {value!r} for avro {schema}")
+        enc.write_long(iv)
         return
     if schema == "float":
         enc.write_float(float(value))
