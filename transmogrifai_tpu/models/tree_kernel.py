@@ -103,6 +103,17 @@ def bin_data(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
     return out
 
 
+def bins_device_dtype(max_bins: int):
+    """Device dtype for the binned matrix: int8 when every bin id fits
+    (max_bins <= 127; searchsorted can emit max_bins itself for
+    right-of-last-edge values, still < 127) - the [n, d] bins read is a
+    dominant HBM term of every level scan, and int8 carries it at 1/4 the
+    traffic.  TX_TREE_BIN_DTYPE=int32 opts out."""
+    if os.environ.get("TX_TREE_BIN_DTYPE", "").strip() == "int32":
+        return jnp.int32
+    return jnp.int8 if max_bins <= 127 else jnp.int32
+
+
 def _level_hist(bins, node_of_row, stats_w, L: int, B: int):
     """Per-level histogram [L, d, B, C] by one segment_sum scatter over all
     (row, feature) pairs — segment id = ((node * d) + j) * B + bin.
@@ -123,7 +134,11 @@ def _level_hist(bins, node_of_row, stats_w, L: int, B: int):
     C = stats_w.shape[1]
 
     def block_hist(nr, bb, sw):
-        seg = (nr[:, None] * d + jnp.arange(d)[None, :]) * B + bb
+        # bins may arrive int8 (bins_device_dtype): the segment-id
+        # arithmetic needs int32 range (L*d*B >> 127)
+        seg = (nr[:, None] * d + jnp.arange(d)[None, :]) * B + bb.astype(
+            jnp.int32
+        )
         flat = jnp.broadcast_to(
             sw[:, None, :], (sw.shape[0], d, C)
         ).reshape(-1, C)

@@ -88,34 +88,37 @@ class LangDetector(Transformer):
 
 
 # -- Name entity recognition -------------------------------------------------
-_HONORIFICS = {"mr", "mrs", "ms", "miss", "dr", "prof", "sir", "madam", "rev"}
 
 
 class NameEntityRecognizer(Transformer):
-    """Capitalization-heuristic person-name token extraction (reference:
-    NameEntityRecognizer.scala via OpenNLP tokenizer+NER models)."""
+    """Gazetteer+context person/location/organization tagger (reference:
+    NameEntityRecognizer.scala via OpenNLP tokenizer+NER models; rules and
+    accuracy fixture in ops/ner.py + tests/test_text_accuracy.py).  The
+    transformer emits the tagged TOKENS for ``entity_type`` (person by
+    default - the SmartTextVectorizer name-detection contract)."""
 
     input_types = [Text]
     output_type = MultiPickList
 
+    def __init__(self, entity_type: str = "person", **kw) -> None:
+        super().__init__(**kw)
+        self.params.setdefault("entity_type", entity_type)
+
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        from .ner import person_name_tokens, tag_entities
+
         (col,) = cols
         assert isinstance(col, TextColumn)
+        kind = str(self.params.get("entity_type", "person"))
         out = []
         for v in col.values:
-            names: set[str] = set()
-            if v:
-                tokens = re.findall(r"[A-Za-z][a-z']+|[A-Z]{2,}", v)
-                prev_hon = False
-                for tok in tokens:
-                    low = tok.lower().rstrip(".")
-                    if low in _HONORIFICS:
-                        prev_hon = True
-                        continue
-                    if tok[0].isupper() and (prev_hon or len(tok) > 2):
-                        names.add(low)
-                    prev_hon = False
-            out.append(frozenset(names))
+            if kind == "person":
+                out.append(person_name_tokens(v))
+            else:
+                toks: set[str] = set()
+                for ent in tag_entities(v).get(kind, []):
+                    toks.update(ent.split())
+                out.append(frozenset(toks))
         return ListColumn(out, MultiPickList)
 
 
