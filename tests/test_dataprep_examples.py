@@ -1,0 +1,59 @@
+"""End-to-end tests for the two data-prep example apps (reference:
+helloworld/.../dataprep/{JoinsAndAggregates,ConditionalAggregation}.scala)
+- the user-visible proof that aggregate/conditional/joined readers compose
+into real workflows.  Expected values are hand-derived from the embedded
+event tables using the reference's cutoff comparisons
+(FeatureAggregator.scala:114-123: predictors strictly before the cutoff,
+responses from it, windows inclusive at the far edge)."""
+
+from transmogrifai_tpu.examples.conditional_aggregation import (
+    conditional_aggregation_workflow,
+)
+from transmogrifai_tpu.examples.joins_and_aggregates import (
+    joins_and_aggregates_workflow,
+)
+
+
+def test_joins_and_aggregates_end_to_end():
+    wf, feats = joins_and_aggregates_workflow()
+    model = wf.train()
+    scored = model.score()
+    cols = scored.columns()
+    keys = wf._reader.left.row_keys()
+    assert keys == ["u1", "u2", "u3"]
+
+    by = {f.name: cols[f.name].to_list() for f in feats if f.name in cols}
+    # u1: 2 clicks yesterday, 1 tomorrow, 2 sends last week, ctr 2/(2+1)
+    # u2: no clicks in the yday window (Mar 8 is out), 1 tomorrow, 1 send
+    # u3: no click rows at all (left join null side), 1 send in window
+    assert by["numClicksYday"] == [2.0, None, None]
+    assert by["numClicksTomorrow"] == [1.0, 1.0, None]
+    assert by["numSendsLastWeek"] == [2.0, 1.0, 1.0]
+    assert [round(v, 4) for v in by["ctr"]] == [0.6667, 0.0, 0.0]
+
+
+def test_conditional_aggregation_end_to_end():
+    wf, feats = conditional_aggregation_workflow()
+    model = wf.train()
+    scored = model.score()
+    cols = scored.columns()
+    keys = wf._reader.row_keys()
+    # dan never lands on the target page -> dropped
+    assert keys == ["ann", "bob", "cat"]
+
+    by = {f.name: cols[f.name].to_list() for f in feats}
+    # ann: 3 browse visits strictly before her landing; purchase 30 min
+    # after it.  bob: landed with no prior visits (the landing itself is
+    # response-side); bought next morning.  cat: 1 prior visit; purchase
+    # 3 days later falls OUTSIDE the 1-day response window.
+    assert by["numVisitsWeekPrior"] == [3.0, None, 1.0]
+    assert by["numPurchasesNextDay"] == [1.0, 1.0, None]
+
+
+def test_conditional_scoring_reuses_fitted_model():
+    wf, feats = conditional_aggregation_workflow()
+    model = wf.train()
+    first = model.score().columns()
+    second = model.score().columns()
+    for name in first:
+        assert first[name].to_list() == second[name].to_list()

@@ -61,7 +61,8 @@ def test_aggregate_reader_cutoff_semantics():
         cutoff=CutOffTime(5.0),
     )
     ds = reader.generate_dataset([amount, label])
-    # predictors: events <= 5 summed; responses: events > 5 or'd
+    # predictors: events strictly before 5 summed; responses: events >= 5
+    # or'd (reference comparison FeatureAggregator.scala:114-123)
     assert ds["amount"].to_list() == [15.0, 7.0]
     assert ds["label"].to_list() == [1.0, None]
 
@@ -83,9 +84,11 @@ def test_conditional_reader_per_key_cutoff():
         response_window=5.0,
     )
     ds = reader.generate_dataset([spend, conv])
-    # only key 'a' has the condition; spend aggregates events <= t(visit)=2
+    # only key 'a' has the condition; spend aggregates events STRICTLY
+    # before t(visit)=2 - the visit that set the cutoff is response-side
+    # (reference FeatureAggregator.scala:119: date < cutoff)
     assert len(ds) == 1
-    assert ds["spend"].to_list() == [7.0]
+    assert ds["spend"].to_list() == [3.0]
     assert ds["converted"].to_list() == [1.0]
 
 

@@ -121,6 +121,55 @@ LANG_SAMPLES = [
     ("fr", "Nous avons passé nos vacances au bord de la mer avec toute la famille."),
     ("de", "Im Winter fahren wir oft in die Berge, um Ski zu fahren und zu wandern."),
     ("es", "Los estudiantes presentaron sus proyectos delante de toda la clase ayer."),
+    # round-4 expansion languages (held-out; none appear in the corpora)
+    ("no", "Min bror kjøpte en ny bil forrige måned, og han kjører den til jobben hver dag."),
+    ("no", "Restauranten på hjørnet serverer den beste kaffen i hele nabolaget."),
+    ("is", "Bróðir minn keypti nýjan bíl í síðasta mánuði og hann keyrir hann í vinnuna á hverjum degi."),
+    ("is", "Veitingastaðurinn á horninu býður upp á besta kaffið í öllu hverfinu."),
+    ("sk", "Môj brat si minulý mesiac kúpil nové auto a každý deň ním jazdí do práce."),
+    ("sk", "Reštaurácia na rohu podáva najlepšiu kávu v celej štvrti."),
+    ("hr", "Moj brat je prošli mjesec kupio novi auto i svaki dan se njime vozi na posao."),
+    ("hr", "Restoran na uglu poslužuje najbolju kavu u cijelom kvartu."),
+    ("sl", "Moj brat je prejšnji mesec kupil nov avto in se z njim vsak dan vozi v službo."),
+    ("sl", "Restavracija na vogalu streže najboljšo kavo v vsej soseski."),
+    ("sq", "Vëllai im bleu një makinë të re muajin e kaluar dhe e nget atë çdo ditë për në punë."),
+    ("sq", "Restoranti në qoshe shërben kafenë më të mirë në gjithë lagjen."),
+    ("lt", "Mano brolis praėjusį mėnesį nusipirko naują automobilį ir kasdien juo važiuoja į darbą."),
+    ("lt", "Restoranas ant kampo patiekia geriausią kavą visame rajone."),
+    ("lv", "Mans brālis pagājušajā mēnesī nopirka jaunu mašīnu un katru dienu ar to brauc uz darbu."),
+    ("lv", "Restorāns uz stūra pasniedz labāko kafiju visā apkaimē."),
+    ("et", "Mu vend ostis eelmisel kuul uue auto ja sõidab sellega iga päev tööle."),
+    ("et", "Nurgapealne restoran pakub kogu linnaosa parimat kohvi."),
+    ("ca", "El meu germà es va comprar un cotxe nou el mes passat i el condueix cada dia per anar a la feina."),
+    ("ca", "El restaurant de la cantonada serveix el millor cafè de tot el barri."),
+    ("gl", "O meu irmán mercou un coche novo o mes pasado e condúceo ao traballo todos os días."),
+    ("gl", "Despois da xuntanza decidimos cambiar o plan por completo."),
+    ("af", "My broer het verlede maand 'n nuwe motor gekoop en hy ry elke dag daarmee werk toe."),
+    ("af", "Die restaurant op die hoek bedien die beste koffie in die hele buurt."),
+    ("vi", "Anh trai tôi đã mua một chiếc xe mới vào tháng trước và lái nó đi làm mỗi ngày."),
+    ("vi", "Nhà hàng ở góc phố phục vụ cà phê ngon nhất trong cả khu phố."),
+    ("tl", "Bumili ang kuya ko ng bagong kotse noong nakaraang buwan at minamaneho niya ito papunta sa trabaho araw-araw."),
+    ("tl", "Ang restawran sa kanto ay naghahain ng pinakamasarap na kape sa buong lugar."),
+    ("sw", "Kaka yangu alinunua gari jipya mwezi uliopita na analiendesha kazini kila siku."),
+    ("sw", "Mkahawa ulioko kona hutoa kahawa bora zaidi katika mtaa mzima."),
+    ("ms", "Abang saya membeli kereta baharu bulan lepas dan memandunya ke tempat kerja setiap hari."),
+    ("ms", "Restoran di simpang itu menghidangkan kopi terbaik di seluruh kawasan."),
+    ("mt", "Ħija xtara karozza ġdida x-xahar li għadda u jsuqha kuljum għax-xogħol."),
+    ("mt", "Ir-ristorant fil-kantuniera jservi l-aħjar kafè fl-inħawi kollha."),
+    ("cy", "Prynodd fy mrawd gar newydd y mis diwethaf ac mae'n ei yrru i'r gwaith bob dydd."),
+    ("cy", "Mae'r bwyty ar y gornel yn gweini'r coffi gorau yn yr ardal gyfan."),
+    ("ga", "Cheannaigh mo dheartháir carr nua an mhí seo caite agus tiomáineann sé chun na hoibre é gach lá."),
+    ("ga", "Freastalaíonn an bialann ar an gcúinne an caife is fearr sa cheantar ar fad."),
+    ("eu", "Nire anaiak auto berri bat erosi zuen joan den hilabetean eta egunero lanera gidatzen du."),
+    ("eu", "Izkinako jatetxeak auzo osoko kafe onena zerbitzatzen du."),
+    ("az", "Qardaşım keçən ay təzə maşın aldı və hər gün onunla işə gedir."),
+    ("az", "Küncdəki restoran bütün məhəllədə ən yaxşı qəhvəni təqdim edir."),
+    ("uz", "Akam oʻtgan oy yangi mashina sotib oldi va har kuni u bilan ishga boradi."),
+    ("uz", "Burchakdagi restoran butun mahallada eng yaxshi qahvani taklif qiladi."),
+    ("ht", "Frè mwen an te achte yon machin nèf mwa pase a e li kondui li al travay chak jou."),
+    ("ht", "Restoran ki nan kwen an sèvi pi bon kafe nan tout katye a."),
+    ("so", "Walaalkay wuxuu iibsaday baabuur cusub bishii hore wuxuuna ku qaataa shaqada maalin kasta."),
+    ("so", "Makhaayadda geeska ku taal ayaa bixisa kaafiga ugu fiican xaafadda oo dhan."),
 ]
 
 

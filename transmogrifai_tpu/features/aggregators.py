@@ -30,16 +30,20 @@ class Event:
 
 @dataclass(frozen=True)
 class CutOffTime:
-    """Predictor/response split point (reference: CutOffTime.scala):
-    predictors aggregate events <= cutoff, responses events > cutoff."""
+    """Predictor/response split point (reference: CutOffTime.scala;
+    comparison semantics FeatureAggregator.scala:114-123): predictors
+    aggregate events STRICTLY before the cutoff, responses from the
+    cutoff on - so the event that set a conditional cutoff (the landing
+    on the target page) belongs to the response side, not the
+    predictors."""
 
     time: Optional[float] = None
 
     def is_predictor_event(self, ts: float) -> bool:
-        return self.time is None or ts <= self.time
+        return self.time is None or ts < self.time
 
     def is_response_event(self, ts: float) -> bool:
-        return self.time is None or ts > self.time
+        return self.time is None or ts >= self.time
 
 
 class MonoidAggregator:

@@ -63,9 +63,10 @@ def detect_language(text: Optional[str]) -> dict[str, float]:
     """Language -> confidence scores (reference: LangDetector.scala via
     the Optimaize profiles).  Unicode-script routing decides non-Latin
     scripts outright; Latin- and Cyrillic-script text is identified by
-    Cavnar-Trenkle rank-order trigram profiles built from the embedded
-    seed corpora in ops.lang_data (17 profiled + 13 script-decided
-    languages; accuracy pinned by tests/test_text_accuracy.py)."""
+    mixed 1-5-gram profile likelihoods built from the embedded seed
+    corpora in ops.lang_data (40 Latin + 3 Cyrillic profiled languages +
+    the script-decided set, ~57 total; accuracy pinned at >=90% on the
+    148-sample held-out fixture in tests/test_text_accuracy.py)."""
     if not text:
         return {}
     from .lang_data import detect

@@ -66,6 +66,12 @@ class AggregateReader:
     def _cutoff_for(self, key: Any, events) -> CutOffTime:
         return self.cutoff
 
+    def row_keys(self) -> list:
+        """Group keys in output-row order (the 'key' column of the
+        reference's aggregated frame, DataReader.scala:202) - what joins
+        on the aggregation key align on."""
+        return sorted(self._grouped(), key=str)
+
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
     ) -> Dataset:
@@ -116,9 +122,9 @@ class ConditionalReader(AggregateReader):
         self.drop_if_no_condition = drop_if_no_condition
         self.use_first = use_first
 
-    def generate_dataset(
-        self, raw_features: Sequence[Feature], params: Optional[dict] = None
-    ) -> Dataset:
+    def _effective_groups(self):
+        """(groups, cutoffs) after applying the target condition and the
+        drop rule - shared by generate_dataset and row_keys."""
         groups = self._grouped()
         cutoffs: dict[Any, CutOffTime] = {}
         for key, events in groups.items():
@@ -129,6 +135,15 @@ class ConditionalReader(AggregateReader):
                 )
         if self.drop_if_no_condition:
             groups = {k: v for k, v in groups.items() if k in cutoffs}
+        return groups, cutoffs
+
+    def row_keys(self) -> list:
+        return sorted(self._effective_groups()[0], key=str)
+
+    def generate_dataset(
+        self, raw_features: Sequence[Feature], params: Optional[dict] = None
+    ) -> Dataset:
+        groups, cutoffs = self._effective_groups()
         self._per_key_cutoffs = cutoffs
         keys = sorted(groups, key=str)
         cols: dict[str, list] = {}
@@ -193,6 +208,11 @@ class JoinedReader:
             (rdf, self.right, self.right_key),
         ):
             if key not in df.columns:
+                # aggregate/conditional readers emit one row per GROUP -
+                # their join key is the aggregation key, in row order
+                if hasattr(reader, "row_keys"):
+                    df[key] = reader.row_keys()
+                    continue
                 recs = getattr(reader, "records", None)
                 if recs is None:
                     raise KeyError(f"join key {key!r} unavailable")
