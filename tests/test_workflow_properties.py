@@ -1,0 +1,256 @@
+"""Property-style tests for the workflow engine over RANDOM feature DAGs
+(VERDICT r3 item 8: aim the contract-harness style at workflow/dag.py).
+
+A generator builds random graphs - 2-5 numeric predictors, random-depth
+transformer chains, label-touching sanity checkers at random depths,
+1-3 parallel selectors - and asserts cut_dag's structural invariants on
+every one (partition, leakage-freedom, transitive refit closure,
+downstream 'after' exactness).  A smaller seed set backs the invariants
+with real training: fold refit counts, warm-start skip sets, and
+computeDataUpTo prefix equivalence against a fully trained model.
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - activates the feature DSL
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.selector.splitters import DataSplitter
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.dag import (
+    _label_touching,
+    compute_dag,
+    cut_dag,
+    flatten,
+)
+
+
+def _random_graph(rng, n_selectors=None, with_after=None):
+    """Random feature DAG.  Returns (data, y, selectors, result_features,
+    intermediates) where intermediates are features strictly upstream of
+    the selectors (fair game for computeDataUpTo)."""
+    n = 160
+    n_pred = int(rng.randint(2, 6))
+    data = {"y": (rng.rand(n) > 0.5).astype(float).tolist()}
+    names = [f"x{i}" for i in range(n_pred)]
+    for i, nm in enumerate(names):
+        col = rng.randn(n)
+        if i == 0:  # keep one informative column so fits converge
+            col = col + 2.0 * np.asarray(data["y"])
+        data[nm] = col.tolist()
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    preds = [FeatureBuilder(ft.Real, nm).as_predictor() for nm in names]
+
+    # random transformer chains on a few predictors (non-label stages)
+    chained = []
+    for f in preds:
+        depth = int(rng.randint(0, 3))
+        for _ in range(depth):
+            f = (f + float(rng.randn())) if rng.rand() < 0.5 else (
+                f * float(1.0 + abs(rng.randn()))
+            )
+        chained.append(f)
+
+    k = int(rng.randint(1, 4)) if n_selectors is None else n_selectors
+    selectors, sel_preds, intermediates = [], [], []
+    for si in range(k):
+        lo = int(rng.randint(0, len(chained)))
+        subset = chained[lo:] or chained
+        vec = transmogrify(list(subset))
+        intermediates.append(vec)
+        # label-touching stage at random depth (or absent)
+        branch = rng.rand()
+        if branch < 0.6:
+            vec = y.sanity_check(vec, remove_bad_features=False)
+            intermediates.append(vec)
+            if branch < 0.2:  # two chained label-touching stages
+                vec = y.sanity_check(vec, remove_bad_features=False)
+                intermediates.append(vec)
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3,
+            models_and_parameters=[
+                (OpLogisticRegression(max_iter=6), [{"reg_param": 0.01}])
+            ],
+            splitter=DataSplitter(reserve_test_fraction=0.1),
+        )
+        pred = sel.set_input(y, vec).get_output()
+        selectors.append(sel)
+        sel_preds.append(pred)
+
+    results = list(sel_preds)
+    if with_after or (with_after is None and rng.rand() < 0.4):
+        # a stage strictly downstream of a selector output
+        results.append(sel_preds[0].alias(f"renamed_{rng.randint(10**6)}"))
+    return data, y, selectors, results, intermediates
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_cut_dag_invariants_on_random_graphs(seed):
+    rng = np.random.RandomState(seed)
+    data, y, selectors, results, _ = _random_graph(rng)
+    dag = compute_dag(results)
+    before, during, after = cut_dag(dag, selectors)
+
+    all_stages = set(flatten(dag))
+    b = {s for layer in before for s in layer}
+    d = set(during)
+    a = {s for layer in after for s in layer}
+
+    # 1. exact partition
+    assert b | d | a == all_stages
+    assert not (b & d) and not (b & a) and not (d & a)
+    assert all(sel in d for sel in selectors)
+
+    # per-selector upstream cones (stage -> in cone of selector?)
+    cones = {}
+    for sel in selectors:
+        cone = {
+            st for st in sel.get_output().parent_stages()
+            if st is not sel and st in all_stages
+        }
+        cones[sel.uid] = cone
+
+    # 2. leakage-freedom: no label-touching stage upstream of a selector
+    #    ever stays in 'before'
+    for sel in selectors:
+        for st in cones[sel.uid]:
+            if _label_touching(st):
+                assert st in d, (
+                    f"seed {seed}: label-touching {st.uid} left in before"
+                )
+
+    # 3. transitive closure: anything in a cone DOWNSTREAM of a during
+    #    stage is during too (the round-2 single-hop bug regression)
+    for sel in selectors:
+        cone = cones[sel.uid]
+        for st in cone:
+            if st not in d:
+                continue
+            st_out = st.get_output().uid
+            for other in cone:
+                if any(p.uid == st_out for p in other.input_features):
+                    assert other in d, (
+                        f"seed {seed}: {other.uid} consumes during-stage "
+                        f"{st.uid} output but is not during"
+                    )
+
+    # 4. 'after' is exactly the transitive downstream of selector outputs
+    produced = {sel.get_output().uid for sel in selectors}
+    expect_after = set()
+    changed = True
+    while changed:
+        changed = False
+        for st in all_stages - set(selectors) - expect_after:
+            if any(p.uid in produced for p in st.input_features):
+                expect_after.add(st)
+                produced.add(st.get_output().uid)
+                changed = True
+    assert a == expect_after, f"seed {seed}"
+
+    # 5. selectors with no label-touching cone stage contribute only
+    #    themselves to 'during'
+    for sel in selectors:
+        if not any(_label_touching(st) for st in cones[sel.uid]):
+            assert not (cones[sel.uid] & d), f"seed {seed}"
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_workflow_cv_fold_refit_counts_on_random_graphs(seed, monkeypatch):
+    """Every label-touching SanityChecker upstream of a selector refits
+    once per fold under with_workflow_cv - counted, not assumed."""
+    from transmogrifai_tpu.preparators import sanity_checker as sc_mod
+
+    rng = np.random.RandomState(seed)
+    data, y, selectors, results, _ = _random_graph(
+        rng, n_selectors=1, with_after=False
+    )
+    dag = compute_dag(results)
+    _, during, _ = cut_dag(dag, selectors)
+    n_checkers = sum(
+        1 for s in during if isinstance(s, sc_mod.SanityChecker)
+    )
+
+    calls = {"n": 0}
+    orig = sc_mod.SanityChecker.fit_model
+
+    def counting(self, cols, ds):
+        calls["n"] += 1
+        return orig(self, cols, ds)
+
+    monkeypatch.setattr(sc_mod.SanityChecker, "fit_model", counting)
+    wf = (
+        OpWorkflow().set_result_features(*results)
+        .set_input_dataset(data).with_workflow_cv()
+    )
+    wf.train()
+    # n_folds refits per during-checker + exactly one final full-data fit
+    assert calls["n"] == 3 * n_checkers + n_checkers, (
+        f"seed {seed}: {calls['n']} fits for {n_checkers} checkers"
+    )
+
+
+def _fit_uids(model):
+    return {
+        m["stage_uid"] for m in model.app_metrics.to_json()["stages"]
+        if m["phase"] == "fit"
+    }
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_warm_start_skip_sets_on_random_graphs(seed):
+    """Extending a random trained graph with one new estimator and warm
+    starting refits EXACTLY the new stages."""
+    rng = np.random.RandomState(seed)
+    data, y, selectors, results, intermediates = _random_graph(
+        rng, n_selectors=1, with_after=False
+    )
+    wf1 = OpWorkflow().set_result_features(*results).set_input_dataset(data)
+    m1 = wf1.train()
+    fitted_once = _fit_uids(m1)
+    assert fitted_once
+
+    new_pred = (
+        OpLogisticRegression(max_iter=6, reg_param=0.1)
+        .set_input(y, intermediates[0])
+        .get_output()
+    )
+    wf2 = (
+        OpWorkflow()
+        .set_result_features(*results, new_pred)
+        .set_input_dataset(data)
+        .with_model_stages(m1)
+    )
+    m2 = wf2.train()
+    refit = _fit_uids(m2)
+    assert not (refit & fitted_once), f"seed {seed}: re-fit {refit & fitted_once}"
+    assert refit == {new_pred.origin_stage.uid}
+
+
+@pytest.mark.parametrize("seed", [2, 9])
+def test_compute_data_up_to_prefix_equivalence(seed):
+    """computeDataUpTo on the workflow == the same columns from a fully
+    trained model's computeDataUpTo (deterministic upstream stages)."""
+    rng = np.random.RandomState(seed)
+    data, y, selectors, results, intermediates = _random_graph(
+        rng, n_selectors=1, with_after=False
+    )
+    target = intermediates[-1]
+    wf = OpWorkflow().set_result_features(*results).set_input_dataset(data)
+    ds_workflow = wf.compute_data_up_to(target)
+
+    model = wf.train()
+    ds_model = model.compute_data_up_to(target, data=data)
+    for name, col in ds_workflow.columns().items():
+        other = ds_model.columns().get(name)
+        assert other is not None, f"seed {seed}: {name} missing from model side"
+        va, vb = col.to_list(), other.to_list()
+        assert len(va) == len(vb)
+        for x, z in zip(va, vb):
+            if isinstance(x, float) and isinstance(z, float):
+                assert abs(x - z) < 1e-9
+            else:
+                assert x == z
