@@ -220,6 +220,52 @@ MIME_FIXTURES = [
     (b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1" + b"\x00" * 8,
      "application/x-ole-storage"),
     (b"II*\x00\x08\x00", "image/tiff"),
+    # round-4 breadth extension
+    (b"Rar!\x1a\x07\x00", "application/x-rar-compressed"),
+    (b"MSCF\x00\x00", "application/vnd.ms-cab-compressed"),
+    (b"!<arch>\ndebian", "application/x-archive"),
+    (b"\xed\xab\xee\xdb\x03\x00", "application/x-rpm"),
+    (b"\x28\xb5\x2f\xfd\x24\x00", "application/zstd"),
+    (b"\x04\x22\x4d\x18\x64\x40", "application/x-lz4"),
+    (b"\xff\xf1\x50\x80", "audio/aac"),
+    (b"#!AMR\n", "audio/amr"),
+    (b"MThd\x00\x00\x00\x06", "audio/midi"),
+    (b"FLV\x01\x05", "video/x-flv"),
+    (b"\x30\x26\xb2\x75\x8e\x66\xcf\x11\xa6\xd9", "video/x-ms-asf"),
+    (b"\x00\x00\x01\xba\x44\x00", "video/mpeg"),
+    (b"8BPS\x00\x01", "image/vnd.adobe.photoshop"),
+    (b"\x76\x2f\x31\x01\x02\x00", "image/x-exr"),
+    (b"PAR1\x15\x04", "application/x-parquet"),
+    (b"Obj\x01\x04\x14", "application/avro"),
+    (b"ORC\x08\x03", "application/x-orc"),
+    (b"\x89HDF\r\n\x1a\n\x00", "application/x-hdf5"),
+    (b"\xd4\xc3\xb2\xa1\x02\x00", "application/vnd.tcpdump.pcap"),
+    (b"\x00\x01\x00\x00\x00\x0c\x80\x00", "font/ttf"),
+    (b"OTTO\x00\x0b", "font/otf"),
+    (b"\x00asm\x01\x00\x00\x00", "application/wasm"),
+    (b"\xca\xfe\xba\xbe\x00\x00\x00\x34", "application/java-vm"),
+    (b"\xcf\xfa\xed\xfe\x07\x00", "application/x-mach-binary"),
+    (b"%!PS-Adobe-3.0\n", "application/postscript"),
+    (b"BEGIN:VCARD\nVERSION:3.0", "text/vcard"),
+    (b"BEGIN:VCALENDAR\nVERSION:2.0", "text/calendar"),
+    (b"\x1a\x45\xdf\xa3\x01\x00\x00\x00\x00\x00\x00\x23\x42\x86\x81\x01"
+     b"\x42\xf7\x81\x01\x42\x82\x84webm", "video/webm"),
+    (b"\x1a\x45\xdf\xa3\x01\x00\x00\x00\x00\x00\x00\x23\x42\x86\x81\x01"
+     b"\x42\x82\x88matroska", "video/x-matroska"),
+    (b"\x00\x00\x00\x1cftypavif\x00\x00", "image/avif"),
+    (b"\x00\x00\x00\x1cftyp3gp5\x00\x00", "video/3gpp"),
+    (b"PK\x03\x04\x14\x00\x08\x08" + b"\x00" * 18
+     + b"[Content_Types].xml" + b"\x00" * 8 + b"word/document.xml",
+     "application/vnd.openxmlformats-officedocument"
+     ".wordprocessingml.document"),
+    (b"PK\x03\x04\x14\x00\x08\x08" + b"\x00" * 18
+     + b"[Content_Types].xml" + b"\x00" * 8 + b"xl/workbook.xml",
+     "application/vnd.openxmlformats-officedocument.spreadsheetml.sheet"),
+    (b"PK\x03\x04\x0a\x00\x00\x00\x00\x00" + b"\x00" * 16 + b"\x08\x00"
+     + b"\x00\x00mimetypeapplication/epub+zip", "application/epub+zip"),
+    (b"PK\x03\x04\x0a\x00\x00\x00\x00\x00" + b"\x00" * 16 + b"\x08\x00"
+     + b"\x00\x00mimetypeapplication/vnd.oasis.opendocument.text",
+     "application/vnd.oasis.opendocument.text"),
     (b"<!DOCTYPE html><html><body>", "text/html"),
     (b"  <svg xmlns='http://www.w3.org/2000/svg'>", "image/svg+xml"),
     (b'{"key": "value"}', "application/json"),
