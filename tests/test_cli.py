@@ -1,6 +1,7 @@
 """CLI project generator test (reference: cli/src/test/.../CliFullCycleTest
 - generate then actually run the generated project)."""
 import os
+import re
 import subprocess
 import sys
 
@@ -187,3 +188,67 @@ def test_generate_handles_label_column_and_nonidentifiers(tmp_path, rng):
     with pytest.raises(KeyError, match="id column"):
         generate(str(path), response="label", name="X",
                  output=str(tmp_path / "nope"), id_col="typo")
+
+
+def test_ask_accepts_index_alias_and_reprompts():
+    from transmogrifai_tpu.cli import ask
+
+    opts = [("binary", ["binary", "yes"]), ("regression", ["regression"])]
+    feed = iter(["bogus", "1"])  # invalid input re-prompts
+    assert ask("Kind?", opts, input_fn=lambda q: next(feed)) == "regression"
+    assert ask("Kind?", opts, input_fn=lambda q: "YES") == "binary"
+    assert ask("Kind?", opts, input_fn=lambda q: "0") == "binary"
+
+
+def test_ask_answers_map_short_circuits_stdin():
+    from transmogrifai_tpu.cli import ask
+
+    def explode(q):  # stdin must never be touched
+        raise AssertionError("stdin used despite answers map")
+
+    got = ask(
+        "Problem kind for response 'y'",
+        [("binary", ["binary"]), ("multiclass", ["multiclass"])],
+        answers={"problem kind": "multiclass"},
+        input_fn=explode,
+    )
+    assert got == "multiclass"
+
+
+def test_generate_interactive_dialogue(tmp_path, csv_file):
+    """Scripted interactive run (reference: op gen question dialogue,
+    cli/gen/Ops.scala UserIO): confirm the inferred kind, pick no id."""
+    out = tmp_path / "proj_interactive"
+    feed = iter(["yes", "none"])
+    main_py = generate(
+        csv_file, response="y", name="InteractiveApp", output=str(out),
+        interactive=True, input_fn=lambda q: next(feed),
+    )
+    src = open(main_py).read()
+    assert "BinaryClassificationModelSelector" in src
+
+
+def test_generate_with_answers_file(tmp_path, csv_file):
+    """--answers scripts the dialogue without stdin (reference:
+    CliParameters.answersFile, 'prefix => answer' lines)."""
+    from transmogrifai_tpu.cli import load_answers, main
+
+    answers = tmp_path / "answers.txt"
+    answers.write_text(
+        "problem kind => binary\nwhich column is the row id => cat\n"
+    )
+    amap = load_answers(str(answers))
+    assert amap == {
+        "problem kind": "binary", "which column is the row id": "cat",
+    }
+    out = tmp_path / "proj_answers"
+    rc = main([
+        "gen", "--input", csv_file, "--response", "y",
+        "--name", "AnswersApp", "--output", str(out),
+        "--answers", str(answers),
+    ])
+    assert rc == 0
+    src = open(out / "main.py").read()
+    assert "BinaryClassificationModelSelector" in src
+    # 'cat' picked as the id column -> no predictor FeatureBuilder for it
+    assert not re.search(r'FeatureBuilder\([^)]*"cat"\)[^\n]*as_predictor', src)
