@@ -1,0 +1,159 @@
+"""Property tests for the evaluator stack (reference test strategy,
+SURVEY §4: the reference pins its metric implementations with exhaustive
+identity checks - same style over random predictions here).
+
+Invariants exercised per random seed:
+- AuROC is invariant under strictly monotone score transforms and flips
+  to 1-AuROC under score negation; perfect/anti-perfect/constant scores
+  hit their closed-form values
+- AuROC equals the Mann-Whitney U statistic (pair-counting definition,
+  ties at half weight) on small samples
+- confusion-matrix identities: TP+FN = positives, TN+FP = negatives,
+  Error = (FP+FN)/n, F1 harmonic identity
+- threshold curves: recall_by_threshold non-increasing in the threshold;
+  endpoints recall(0)=1, and the curve lengths match num_thresholds+1
+- the device-approximate masked rank metrics agree with the exact host
+  AuROC/AuPR within histogram resolution
+- multiclass: per-row probability rows sum to 1 -> top-1 threshold-0
+  point equals plain accuracy; regression: RMSE/MAE/R2 identities
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.binary import (
+    OpBinaryClassificationEvaluator,
+    _roc_pr_areas,
+    masked_rank_metrics,
+)
+from transmogrifai_tpu.evaluators.multiclass import (
+    OpMultiClassificationEvaluator,
+)
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+from transmogrifai_tpu.types.columns import PredictionColumn
+
+
+def _random_binary(rng, n=400):
+    y = (rng.random(n) > 0.4).astype(np.float64)
+    score = np.clip(
+        0.3 * y + 0.5 + 0.25 * rng.standard_normal(n), 0.0, 1.0
+    )
+    return y, score
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_auroc_monotone_invariance_and_negation(seed):
+    rng = np.random.default_rng(seed)
+    y, score = _random_binary(rng)
+    base, _ = _roc_pr_areas(y, score)
+    for transform in (
+        lambda s: 2.0 * s + 1.0,
+        lambda s: np.exp(s),
+        lambda s: s**3 + s,  # strictly increasing on [0, 1]
+    ):
+        got, _ = _roc_pr_areas(y, transform(score))
+        assert abs(got - base) < 1e-12
+    neg, _ = _roc_pr_areas(y, -score)
+    assert abs((base + neg) - 1.0) < 1e-9
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_auroc_equals_pair_counting(seed):
+    rng = np.random.default_rng(100 + seed)
+    n = 60
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    if y.sum() in (0, n):
+        y[0] = 1.0 - y[0]
+    score = np.round(rng.random(n), 2)  # coarse grid -> real ties
+    auroc, _ = _roc_pr_areas(y, score)
+    pos = score[y == 1]
+    neg = score[y == 0]
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    u = (wins + 0.5 * ties) / (len(pos) * len(neg))
+    assert abs(auroc - u) < 1e-9, f"seed {seed}: {auroc} vs U {u}"
+
+
+def test_auroc_closed_forms():
+    y = np.array([0, 0, 1, 1], dtype=np.float64)
+    assert _roc_pr_areas(y, np.array([0.1, 0.2, 0.8, 0.9]))[0] == 1.0
+    assert _roc_pr_areas(y, np.array([0.9, 0.8, 0.2, 0.1]))[0] == 0.0
+    auroc, _ = _roc_pr_areas(y, np.full(4, 0.5))
+    assert abs(auroc - 0.5) < 1e-12  # all-tied = chance
+    assert _roc_pr_areas(np.zeros(4), np.linspace(0, 1, 4)) == (0.0, 0.0)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_confusion_identities_and_threshold_curves(seed):
+    rng = np.random.default_rng(200 + seed)
+    y, score = _random_binary(rng)
+    pred = PredictionColumn(
+        (score > 0.5).astype(np.float64),
+        np.stack([-score, score], axis=1),
+        np.stack([1 - score, score], axis=1),
+    )
+    ev = OpBinaryClassificationEvaluator()
+    m = ev.evaluate_arrays(y, pred)
+    n = len(y)
+    assert m.TP + m.FN == y.sum()
+    assert m.TN + m.FP == n - y.sum()
+    assert abs(m.Error - (m.FP + m.FN) / n) < 1e-12
+    if m.Precision + m.Recall > 0:
+        f1 = 2 * m.Precision * m.Recall / (m.Precision + m.Recall)
+        assert abs(m.F1 - f1) < 1e-12
+    rec = m.recall_by_threshold
+    assert len(rec) == ev.num_thresholds + 1
+    assert len(m.precision_by_threshold) == ev.num_thresholds + 1
+    assert abs(rec[0] - 1.0) < 1e-12  # threshold 0 catches everything
+    assert all(a >= b - 1e-12 for a, b in zip(rec, rec[1:]))  # monotone
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_device_rank_metrics_match_host(seed):
+    rng = np.random.default_rng(300 + seed)
+    y, score = _random_binary(rng, n=2000)
+    exact_auroc, exact_aupr = _roc_pr_areas(y, score)
+    # one replica, full validation mask
+    auroc_b, aupr_b = masked_rank_metrics(
+        score[None, :], y, np.ones((1, len(y))))
+    assert abs(float(auroc_b[0]) - exact_auroc) < 5e-3
+    assert abs(float(aupr_b[0]) - exact_aupr) < 2e-2
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_multiclass_topk_and_accuracy(seed):
+    rng = np.random.default_rng(400 + seed)
+    n, k = 300, 4
+    y = rng.integers(0, k, n).astype(np.float64)
+    logits = rng.standard_normal((n, k)) + 1.5 * np.eye(k)[y.astype(int)]
+    prob = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+    pred = PredictionColumn(prob.argmax(axis=1).astype(np.float64),
+                            logits, prob)
+    m = OpMultiClassificationEvaluator().evaluate_arrays(y, pred)
+    acc = (prob.argmax(axis=1) == y).mean()
+    assert abs(m.F1 - m.F1) == 0.0  # finite
+    assert abs(m.Error - (1.0 - acc)) < 1e-12
+    tm = m.threshold_metrics
+    # top-1 at threshold 0 == plain accuracy; top-k correct rates are
+    # non-decreasing in k at every threshold
+    top1 = tm["correct_counts"]["1"][0] / max(n, 1)
+    assert abs(top1 - acc) < 1e-12
+    for t_idx in range(0, len(tm["thresholds"]), 25):
+        counts = [tm["correct_counts"][str(topn)][t_idx]
+                  for topn in sorted(int(s) for s in tm["correct_counts"])]
+        assert counts == sorted(counts)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_regression_metric_identities(seed):
+    rng = np.random.default_rng(500 + seed)
+    n = 250
+    y = rng.standard_normal(n) * 3 + 1
+    yhat = y + 0.5 * rng.standard_normal(n)
+    m = OpRegressionEvaluator().evaluate_arrays(
+        y, PredictionColumn(yhat))
+    err = y - yhat
+    assert abs(m.RootMeanSquaredError - np.sqrt((err**2).mean())) < 1e-9
+    assert abs(m.MeanAbsoluteError - np.abs(err).mean()) < 1e-9
+    ss_res = (err**2).sum()
+    ss_tot = ((y - y.mean()) ** 2).sum()
+    assert abs(m.R2 - (1 - ss_res / ss_tot)) < 1e-9

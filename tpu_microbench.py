@@ -128,6 +128,57 @@ def main() -> int:
         bin_winner=("pallas" if t_bpallas < t_bjnp else "jnp"),
     )
 
+    # -- packed vs vmap batched LR fit (the round-4 MXU packing) ----------
+    # (models/packed_newton.py: the CV fan-out Gram as [d,n]@[n,B*d]
+    # packed matmuls vs the [B,d,d] batched-vmap form - this records the
+    # on-chip speedup behind the synth_cv_mfu target)
+    try:
+        from transmogrifai_tpu.models.logistic_regression import (
+            _lr_fit_batched,
+        )
+        from transmogrifai_tpu.models.packed_newton import (
+            lr_fit_batched_packed,
+        )
+
+        ln = 2_000_000 if on_tpu else 50_000
+        ld, lB, liters = 39, 24, 5
+        lk = jax.random.split(key, 3)
+        lX = jax.random.normal(lk[0], (ln, ld), jnp.float32)
+        ly = (jax.random.uniform(lk[1], (ln,)) > 0.5).astype(jnp.float32)
+        lW = (jax.random.uniform(lk[2], (lB, ln)) > 0.25).astype(jnp.float32)
+        lregs = jnp.tile(jnp.asarray([0.001, 0.01, 0.1, 0.2] * 2), 3)
+        lens = jnp.full((lB,), 0.1, jnp.float32)
+        jax.block_until_ready((lX, ly, lW))
+        hess_bf16 = on_tpu
+        t_packed = _timeit(
+            lambda: lr_fit_batched_packed(
+                lX, ly, lW, lregs, lens, iters=liters, hess_bf16=hess_bf16
+            ), reps=3,
+        )
+        t_vmap = _timeit(
+            lambda: _lr_fit_batched(lX, ly, lW, lregs, lens, liters),
+            reps=3,
+        )
+        bp, ip = lr_fit_batched_packed(
+            lX, ly, lW, lregs, lens, iters=liters, hess_bf16=hess_bf16
+        )
+        bv, iv = _lr_fit_batched(lX, ly, lW, lregs, lens, liters)
+        par = float(np.max(np.abs(np.asarray(bp) - np.asarray(bv))))
+        lr_flops = lB * liters * (2.0 * ln * ld * ld + 4.0 * ln * ld)
+        result.update(
+            lrpack_rows=ln,
+            lrpack_packed_s=round(t_packed, 4),
+            lrpack_vmap_s=round(t_vmap, 4),
+            lrpack_speedup=round(t_vmap / t_packed, 3),
+            lrpack_packed_tflops_per_s=round(
+                lr_flops / t_packed / 1e12, 3
+            ),
+            lrpack_vmap_tflops_per_s=round(lr_flops / t_vmap / 1e12, 3),
+            lrpack_coef_maxdiff=float(f"{par:.3e}"),
+        )
+    except Exception as e:
+        result["lrpack_error"] = f"{type(e).__name__}: {e}"
+
     # -- tree level-histogram: scatter block size + bin dtype sweep -------
     # (VERDICT r4 prep: the 2^23 default block was sized from compile-time
     # HBM bounds, not throughput; sweep it on the chip and record the
