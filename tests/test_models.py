@@ -359,3 +359,24 @@ def test_fits_per_dispatch_work_model(monkeypatch):
     monkeypatch.setenv("TX_TREE_DISPATCH_BUDGET_S", "60")
     doubled = fits_per_dispatch(6, 10_000, 30, 32, 3)
     assert abs(doubled - 2 * base) <= 2  # int truncation slack
+
+
+def test_bench_scale_dispatch_plan_stays_under_watchdog():
+    """BASELINE config-5 shapes (10M x 39, 64 bins): the r3 on-chip
+    capture died with synth_rf_error because a dispatch outlived the
+    ~2-minute runtime watchdog.  Pin the work-model plan at exactly the
+    bench's RF (depth<=6, gini C=3) and GBT (depth<=4, C=4) shapes: one
+    fit must never threaten the kill, and a full dispatch must stay at
+    the ~30 s budget."""
+    from transmogrifai_tpu.models.tree_kernel import (
+        _tree_fit_work,
+        fits_per_dispatch,
+    )
+
+    rate, watchdog_s = 2.0e9, 120.0
+    for depth, n_stats in ((6, 3), (4, 4)):
+        per_fit_s = _tree_fit_work(depth, 10_000_000, 39, 64, n_stats) / rate
+        assert per_fit_s < watchdog_s / 3, per_fit_s
+        k = fits_per_dispatch(depth, 10_000_000, 39, 64, n_stats)
+        assert k >= 1
+        assert k * per_fit_s <= 45.0, (k, per_fit_s)
