@@ -132,10 +132,13 @@ def test_linreg_packed_matches_vmap(problem):
     np.testing.assert_allclose(np.asarray(ip), np.asarray(iv), atol=1e-4)
 
 
-def test_fit_arrays_batched_routes_packed_and_matches_single(problem):
-    """The public entry point must (a) take the packed route on a single
-    device and (b) still agree with the unbatched per-replica fit."""
+def test_fit_arrays_batched_routes_packed_and_matches_single(problem, monkeypatch):
+    """The public entry point must (a) take the packed route when forced
+    (on-TPU default; CPU hosts default to vmap - the packing measured
+    0.5x there) and (b) still agree with the unbatched per-replica fit."""
     X, y, W, regs, ens = problem
+    assert not use_packed(jnp.asarray(X), jnp.asarray(W))  # cpu default
+    monkeypatch.setenv("TX_PACKED_GRAM", "1")
     assert use_packed(jnp.asarray(X), jnp.asarray(W))
     est = OpLogisticRegression(max_iter=25)
     betas, b0s = est.fit_arrays_batched(X, y, W, regs, ens)

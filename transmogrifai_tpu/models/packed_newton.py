@@ -89,12 +89,20 @@ def packed_weighted_gram(Xh, wt_nB):
 
 
 def use_packed(*arrays) -> bool:
-    """Packed kernels are the single-device route (TX_PACKED_GRAM=0 forces
-    the vmap path, =1 forces packed).  Multi-device inputs keep the vmap
-    kernels, whose GSPMD row-sharding + psum lowering is already proven."""
+    """Packed kernels are the single-device TPU route (TX_PACKED_GRAM=0
+    forces the vmap path, =1 forces packed anywhere).  Multi-device
+    inputs keep the vmap kernels, whose GSPMD row-sharding + psum
+    lowering is already proven - and so do CPU hosts: the packing trades
+    a [c, B*d] temporary for MXU tile occupancy, a trade that MEASURED
+    0.5x on CPU (no MXU to feed; microbench lrpack section, 2026-07-30)."""
     override = os.environ.get("TX_PACKED_GRAM")
     if override is not None:
         return override.strip().lower() not in ("0", "false", "")
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
     for a in arrays:
         sharding = getattr(a, "sharding", None)
         if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
