@@ -380,3 +380,57 @@ def test_bench_scale_dispatch_plan_stays_under_watchdog():
         k = fits_per_dispatch(depth, 10_000_000, 39, 64, n_stats)
         assert k >= 1
         assert k * per_fit_s <= 45.0, (k, per_fit_s)
+
+
+def test_logistic_regression_multiclass_ovr():
+    """>2 classes route through one-vs-rest fits of the binary Newton
+    kernel with softmax-normalized scores (the reference's multinomial
+    LR counterpart); a 3-class linearly separable problem must be
+    recovered nearly perfectly."""
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+
+    rng = np.random.RandomState(4)
+    n = 600
+    centers = np.array([[2.0, 0.0], [-2.0, 1.5], [0.0, -2.5]])
+    y = np.repeat(np.arange(3.0), n // 3)
+    X = centers[y.astype(int)] + 0.5 * rng.randn(n, 2)
+    est = OpLogisticRegression(reg_param=0.01, max_iter=25)
+    params = est.fit_arrays(X, y)
+    assert set(params) >= {"betas", "intercepts", "classes"}
+    pred, raw, prob = est.predict_arrays(params, X)
+    assert (pred == y).mean() > 0.97
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-9)
+    # engine-free path identical
+    pred2, _, prob2 = est.predict_arrays_np(params, X)
+    np.testing.assert_array_equal(pred, pred2)
+    np.testing.assert_allclose(prob, prob2, atol=1e-12)
+    assert est.contributions(params).shape == (2,)
+
+
+def test_multiclass_selector_default_includes_working_lr():
+    """The default multiclass model set fields OpLogisticRegression
+    (reference MultiClassificationModelSelector Defaults: LR + RF); its
+    candidates must produce REAL metrics, not sigmoid-on-{0,1,2} garbage
+    riding the binary batched path (pinned via the _binary_labels guard)."""
+    from transmogrifai_tpu.evaluators.multiclass import (
+        OpMultiClassificationEvaluator,
+    )
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.selector.factories import lr_grid
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    rng = np.random.RandomState(11)
+    n = 450
+    centers = np.array([[2.5, 0.0], [-2.5, 1.0], [0.0, -3.0]])
+    y = np.repeat(np.arange(3.0), n // 3)
+    X = (centers[y.astype(int)] + 0.6 * rng.randn(n, 2)).astype(np.float64)
+    cv = OpCrossValidation(
+        num_folds=3, evaluator=OpMultiClassificationEvaluator(),
+        stratify=True, seed=0,
+    )
+    res = cv.validate([(OpLogisticRegression(max_iter=15), lr_grid())], X, y)
+    assert res.best_metric > 0.9, res.best_metric  # F1 on separable data

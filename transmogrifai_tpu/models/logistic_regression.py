@@ -169,6 +169,31 @@ class OpLogisticRegression(PredictorEstimator):
     def fit_arrays(self, X, y, w=None):
         n = len(y)
         w = np.ones(n) if w is None else w
+        classes = np.unique(np.asarray(y))
+        if len(classes) > 2:
+            # multiclass: one-vs-rest over the SAME binary Newton kernel
+            # (reference OpLogisticRegression is multinomial via MLlib;
+            # OvR + softmax normalization is the measured equivalent here
+            # - quality pinned by tests/test_models.py multiclass case).
+            # K is small, so a host loop of jitted fits is fine; each fit
+            # reuses the same compiled kernel (shapes identical).
+            betas, b0s = [], []
+            for c in classes:
+                beta, b0 = _lr_fit_kernel(
+                    jnp.asarray(X),
+                    jnp.asarray((np.asarray(y) == c).astype(np.float64)),
+                    jnp.asarray(w),
+                    jnp.asarray(float(self.params["reg_param"])),
+                    jnp.asarray(float(self.params["elastic_net_param"])),
+                    iters=int(self.params["max_iter"]),
+                )
+                betas.append(np.asarray(beta))
+                b0s.append(float(b0))
+            return {
+                "betas": np.stack(betas),
+                "intercepts": np.asarray(b0s),
+                "classes": classes.astype(np.float64),
+            }
         beta, b0 = _lr_fit_kernel(
             jnp.asarray(X),
             jnp.asarray(y),
@@ -202,6 +227,8 @@ class OpLogisticRegression(PredictorEstimator):
         return np.asarray(beta), np.asarray(b0)
 
     def predict_arrays(self, params: Any, X: np.ndarray):
+        if "betas" in params:  # one-vs-rest multiclass
+            return self.predict_arrays_np(params, np.asarray(X))
         pred, raw, prob = _lr_predict_kernel(
             jnp.asarray(X), jnp.asarray(params["beta"]),
             jnp.asarray(params["intercept"]),
@@ -209,6 +236,14 @@ class OpLogisticRegression(PredictorEstimator):
         return np.asarray(pred), np.asarray(raw), np.asarray(prob)
 
     def predict_arrays_np(self, params: Any, X: np.ndarray):
+        if "betas" in params:
+            z = X @ params["betas"].T + params["intercepts"]  # [n, K]
+            z = np.clip(z, -500, 500)
+            # softmax over the per-class margins normalizes the OvR scores
+            e = np.exp(z - z.max(axis=1, keepdims=True))
+            prob = e / e.sum(axis=1, keepdims=True)
+            pred = params["classes"][np.argmax(prob, axis=1)]
+            return pred.astype(np.float64), z, prob
         z = X @ params["beta"] + params["intercept"]
         p1 = 1.0 / (1.0 + np.exp(-np.clip(z, -500, 500)))
         prob = np.stack([1.0 - p1, p1], axis=1)
@@ -217,4 +252,6 @@ class OpLogisticRegression(PredictorEstimator):
         return pred, raw, prob
 
     def contributions(self, params: Any) -> Optional[np.ndarray]:
+        if "betas" in params:
+            return np.abs(params["betas"]).mean(axis=0)
         return np.abs(params["beta"])

@@ -189,11 +189,14 @@ class ModelSelector(Estimator):
         LR-style grids ride fit_arrays_batched, tree grids ride
         fit_arrays_folds_grid with a single fold row.  Falls back to
         per-candidate fits for estimators with no batched path."""
-        from .validator import _lr_style_grid, lr_grid_scalars
+        from .validator import _binary_labels, _lr_style_grid, lr_grid_scalars
 
         g = len(grid)
-        if g > 1 and hasattr(est, "fit_arrays_batched") and _lr_style_grid(
-            grid
+        if (
+            g > 1
+            and hasattr(est, "fit_arrays_batched")
+            and _lr_style_grid(grid)
+            and _binary_labels(yt)
         ):
             import jax.numpy as jnp
 

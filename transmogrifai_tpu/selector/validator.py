@@ -176,6 +176,7 @@ class OpValidator:
                 and metric_name in ("AuROC", "AuPR")
                 and hasattr(est, "fit_arrays_batched")
                 and _lr_style_grid(grid)
+                and _binary_labels(y)
             )
             return "approx" if uses_approx else "exact"
 
@@ -199,7 +200,11 @@ class OpValidator:
                     metrics[j] = np.asarray(ckpt[_key(est, pmap, mode)])
             if all(done_mask):
                 pass  # everything restored from checkpoint
-            elif hasattr(est, "fit_arrays_batched") and _lr_style_grid(grid):
+            elif (
+                hasattr(est, "fit_arrays_batched")
+                and _lr_style_grid(grid)
+                and _binary_labels(y)
+            ):
                 # ONE vmapped fit for the whole fold x grid batch.  Host
                 # ships only X (or nothing, if X is already a device
                 # array), the [k, n] fold masks and [n] weights - the
@@ -370,6 +375,14 @@ def _lr_style_grid(grid: Sequence[dict]) -> bool:
     """Batched path applies when every grid key is a batched-fit scalar."""
     ok = {"reg_param", "elastic_net_param"}
     return all(set(p) <= ok for p in grid)
+
+
+def _binary_labels(y) -> bool:
+    """The batched LR/SVC kernels assume y in {0,1}; multiclass labels
+    must take the generic per-candidate path, where fit_arrays routes to
+    the one-vs-rest fit (a 3-class label through the binary batched
+    kernel would silently fit sigmoid-on-{0,1,2} garbage)."""
+    return len(np.unique(np.asarray(y))) <= 2
 
 
 def lr_grid_scalars(est, grid: Sequence[dict]) -> tuple[np.ndarray, np.ndarray]:
