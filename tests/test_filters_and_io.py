@@ -188,3 +188,46 @@ def test_compute_data_up_to(tmp_path, rng):
     pred2 = OpLogisticRegression().set_input(y, vec2).get_output()
     with _pytest.raises(ValueError, match="not in .* DAG|not in"):
         model.compute_data_up_to(pred2, data=data)
+
+
+def test_multiclass_ovr_lr_save_load_roundtrip(tmp_path, rng):
+    """One-vs-rest LR params (betas [K,d] / intercepts / classes) must
+    survive the model writer and score identically after load."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    n = 240
+    yv = np.repeat(np.arange(3.0), n // 3)
+    Xv = np.array([[2.0, 0], [-2, 1], [0, -2.5]])[yv.astype(int)]
+    Xv = Xv + 0.5 * rng.randn(n, 2)
+    data = {"y": yv.tolist(), "a": Xv[:, 0].tolist(), "b": Xv[:, 1].tolist()}
+
+    def build():
+        y = FeatureBuilder(ft.RealNN, "y").as_response()
+        a = FeatureBuilder(ft.Real, "a").as_predictor()
+        b = FeatureBuilder(ft.Real, "b").as_predictor()
+        vec = transmogrify([a, b])
+        pred = (
+            OpLogisticRegression(reg_param=0.01)
+            .set_input(y, vec).get_output()
+        )
+        return OpWorkflow().set_result_features(pred).set_input_dataset(data)
+
+    m1 = build().train()
+    m1.save(str(tmp_path / "ovr_model"))
+    m2 = OpWorkflowModel.load(str(tmp_path / "ovr_model"), build())
+    s1 = [c for c in m1.score(data).columns().values()
+          if hasattr(c, "prediction")]
+    s2 = [c for c in m2.score(data).columns().values()
+          if hasattr(c, "prediction")]
+    assert len(s1) == len(s2) == 1
+    np.testing.assert_allclose(s1[0].prediction, s2[0].prediction)
+    np.testing.assert_allclose(s1[0].probability, s2[0].probability,
+                               atol=1e-12)
