@@ -254,3 +254,36 @@ def test_compute_data_up_to_prefix_equivalence(seed):
                 assert abs(x - z) < 1e-9
             else:
                 assert x == z
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_training_is_deterministic(seed):
+    """SURVEY §5.2: determinism is engineered (name-sorted layers, seeded
+    samplers).  Two trains of the same random graph on the same data must
+    produce IDENTICAL fitted parameters and scores."""
+    rng = np.random.RandomState(seed)
+    data, y, selectors, results, _ = _random_graph(
+        rng, n_selectors=1, with_after=False
+    )
+
+    def train_once():
+        wf = (
+            OpWorkflow().set_result_features(*results)
+            .set_input_dataset(data)
+        )
+        model = wf.train()
+        scored = model.score()
+        return {
+            name: col.prediction if hasattr(col, "prediction")
+            else col.values
+            for name, col in scored.columns().items()
+        }
+
+    s1, s2 = train_once(), train_once()
+    assert set(s1) == set(s2)
+    for name in s1:
+        v1, v2 = np.asarray(s1[name]), np.asarray(s2[name])
+        if v1.dtype.kind in "fc":
+            np.testing.assert_array_equal(v1, v2), name
+        else:
+            assert (v1 == v2).all(), name
