@@ -174,3 +174,22 @@ def test_linreg_entry_parity(problem):
     ).fit_arrays(X, target, W[2])
     np.testing.assert_allclose(betas[2], single["beta"], atol=1e-4)
     np.testing.assert_allclose(b0s[2], single["intercept"], atol=1e-4)
+
+
+def test_packed_gram_wide_design_matrix(monkeypatch):
+    """Hashing caps vectorized width at 16k dims (Transmogrifier.scala:
+    55-56); at B=24 replicas the packed Gram's N dimension spans ~384k
+    columns and the chunker must shrink rows accordingly.  Pin a scaled
+    stand-in (d=512, tight budget -> multi-chunk) against the einsum."""
+    rng = np.random.default_rng(13)
+    n, d, B = 700, 512, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = (rng.random((B, n)) > 0.3).astype(np.float32)
+    monkeypatch.setenv("TX_PACKED_GRAM_ELEMS", str(160 * B * d))
+    from transmogrifai_tpu.models.packed_newton import _gram_chunk_rows
+
+    c = _gram_chunk_rows(n, B, d)
+    assert 128 <= c < n  # multi-chunk with the floor respected
+    G = np.asarray(packed_weighted_gram(jnp.asarray(X), jnp.asarray(W.T)))
+    ref = np.einsum("nd,bn,ne->bde", X, W, X)
+    np.testing.assert_allclose(G, ref, rtol=3e-5, atol=5e-2)
