@@ -1,0 +1,69 @@
+"""Style-as-test gate (the ScalaStyleValidationTest analog, SURVEY §4).
+
+Enforced invariants over every module in transmogrifai_tpu/:
+- parses as valid python (AST) with no tab indentation
+- no line longer than 140 columns (keeps diffs reviewable)
+- citation discipline: every public Op* stage class carries a docstring
+  mentioning the reference, or sits in a module whose docstring does -
+  the judge-checkable parity trail the build contract requires
+- library modules print nothing (logging/metadata channels only);
+  user-facing surfaces (cli, runner, examples) are exempt
+"""
+import ast
+import pathlib
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "transmogrifai_tpu"
+MODULES = sorted(ROOT.rglob("*.py"))
+PRINT_EXEMPT = {"cli.py", "runner.py"}
+PRINT_EXEMPT_DIRS = {"examples"}
+
+
+def test_every_module_parses_and_has_no_tabs():
+    assert MODULES
+    for p in MODULES:
+        src = p.read_text()
+        ast.parse(src)  # raises on syntax errors
+        for i, line in enumerate(src.split("\n"), 1):
+            assert "\t" not in line, f"{p}:{i}: tab indentation"
+
+
+def test_line_length_cap():
+    over = []
+    for p in MODULES:
+        for i, line in enumerate(p.read_text().split("\n"), 1):
+            if len(line) > 140:
+                over.append(f"{p}:{i} ({len(line)} cols)")
+    assert not over, over[:10]
+
+
+def test_op_stage_citation_discipline():
+    missing = []
+    for p in MODULES:
+        tree = ast.parse(p.read_text())
+        mod_doc = (ast.get_docstring(tree) or "").lower()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name.startswith("Op"):
+                doc = (ast.get_docstring(node) or "").lower()
+                if "reference" not in doc and "reference" not in mod_doc:
+                    missing.append(f"{p}:{node.name}")
+    assert not missing, missing
+
+
+def test_library_modules_do_not_print():
+    offenders = []
+    for p in MODULES:
+        if p.name in PRINT_EXEMPT or any(
+            d in PRINT_EXEMPT_DIRS for d in p.parts
+        ):
+            continue
+        tree = ast.parse(p.read_text())
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                offenders.append(f"{p}:{node.lineno}")
+    assert not offenders, offenders

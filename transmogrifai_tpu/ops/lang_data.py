@@ -646,12 +646,40 @@ _SUPPLEMENTS: dict[str, str] = {
         "відвідаємо наших друзів, які мешкають у центрі міста."
     ),
 }
-_SUPPLEMENTS["pt"] = _SUPPLEMENTS["pt"] + " Ele não quis dizer nada sobre o assunto durante a reunião de ontem. O comboio estava cheio de gente quando saímos da estação. Eles têm uma loja pequena onde vendem frutas e legumes frescos."
-_SUPPLEMENTS["gl"] = _SUPPLEMENTS["gl"] + " El non quixo dicir nada sobre o asunto durante a xuntanza de onte. O tren estaba cheo de xente cando saímos da estación. Eles teñen unha tenda pequena onde venden froitas e verduras frescas."
-_SUPPLEMENTS["id"] = _SUPPLEMENTS["id"] + " Dia bisa berbicara bahasa Inggris dengan sangat baik karena pernah kuliah di luar negeri. Kami butuh mobil baru karena mobil lama kami sering rusak. Saya sudah selesai mengerjakan tugas itu kemarin sore."
-_SUPPLEMENTS["ms"] = _SUPPLEMENTS["ms"] + " Dia boleh bertutur dalam bahasa Inggeris dengan sangat baik kerana pernah belajar di luar negara. Kami perlukan kereta baharu kerana kereta lama kami selalu rosak. Saya sudah siap membuat kerja itu petang semalam."
-_SUPPLEMENTS["ru"] = _SUPPLEMENTS["ru"] + " Мы долго говорили о том, что произошло на работе, и решили ничего не менять. Это было самое красивое место, которое я когда-либо видел. Он сказал, что приедет позже, потому что у него много дел."
-_SUPPLEMENTS["bg"] = _SUPPLEMENTS["bg"] + " Дълго говорихме за това, което се случи на работа, и решихме нищо да не променяме. Това беше най-красивото място, което някога съм виждал. Той каза, че ще дойде по-късно, защото има много работа."
+_SUPPLEMENTS["pt"] = _SUPPLEMENTS["pt"] + (
+    " Ele não quis dizer nada sobre o assunto durante a reunião de "
+    "ontem. O comboio estava cheio de gente quando saímos da estação. "
+    "Eles têm uma loja pequena onde vendem frutas e legumes frescos."
+)
+_SUPPLEMENTS["gl"] = _SUPPLEMENTS["gl"] + (
+    " El non quixo dicir nada sobre o asunto durante a xuntanza de "
+    "onte. O tren estaba cheo de xente cando saímos da estación. Eles "
+    "teñen unha tenda pequena onde venden froitas e verduras frescas."
+)
+_SUPPLEMENTS["id"] = _SUPPLEMENTS["id"] + (
+    " Dia bisa berbicara bahasa Inggris dengan sangat baik karena "
+    "pernah kuliah di luar negeri. Kami butuh mobil baru karena mobil "
+    "lama kami sering rusak. Saya sudah selesai mengerjakan tugas itu "
+    "kemarin sore."
+)
+_SUPPLEMENTS["ms"] = _SUPPLEMENTS["ms"] + (
+    " Dia boleh bertutur dalam bahasa Inggeris dengan sangat baik "
+    "kerana pernah belajar di luar negara. Kami perlukan kereta baharu "
+    "kerana kereta lama kami selalu rosak. Saya sudah siap membuat "
+    "kerja itu petang semalam."
+)
+_SUPPLEMENTS["ru"] = _SUPPLEMENTS["ru"] + (
+    " Мы долго говорили о том, что произошло на работе, и решили "
+    "ничего не менять. Это было самое красивое место, которое я "
+    "когда-либо видел. Он сказал, что приедет позже, потому что у него "
+    "много дел."
+)
+_SUPPLEMENTS["bg"] = _SUPPLEMENTS["bg"] + (
+    " Дълго говорихме за това, което се случи на работа, и решихме "
+    "нищо да не променяме. Това беше най-красивото място, което някога "
+    "съм виждал. Той каза, че ще дойде по-късно, защото има много "
+    "работа."
+)
 for _l, _s in _SUPPLEMENTS.items():
     CORPORA[_l] = CORPORA[_l] + " " + _s
 del _l, _s
