@@ -131,3 +131,39 @@ def test_correlation_exclusion_hashed_text(rng):
         if "sanity_checker_summary" in s.metadata
     )
     assert summary2["correlation_excluded_columns"] == 0
+
+
+def test_cramers_v_edge_cases():
+    """Reference parity for the association statistic's edge behavior
+    (OpStatistics.cramersV; SURVEY §4 names these cases): perfect
+    association = 1, independence = 0, empty rows/cols filtered before
+    the test, degenerate 1xk tables = 0, empty = 0."""
+    import numpy as np
+
+    from transmogrifai_tpu.utils.stats import cramers_v
+
+    # perfect association (diagonal)
+    assert cramers_v(np.array([[50, 0], [0, 50]])) == pytest.approx(1.0)
+    assert cramers_v(np.array([[30, 0, 0], [0, 30, 0], [0, 0, 30]])) == (
+        pytest.approx(1.0)
+    )
+    # exact independence (outer product of margins)
+    ind = np.outer([40, 60], [30, 70]) / 100.0
+    assert cramers_v(ind) == pytest.approx(0.0, abs=1e-12)
+    # empty row AND empty column are filtered, not counted in dof
+    with_empty = np.array([[50, 0, 0], [0, 50, 0], [0, 0, 0]])
+    assert cramers_v(with_empty) == pytest.approx(1.0)
+    # degenerate shapes
+    assert cramers_v(np.array([[10, 20, 30]])) == 0.0  # 1 x k
+    assert cramers_v(np.array([[10], [20]])) == 0.0    # k x 1
+    assert cramers_v(np.zeros((3, 3))) == 0.0
+    assert cramers_v(np.zeros((0, 0))) == 0.0
+    # V is symmetric in table transpose
+    t = np.array([[12, 7, 3], [5, 22, 9]])
+    assert cramers_v(t) == pytest.approx(cramers_v(t.T))
+    # bounded in [0, 1] on random tables
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        tbl = rng.integers(0, 50, size=(3, 4))
+        v = cramers_v(tbl)
+        assert 0.0 <= v <= 1.0 + 1e-12
