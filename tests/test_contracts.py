@@ -436,6 +436,8 @@ def _specs():
         "JaccardSimilarity": _wire_simple(
             ta.JaccardSimilarity, [ft.MultiPickList, ft.MultiPickList]),
         "LangDetector": _wire_simple(ta.LangDetector, [ft.Text]),
+        "BestLanguageDetector": _wire_simple(
+            ta.BestLanguageDetector, [ft.Text]),
         "MimeTypeDetector": _wire_simple(ta.MimeTypeDetector, [ft.Base64]),
         "NGramSimilarity": _wire_simple(ta.NGramSimilarity, [ft.Text, ft.Text]),
         "SetNGramSimilarity": _wire_simple(

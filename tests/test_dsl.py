@@ -122,7 +122,10 @@ def test_text_enrichments_end_to_end(rng):
         "toks": t.tokenize(remove_stopwords=True, language="en"),
     }
     scored = _train(list(outs.values()), data)
-    assert scored[outs["lang"].name].values[0] == "en"
+    lang_scores = scored[outs["lang"].name].values[0]
+    # detect_languages returns the reference's RealMap of confidences
+    assert max(lang_scores, key=lang_scores.get) == "en"
+    assert abs(sum(lang_scores.values()) - 1.0) < 1e-6
     assert "smith" in scored[outs["ents"].name].values[0]
     assert scored[outs["len"].name].values[0] == len(data["t"][0])
     assert 0.0 < scored[outs["sim"].name].values[0] < 1.0

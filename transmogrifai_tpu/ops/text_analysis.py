@@ -33,6 +33,7 @@ from ..types.feature_types import (
     PickList,
     PickListMap,
     Real,
+    RealMap,
     RealNN,
     Text,
     TextList,
@@ -75,6 +76,27 @@ def detect_language(text: Optional[str]) -> dict[str, float]:
 
 
 class LangDetector(Transformer):
+    """Language -> confidence map per row (reference: OpLangDetector /
+    RichTextFeature.detectLanguages:394 returns a RealMap of scores, not
+    just the argmax - downstream vectorizers consume the full map)."""
+
+    input_types = [Text]
+    output_type = RealMap
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        from ..types.columns import MapColumn
+
+        (col,) = cols
+        assert isinstance(col, TextColumn)
+        out = [detect_language(v) if v else {} for v in col.values]
+        return MapColumn(out, RealMap)
+
+
+class BestLanguageDetector(Transformer):
+    """Convenience argmax of LangDetector's score map -> PickList (no
+    direct reference counterpart; the reference reaches the same value
+    via detectLanguages + map ops)."""
+
     input_types = [Text]
     output_type = PickList
 
