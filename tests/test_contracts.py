@@ -276,6 +276,16 @@ def _drop_null_indicators(meta):  # module-level: survives workflow rebuild
     return meta.is_null_indicator
 
 
+def _build_idf(n, rng):
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.ops.text import OpIDF
+
+    a, b = _raw("a", ft.Real), _raw("b", ft.Real)
+    vec = RealVectorizer().set_input(a, b).get_output()
+    out = OpIDF(min_doc_freq=1).set_input(vec).get_output()
+    return out, {"a": _values(ft.Real, n, rng), "b": _values(ft.Real, n, rng)}
+
+
 def _build_vectors_combiner(n, rng):
     from transmogrifai_tpu.ops.combiner import VectorsCombiner
     from transmogrifai_tpu.ops.numeric import IntegralVectorizer, RealVectorizer
@@ -431,6 +441,7 @@ def _specs():
             ctor=lambda: AliasTransformer(name="aliased")),
         "DropIndicesByTransformer": _build_drop_indices,
         "VectorsCombiner": _build_vectors_combiner,
+        "OpIDF": _build_idf,
         "TextTokenizer": _wire_simple(TextTokenizer, [ft.Text]),
         "EmailToPickList": _wire_simple(ta.EmailToPickList, [ft.Email]),
         "JaccardSimilarity": _wire_simple(

@@ -197,3 +197,77 @@ def test_examples_are_dsl_only():
         assert "from ..ops." not in src.replace(
             "from ..ops.transmogrifier import transmogrify", ""
         ), f"{fname} imports ops classes directly"
+
+
+def test_text_ml_sugar_tf_idf_lda_w2v(rng):
+    """Round-4 DSL closure (reference RichTextFeature tf/idf/tfidf,
+    countVec, lda, word + removeStopWords/tokenizeRegex)."""
+    docs = [
+        "the cat sat on the warm mat near the door",
+        "dogs chase the cat around the garden every day",
+        "stock markets fell sharply after the earnings report",
+        "investors sold shares as the market dropped again",
+    ] * 8
+    data = {"t": docs, "y": [0.0, 0.0, 1.0, 1.0] * 8}
+    t = FeatureBuilder(ft.Text, "t").as_predictor()
+    toks = t.tokenize()
+    tf_vec = toks.tf(num_features=64)
+    tfidf_vec = toks.tfidf(num_features=64)
+    counts = toks.count_vec(vocab_size=50, min_df=2.0)
+    topics = counts.lda(k=2, max_iter=10)
+    emb = toks.word2vec(vector_size=8, min_count=2)
+    nostop = toks.remove_stop_words()
+    rx = t.tokenize_regex(r"[^a-z]+")
+    scored = _train(
+        [tf_vec, tfidf_vec, counts, topics, emb, nostop, rx], data
+    )
+    tfv = scored[tf_vec.name].values
+    tiv = scored[tfidf_vec.name].values
+    assert tfv.shape == (32, 64) and (tfv.sum(axis=1) > 0).all()
+    # idf rescales but never flips presence
+    assert ((tfv != 0) >= (tiv != 0)).all()
+    assert scored[topics.name].values.shape == (32, 2)
+    assert scored[emb.name].values.shape == (32, 8)
+    assert "the" not in set().union(*scored[nostop.name].values)
+    assert scored[rx.name].values[0][0] == "the"
+
+
+def test_functional_and_phone_sugar(rng):
+    data = {
+        "r": [1.0, -2.0, 3.0, None],
+        "ph": ["650-253-0000", "not a phone", None, "+1 212 555 2368"],
+    }
+    r = FeatureBuilder(ft.Real, "r").as_predictor()
+    ph = FeatureBuilder(ft.Phone, "ph").as_predictor()
+    outs = {
+        "pos": r.exists(lambda v: v > 0),
+        "swap": r.replace_with(-2.0, 0.0),
+        "kept": r.filter_values(lambda v: v > 0, default=0.0),
+        "parsed": ph.parse_phone("US"),
+    }
+    scored = _train(list(outs.values()), data)
+    assert list(scored[outs["pos"].name].to_list()) == [
+        True, False, True, False]
+    assert scored[outs["swap"].name].to_list()[1] == 0.0
+    assert scored[outs["kept"].name].to_list()[:3] == [1.0, 0.0, 3.0]
+    parsed = scored[outs["parsed"].name].to_list()
+    assert parsed[0] == "+16502530000"
+    assert parsed[1] is None and parsed[2] is None
+    assert parsed[3] == "+12125552368"
+
+
+def test_date_unit_circle_sugar(rng):
+    import math
+
+    hour_ms = 3600 * 1000
+    data = {"d": [0, 6 * hour_ms, 12 * hour_ms, 18 * hour_ms]}
+    d = FeatureBuilder(ft.Date, "d").as_predictor()
+    circ = d.to_unit_circle("HourOfDay")
+    scored = _train([circ], data)
+    vals = scored[circ.name].values
+    assert vals.shape[1] == 2
+    # midnight -> angle 0 -> (sin, cos) in some order with unit norm
+    norms = np.sqrt((vals**2).sum(axis=1))
+    np.testing.assert_allclose(norms, 1.0, atol=1e-9)
+    # noon is diametrically opposite midnight
+    np.testing.assert_allclose(vals[2], -vals[0], atol=1e-9)

@@ -346,6 +346,135 @@ def _patch_feature() -> None:
 
         return ToOccurTransformer(matches=matches).set_input(self).get_output()
 
+    # -- text-ML sugar (reference RichTextFeature tf/idf/tfidf, countVec,
+    # lda, word2vec, removeStopWords, tokenizeRegex) ------------------------
+    def tf(self: Feature, num_features: int = 512) -> Feature:
+        """Hashing term frequencies of a TextList -> OPVector
+        (reference: RichTextFeature.tf via HashingTF)."""
+        from .ops.text import TextListHashingVectorizer
+
+        return (
+            TextListHashingVectorizer(hash_dims=num_features)
+            .set_input(self).get_output()
+        )
+
+    def idf(self: Feature, min_doc_freq: int = 0) -> Feature:
+        """Inverse document frequency scaling of a TF vector
+        (reference: RichTextFeature.idf via ml.feature.IDF)."""
+        from .ops.text import OpIDF
+
+        return OpIDF(min_doc_freq=min_doc_freq).set_input(self).get_output()
+
+    def tfidf(self: Feature, num_features: int = 512,
+              min_doc_freq: int = 0) -> Feature:
+        """tf then idf (reference: RichTextFeature.tfidf)."""
+        return idf(tf(self, num_features), min_doc_freq)
+
+    def count_vec(self: Feature, vocab_size: int = 1 << 18,
+                  min_df: float = 1.0, min_tf: float = 1.0,
+                  binary: bool = False) -> Feature:
+        """Vocabulary term counts (reference: RichTextFeature.countVec)."""
+        from .ops.text import OpCountVectorizer
+
+        return (
+            OpCountVectorizer(vocab_size=vocab_size, min_df=min_df,
+                              min_tf=min_tf, binary=binary)
+            .set_input(self).get_output()
+        )
+
+    def lda(self: Feature, k: int = 10, max_iter: int = 30) -> Feature:
+        """Topic mixture of a term-count/TF vector (reference:
+        RichVectorFeature.lda via ml.clustering.LDA)."""
+        from .models.unsupervised import OpLDA
+
+        return OpLDA(k=k, max_iter=max_iter).set_input(self).get_output()
+
+    def word2vec(self: Feature, vector_size: int = 100,
+                 min_count: int = 5) -> Feature:
+        """Mean skip-gram embedding of a TextList (reference:
+        RichTextFeature.word via ml.feature.Word2Vec)."""
+        from .models.unsupervised import OpWord2Vec
+
+        return (
+            OpWord2Vec(vector_size=vector_size, min_count=min_count)
+            .set_input(self).get_output()
+        )
+
+    def remove_stop_words(self: Feature, language: str = "en") -> Feature:
+        """Drop function words from a TextList (reference:
+        RichTextFeature.removeStopWords via StopWordsRemover)."""
+        from .ops.stopwords import stopwords_for
+        from .types.feature_types import TextList as _TL
+
+        stops = stopwords_for(language)
+        return map_values(
+            self,
+            lambda toks: tuple(t for t in (toks or ()) if t not in stops),
+            _TL,
+        )
+
+    def tokenize_regex(self: Feature, pattern: str,
+                       to_lowercase: bool = True) -> Feature:
+        """Split on a regex (reference: RichTextFeature.tokenizeRegex)."""
+        import re as _re
+
+        from .types.feature_types import TextList as _TL
+
+        rx = _re.compile(pattern)
+
+        def _split(v):
+            if not v:
+                return ()
+            toks = [t for t in rx.split(v) if t]
+            return tuple(t.lower() for t in toks) if to_lowercase else tuple(toks)
+
+        return map_values(self, _split, _TL)
+
+    # -- row-level functional sugar (reference FeatureLike exists/filter/
+    # replaceWith - Option-typed row ops become masked column maps) ---------
+    def exists(self: Feature, fn) -> Feature:
+        """True where the (non-missing) value satisfies ``fn``
+        (reference: RichFeature.exists)."""
+        from .types.feature_types import Binary as _B
+
+        return map_values(
+            self, lambda v: bool(v is not None and fn(v)), _B
+        )
+
+    def replace_with(self: Feature, old, new) -> Feature:
+        """Substitute one value for another (reference:
+        RichFeature.replaceWith)."""
+        return map_values(
+            self, lambda v, _o=old, _n=new: _n if v == _o else v, self.ftype
+        )
+
+    def filter_values(self: Feature, fn, default=None) -> Feature:
+        """Keep values satisfying ``fn``, else ``default`` (reference:
+        RichFeature.filter/filterNot)."""
+        return map_values(
+            self,
+            lambda v: v if (v is not None and fn(v)) else default,
+            self.ftype,
+        )
+
+    def parse_phone(self: Feature, region: str = "US") -> Feature:
+        """Normalize a phone number to digits-with-country-code, None when
+        invalid (reference: RichPhoneFeature.parsePhone via
+        libphonenumber)."""
+        from .ops.text_analysis import parse_phone as _pp
+        from .types.feature_types import Phone as _P
+
+        return map_values(self, lambda v: _pp(v, region), _P)
+
+    def to_unit_circle(self: Feature, period: str = "HourOfDay") -> Feature:
+        """(sin, cos) encoding of a date's position in ``period``
+        (reference: RichDateFeature.toUnitCircle via
+        DateToUnitCircleTransformer)."""
+        from .ops.dates import DateVectorizer
+
+        return DateVectorizer(periods=(period,), track_nulls=False) \
+            .set_input(self).get_output()
+
     F.fill_missing_with_mean = fill_missing_with_mean
     F.z_normalize = z_normalize
     F.pivot = pivot
@@ -379,6 +508,19 @@ def _patch_feature() -> None:
     F.drop_indices_by = drop_indices_by
     F.filter_map = filter_map
     F.to_occur = to_occur
+    F.tf = tf
+    F.idf = idf
+    F.tfidf = tfidf
+    F.count_vec = count_vec
+    F.lda = lda
+    F.word2vec = word2vec
+    F.remove_stop_words = remove_stop_words
+    F.tokenize_regex = tokenize_regex
+    F.exists = exists
+    F.replace_with = replace_with
+    F.filter_values = filter_values
+    F.parse_phone = parse_phone
+    F.to_unit_circle = to_unit_circle
 
 
 _patch_feature()

@@ -19,10 +19,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..stages.base import Transformer
-from ..types.columns import Column, ListColumn, TextColumn
+from ..stages.base import Estimator, Transformer
+from ..types.columns import Column, ListColumn, TextColumn, VectorColumn
 from ..types.dataset import Dataset
-from ..types.feature_types import Text, TextList
+from ..types.feature_types import OPVector, Text, TextList
 from ..types.vector_metadata import NULL_STRING, VectorColumnMeta
 from ..utils.hashing import hashing_tf
 from .categorical import OneHotModel, top_k_labels, _clean_value
@@ -373,3 +373,45 @@ class OpCountVectorizer(SequenceVectorizer):
         model.metadata = {"vocabulary": list(vocab)}
         self.metadata = model.metadata
         return model
+
+
+class IDFModel(Transformer):
+    """Scale a term-frequency vector by fitted idf weights."""
+
+    input_types = [OPVector]
+    output_type = OPVector
+
+    def __init__(self, idf: np.ndarray, **kw) -> None:
+        super().__init__(**kw)
+        self.idf = np.asarray(idf, dtype=np.float64)
+
+    def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
+        (c,) = cols
+        assert isinstance(c, VectorColumn)
+        return VectorColumn(c.values * self.idf[None, :], c.metadata)
+
+
+class OpIDF(Estimator):
+    """Inverse document frequency over a TF vector (reference: dsl
+    RichTextFeature.scala idf/tfidf wrapping spark ml feature.IDF):
+    idf_j = log((n + 1) / (df_j + 1)), df_j = documents with a non-zero
+    j-th component; components with df below ``min_doc_freq`` zero out
+    (spark's minDocFreq contract).  Vector metadata passes through
+    unchanged - the columns are the same terms, rescaled."""
+
+    input_types = [OPVector]
+    output_type = OPVector
+
+    def __init__(self, min_doc_freq: int = 0, **kw) -> None:
+        super().__init__(**kw)
+        self.min_doc_freq = int(min_doc_freq)
+
+    def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        (c,) = cols
+        assert isinstance(c, VectorColumn)
+        n = len(c)
+        df = (np.asarray(c.values) != 0.0).sum(axis=0).astype(np.float64)
+        idf = np.log((n + 1.0) / (df + 1.0))
+        if self.min_doc_freq > 0:
+            idf = np.where(df >= self.min_doc_freq, idf, 0.0)
+        return IDFModel(idf)

@@ -369,6 +369,24 @@ def is_valid_phone(phone: Optional[str], region: str = "US") -> Optional[bool]:
     return bool(pattern.match(digits))
 
 
+def parse_phone(phone: Optional[str], region: str = "US") -> Optional[str]:
+    """Normalize to '+<country code><national number>' or None when the
+    number does not validate for ``region`` (reference:
+    PhoneNumberParser.scala parsePhoneDefaultCountry via libphonenumber's
+    E.164 formatting)."""
+    if not phone or not is_valid_phone(phone, region):
+        return None
+    cc, (lo, hi), _ = _PHONE_RULES.get(region, _NANP)
+    digits = re.sub(r"[^\d+]", "", phone)
+    if digits.startswith("+"):
+        digits = digits[1 + len(cc):]
+    elif digits.startswith(cc) and len(digits) > hi:
+        digits = digits[len(cc):]
+    if region not in ("US", "CA") and digits.startswith("0"):
+        digits = digits[1:]
+    return f"+{cc}{digits}"
+
+
 class PhoneNumberParser(Transformer):
     """Phone -> Binary validity (reference: PhoneNumberParser.scala
     isValidPhoneDefaultCountry)."""
