@@ -466,6 +466,24 @@ def _patch_feature() -> None:
 
         return map_values(self, lambda v: _pp(v, region), _P)
 
+    def to_date_list(self: Feature) -> Feature:
+        """Wrap a Date in a single-element DateList (reference:
+        RichDateFeature.toDateList:54)."""
+        from .types.feature_types import DateList as _DL
+
+        return map_values(
+            self, lambda v: () if v is None else (v,), _DL
+        )
+
+    def to_multi_pick_list(self: Feature) -> Feature:
+        """TextList -> set-valued MultiPickList (reference:
+        RichTextFeature.toMultiPickList:58)."""
+        from .types.feature_types import MultiPickList as _MPL
+
+        return map_values(
+            self, lambda v: frozenset(v or ()), _MPL
+        )
+
     def to_unit_circle(self: Feature, period: str = "HourOfDay") -> Feature:
         """(sin, cos) encoding of a date's position in ``period``
         (reference: RichDateFeature.toUnitCircle via
@@ -521,6 +539,8 @@ def _patch_feature() -> None:
     F.filter_values = filter_values
     F.parse_phone = parse_phone
     F.to_unit_circle = to_unit_circle
+    F.to_date_list = to_date_list
+    F.to_multi_pick_list = to_multi_pick_list
 
 
 _patch_feature()
