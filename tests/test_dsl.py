@@ -271,3 +271,27 @@ def test_date_unit_circle_sugar(rng):
     np.testing.assert_allclose(norms, 1.0, atol=1e-9)
     # noon is diametrically opposite midnight
     np.testing.assert_allclose(vals[2], -vals[0], atol=1e-9)
+
+
+def test_to_date_list_and_to_multi_pick_list(rng):
+    """Scalar Text -> 0/1-element set (the reference receiver shape,
+    RichTextFeature.toMultiPickList:58 - NOT char-split); TextList ->
+    distinct tokens; Date -> single-element DateList with epoch 0
+    surviving (no falsy-zero trap)."""
+    data = {
+        "d": [0, 3600000, None],
+        "t": ["red", "blue", None],
+        "toks": ["a b a", "c", None],
+    }
+    d = FeatureBuilder(ft.Date, "d").as_predictor()
+    t = FeatureBuilder(ft.Text, "t").as_predictor()
+    toks = FeatureBuilder(ft.Text, "toks").as_predictor().tokenize()
+    dl = d.to_date_list()
+    scalar_set = t.to_multi_pick_list()
+    token_set = toks.to_multi_pick_list()
+    scored = _train([dl, scalar_set, token_set], data)
+    assert scored[dl.name].to_list() == [[0.0], [3600000.0], []]
+    assert list(scored[scalar_set.name].values) == [
+        frozenset({"red"}), frozenset({"blue"}), frozenset()]
+    assert list(scored[token_set.name].values) == [
+        frozenset({"a", "b"}), frozenset({"c"}), frozenset()]

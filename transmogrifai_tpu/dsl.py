@@ -476,13 +476,20 @@ def _patch_feature() -> None:
         )
 
     def to_multi_pick_list(self: Feature) -> Feature:
-        """TextList -> set-valued MultiPickList (reference:
-        RichTextFeature.toMultiPickList:58)."""
+        """Set-valued MultiPickList: a scalar Text becomes its 0/1-element
+        set (the reference receiver, RichTextFeature.toMultiPickList:58);
+        a TextList becomes its distinct-token set.  Strings must NOT be
+        iterated - frozenset('red') would char-split silently."""
         from .types.feature_types import MultiPickList as _MPL
 
-        return map_values(
-            self, lambda v: frozenset(v or ()), _MPL
-        )
+        def _to_set(v):
+            if v is None:
+                return frozenset()
+            if isinstance(v, str):
+                return frozenset((v,))
+            return frozenset(v)
+
+        return map_values(self, _to_set, _MPL)
 
     def to_unit_circle(self: Feature, period: str = "HourOfDay") -> Feature:
         """(sin, cos) encoding of a date's position in ``period``
