@@ -115,3 +115,66 @@ def test_batched_cv_matches_loop_cv(rng):
     res_loop = cv2.validate([(NoBatch(), grid)], X, y)
     for a, b in zip(res_batched.all_results, res_loop.all_results):
         assert a["metric"] == pytest.approx(b["metric"], abs=2e-3)
+
+
+def test_databalancer_weight_algebra_properties():
+    """DataBalancer edge cases (reference DataBalancer.scala:45-90; the
+    TPU redesign expresses resampling as sample weights): the reweighted
+    positive fraction hits the target exactly; already-balanced and
+    degenerate label sets pass through; the size cap uniformly
+    down-weights."""
+    import numpy as np
+
+    from transmogrifai_tpu.selector.splitters import DataBalancer
+
+    # 2% positives, target 10%: weighted positive fraction == target
+    rng = np.random.RandomState(0)
+    y = (rng.rand(5000) < 0.02).astype(float)
+    prep = DataBalancer(sample_fraction=0.1).prepare(y)
+    w = prep.weights
+    wp = w[y == 1].sum() / w.sum()
+    assert abs(wp - 0.1) < 1e-9
+    assert prep.summary["upSampled"] and not prep.summary["downSampled"]
+
+    # already above the target: untouched
+    y2 = (rng.rand(1000) < 0.4).astype(float)
+    prep2 = DataBalancer(sample_fraction=0.1).prepare(y2)
+    assert (prep2.weights == 1.0).all()
+    assert not prep2.summary["upSampled"]
+
+    # single-class labels: no reweighting, no NaN
+    prep3 = DataBalancer(sample_fraction=0.1).prepare(np.ones(50))
+    assert np.isfinite(prep3.weights).all() and (prep3.weights == 1.0).all()
+
+    # size cap: effective sample (sum of weights) respects the maximum
+    prep4 = DataBalancer(
+        sample_fraction=0.1, max_training_sample=100
+    ).prepare((rng.rand(1000) < 0.3).astype(float))
+    assert prep4.weights.sum() <= 100 + 1e-9
+    assert prep4.summary["downSampled"]
+
+
+def test_datacutter_label_curation_properties():
+    """DataCutter edge cases (reference DataCutter.scala:48-141): the
+    min-fraction floor and the top-K cap compose; kept+dropped partition
+    the label set; the keep mask matches the summary counts."""
+    import numpy as np
+
+    from transmogrifai_tpu.selector.splitters import DataCutter
+
+    y = np.array([0.0] * 500 + [1.0] * 300 + [2.0] * 150 + [3.0] * 45
+                 + [4.0] * 5)
+    prep = DataCutter(min_label_fraction=0.02).prepare(y)
+    assert prep.summary["labelsDropped"] == [4.0]  # 0.5% < 2%
+    assert prep.keep_mask.sum() == len(y) - 5
+
+    prep2 = DataCutter(max_label_categories=2).prepare(y)
+    assert prep2.summary["labelsKept"] == [0.0, 1.0]
+    assert prep2.summary["rowsDropped"] == 200
+
+    # kept + dropped partition the distinct labels
+    all_labels = {0.0, 1.0, 2.0, 3.0, 4.0}
+    for p in (prep, prep2):
+        kept = set(p.summary["labelsKept"])
+        dropped = set(p.summary["labelsDropped"])
+        assert kept | dropped == all_labels and not kept & dropped
