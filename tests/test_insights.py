@@ -103,3 +103,47 @@ def test_loco_detailed_format_round_trips(fitted):
             assert scores[1][1] == pytest.approx(
                 row_plain[history["columnName"]])
             assert scores[0][1] == pytest.approx(-scores[1][1], abs=1e-5)
+
+
+def test_loco_on_multiclass_ovr_lr(rng):
+    """Record insights over the one-vs-rest multiclass LR (round-4):
+    LOCO deltas must exist, rank the informative feature first, and the
+    detailed per-class format must carry one delta per class
+    (RecordInsightsLOCO.scala per-class score diffs)."""
+    from transmogrifai_tpu.insights.loco import parse_insights
+
+    n = 300
+    centers = np.array([[2.5, 0.0], [-2.5, 1.0], [0.0, -3.0]])
+    yv = np.repeat(np.arange(3.0), n // 3)
+    strong = centers[yv.astype(int), 0] + 0.4 * rng.randn(n)
+    weak = rng.randn(n)
+    data = {"y": yv.tolist(), "strong": strong.tolist(),
+            "weak": weak.tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fs = FeatureBuilder(ft.Real, "strong").as_predictor()
+    fw = FeatureBuilder(ft.Real, "weak").as_predictor()
+    vec = transmogrify([fs, fw])
+    pred = OpLogisticRegression(reg_param=0.01).set_input(fy, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    model = wf.train()
+    predictor_model = model.stages[-1]
+    assert "betas" in predictor_model.model_params  # OvR params in play
+
+    scored = model.score(data)
+    loco = RecordInsightsLOCO(predictor_model, top_k=4).set_input(vec)
+    out = loco.transform(scored)[loco.output_name]
+    # the strong feature's column dominates in most rows
+    top_hits = 0
+    for row in out.values[:50]:
+        top_col = max(row, key=lambda k: abs(row[k]))
+        if "strong" in top_col:
+            top_hits += 1
+    assert top_hits > 35, top_hits
+
+    detailed = RecordInsightsLOCO(
+        predictor_model, top_k=4, detailed=True
+    ).set_input(vec)
+    dout = detailed.transform(scored)[detailed.output_name]
+    parsed = parse_insights(dout.values[0])
+    # per-class deltas: 3 classes -> 3 (prediction_index, delta) pairs
+    assert all(len(deltas) == 3 for _, deltas in parsed)
