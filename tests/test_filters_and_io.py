@@ -231,3 +231,35 @@ def test_multiclass_ovr_lr_save_load_roundtrip(tmp_path, rng):
     np.testing.assert_allclose(s1[0].prediction, s2[0].prediction)
     np.testing.assert_allclose(s1[0].probability, s2[0].probability,
                                atol=1e-12)
+
+
+def test_feature_distribution_js_divergence_properties(rng):
+    """JS divergence invariants (reference FeatureDistribution.
+    jsDivergence): identity 0, symmetry, log2 bound of 1 on disjoint
+    support, monoid merge commutes with divergence inputs."""
+    from transmogrifai_tpu.filters.feature_distribution import (
+        FeatureDistribution,
+    )
+
+    def dist(hist):
+        h = np.asarray(hist, dtype=np.float64)
+        return FeatureDistribution(
+            name="f", key=None, count=int(h.sum()), nulls=0, histogram=h
+        )
+
+    a = dist(rng.randint(1, 50, 16))
+    b = dist(rng.randint(1, 50, 16))
+    assert a.js_divergence(a) == pytest.approx(0.0, abs=1e-12)
+    assert a.js_divergence(b) == pytest.approx(b.js_divergence(a))
+    assert 0.0 <= a.js_divergence(b) <= 1.0 + 1e-12
+    # disjoint support saturates the log2 bound
+    left = dist([10, 10, 0, 0])
+    right = dist([0, 0, 7, 3])
+    assert left.js_divergence(right) == pytest.approx(1.0)
+    # scale invariance: divergence depends on shapes, not counts
+    scaled = dist(np.asarray(a.histogram) * 7)
+    assert a.js_divergence(b) == pytest.approx(scaled.js_divergence(b))
+    # merge is the histogram monoid: merging equals summing
+    m = a.merge(dist(a.histogram))
+    assert m.count == 2 * a.count
+    assert m.js_divergence(a) == pytest.approx(0.0, abs=1e-12)
