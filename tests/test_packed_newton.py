@@ -193,3 +193,39 @@ def test_packed_gram_wide_design_matrix(monkeypatch):
     G = np.asarray(packed_weighted_gram(jnp.asarray(X), jnp.asarray(W.T)))
     ref = np.einsum("nd,bn,ne->bde", X, W, X)
     np.testing.assert_allclose(G, ref, rtol=3e-5, atol=5e-2)
+
+
+def test_full_cv_selection_parity_packed_vs_vmap(monkeypatch):
+    """Validator-level integration: the whole fold x grid CV flow must
+    pick the same candidate with the same metric through the packed and
+    vmap routes (the exact flow the on-chip bench runs)."""
+    import jax
+
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+    from transmogrifai_tpu.selector.factories import lr_grid
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    rng = np.random.default_rng(2)
+    n, d = 6000, 13
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    truth = rng.normal(size=d)
+    y = (
+        X @ truth / np.linalg.norm(truth) + 0.5 * rng.normal(size=n) > 0
+    ).astype(np.float64)
+
+    def run():
+        cv = OpCrossValidation(
+            num_folds=3, evaluator=OpBinaryClassificationEvaluator(),
+            stratify=True, seed=0,
+        )
+        return cv.validate([(OpLogisticRegression(), lr_grid())], X, y)
+
+    monkeypatch.setenv("TX_PACKED_GRAM", "1")
+    packed = run()
+    monkeypatch.setenv("TX_PACKED_GRAM", "0")
+    jax.clear_caches()
+    vmap = run()
+    assert packed.best_params == vmap.best_params
+    assert abs(packed.best_metric - vmap.best_metric) < 1e-4
