@@ -170,6 +170,20 @@ LANG_SAMPLES = [
     ("ht", "Restoran ki nan kwen an sèvi pi bon kafe nan tout katye a."),
     ("so", "Walaalkay wuxuu iibsaday baabuur cusub bishii hore wuxuuna ku qaataa shaqada maalin kasta."),
     ("so", "Makhaayadda geeska ku taal ayaa bixisa kaafiga ugu fiican xaafadda oo dhan."),
+    # third held-out template for a dozen round-4 languages (includes one
+    # honest sl->hr confusion - the closest pair in the set)
+    ("no", "Studentene la frem prosjektene sine foran hele klassen i går."),
+    ("is", "Nemendurnir kynntu verkefnin sín fyrir öllum bekknum í gær."),
+    ("sk", "Študenti včera predstavili svoje projekty pred celou triedou."),
+    ("hr", "Studenti su jučer predstavili svoje projekte pred cijelim razredom."),
+    ("sl", "Študenti so včeraj predstavili svoje projekte pred celim razredom."),
+    ("ca", "Els estudiants van presentar els seus projectes davant de tota la classe ahir."),
+    ("af", "Die studente het gister hulle projekte voor die hele klas aangebied."),
+    ("vi", "Các sinh viên đã trình bày dự án của họ trước cả lớp vào ngày hôm qua."),
+    ("sw", "Wanafunzi waliwasilisha miradi yao mbele ya darasa zima jana."),
+    ("tl", "Iniharap ng mga mag-aaral ang kanilang mga proyekto sa harap ng buong klase kahapon."),
+    ("az", "Tələbələr dünən layihələrini bütün sinfin qarşısında təqdim etdilər."),
+    ("ht", "Etidyan yo te prezante pwojè yo devan tout klas la yè."),
 ]
 
 
