@@ -38,7 +38,17 @@ class VectorsCombiner(Transformer):
             if vecs
             else np.zeros((len(ds), 0), dtype=np.float32)
         )
-        meta = VectorMetadata.combine(self.output_name, metas)
+        # memoize by input-metadata identity: upstream fitted stages emit
+        # cached metadata objects, so repeated transforms (per-row
+        # serving) skip the O(total columns) merge; the cache holds the
+        # input metas to pin their ids
+        cache = getattr(self, "_combine_cache", None)
+        key = tuple(id(m) for m in metas)
+        if cache is not None and cache[0] == key:
+            meta = cache[1]
+        else:
+            meta = VectorMetadata.combine(self.output_name, metas)
+            self._combine_cache = (key, meta, metas)
         return VectorColumn(values, meta)
 
 

@@ -41,7 +41,28 @@ class SequenceVectorizerModel(Transformer):
             if arrays
             else np.zeros((len(ds), 0), dtype=np.float32)
         )
-        meta = VectorMetadata(self.output_name, tuple(metas)).reindexed()
+        # a FITTED vectorizer's metadata is static: cache the reindexed
+        # tuple so repeated transforms (row scoring calls the whole DAG
+        # per row) skip ~k dataclass copies per call - profiled as the
+        # dominant single-row serving cost
+        cache = getattr(self, "_meta_cache", None)
+        if (
+            cache is not None
+            and cache[0] == self.output_name
+            and len(cache[1].columns) == len(metas)
+            and (not metas or (
+                # spot-check ends: fitted metas are deterministic, the
+                # guard catches stages whose state was mutated post-fit
+                cache[2] == metas[0] and cache[3] == metas[-1]
+            ))
+        ):
+            meta = cache[1]
+        else:
+            meta = VectorMetadata(self.output_name, tuple(metas)).reindexed()
+            self._meta_cache = (
+                self.output_name, meta,
+                metas[0] if metas else None, metas[-1] if metas else None,
+            )
         return VectorColumn(values, meta)
 
 

@@ -61,10 +61,16 @@ class SanityCheckerModel(Transformer):
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         vec = cols[1]
         assert isinstance(vec, VectorColumn)
-        return VectorColumn(
-            vec.values[:, self.indices_to_keep],
-            vec.metadata.select(self.indices_to_keep),
-        )
+        # memoize the metadata selection by input identity (see
+        # VectorsCombiner: upstream metadata objects are cached, so
+        # per-row serving reuses one selected metadata)
+        cache = getattr(self, "_select_cache", None)
+        if cache is not None and cache[0] == id(vec.metadata):
+            meta = cache[1]
+        else:
+            meta = vec.metadata.select(self.indices_to_keep)
+            self._select_cache = (id(vec.metadata), meta, vec.metadata)
+        return VectorColumn(vec.values[:, self.indices_to_keep], meta)
 
 
 class SanityChecker(Estimator):

@@ -73,6 +73,9 @@ _SKIP_ATTRS = {
     "metadata", "estimator_ref", "selector", "validator", "models",
     "splitter", "evaluators", "validation_result", "fn", "predicate",
     "model", "output_type", "input_types", "prefer_numpy",
+    # per-process transform memoizations (vectorizer_base/combiner/
+    # sanity_checker): identity-keyed, must never persist
+    "_meta_cache", "_combine_cache", "_select_cache",
 }
 
 
