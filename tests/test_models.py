@@ -434,3 +434,57 @@ def test_multiclass_selector_default_includes_working_lr():
     )
     res = cv.validate([(OpLogisticRegression(max_iter=15), lr_grid())], X, y)
     assert res.best_metric > 0.9, res.best_metric  # F1 on separable data
+
+
+def test_linear_kernels_survive_high_mean_low_variance_columns():
+    """f32 conditioning regression (round-4): columns whose |mean| >> std
+    made the folded centered-Gram identity cancel catastrophically - the
+    standardized Hessian went indefinite and the Newton solve NaN'd
+    (found driving a softmax language-score map with 2 distinct rows
+    through LR).  All linear kernels now pre-center globally and exclude
+    near-constant-under-weights columns like Spark's std==0 handling."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+    from transmogrifai_tpu.models.linear_svc import OpLinearSVC
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.models.packed_newton import (
+        lr_fit_batched_packed,
+    )
+
+    # 2 distinct rows, 40 columns ~N(0.03, 1e-4): mean/std ~ 300
+    row_a = 0.03 + 0.0003 * np.arange(40)
+    row_b = row_a + 0.0005 * ((-1.0) ** np.arange(40))
+    X = np.tile(np.stack([row_a, row_b]), (20, 1)).astype(np.float64)
+    y = np.tile([0.0, 1.0], 20)
+
+    lr = OpLogisticRegression(reg_param=0.01, max_iter=25)
+    p = lr.fit_arrays(X, y)
+    assert np.isfinite(p["beta"]).all() and np.isfinite(p["intercept"])
+    pred, _, _ = lr.predict_arrays(p, X)
+    assert (pred == y).mean() == 1.0
+
+    svc = OpLinearSVC(reg_param=0.01, max_iter=20)
+    ps = svc.fit_arrays(X, y)
+    assert np.isfinite(ps["beta"]).all()
+    preds, _, _ = svc.predict_arrays(ps, X)
+    assert (preds == y).mean() == 1.0
+
+    lin = OpLinearRegression(reg_param=0.01)
+    pl = lin.fit_arrays(X, y.astype(np.float64))
+    assert np.isfinite(pl["beta"]).all()
+    yhat, _, _ = lin.predict_arrays(pl, X)
+    assert np.corrcoef(yhat, y)[0, 1] > 0.99
+
+    # packed route too
+    W = np.ones((3, len(y)), np.float32)
+    bp, ip = lr_fit_batched_packed(
+        jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.float32),
+        jnp.asarray(W), jnp.asarray([0.01, 0.1, 0.01], jnp.float32),
+        jnp.asarray([0.0, 0.0, 0.1], jnp.float32), iters=25,
+        hess_bf16=False,
+    )
+    assert np.isfinite(np.asarray(bp)).all()
+    assert np.isfinite(np.asarray(ip)).all()

@@ -22,9 +22,15 @@ from .base import PredictorEstimator
 def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
     n, d = X.shape
     wsum = w.sum()
+    # global pre-centering + inactive-column exclusion: same f32
+    # conditioning fix as logistic_regression._lr_fit_kernel
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu = (w @ X) / wsum
-    var = (w @ (X * X)) / wsum - mu**2
-    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    msq = (w @ (X * X)) / wsum
+    var = msq - mu**2
+    active = var > 1e-6 * msq + 1e-30
+    sd = jnp.where(active, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
     ybar = (w @ y) / wsum
 
     lam_l2 = reg * (1.0 - elastic_net)
@@ -36,17 +42,20 @@ def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
     G = (
         XtWX - jnp.outer(mu, a) - jnp.outer(a, mu) + wsum * jnp.outer(mu, mu)
     ) / jnp.outer(sd, sd) / wsum
+    G = G * jnp.outer(active, active)
     r = w * (y - ybar)
-    c = ((X.T @ r) - mu * r.sum()) / sd / wsum
+    c = (((X.T @ r) - mu * r.sum()) / sd / wsum) * active
 
     def step(beta, _):
         l1_diag = lam_l1 / (jnp.abs(beta) + 1e-3)
-        H = G + jnp.diag(lam_l2 + l1_diag + jnp.full((d,), 1e-9))
+        H = G + jnp.diag(
+            lam_l2 + l1_diag + jnp.full((d,), 1e-9) + (1.0 - active)
+        )
         return jax.scipy.linalg.solve(H, c, assume_a="pos"), None
 
     beta_s, _ = jax.lax.scan(step, jnp.zeros((d,)), None, length=l1_iters)
     beta = beta_s / sd
-    intercept = ybar - (mu * beta).sum()
+    intercept = ybar - ((mu + m0) * beta).sum()
     return beta, intercept
 
 

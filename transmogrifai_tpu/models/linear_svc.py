@@ -28,8 +28,16 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
     n, d = X.shape
     ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
     wsum = jnp.maximum(w.sum(), 1e-12)
+    # global pre-centering + inactive-column exclusion: same f32
+    # conditioning fix as logistic_regression._lr_fit_kernel (the folded
+    # centered-Gram identity cancels catastrophically when |mean| >> std)
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu = (w @ X) / wsum
-    sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu**2, 1e-12))
+    msq = (w @ (X * X)) / wsum
+    var = msq - mu**2
+    active = var > 1e-6 * msq + 1e-30
+    sd = jnp.where(active, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
     # bf16 Hessian Gram on TPU, f32 gradient/active set: same fixed-point
     # argument as logistic_regression (curvature steers the path only)
     from .logistic_regression import _hessian_bf16
@@ -41,29 +49,33 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
         beta, b0 = carry  # beta in standardized space
         gamma = beta / sd
         margin = ypm * (X @ gamma + (b0 - mu @ gamma))
-        active = (margin < 1.0).astype(X.dtype) * w
+        act_rows = (margin < 1.0).astype(X.dtype) * w
         # squared hinge: L = sum_active (1 - m)^2 / wsum + reg |beta|^2
-        r = active * (margin - 1.0) * ypm
+        r = act_rows * (margin - 1.0) * ypm
         sr = r.sum()
-        g = (X.T @ r - mu * sr) / sd / wsum + 2.0 * reg * beta
+        g = ((X.T @ r - mu * sr) / sd / wsum + 2.0 * reg * beta) * active
         if hess_bf16:
             XtAX = jnp.matmul(
-                Xh.T, Xh * active.astype(jnp.bfloat16)[:, None],
+                Xh.T, Xh * act_rows.astype(jnp.bfloat16)[:, None],
                 preferred_element_type=jnp.float32,
             )
         else:
-            XtAX = X.T @ (X * active[:, None])
-        a = active @ X
-        s = active.sum()
+            XtAX = X.T @ (X * act_rows[:, None])
+        a = act_rows @ X
+        s = act_rows.sum()
         Hs = (
             XtAX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
         ) / jnp.outer(sd, sd) / wsum
+        Hs = Hs * jnp.outer(active, active)
         # trace-scaled jitter when the Gram is bf16-quantized (same
         # PD-safety argument as logistic_regression: curvature-only)
         jitter = 1e-8 + (
             1e-3 * jnp.trace(Hs) / d if hess_bf16 else 0.0
         )
-        H = Hs + jnp.diag(jnp.full((d,), 2.0 * reg)) + jitter * jnp.eye(d)
+        H = (
+            Hs + jnp.diag(jnp.full((d,), 2.0 * reg)) + jitter * jnp.eye(d)
+            + jnp.diag(1.0 - active)
+        )
         g0 = sr / wsum
         h0 = s / wsum + 1e-8
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
@@ -73,7 +85,7 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
         step, (jnp.zeros((d,)), jnp.asarray(0.0)), None, length=iters
     )
     beta = beta_s / sd
-    return beta, b0 - (mu * beta).sum()
+    return beta, b0 - ((mu + m0) * beta).sum()
 
 
 @partial(jax.jit, static_argnames=("iters",))

@@ -58,9 +58,22 @@ def _lr_fit_kernel(
     """
     n, d = X.shape
     wsum = w.sum()
+    # GLOBAL pre-centering: the folded-standardization identities below
+    # compute centered moments by subtracting outer products, which
+    # catastrophically cancels in f32 when |mean| >> std (a softmax-score
+    # map NaN'd the Cholesky: noise ~eps*mu^2/sd^2 reached the signal's
+    # order).  Centering by the unweighted global mean ONCE keeps every
+    # replica reading a single shared matrix (the design constraint) while
+    # making the per-replica means - and their cancellations - O(sd).
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu = (w @ X) / wsum
-    var = (w @ (X * X)) / wsum - mu**2
-    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    msq = (w @ (X * X)) / wsum
+    var = msq - mu**2
+    # (near-)constant-under-w columns are EXCLUDED like Spark's std==0
+    # handling (coefficient pinned to 0)
+    active = var > 1e-6 * msq + 1e-30
+    sd = jnp.where(active, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
     # Standardization is folded into the algebra instead of materializing a
     # standardized copy of X: under vmap over (folds x grid) weight vectors a
     # per-replica Xs would be a [B, n, d] temporary - the whole design
@@ -93,7 +106,7 @@ def _lr_fit_kernel(
         l1_diag = lam_l1 / (jnp.abs(beta) + 1e-3)
         Xr = X.T @ resid
         sr = resid.sum()
-        g = (Xr - mu * sr) / sd / wsum + (lam_l2 + l1_diag) * beta
+        g = ((Xr - mu * sr) / sd / wsum + (lam_l2 + l1_diag) * beta) * active
         if hess_bf16:
             XtWX = jnp.matmul(
                 Xh.T, Xh * wt.astype(jnp.bfloat16)[:, None],
@@ -114,7 +127,13 @@ def _lr_fit_kernel(
         jitter = 1e-9 + (
             1e-3 * jnp.trace(Hs) / d if hess_bf16 else 0.0
         )
-        H = Hs + jnp.diag(lam_l2 + l1_diag) + jitter * jnp.eye(d)
+        # excluded columns: identity row/col so the solve leaves them 0
+        amask = jnp.outer(active, active)
+        Hs = Hs * amask
+        H = (
+            Hs + jnp.diag(lam_l2 + l1_diag) + jitter * jnp.eye(d)
+            + jnp.diag(1.0 - active)
+        )
         g0 = sr / wsum
         h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
@@ -124,7 +143,7 @@ def _lr_fit_kernel(
         step, (jnp.zeros((d,)), jnp.asarray(0.0)), None, length=iters
     )
     beta = beta_s / sd
-    intercept = b0 - (mu * beta).sum()
+    intercept = b0 - ((mu + m0) * beta).sum()  # un-center the intercept
     return beta, intercept
 
 

@@ -128,9 +128,18 @@ def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
     n, d = X.shape
     B = W.shape[0]
     wsum = W.sum(axis=1)  # [B]
+    # global pre-centering + inactive-column exclusion (per replica):
+    # same f32 conditioning fix as the unbatched kernels - the shared
+    # matrix is centered ONCE, so replicas still read one array
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu = (W @ X) / wsum[:, None]  # [B, d]
-    var = (W @ (X * X)) / wsum[:, None] - mu**2
-    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    msq = (W @ (X * X)) / wsum[:, None]
+    var = msq - mu**2
+    active = (var > 1e-6 * msq + 1e-30).astype(X.dtype)  # [B, d]
+    sd = jnp.where(
+        active > 0, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0
+    )
     lam_l2 = regs * (1.0 - ens)
     lam_l1 = regs * ens
     eps = 1e-8
@@ -148,9 +157,10 @@ def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
         l1_diag = lam_l1[:, None] / (jnp.abs(beta) + 1e-3)  # [B, d]
         Xr = X.T @ resid  # [d, B]
         sr = resid.sum(axis=0)  # [B]
-        g = (Xr.T - mu * sr[:, None]) / sd / wsum[:, None] + (
-            lam_l2[:, None] + l1_diag
-        ) * beta
+        g = (
+            (Xr.T - mu * sr[:, None]) / sd / wsum[:, None]
+            + (lam_l2[:, None] + l1_diag) * beta
+        ) * active
         XtWX = packed_weighted_gram(Xh, wt.astype(Xh.dtype))  # [B, d, d] f32
         a = (X.T @ wt).T  # [B, d]
         s = wt.sum(axis=0)  # [B]
@@ -160,11 +170,14 @@ def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
             - a[:, :, None] * mu[:, None, :]
             + s[:, None, None] * (mu[:, :, None] * mu[:, None, :])
         ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
+        Hs = Hs * (active[:, :, None] * active[:, None, :])
         # same trace-scaled PD-safety jitter as the vmap kernel
         tr = jnp.trace(Hs, axis1=1, axis2=2)
         jitter = 1e-9 + (1e-3 * tr / d if hess_bf16 else 0.0)
-        H = Hs + _batched_diag(lam_l2[:, None] + l1_diag) + (
-            jitter[:, None, None] * eye if hess_bf16 else 1e-9 * eye
+        H = (
+            Hs
+            + _batched_diag(lam_l2[:, None] + l1_diag + (1.0 - active))
+            + (jitter[:, None, None] * eye if hess_bf16 else 1e-9 * eye)
         )
         g0 = sr / wsum
         h0 = s / wsum
@@ -175,7 +188,7 @@ def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
         step, (jnp.zeros((B, d)), jnp.zeros((B,))), None, length=iters
     )
     beta = beta_s / sd
-    intercept = b0 - (mu * beta).sum(axis=1)
+    intercept = b0 - ((mu + m0[None, :]) * beta).sum(axis=1)
     return beta, intercept
 
 
@@ -187,10 +200,14 @@ def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
     B = W.shape[0]
     ypm = 2.0 * y - 1.0
     wsum = jnp.maximum(W.sum(axis=1), 1e-12)  # [B]
+    # global pre-centering + exclusion (see lr_fit_batched_packed)
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu = (W @ X) / wsum[:, None]
-    sd = jnp.sqrt(
-        jnp.maximum((W @ (X * X)) / wsum[:, None] - mu**2, 1e-12)
-    )
+    msq = (W @ (X * X)) / wsum[:, None]
+    var = msq - mu**2
+    active = (var > 1e-6 * msq + 1e-30).astype(X.dtype)
+    sd = jnp.where(active > 0, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
     Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
     Wn = W.T  # [n, B]
     eye = jnp.eye(d)
@@ -201,21 +218,23 @@ def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
         margin = ypm[:, None] * (
             X @ gamma.T + (b0 - (mu * gamma).sum(axis=1))[None, :]
         )  # [n, B]
-        active = (margin < 1.0).astype(X.dtype) * Wn  # [n, B]
-        r = active * (margin - 1.0) * ypm[:, None]
+        act_rows = (margin < 1.0).astype(X.dtype) * Wn  # [n, B]
+        r = act_rows * (margin - 1.0) * ypm[:, None]
         sr = r.sum(axis=0)  # [B]
-        g = ((X.T @ r).T - mu * sr[:, None]) / sd / wsum[:, None] + (
-            2.0 * regs[:, None]
-        ) * beta
-        XtAX = packed_weighted_gram(Xh, active.astype(Xh.dtype))
-        a = (X.T @ active).T  # [B, d]
-        s = active.sum(axis=0)
+        g = (
+            ((X.T @ r).T - mu * sr[:, None]) / sd / wsum[:, None]
+            + (2.0 * regs[:, None]) * beta
+        ) * active
+        XtAX = packed_weighted_gram(Xh, act_rows.astype(Xh.dtype))
+        a = (X.T @ act_rows).T  # [B, d]
+        s = act_rows.sum(axis=0)
         Hs = (
             XtAX
             - mu[:, :, None] * a[:, None, :]
             - a[:, :, None] * mu[:, None, :]
             + s[:, None, None] * (mu[:, :, None] * mu[:, None, :])
         ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
+        Hs = Hs * (active[:, :, None] * active[:, None, :])
         tr = jnp.trace(Hs, axis1=1, axis2=2)
         jitter = (
             (1e-8 + 1e-3 * tr / d)[:, None, None] * eye
@@ -224,7 +243,10 @@ def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
         )
         H = (
             Hs
-            + _batched_diag(jnp.broadcast_to(2.0 * regs[:, None], (B, d)))
+            + _batched_diag(
+                jnp.broadcast_to(2.0 * regs[:, None], (B, d))
+                + (1.0 - active)
+            )
             + jitter
         )
         g0 = sr / wsum
@@ -236,7 +258,7 @@ def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
         step, (jnp.zeros((B, d)), jnp.zeros((B,))), None, length=iters
     )
     beta = beta_s / sd
-    return beta, b0 - (mu * beta).sum(axis=1)
+    return beta, b0 - ((mu + m0[None, :]) * beta).sum(axis=1)
 
 
 @partial(jax.jit, static_argnames=("l1_iters",))
@@ -249,9 +271,14 @@ def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8):
     n, d = X.shape
     B = W.shape[0]
     wsum = W.sum(axis=1)
+    # global pre-centering + exclusion (see lr_fit_batched_packed)
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu = (W @ X) / wsum[:, None]
-    var = (W @ (X * X)) / wsum[:, None] - mu**2
-    sd = jnp.sqrt(jnp.maximum(var, 1e-12))
+    msq = (W @ (X * X)) / wsum[:, None]
+    var = msq - mu**2
+    active = (var > 1e-6 * msq + 1e-30).astype(X.dtype)
+    sd = jnp.where(active > 0, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
     ybar = (W @ y) / wsum
     lam_l2 = regs * (1.0 - ens)
     lam_l1 = regs * ens
@@ -263,15 +290,20 @@ def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8):
         - a[:, :, None] * mu[:, None, :]
         + wsum[:, None, None] * (mu[:, :, None] * mu[:, None, :])
     ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
+    G = G * (active[:, :, None] * active[:, None, :])
     r = W * (y[None, :] - ybar[:, None])  # [B, n]
-    c = ((X.T @ r.T).T - mu * r.sum(axis=1)[:, None]) / sd / wsum[:, None]
+    c = (
+        ((X.T @ r.T).T - mu * r.sum(axis=1)[:, None]) / sd / wsum[:, None]
+    ) * active
 
     def step(beta, _):
         l1_diag = lam_l1[:, None] / (jnp.abs(beta) + 1e-3)
-        H = G + _batched_diag(lam_l2[:, None] + l1_diag + 1e-9)
+        H = G + _batched_diag(
+            lam_l2[:, None] + l1_diag + 1e-9 + (1.0 - active)
+        )
         return _psolve(H, c), None
 
     beta_s, _ = jax.lax.scan(step, jnp.zeros((B, d)), None, length=l1_iters)
     beta = beta_s / sd
-    intercept = ybar - (mu * beta).sum(axis=1)
+    intercept = ybar - ((mu + m0[None, :]) * beta).sum(axis=1)
     return beta, intercept
