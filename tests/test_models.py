@@ -478,6 +478,15 @@ def test_linear_kernels_survive_high_mean_low_variance_columns():
     yhat, _, _ = lin.predict_arrays(pl, X)
     assert np.corrcoef(yhat, y)[0, 1] > 0.99
 
+    # GLM families on the same matrix
+    from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+
+    for fam in ("gaussian", "poisson", "binomial"):
+        glm = OpGeneralizedLinearRegression(family=fam, reg_param=0.01)
+        pg = glm.fit_arrays(X, y if fam != "poisson" else y + 1.0)
+        assert np.isfinite(pg["beta"]).all(), fam
+        assert np.isfinite(pg["intercept"]), fam
+
     # packed route too
     W = np.ones((3, len(y)), np.float32)
     bp, ip = lr_fit_batched_packed(

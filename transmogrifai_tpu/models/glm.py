@@ -27,8 +27,15 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
     design matrix and adds only O(d^2) per-replica state."""
     n, d = X.shape
     wsum = jnp.maximum(w.sum(), 1e-12)
+    # global pre-centering + inactive-column exclusion: same f32
+    # conditioning fix as logistic_regression._lr_fit_kernel
+    m0 = X.mean(axis=0)
+    X = X - m0
     mu_x = (w @ X) / wsum
-    sd = jnp.sqrt(jnp.maximum((w @ (X * X)) / wsum - mu_x**2, 1e-12))
+    msq = (w @ (X * X)) / wsum
+    var = msq - mu_x**2
+    active = var > 1e-6 * msq + 1e-30
+    sd = jnp.where(active, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
 
     ybar = (w @ y) / wsum
     if family == "poisson":
@@ -61,7 +68,7 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
         wt = w * wt + 1e-8
         resid = w * (mu - y)
         sr = resid.sum()
-        g = (X.T @ resid - mu_x * sr) / sd / wsum + reg * beta
+        g = ((X.T @ resid - mu_x * sr) / sd / wsum + reg * beta) * active
         XtWX = X.T @ (X * wt[:, None])
         a = wt @ X
         s = wt.sum()
@@ -69,7 +76,8 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
             XtWX - jnp.outer(mu_x, a) - jnp.outer(a, mu_x)
             + s * jnp.outer(mu_x, mu_x)
         ) / jnp.outer(sd, sd) / wsum
-        H = Hs + jnp.diag(jnp.full((d,), reg + 1e-9))
+        Hs = Hs * jnp.outer(active, active)
+        H = Hs + jnp.diag(jnp.full((d,), reg + 1e-9) + (1.0 - active))
         g0 = sr / wsum
         h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
@@ -79,7 +87,7 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25):
         step, (jnp.zeros((d,)), b0_init), None, length=iters
     )
     beta = beta_s / sd
-    return beta, b0 - (mu_x * beta).sum()
+    return beta, b0 - ((mu_x + m0) * beta).sum()
 
 
 @partial(jax.jit, static_argnames=("family", "iters"))
