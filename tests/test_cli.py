@@ -252,3 +252,26 @@ def test_generate_with_answers_file(tmp_path, csv_file):
     assert "BinaryClassificationModelSelector" in src
     # 'cat' picked as the id column -> no predictor FeatureBuilder for it
     assert not re.search(r'FeatureBuilder\([^)]*"cat"\)[^\n]*as_predictor', src)
+
+
+def test_ask_strict_and_layered_answers():
+    """Scripted (strict) runs fail fast on missing/invalid answers; layered
+    prefix files let a later, more specific prefix supply the answer; and
+    non-strict (interactive + partial answers) falls through to the
+    prompt (advisor r4 + review r5)."""
+    import pytest
+
+    from transmogrifai_tpu.cli import ask
+
+    opts = [("a", ["colA"]), ("b", ["colB"])]
+    with pytest.raises(ValueError, match="no entry"):
+        ask("Which id column?", opts, answers={"unrelated": "colA"},
+            strict=True)
+    with pytest.raises(ValueError, match="invalid answer"):
+        ask("Which id column?", opts, answers={"which id": "nope"},
+            strict=True)
+    assert ask("Which id column?", opts,
+               answers={"which": "nope", "which id": "colB"},
+               strict=True) == "b"
+    assert ask("Which id column?", opts, answers={"unrelated": "colA"},
+               strict=False, input_fn=lambda q: "colB") == "b"

@@ -193,3 +193,77 @@ def test_validator_gbt_grid_end_to_end(rng):
             expect.append(ev.default_metric(m))
         got = res.all_results[j]["fold_metrics"]
         assert np.allclose(got, expect, atol=1e-9)
+
+def test_regression_cv_stays_on_batched_path(rng, monkeypatch):
+    """Continuous labels must NOT knock OpLinearRegression off the batched
+    route: the kernel is squared-loss, label cardinality is irrelevant
+    (regression: advisor r4 — the binary-label gate silently demoted every
+    regression CV/grid fit to per-candidate fit_arrays loops)."""
+    from transmogrifai_tpu.evaluators.regression import (
+        OpRegressionEvaluator,
+    )
+    from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    X, _, z = _data(rng, n=300)
+    grid = [{"reg_param": 0.01}, {"reg_param": 0.1}, {"reg_param": 0.2}]
+    est = OpLinearRegression()
+    assert est.batched_needs_binary_y is False
+
+    calls = {"single": 0}
+    orig = OpLinearRegression.fit_arrays
+
+    def counting_fit(self, Xa, ya, w=None):
+        calls["single"] += 1
+        return orig(self, Xa, ya, w)
+
+    monkeypatch.setattr(OpLinearRegression, "fit_arrays", counting_fit)
+    cv = OpCrossValidation(
+        num_folds=3, evaluator=OpRegressionEvaluator(), seed=0,
+        stratify=False,
+    )
+    res = cv.validate([(est, grid)], X, z)
+    assert len(res.all_results) == 3
+    # the batched branch never touches per-candidate fit_arrays; a demotion
+    # to the generic loop would call it k*g = 9 times
+    assert calls["single"] == 0
+
+
+def test_multiclass_labels_still_demote_classifier_batched_path(
+    rng, monkeypatch
+):
+    """3-class y through OpLogisticRegression must keep falling back to the
+    OVR per-candidate route (the binary batched kernel would fit sigmoid on
+    {0,1,2} garbage).  Pinned by call counting, same as the regression
+    sibling: the generic loop calls fit_arrays k*g times; the batched
+    branch would call it zero times."""
+    from transmogrifai_tpu.evaluators.multiclass import (
+        OpMultiClassificationEvaluator,
+    )
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    X, _, z = _data(rng, n=240)
+    y3 = np.digitize(z, np.quantile(z, [1 / 3, 2 / 3])).astype(float)
+    est = OpLogisticRegression()
+    assert est.batched_needs_binary_y is True
+
+    calls = {"single": 0}
+    orig = OpLogisticRegression.fit_arrays
+
+    def counting_fit(self, Xa, ya, w=None):
+        calls["single"] += 1
+        return orig(self, Xa, ya, w)
+
+    monkeypatch.setattr(OpLogisticRegression, "fit_arrays", counting_fit)
+    cv = OpCrossValidation(
+        num_folds=3, evaluator=OpMultiClassificationEvaluator(), seed=0,
+        stratify=True,
+    )
+    res = cv.validate([(est, [{"reg_param": 0.01}, {"reg_param": 0.1}])], X, y3)
+    best = res.best_params
+    assert calls["single"] == 3 * 2  # demoted: per-(fold, config) fits
+    assert res.best_metric > 0.5  # OVR fits real 3-class models
+    assert best["reg_param"] in (0.01, 0.1)

@@ -83,6 +83,12 @@ class PredictorEstimator(Estimator):
     input_types = [RealNN, OPVector]
     output_type = Prediction
     model_type: str = "Predictor"
+    # Whether fit_arrays_batched's kernel assumes y in {0,1} (sigmoid/hinge
+    # losses).  Classifiers keep the conservative True so multiclass labels
+    # fall back to the per-candidate OVR route; regressors override to False
+    # so continuous y never knocks them off the batched (MXU-packed) path
+    # and never pays an np.unique scan over the full label column.
+    batched_needs_binary_y: bool = True
 
     def fit_arrays(
         self, X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None

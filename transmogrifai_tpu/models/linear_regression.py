@@ -77,6 +77,7 @@ class OpLinearRegression(PredictorEstimator):
     {0.001,0.01,0.1,0.2}, elasticNet {0.1,0.5})"""
 
     model_type = "OpLinearRegression"
+    batched_needs_binary_y = False  # squared loss: any real y batches fine
 
     def __init__(
         self,

@@ -21,8 +21,8 @@ from __future__ import annotations
 
 from collections import Counter
 
-PROFILE_SIZE = 800  # mixed 1-4-gram ranks (sweep: 300=92%, 800=94% on
-# the held-out fixture at 40 Latin languages)
+PROFILE_SIZE = 800  # mixed 1-5-gram ranks (_GRAM_SIZES below; sweep:
+# 300=92%, 800=94% on the held-out fixture at 40 Latin languages)
 
 # -- Latin-script seed corpora ----------------------------------------------
 CORPORA: dict[str, str] = {

@@ -45,24 +45,20 @@ class SequenceVectorizerModel(Transformer):
         # tuple so repeated transforms (row scoring calls the whole DAG
         # per row) skip ~k dataclass copies per call - profiled as the
         # dominant single-row serving cost
+        metas_t = tuple(metas)
         cache = getattr(self, "_meta_cache", None)
         if (
             cache is not None
             and cache[0] == self.output_name
-            and len(cache[1].columns) == len(metas)
-            and (not metas or (
-                # spot-check ends: fitted metas are deterministic, the
-                # guard catches stages whose state was mutated post-fit
-                cache[2] == metas[0] and cache[3] == metas[-1]
-            ))
+            # full-tuple equality: metas are small frozen dataclasses, so
+            # this is cheap relative to reindexed() and it catches post-fit
+            # mutation of ANY column meta, not just the ends
+            and cache[2] == metas_t
         ):
             meta = cache[1]
         else:
-            meta = VectorMetadata(self.output_name, tuple(metas)).reindexed()
-            self._meta_cache = (
-                self.output_name, meta,
-                metas[0] if metas else None, metas[-1] if metas else None,
-            )
+            meta = VectorMetadata(self.output_name, metas_t).reindexed()
+            self._meta_cache = (self.output_name, meta, metas_t)
         return VectorColumn(values, meta)
 
 

@@ -196,7 +196,10 @@ class ModelSelector(Estimator):
             g > 1
             and hasattr(est, "fit_arrays_batched")
             and _lr_style_grid(grid)
-            and _binary_labels(yt)
+            and (
+                not getattr(est, "batched_needs_binary_y", True)
+                or _binary_labels(yt)
+            )
         ):
             import jax.numpy as jnp
 

@@ -167,6 +167,18 @@ class OpValidator:
         self._beat()  # validation started: open the liveness window
         metric_name = getattr(self.evaluator, "metric_name", "")
 
+        # One np.unique scan per validate() at most, and only if some
+        # classifier actually asks (regression estimators set
+        # batched_needs_binary_y=False and never trigger it).
+        _ybin: list = []
+
+        def _labels_ok(est) -> bool:
+            if not getattr(est, "batched_needs_binary_y", True):
+                return True
+            if not _ybin:
+                _ybin.append(_binary_labels(y))
+            return _ybin[0]
+
         def _est_mode(est, grid) -> str:
             """Whether THIS estimator's metrics will come from the 1024-bin
             device approximation; only the batched-LR rank-metric branch
@@ -176,7 +188,7 @@ class OpValidator:
                 and metric_name in ("AuROC", "AuPR")
                 and hasattr(est, "fit_arrays_batched")
                 and _lr_style_grid(grid)
-                and _binary_labels(y)
+                and _labels_ok(est)
             )
             return "approx" if uses_approx else "exact"
 
@@ -203,7 +215,7 @@ class OpValidator:
             elif (
                 hasattr(est, "fit_arrays_batched")
                 and _lr_style_grid(grid)
-                and _binary_labels(y)
+                and _labels_ok(est)
             ):
                 # ONE vmapped fit for the whole fold x grid batch.  Host
                 # ships only X (or nothing, if X is already a device
