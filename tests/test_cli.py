@@ -275,3 +275,33 @@ def test_ask_strict_and_layered_answers():
                strict=True) == "b"
     assert ask("Which id column?", opts, answers={"unrelated": "colA"},
                strict=False, input_fn=lambda q: "colB") == "b"
+
+
+def test_load_answers_rejects_malformed_lines(tmp_path):
+    """A malformed answers line must raise, not silently vanish (a dropped
+    entry turns a scripted run interactive) - review r5.  Blank lines and
+    #-comments stay legal; '=>' without surrounding spaces parses."""
+    import pytest
+
+    from transmogrifai_tpu.cli import load_answers
+
+    good = tmp_path / "good.txt"
+    good.write_text("# comment\n\nwhich id=>colB\nproblem kind => binary\n")
+    assert load_answers(str(good)) == {
+        "which id": "colB", "problem kind": "binary",
+    }
+    bad = tmp_path / "bad.txt"
+    bad.write_text("which id colB\n")
+    with pytest.raises(ValueError, match="expected 'prefix => answer'"):
+        load_answers(str(bad))
+
+
+def test_ask_empty_answers_dict_is_still_strict():
+    """answers={} (empty/malformed file) with strict must fail fast, not
+    fall through to a blocking stdin prompt - review r5."""
+    import pytest
+
+    from transmogrifai_tpu.cli import ask
+
+    with pytest.raises(ValueError, match="no entry"):
+        ask("Which id column?", [("a", ["colA"])], answers={}, strict=True)

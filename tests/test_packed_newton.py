@@ -229,3 +229,169 @@ def test_full_cv_selection_parity_packed_vs_vmap(monkeypatch):
     vmap = run()
     assert packed.best_params == vmap.best_params
     assert abs(packed.best_metric - vmap.best_metric) < 1e-4
+
+
+# -- mesh composition (round 5: shard_map Gram over the 'data' axis) --------
+
+def _mesh_24():
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axis_names=("replica", "data"), shape=(2, 4))
+
+
+def _shard_problem(problem, mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    X, y, W, regs, ens = problem
+    n = X.shape[0] - (X.shape[0] % mesh.shape["data"])
+    X, y, W = X[:n], y[:n], W[:, :n]
+    Xs = jax.device_put(X, NamedSharding(mesh, P("data", None)))
+    ys = jax.device_put(y, NamedSharding(mesh, P("data")))
+    Ws = jax.device_put(W, NamedSharding(mesh, P("replica", "data")))
+    rs = jax.device_put(
+        jnp.asarray(regs), NamedSharding(mesh, P("replica"))
+    )
+    es = jax.device_put(jnp.asarray(ens), NamedSharding(mesh, P("replica")))
+    return (X, y, W, regs, ens), (Xs, ys, Ws, rs, es)
+
+
+def test_packed_gram_mesh_matches_unsharded(problem):
+    """Each device packs its local row shard; psum('data') must reproduce
+    the single-device packed Gram to f32 reduction-order noise."""
+    mesh = _mesh_24()
+    (X, _, W, _, _), (Xs, _, Ws, _, _) = _shard_problem(problem, mesh)
+    G_ref = np.asarray(
+        packed_weighted_gram(jnp.asarray(X), jnp.asarray(W.T))
+    )
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    Wts = jax.device_put(
+        jnp.asarray(W.T), NamedSharding(mesh, P("data", "replica"))
+    )
+    G_mesh = np.asarray(packed_weighted_gram(Xs, Wts, mesh))
+    np.testing.assert_allclose(G_mesh, G_ref, rtol=2e-5, atol=1e-2)
+
+
+def test_packed_kernels_sharded_match_unsharded(problem):
+    """Coefficient parity for all three packed kernels between the
+    shard_map mesh route and the single-device route (VERDICT r4 #2:
+    sharded == unsharded on an 8-device CPU mesh)."""
+    mesh = _mesh_24()
+    (X, y, W, regs, ens), (Xs, ys, Ws, rs, es) = _shard_problem(
+        problem, mesh
+    )
+    Xj, yj, Wj = jnp.asarray(X), jnp.asarray(y), jnp.asarray(W)
+    rj, ej = jnp.asarray(regs), jnp.asarray(ens)
+
+    b0, i0 = lr_fit_batched_packed(Xj, yj, Wj, rj, ej, iters=8,
+                                   hess_bf16=False)
+    b1, i1 = lr_fit_batched_packed(Xs, ys, Ws, rs, es, iters=8,
+                                   hess_bf16=False, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), atol=5e-5)
+    np.testing.assert_allclose(np.asarray(i1), np.asarray(i0), atol=5e-5)
+
+    b0, i0 = svc_fit_batched_packed(Xj, yj, Wj, rj, iters=8,
+                                    hess_bf16=False)
+    b1, i1 = svc_fit_batched_packed(Xs, ys, Ws, rs, iters=8,
+                                    hess_bf16=False, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), atol=5e-5)
+    np.testing.assert_allclose(np.asarray(i1), np.asarray(i0), atol=5e-5)
+
+    b0, i0 = linreg_fit_batched_packed(Xj, yj, Wj, rj, ej)
+    b1, i1 = linreg_fit_batched_packed(Xs, ys, Ws, rs, es, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), atol=5e-5)
+    np.testing.assert_allclose(np.asarray(i1), np.asarray(i0), atol=5e-5)
+
+
+def test_packed_mesh_detection_and_use_packed(problem, monkeypatch):
+    """Mesh-sharded inputs must KEEP the packed route (round 4 excluded
+    them): packed_mesh_or_none finds the validator's mesh from the array
+    shardings and use_packed no longer refuses multi-device."""
+    from transmogrifai_tpu.models.packed_newton import packed_mesh_or_none
+
+    mesh = _mesh_24()
+    _, (Xs, _, Ws, _, _) = _shard_problem(problem, mesh)
+    assert packed_mesh_or_none(Xs, Ws) is mesh
+    monkeypatch.setenv("TX_PACKED_GRAM", "1")
+    assert use_packed(Xs, Ws)
+    # single-host numpy arrays have no mesh: plain body
+    assert packed_mesh_or_none(np.ones((4, 2))) is None
+
+
+def test_packed_gram_mesh_indivisible_falls_back(problem):
+    """Shapes the mesh does not divide must still produce the right Gram
+    (guard falls back to the GSPMD-lowered plain body)."""
+    mesh = _mesh_24()
+    X, _, W, _, _ = problem
+    n = (X.shape[0] // mesh.shape["data"]) * mesh.shape["data"] - 1
+    X, W = X[:n], W[:5, :n]  # B=5 not divisible by replica=2 either
+    G = np.asarray(
+        packed_weighted_gram(jnp.asarray(X), jnp.asarray(W.T), mesh)
+    )
+    ref = np.einsum("nd,bn,ne->bde", X, W, X)
+    np.testing.assert_allclose(G, ref, rtol=2e-5, atol=1e-2)
+
+
+def test_full_cv_mesh_selection_parity_packed_vs_vmap(monkeypatch):
+    """End-to-end: the validator's mesh branch (8 virtual CPU devices ->
+    rows on 'data', fold x grid on 'replica') with the packed route forced
+    must select the same candidate as the vmap route - the v5e-8 BASELINE
+    shape the round-4 packed kernels excluded."""
+    import jax
+
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+    from transmogrifai_tpu.selector.factories import lr_grid
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    rng = np.random.default_rng(3)
+    n, d = 4000, 11
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    truth = rng.normal(size=d)
+    y = (
+        X @ truth / np.linalg.norm(truth) + 0.5 * rng.normal(size=n) > 0
+    ).astype(np.float64)
+
+    def run():
+        cv = OpCrossValidation(
+            num_folds=3, evaluator=OpBinaryClassificationEvaluator(),
+            stratify=True, seed=0,
+        )
+        return cv.validate([(OpLogisticRegression(), lr_grid())], X, y)
+
+    monkeypatch.setenv("TX_PACKED_GRAM", "1")
+    packed = run()
+    monkeypatch.setenv("TX_PACKED_GRAM", "0")
+    jax.clear_caches()
+    vmap = run()
+    assert packed.best_params == vmap.best_params
+    assert abs(packed.best_metric - vmap.best_metric) < 1e-4
+
+
+def test_packed_mesh_or_none_rejects_indivisible_shapes(problem):
+    """Shapes the mesh does not divide must NOT take the packed route (the
+    dynamic_slice fallback under GSPMD row sharding is the exact layout
+    conflict the vmap kernels avoid) - review r5.  jax.device_put itself
+    refuses indivisible NamedSharding placement, so the guard is exercised
+    through duck-typed stand-ins (the shapes a non-validator caller could
+    hand over after jit with uneven outputs)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from transmogrifai_tpu.models.packed_newton import packed_mesh_or_none
+
+    mesh = _mesh_24()
+
+    class FakeArr:
+        def __init__(self, shape):
+            self.shape = shape
+            self.sharding = NamedSharding(mesh, P("data", None))
+
+    d = 13
+    assert packed_mesh_or_none(FakeArr((899, d)), FakeArr((8, 899))) is None
+    assert packed_mesh_or_none(FakeArr((904, d)), FakeArr((5, 904))) is None
+    assert (
+        packed_mesh_or_none(FakeArr((904, d)), FakeArr((8, 904))) is mesh
+    )

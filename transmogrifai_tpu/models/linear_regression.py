@@ -102,15 +102,21 @@ class OpLinearRegression(PredictorEstimator):
         return {"beta": np.asarray(beta), "intercept": float(b0)}
 
     def fit_arrays_batched(self, X, y, W, regs, ens):
-        """Single-device inputs ride the MXU-packed explicit batch
-        (packed_newton.py: the fixed fold-mask Gram runs ONCE as a packed
-        matmul, the l1 scan is [B, d, d] solves only)."""
-        from .packed_newton import linreg_fit_batched_packed, use_packed
+        """TPU inputs ride the MXU-packed explicit batch (packed_newton.py:
+        the fixed fold-mask Gram runs ONCE as a packed matmul, the l1 scan
+        is [B, d, d] solves only); mesh-sharded inputs keep packing via
+        the shard_map Gram."""
+        from .packed_newton import (
+            linreg_fit_batched_packed,
+            packed_mesh_or_none,
+            use_packed,
+        )
 
         if use_packed(X, W):
             beta, b0 = linreg_fit_batched_packed(
                 jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
                 jnp.asarray(regs), jnp.asarray(ens),
+                mesh=packed_mesh_or_none(X, W),
             )
         else:
             beta, b0 = _linreg_fit_batched(

@@ -117,16 +117,22 @@ class OpLinearSVC(PredictorEstimator):
         """Batched fit: W [B, n] weight masks, regs [B] -> stacked params;
         the whole CV x grid fan-out as one vmapped dispatch (same contract
         as OpLogisticRegression.fit_arrays_batched; SVC has no elastic-net
-        term, so ``ens`` is accepted and ignored).  Single-device inputs
-        ride the MXU-packed explicit batch (packed_newton.py)."""
+        term, so ``ens`` is accepted and ignored).  TPU inputs ride the
+        MXU-packed explicit batch (packed_newton.py); mesh-sharded inputs
+        keep packing via the shard_map Gram."""
         from .logistic_regression import _hessian_bf16
-        from .packed_newton import svc_fit_batched_packed, use_packed
+        from .packed_newton import (
+            packed_mesh_or_none,
+            svc_fit_batched_packed,
+            use_packed,
+        )
 
         iters = int(self.params.get("max_iter", 20))
         if use_packed(X, W):
             beta, b0 = svc_fit_batched_packed(
                 jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
                 jnp.asarray(regs), iters=iters, hess_bf16=_hessian_bf16(),
+                mesh=packed_mesh_or_none(X, W),
             )
         else:
             beta, b0 = _svc_fit_batched(

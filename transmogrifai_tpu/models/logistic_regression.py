@@ -225,11 +225,16 @@ class OpLogisticRegression(PredictorEstimator):
 
     def fit_arrays_batched(self, X, y, W, regs, ens):
         """Batched fit: W [B, n] weight masks, regs/ens [B] -> stacked params.
-        One computation = the whole CV x grid fan-out.  Single-device
-        inputs ride the MXU-packed explicit batch (packed_newton.py, the
-        Gram packs all replicas into the matmul N dimension); multi-device
-        inputs keep the vmap kernel whose GSPMD sharding is proven."""
-        from .packed_newton import lr_fit_batched_packed, use_packed
+        One computation = the whole CV x grid fan-out.  TPU inputs ride
+        the MXU-packed explicit batch (packed_newton.py, the Gram packs
+        all replicas into the matmul N dimension); mesh-sharded inputs
+        keep packing via the shard_map Gram, with rows on 'data' and
+        replicas on 'replica'."""
+        from .packed_newton import (
+            lr_fit_batched_packed,
+            packed_mesh_or_none,
+            use_packed,
+        )
 
         iters = int(self.params.get("max_iter", 25))
         if use_packed(X, W):
@@ -237,6 +242,7 @@ class OpLogisticRegression(PredictorEstimator):
                 jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
                 jnp.asarray(regs), jnp.asarray(ens),
                 iters=iters, hess_bf16=_hessian_bf16(),
+                mesh=packed_mesh_or_none(X, W),
             )
         else:
             beta, b0 = _lr_fit_batched(

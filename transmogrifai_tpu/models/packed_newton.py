@@ -23,13 +23,23 @@ Replica-count note: B = folds x grid is 24 for the reference default LR
 grid (DefaultSelectorParams.scala:36-61) - B*d ~ 936 columns, 7+ full MXU
 lanes.
 
-The vmap path remains the multi-device route: these kernels scan over row
-chunks with ``dynamic_slice``, which would fight GSPMD's row sharding;
-``fit_arrays_batched`` routes here only when inputs live on a single
-device (see ``use_packed``).  Math per row is IDENTICAL to the vmapped
-per-replica kernels (same standardization-folded algebra, same bf16-view /
-f32-accumulate Hessian contract, same eps/jitter terms), so coefficients
-agree to f32 fixed-point tolerance - pinned by tests/test_packed_newton.py.
+Multi-device composition (round 5): the row-chunk ``dynamic_slice`` scan
+that fought GSPMD row sharding now runs INSIDE a ``shard_map`` body over
+the mesh's 'data' axis - each device packs its LOCAL row shard (slicing is
+shard-local, so the conflict disappears), then a single ``psum`` over
+'data' reduces the [d, B_local*d] partials; with a 'replica' axis on the
+mesh the B replicas shard too and the [B, d, d] Gram comes back
+replica-sharded.  Every other op in these kernels is a plain matmul /
+reduction that GSPMD shards the same way it shards the vmap kernels.  So
+the v5e-8 CV fan-out shape (rows over 'data', fold x grid over 'replica',
+the reference's Future-pool analog, OpValidator.scala:289-306) keeps MXU
+packing instead of falling back to the [B, d, d] batched-matmul form.
+
+Math per row is IDENTICAL to the vmapped per-replica kernels (same
+standardization-folded algebra, same bf16-view / f32-accumulate Hessian
+contract, same eps/jitter terms), so coefficients agree to f32 fixed-point
+tolerance - pinned by tests/test_packed_newton.py, including the
+sharded == unsharded parity cases on an 8-device CPU mesh.
 """
 from __future__ import annotations
 
@@ -38,6 +48,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding
+try:  # jax >= 0.4.35 exports it at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
 
 
 def _gram_chunk_rows(n: int, B: int, d: int) -> int:
@@ -51,7 +67,40 @@ def _gram_chunk_rows(n: int, B: int, d: int) -> int:
     return min(n, c - (c % 8))
 
 
-def packed_weighted_gram(Xh, wt_nB):
+def _gram_2d(Xh, wt_nB):
+    """Packed weighted Gram over the rows this function SEES: [d, B*d] f32
+    with columns b*d+j holding X^T diag(wt[:, b]) X[:, j].  Row-chunked so
+    the [c, B*d] packed temporary stays within the element budget; under
+    shard_map the dynamic_slice indices are shard-local, so this same body
+    serves both the single-device and the mesh route."""
+    n, d = Xh.shape
+    B = wt_nB.shape[1]
+    c = _gram_chunk_rows(n, B, d)
+    if c >= n:
+        Z = (wt_nB[:, :, None] * Xh[:, None, :]).reshape(n, B * d)
+        return jnp.matmul(Xh.T, Z, preferred_element_type=jnp.float32)
+    nc = -(-n // c)
+    pad = nc * c - n
+    # zero rows in BOTH operands contribute exactly zero to the Gram
+    Xp = jnp.pad(Xh, ((0, pad), (0, 0)))
+    Wp = jnp.pad(wt_nB, ((0, pad), (0, 0)))
+
+    def body(acc, i):
+        Xc = jax.lax.dynamic_slice_in_dim(Xp, i * c, c)
+        Wc = jax.lax.dynamic_slice_in_dim(Wp, i * c, c)
+        Zc = (Wc[:, :, None] * Xc[:, None, :]).reshape(c, B * d)
+        return (
+            acc + jnp.matmul(Xc.T, Zc, preferred_element_type=jnp.float32),
+            None,
+        )
+
+    G, _ = jax.lax.scan(
+        body, jnp.zeros((d, B * d), jnp.float32), jnp.arange(nc)
+    )
+    return G
+
+
+def packed_weighted_gram(Xh, wt_nB, mesh=None):
     """All-replica weighted Gram as packed matmuls: returns [B, d, d] f32
     with G[b] = X^T diag(wt[:, b]) X.
 
@@ -59,42 +108,78 @@ def packed_weighted_gram(Xh, wt_nB):
     choice; accumulation is always f32).  wt_nB: [n, B] per-replica row
     weights in the SAME dtype as Xh so the multiply stays in the matmul's
     input precision.
+
+    ``mesh``: a Mesh with a 'data' axis routes through shard_map - each
+    device packs its local rows, one psum('data') reduces the partial
+    Grams, and a 'replica' axis (if present) keeps B sharded end to end.
+    Requires n divisible by the data axis and B by the replica axis (the
+    validator pads rows; cv_mesh_or_none picks replica | B).
     """
-    n, d = Xh.shape
-    B = wt_nB.shape[1]
-    c = _gram_chunk_rows(n, B, d)
-    if c >= n:
-        Z = (wt_nB[:, :, None] * Xh[:, None, :]).reshape(n, B * d)
-        G = jnp.matmul(Xh.T, Z, preferred_element_type=jnp.float32)
-    else:
-        nc = -(-n // c)
-        pad = nc * c - n
-        # zero rows in BOTH operands contribute exactly zero to the Gram
-        Xp = jnp.pad(Xh, ((0, pad), (0, 0)))
-        Wp = jnp.pad(wt_nB, ((0, pad), (0, 0)))
-
-        def body(acc, i):
-            Xc = jax.lax.dynamic_slice_in_dim(Xp, i * c, c)
-            Wc = jax.lax.dynamic_slice_in_dim(Wp, i * c, c)
-            Zc = (Wc[:, :, None] * Xc[:, None, :]).reshape(c, B * d)
-            return (
-                acc + jnp.matmul(Xc.T, Zc, preferred_element_type=jnp.float32),
-                None,
-            )
-
-        G, _ = jax.lax.scan(
-            body, jnp.zeros((d, B * d), jnp.float32), jnp.arange(nc)
+    if mesh is not None and "data" in mesh.axis_names:
+        nd = mesh.shape["data"]
+        nr = mesh.shape.get("replica", 1)
+        if Xh.shape[0] % nd or wt_nB.shape[1] % nr:
+            # mesh doesn't divide the shapes (direct caller, not the
+            # validator's padded layout): let GSPMD lower the plain body
+            mesh = None
+    if mesh is not None:
+        has_rep = "replica" in mesh.axis_names
+        wt_spec = P("data", "replica") if has_rep else P("data", None)
+        out_spec = (
+            P("replica", None, None) if has_rep else P(None, None, None)
         )
-    return G.reshape(d, B, d).transpose(1, 0, 2)
+
+        def local_gram(Xl, Wl):
+            d = Xl.shape[1]
+            Bl = Wl.shape[1]
+            G = jax.lax.psum(_gram_2d(Xl, Wl), "data")
+            return G.reshape(d, Bl, d).transpose(1, 0, 2)
+
+        return shard_map(
+            local_gram,
+            mesh=mesh,
+            in_specs=(P("data", None), wt_spec),
+            out_specs=out_spec,
+        )(Xh, wt_nB)
+    d = Xh.shape[1]
+    B = wt_nB.shape[1]
+    return _gram_2d(Xh, wt_nB).reshape(d, B, d).transpose(1, 0, 2)
+
+
+def packed_mesh_or_none(X, W=None):
+    """The Mesh to run the packed Gram over, when an input is sharded over
+    a mesh with a 'data' axis (the validator's device_put layout); None
+    routes the caller to the vmap kernels / plain Gram body.
+
+    Indivisible shapes return None too: X rows must divide the 'data'
+    axis and W's replica count the 'replica' axis, or the shard_map body
+    can't form - and the fallback (dynamic_slice row chunks under plain
+    GSPMD row sharding) is exactly the layout conflict the vmap kernels
+    exist to avoid, so such inputs must NOT take the packed route at all."""
+    for a in (X, W):
+        sh = getattr(a, "sharding", None)
+        if (
+            isinstance(sh, NamedSharding)
+            and "data" in sh.mesh.axis_names
+            and len(sh.mesh.devices.flat) > 1
+        ):
+            mesh = sh.mesh
+            if X.shape[0] % mesh.shape["data"]:
+                return None
+            if W is not None and W.shape[0] % mesh.shape.get("replica", 1):
+                return None
+            return mesh
+    return None
 
 
 def use_packed(*arrays) -> bool:
-    """Packed kernels are the single-device TPU route (TX_PACKED_GRAM=0
-    forces the vmap path, =1 forces packed anywhere).  Multi-device
-    inputs keep the vmap kernels, whose GSPMD row-sharding + psum
-    lowering is already proven - and so do CPU hosts: the packing trades
-    a [c, B*d] temporary for MXU tile occupancy, a trade that MEASURED
-    0.5x on CPU (no MXU to feed; microbench lrpack section, 2026-07-30)."""
+    """Packed kernels are the TPU route (TX_PACKED_GRAM=0 forces the vmap
+    path, =1 forces packed anywhere).  Mesh-sharded inputs ride the
+    shard_map Gram (packed_mesh_or_none supplies the mesh); multi-device
+    inputs sharded some OTHER way fall back to the vmap kernels.  CPU
+    hosts also keep vmap: the packing trades a [c, B*d] temporary for MXU
+    tile occupancy, a trade that MEASURED 0.5x on CPU (no MXU to feed;
+    CPU_MICROBENCH.json lrpack section)."""
     override = os.environ.get("TX_PACKED_GRAM")
     if override is not None:
         return override.strip().lower() not in ("0", "false", "")
@@ -103,11 +188,11 @@ def use_packed(*arrays) -> bool:
             return False
     except Exception:
         return False
-    for a in arrays:
-        sharding = getattr(a, "sharding", None)
-        if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
-            return False
-    return True
+    multi = any(
+        len(getattr(getattr(a, "sharding", None), "device_set", ())) > 1
+        for a in arrays
+    )
+    return not multi or packed_mesh_or_none(*arrays) is not None
 
 
 def _batched_diag(v):
@@ -119,11 +204,14 @@ def _batched_diag(v):
 _psolve = jax.vmap(partial(jax.scipy.linalg.solve, assume_a="pos"))
 
 
-@partial(jax.jit, static_argnames=("iters", "hess_bf16"))
-def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
+@partial(jax.jit, static_argnames=("iters", "hess_bf16", "mesh"))
+def lr_fit_batched_packed(
+    X, y, W, regs, ens, iters: int, hess_bf16: bool, mesh=None
+):
     """Explicitly-batched weighted logistic IRLS: X [n, d], y [n],
     W [B, n] per-replica sample weights, regs/ens [B].  Same per-row math
-    as logistic_regression._lr_fit_kernel under vmap; the Gram is packed.
+    as logistic_regression._lr_fit_kernel under vmap; the Gram is packed
+    (shard_map over ``mesh`` when the caller's arrays are mesh-sharded).
     Returns (beta [B, d] raw-scale, intercept [B])."""
     n, d = X.shape
     B = W.shape[0]
@@ -161,7 +249,9 @@ def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
             (Xr.T - mu * sr[:, None]) / sd / wsum[:, None]
             + (lam_l2[:, None] + l1_diag) * beta
         ) * active
-        XtWX = packed_weighted_gram(Xh, wt.astype(Xh.dtype))  # [B, d, d] f32
+        XtWX = packed_weighted_gram(
+            Xh, wt.astype(Xh.dtype), mesh
+        )  # [B, d, d] f32
         a = (X.T @ wt).T  # [B, d]
         s = wt.sum(axis=0)  # [B]
         Hs = (
@@ -192,8 +282,10 @@ def lr_fit_batched_packed(X, y, W, regs, ens, iters: int, hess_bf16: bool):
     return beta, intercept
 
 
-@partial(jax.jit, static_argnames=("iters", "hess_bf16"))
-def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
+@partial(jax.jit, static_argnames=("iters", "hess_bf16", "mesh"))
+def svc_fit_batched_packed(
+    X, y, W, regs, iters: int, hess_bf16: bool, mesh=None
+):
     """Explicitly-batched squared-hinge Newton (linear_svc._svc_fit_kernel
     under vmap, Gram packed).  Returns (beta [B, d], intercept [B])."""
     n, d = X.shape
@@ -225,7 +317,7 @@ def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
             ((X.T @ r).T - mu * sr[:, None]) / sd / wsum[:, None]
             + (2.0 * regs[:, None]) * beta
         ) * active
-        XtAX = packed_weighted_gram(Xh, act_rows.astype(Xh.dtype))
+        XtAX = packed_weighted_gram(Xh, act_rows.astype(Xh.dtype), mesh)
         a = (X.T @ act_rows).T  # [B, d]
         s = act_rows.sum(axis=0)
         Hs = (
@@ -261,8 +353,8 @@ def svc_fit_batched_packed(X, y, W, regs, iters: int, hess_bf16: bool):
     return beta, b0 - ((mu + m0[None, :]) * beta).sum(axis=1)
 
 
-@partial(jax.jit, static_argnames=("l1_iters",))
-def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8):
+@partial(jax.jit, static_argnames=("l1_iters", "mesh"))
+def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8, mesh=None):
     """Explicitly-batched weighted ridge / elastic-net (normal equations).
     The Gram weights are the FIXED fold masks, so the packed Gram runs
     ONCE - the l1 reweighting scan is [B, d, d] solves only.  The Gram
@@ -282,7 +374,7 @@ def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8):
     ybar = (W @ y) / wsum
     lam_l2 = regs * (1.0 - ens)
     lam_l1 = regs * ens
-    XtWX = packed_weighted_gram(X, W.T)  # [B, d, d] f32
+    XtWX = packed_weighted_gram(X, W.T, mesh)  # [B, d, d] f32
     a = W @ X  # [B, d]
     G = (
         XtWX
