@@ -190,9 +190,11 @@ def test_compute_data_up_to(tmp_path, rng):
         model.compute_data_up_to(pred2, data=data)
 
 
-def test_multiclass_ovr_lr_save_load_roundtrip(tmp_path, rng):
-    """One-vs-rest LR params (betas [K,d] / intercepts / classes) must
-    survive the model writer and score identically after load."""
+@pytest.mark.parametrize("family", ["auto", "ovr"])
+def test_multiclass_lr_save_load_roundtrip(tmp_path, rng, family):
+    """Multiclass LR params (betas [K,d] / intercepts / classes / family)
+    must survive the model writer and score identically after load - for
+    both the round-5 multinomial softmax default and the OVR option."""
     import numpy as np
 
     from transmogrifai_tpu import FeatureBuilder, OpWorkflow
@@ -215,14 +217,17 @@ def test_multiclass_ovr_lr_save_load_roundtrip(tmp_path, rng):
         b = FeatureBuilder(ft.Real, "b").as_predictor()
         vec = transmogrify([a, b])
         pred = (
-            OpLogisticRegression(reg_param=0.01)
+            OpLogisticRegression(reg_param=0.01, family=family)
             .set_input(y, vec).get_output()
         )
         return OpWorkflow().set_result_features(pred).set_input_dataset(data)
 
     m1 = build().train()
-    m1.save(str(tmp_path / "ovr_model"))
-    m2 = OpWorkflowModel.load(str(tmp_path / "ovr_model"), build())
+    expect_family = "multinomial" if family == "auto" else "ovr"
+    assert m1.stages[-1].model_params["family"] == expect_family
+    m1.save(str(tmp_path / "mc_model"))
+    m2 = OpWorkflowModel.load(str(tmp_path / "mc_model"), build())
+    assert m2.stages[-1].model_params["family"] == expect_family
     s1 = [c for c in m1.score(data).columns().values()
           if hasattr(c, "prediction")]
     s2 = [c for c in m2.score(data).columns().values()

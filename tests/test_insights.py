@@ -105,11 +105,14 @@ def test_loco_detailed_format_round_trips(fitted):
             assert scores[0][1] == pytest.approx(-scores[1][1], abs=1e-5)
 
 
-def test_loco_on_multiclass_ovr_lr(rng):
-    """Record insights over the one-vs-rest multiclass LR (round-4):
-    LOCO deltas must exist, rank the informative feature first, and the
-    detailed per-class format must carry one delta per class
-    (RecordInsightsLOCO.scala per-class score diffs)."""
+@pytest.mark.parametrize("family", ["auto", "ovr"])
+def test_loco_on_multiclass_lr(rng, family):
+    """Record insights over multiclass LR - family='auto' exercises the
+    round-5 multinomial softmax model (jointly-normalized per-class
+    probabilities), 'ovr' the one-vs-rest route: LOCO deltas must exist,
+    rank the informative feature first, and the detailed per-class format
+    must carry one delta per class (RecordInsightsLOCO.scala per-class
+    score diffs)."""
     from transmogrifai_tpu.insights.loco import parse_insights
 
     n = 300
@@ -123,11 +126,14 @@ def test_loco_on_multiclass_ovr_lr(rng):
     fs = FeatureBuilder(ft.Real, "strong").as_predictor()
     fw = FeatureBuilder(ft.Real, "weak").as_predictor()
     vec = transmogrify([fs, fw])
-    pred = OpLogisticRegression(reg_param=0.01).set_input(fy, vec).get_output()
+    pred = OpLogisticRegression(
+        reg_param=0.01, family=family
+    ).set_input(fy, vec).get_output()
     wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
     model = wf.train()
     predictor_model = model.stages[-1]
-    assert "betas" in predictor_model.model_params  # OvR params in play
+    expect_family = "multinomial" if family == "auto" else "ovr"
+    assert predictor_model.model_params["family"] == expect_family
 
     scored = model.score(data)
     loco = RecordInsightsLOCO(predictor_model, top_k=4).set_input(vec)

@@ -382,11 +382,12 @@ def test_bench_scale_dispatch_plan_stays_under_watchdog():
         assert k * per_fit_s <= 45.0, (k, per_fit_s)
 
 
-def test_logistic_regression_multiclass_ovr():
-    """>2 classes route through one-vs-rest fits of the binary Newton
-    kernel with softmax-normalized scores (the reference's multinomial
-    LR counterpart); a 3-class linearly separable problem must be
-    recovered nearly perfectly."""
+def test_logistic_regression_multiclass_families():
+    """>2 classes: family='auto' routes through the multinomial softmax
+    Newton (reference OpLogisticRegression.scala:110-116 auto semantics -
+    jointly normalized probabilities by construction); family='ovr' keeps
+    the one-vs-rest route.  Both must recover a separable 3-class
+    problem."""
     from transmogrifai_tpu.models.logistic_regression import (
         OpLogisticRegression,
     )
@@ -396,17 +397,92 @@ def test_logistic_regression_multiclass_ovr():
     centers = np.array([[2.0, 0.0], [-2.0, 1.5], [0.0, -2.5]])
     y = np.repeat(np.arange(3.0), n // 3)
     X = centers[y.astype(int)] + 0.5 * rng.randn(n, 2)
-    est = OpLogisticRegression(reg_param=0.01, max_iter=25)
-    params = est.fit_arrays(X, y)
-    assert set(params) >= {"betas", "intercepts", "classes"}
-    pred, raw, prob = est.predict_arrays(params, X)
-    assert (pred == y).mean() > 0.97
-    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-9)
-    # engine-free path identical
-    pred2, _, prob2 = est.predict_arrays_np(params, X)
-    np.testing.assert_array_equal(pred, pred2)
-    np.testing.assert_allclose(prob, prob2, atol=1e-12)
-    assert est.contributions(params).shape == (2,)
+    for family, expect in (("auto", "multinomial"), ("ovr", "ovr"),
+                           ("multinomial", "multinomial")):
+        est = OpLogisticRegression(reg_param=0.01, max_iter=25,
+                                   family=family)
+        params = est.fit_arrays(X, y)
+        assert set(params) >= {"betas", "intercepts", "classes"}
+        assert params["family"] == expect, (family, params["family"])
+        pred, raw, prob = est.predict_arrays(params, X)
+        assert (pred == y).mean() > 0.97, family
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-9)
+        # engine-free path identical
+        pred2, _, prob2 = est.predict_arrays_np(params, X)
+        np.testing.assert_array_equal(pred, pred2)
+        np.testing.assert_allclose(prob, prob2, atol=1e-12)
+        assert est.contributions(params).shape == (2,)
+
+
+def test_multinomial_softmax_matches_independent_reference():
+    """The softmax Newton must land on the SAME penalized optimum as an
+    independent scipy L-BFGS minimization of the multinomial NLL (same
+    standardized-space objective): probability parity to f32 noise, and
+    the constant column excluded with coefficient pinned to 0."""
+    from scipy.optimize import minimize
+
+    from transmogrifai_tpu.models.logistic_regression import (
+        _softmax_fit_kernel,
+    )
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n, d, K = 600, 7, 4
+    X = rng.randn(n, d).astype(np.float32)
+    X[:, 2] = X[:, 2] * 30 + 100  # ill-conditioned scale/offset
+    X[:, 5] = 3.0  # constant column
+    Xz = (X - X.mean(0)) / np.where(X.std(0) > 0, X.std(0), 1.0)
+    Bt = rng.randn(K, d) * 1.5
+    z = Xz @ Bt.T
+    P = np.exp(z - z.max(1, keepdims=True))
+    P /= P.sum(1, keepdims=True)
+    y = np.array([rng.choice(K, p=pp) for pp in P])
+    w = (rng.rand(n) + 0.5).astype(np.float32)
+    Yoh = np.zeros((n, K), np.float32)
+    Yoh[np.arange(n), y] = 1.0
+    reg = 0.05
+
+    betas, b0 = _softmax_fit_kernel(
+        jnp.asarray(X), jnp.asarray(Yoh), jnp.asarray(w),
+        jnp.asarray(reg), jnp.asarray(0.0), iters=30,
+    )
+    betas = np.asarray(betas, np.float64)
+    b0 = np.asarray(b0, np.float64)
+    assert np.abs(betas[:, 5]).max() == 0.0  # excluded column pinned
+
+    wsum = w.sum()
+    mu = (w @ X) / wsum
+    msq = (w @ (X * X)) / wsum
+    var = msq - mu**2
+    active = var > 1e-6 * msq + 1e-30
+    sd = np.where(active, np.sqrt(np.maximum(var, 1e-12)), 1.0)
+    Xs = (X - mu) / sd * active
+
+    def nll(theta):
+        B = theta[: K * d].reshape(K, d)
+        zz = Xs @ B.T + theta[K * d:]
+        zz = zz - zz.max(axis=1, keepdims=True)
+        logp = zz - np.log(np.exp(zz).sum(axis=1, keepdims=True))
+        return (
+            -(w * logp[np.arange(n), y]).sum() / wsum
+            + 0.5 * reg * (B**2).sum()
+        )
+
+    res = minimize(nll, np.zeros(K * d + K), method="L-BFGS-B",
+                   options={"maxiter": 5000, "ftol": 1e-15, "gtol": 1e-11})
+    beta_ref = res.x[: K * d].reshape(K, d) * active / sd
+    b0_ref = res.x[K * d:] - beta_ref @ mu
+    z1 = X @ betas.T + b0
+    z2 = X @ beta_ref.T + b0_ref
+    p1 = np.exp(z1 - z1.max(1, keepdims=True))
+    p1 /= p1.sum(1, keepdims=True)
+    p2 = np.exp(z2 - z2.max(1, keepdims=True))
+    p2 /= p2.sum(1, keepdims=True)
+    assert np.abs(p1 - p2).max() < 2e-3
+    theta_newton = np.concatenate(
+        [(betas * sd).reshape(K * d), b0 + betas @ mu]
+    )
+    assert nll(theta_newton) <= res.fun + 1e-6  # same penalized optimum
 
 
 def test_multiclass_selector_default_includes_working_lr():
@@ -497,3 +573,66 @@ def test_linear_kernels_survive_high_mean_low_variance_columns():
     )
     assert np.isfinite(np.asarray(bp)).all()
     assert np.isfinite(np.asarray(ip)).all()
+
+
+def test_multinomial_survives_separable_and_zero_variance_columns():
+    """The Iris failure shape (round 5): near-separable classes, zero
+    regularization, and constant-zero null-indicator columns must yield
+    finite, accurate coefficients.  Two guards are pinned: the relative
+    (trace-scaled) ridge that keeps the f32 Cholesky conditioned along
+    the softmax shift-invariance flat directions, and the eps curvature
+    floor that bounds the Newton steps when saturated probabilities zero
+    the Hessian."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.logistic_regression import (
+        _softmax_fit_kernel,
+    )
+
+    rng = np.random.RandomState(5)
+    n, K = 450, 3
+    centers = np.array([[3.0, 0.0], [-3.0, 1.0], [0.0, -4.0]])
+    y = np.repeat(np.arange(K), n // K)
+    Xn = centers[y] + 0.1 * rng.randn(n, 2)
+    # interleave constant-zero columns like transmogrified null trackers
+    X = np.zeros((n, 6), np.float32)
+    X[:, 0], X[:, 2] = Xn[:, 0], Xn[:, 1]
+    Yoh = np.zeros((n, K), np.float32)
+    Yoh[np.arange(n), y] = 1.0
+    w = np.ones(n, np.float32)
+    for reg in (0.0, 0.01):
+        b, b0 = _softmax_fit_kernel(
+            jnp.asarray(X), jnp.asarray(Yoh), jnp.asarray(w),
+            jnp.asarray(reg), jnp.asarray(0.0), iters=20,
+        )
+        b, b0 = np.asarray(b), np.asarray(b0)
+        assert np.isfinite(b).all() and np.isfinite(b0).all(), reg
+        acc = ((X @ b.T + b0).argmax(1) == y).mean()
+        assert acc > 0.97, (reg, acc)
+        assert np.abs(b[:, [1, 3, 4, 5]]).max() == 0.0  # excluded cols
+
+
+def test_logistic_family_contract():
+    """Family validation (review r5): unknown family strings raise at
+    construction; family='binomial' refuses >2 classes (MLlib contract);
+    an explicit 'multinomial' is honored regardless of problem size."""
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+
+    with pytest.raises(ValueError, match="unknown logistic family"):
+        OpLogisticRegression(family="multinominal")
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(90, 2)
+    y3 = np.repeat(np.arange(3.0), 30)
+    with pytest.raises(ValueError, match="at most 2 outcome classes"):
+        OpLogisticRegression(family="binomial").fit_arrays(X, y3)
+
+    # explicit multinomial bypasses the auto-route size heuristic
+    est = OpLogisticRegression(family="multinomial")
+    assert est._multiclass_family(K=3, d=1023) == "multinomial"
+    assert (
+        OpLogisticRegression(family="auto")._multiclass_family(3, 1023)
+        == "ovr"
+    )
