@@ -390,3 +390,64 @@ def test_isotonic_pava_properties(rng):
     # antitonic == negated isotonic of negated labels
     anti = fit_values(x, y, isotonic=False)
     np.testing.assert_allclose(anti[order], -ref_pava(-y[order]), atol=1e-9)
+
+
+def test_vectorizer_meta_memo_identity_and_staleness():
+    """cached_metas must return the SAME meta objects across transforms
+    (the single-row serving win - identity turns the staleness compare
+    into short-circuits) yet rebuild when the fitted state it derives
+    from changes (round-5 serving memo)."""
+    from transmogrifai_tpu.ops.text import SmartTextModel
+    from transmogrifai_tpu.types.columns import TextColumn
+
+    m = SmartTextModel(
+        plans=[{"mode": "hash"}], hash_dims=8, track_nulls=True,
+        clean_text=True,
+    )
+
+    class F:
+        name = "t"
+
+        class ftype:
+            @staticmethod
+            def type_name():
+                return "Text"
+
+    m.input_features = (F,)
+    col = TextColumn(["a b", None], np.array([True, False]))
+    _, ms1 = m.blocks_for(col, 0)
+    _, ms2 = m.blocks_for(col, 0)
+    assert ms1 is ms2  # identical objects, not equal copies
+    assert len(ms1) == 9  # 8 hash dims + null tracker
+    m.hash_dims = 4  # post-fit mutation must invalidate the memo
+    _, ms3 = m.blocks_for(col, 0)
+    assert ms3 is not ms1 and len(ms3) == 5
+
+
+def test_pivot_helper_cache_staleness():
+    """The pivot-mode helper cache must honor the same post-fit-mutation
+    contract as cached_metas: flipping track_nulls rebuilds the helper
+    (review r5 - a stale helper kept emitting the null column)."""
+    from transmogrifai_tpu.ops.text import SmartTextModel
+    from transmogrifai_tpu.types.columns import TextColumn
+
+    m = SmartTextModel(
+        plans=[{"mode": "pivot", "labels": ["a", "b"]}], hash_dims=8,
+        track_nulls=True, clean_text=True,
+    )
+
+    class F:
+        name = "t"
+
+        class ftype:
+            @staticmethod
+            def type_name():
+                return "PickList"
+
+    m.input_features = (F,)
+    col = TextColumn(["a", None], np.array([True, False]))
+    arr1, ms1 = m.blocks_for(col, 0)
+    assert arr1.shape[1] == 4  # 2 labels + OTHER + null
+    m.track_nulls = False
+    arr2, ms2 = m.blocks_for(col, 0)
+    assert arr2.shape[1] == 3  # null column gone after mutation

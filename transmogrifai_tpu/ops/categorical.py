@@ -100,33 +100,44 @@ class OneHotModel(SequenceVectorizerModel):
                     hit_other = True
             if hit_other:
                 arr[r, other_j] = 1.0
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                grouping=feat.name,
-                indicator_value=lab,
+        def build():
+            tname = feat.ftype.type_name()
+            ms = [
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=tname,
+                    grouping=feat.name,
+                    indicator_value=lab,
+                )
+                for lab in labels
+            ]
+            ms.append(
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=tname,
+                    grouping=feat.name,
+                    indicator_value=OTHER_STRING,
+                )
             )
-            for lab in labels
-        ]
-        metas.append(
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                grouping=feat.name,
-                indicator_value=OTHER_STRING,
-            )
+            if self.track_nulls:
+                ms.append(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
+                    )
+                )
+            return ms
+
+        metas = self.cached_metas(
+            i,
+            (feat.name, feat.ftype.type_name(), tuple(labels),
+             self.track_nulls),
+            build,
         )
         if self.track_nulls:
             arr[:, -1] = (~present).astype(np.float64)
-            metas.append(
-                VectorColumnMeta(
-                    parent_feature_name=feat.name,
-                    parent_feature_type=feat.ftype.type_name(),
-                    grouping=feat.name,
-                    indicator_value=NULL_STRING,
-                )
-            )
         return arr, metas
 
 

@@ -147,10 +147,23 @@ class SmartTextModel(SequenceVectorizerModel):
         plan = self.plans[i]
         tname = feat.ftype.type_name()
         if plan["mode"] == "pivot":
-            helper = OneHotModel(
-                [plan["labels"]], self.track_nulls, self.clean_text
-            )
-            helper.input_features = (feat,)
+            # helper cached per column so ITS meta memo survives across
+            # row-scoring calls (a fresh helper per call rebuilt every
+            # label meta)
+            helpers = getattr(self, "_pivot_helpers", None)
+            if helpers is None:
+                helpers = self._pivot_helpers = {}
+            key = (feat.name, tuple(plan["labels"]), self.track_nulls,
+                   self.clean_text)
+            hit = helpers.get(i)
+            if hit is None or hit[0] != key:
+                helper = OneHotModel(
+                    [plan["labels"]], self.track_nulls, self.clean_text
+                )
+                helper.input_features = (feat,)
+                helpers[i] = (key, helper)
+            else:
+                helper = hit[1]
             return helper.blocks_for(col, 0)
         assert isinstance(col, TextColumn)
         mask = col.mask
@@ -160,25 +173,32 @@ class SmartTextModel(SequenceVectorizerModel):
         if arr is None:  # no native lib: pure-python fallback
             toks = [tokenize(v) for v in col.values]
             arr = hashing_tf(toks, self.hash_dims, seed=self.seed)
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=tname,
-                descriptor_value=f"hash_{j}",
-            )
-            for j in range(self.hash_dims)
-        ]
-        if self.track_nulls:
-            arr = np.concatenate(
-                [arr, (~mask).astype(np.float32)[:, None]], axis=1
-            )
-            metas.append(
+        def build():
+            ms = [
                 VectorColumnMeta(
                     parent_feature_name=feat.name,
                     parent_feature_type=tname,
-                    grouping=feat.name,
-                    indicator_value=NULL_STRING,
+                    descriptor_value=f"hash_{j}",
                 )
+                for j in range(self.hash_dims)
+            ]
+            if self.track_nulls:
+                ms.append(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
+                    )
+                )
+            return ms
+
+        metas = self.cached_metas(
+            i, (feat.name, tname, self.hash_dims, self.track_nulls), build
+        )
+        if self.track_nulls:
+            arr = np.concatenate(
+                [arr, (~mask).astype(np.float32)[:, None]], axis=1
             )
         return arr, metas
 

@@ -29,6 +29,26 @@ class SequenceVectorizerModel(Transformer):
     def blocks_for(self, col: Column, i: int) -> tuple[np.ndarray, list[VectorColumnMeta]]:
         raise NotImplementedError
 
+    def cached_metas(self, i: int, state: tuple, build):
+        """Per-column memo for the block's VectorColumnMeta list: a fitted
+        vectorizer's metas are fully determined by its fitted state, yet
+        the naive path rebuilds hundreds of frozen dataclasses per
+        transform - the dominant single-row serving cost after round 4's
+        reindexed() memo.  ``state`` keys the entry to the exact fitted
+        fields the metas derive from, so a post-fit mutation rebuilds
+        instead of serving stale provenance.  Returning the SAME objects
+        also turns transform_columns' full-tuple staleness compare into
+        identity short-circuits."""
+        memo = getattr(self, "_metas_memo", None)
+        if memo is None:
+            memo = self._metas_memo = {}
+        hit = memo.get(i)
+        if hit is not None and hit[0] == state:
+            return hit[1]
+        ms = build()
+        memo[i] = (state, ms)
+        return ms
+
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         arrays: list[np.ndarray] = []
         metas: list[VectorColumnMeta] = []

@@ -76,6 +76,7 @@ _SKIP_ATTRS = {
     # per-process transform memoizations (vectorizer_base/combiner/
     # sanity_checker): identity-keyed, must never persist
     "_meta_cache", "_combine_cache", "_select_cache",
+    "_metas_memo", "_pivot_helpers",
 }
 
 
