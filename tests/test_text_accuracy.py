@@ -109,8 +109,8 @@ LANG_SAMPLES = [
     ("th", "ร้านอาหารตรงหัวมุมเสิร์ฟกาแฟที่ดีที่สุดในละแวกนี้"),
     ("ja", "兄は先月新しい車を買って、毎日それで仕事に行きます。"),
     ("ja", "角のレストランはこの辺りで一番おいしいコーヒーを出します。"),
-    ("zh", "我哥哥上个月买了一辆新车，每天开车去上班。"),
-    ("zh", "拐角处的餐厅供应整个街区最好的咖啡。"),
+    ("zh-cn", "我哥哥上个月买了一辆新车，每天开车去上班。"),
+    ("zh-cn", "拐角处的餐厅供应整个街区最好的咖啡。"),
     ("ko", "우리 형은 지난달에 새 차를 샀고 매일 그 차로 출근합니다."),
     ("ko", "모퉁이에 있는 식당은 동네에서 가장 맛있는 커피를 제공합니다."),
     ("ka", "ჩემმა ძმამ გასულ თვეში ახალი მანქანა იყიდა და ყოველდღე სამსახურში დადის."),
@@ -184,6 +184,52 @@ LANG_SAMPLES = [
     ("tl", "Iniharap ng mga mag-aaral ang kanilang mga proyekto sa harap ng buong klase kahapon."),
     ("az", "Tələbələr dünən layihələrini bütün sinfin qarşısında təqdim etdilər."),
     ("ht", "Etidyan yo te prezante pwojè yo devan tout klas la yè."),
+    # round-5 breadth: the languages added for reference parity, two
+    # held-out sentences each (disjoint from the seed corpora)
+    ("sr", "Моја сестра ради у болници и сваког јутра путује возом у град."),
+    ("sr", "Деца се играју у дворишту док њихов отац спрема ручак."),
+    ("mk", "Мојата сестра работи во болница и секое утро патува со воз до градот."),
+    ("mk", "Децата си играат во дворот додека татко им подготвува ручек."),
+    ("be", "Мая сястра працуе ў бальніцы і кожную раніцу едзе цягніком у горад."),
+    ("be", "Дзеці гуляюць у двары, пакуль іх бацька гатуе абед."),
+    ("kk", "Менің әпкем ауруханада жұмыс істейді және күн сайын пойызбен қалаға барады."),
+    ("kk", "Балалар аулада ойнап жүр, ал әкесі түскі ас дайындап жатыр."),
+    ("fa", "خواهر من در بیمارستان کار می‌کند و هر روز صبح با قطار به شهر می‌رود."),
+    ("fa", "بچه‌ها در حیاط بازی می‌کنند در حالی که پدرشان ناهار آماده می‌کند."),
+    ("ur", "میری بہن ہسپتال میں کام کرتی ہے اور ہر صبح ٹرین سے شہر جاتی ہے۔"),
+    ("ur", "بچے صحن میں کھیل رہے ہیں جبکہ ان کے والد دوپہر کا کھانا تیار کر رہے ہیں۔"),
+    ("ar", "تعمل أختي في المستشفى وتسافر كل صباح بالقطار إلى المدينة."),
+    ("ar", "يلعب الأطفال في الفناء بينما يحضر والدهم الغداء."),
+    ("ckb", "خوشکەکەم لە نەخۆشخانە کار دەکات و هەموو بەیانییەک بە شەمەندەفەر دەچێتە شار."),
+    ("ckb", "منداڵەکان لە حەوشەکە یاری دەکەن کاتێک باوکیان نانی نیوەڕۆ ئامادە دەکات."),
+    ("he", "אחותי עובדת בבית החולים ונוסעת כל בוקר ברכבת העירה."),
+    ("he", "הילדים משחקים בחצר בזמן שאבא שלהם מכין ארוחת צהריים."),
+    ("yi", "מײַן שוועסטער אַרבעט אין שפּיטאָל און פֿאָרט יעדן פֿרימאָרגן מיט דער באַן אין שטאָט."),
+    ("yi", "די קינדער שפּילן זיך אין הויף בשעת זייער טאַטע גרייט צו דאָס וואַרעמעס."),
+    ("hi", "मेरी बहन अस्पताल में काम करती है और हर सुबह ट्रेन से शहर जाती है।"),
+    ("hi", "बच्चे आँगन में खेल रहे हैं जबकि उनके पिता दोपहर का खाना बना रहे हैं।"),
+    ("mr", "माझी बहीण रुग्णालयात काम करते आणि दररोज सकाळी रेल्वेने शहरात जाते."),
+    ("mr", "मुले अंगणात खेळत आहेत आणि त्यांचे वडील जेवण तयार करत आहेत."),
+    ("ne", "मेरी बहिनी अस्पतालमा काम गर्छिन् र हरेक बिहान रेलबाट सहर जान्छिन्।"),
+    ("ne", "केटाकेटीहरू आँगनमा खेलिरहेका छन् भने उनीहरूका बुबा खाना बनाउँदै हुनुहुन्छ।"),
+    ("oc", "Ma sòrre trabalha a l'espital e cada matin pren lo tren per anar a la vila."),
+    ("oc", "Los enfants jògan dins la cort mentre que lor paire prepara lo dinnar."),
+    ("br", "Va c'hoar a labour en ospital hag a gemer an tren bep mintin evit mont e kêr."),
+    ("br", "Ar vugale a c'hoari er porzh e-pad ma fich o zad merenn."),
+    ("se", "Mu oabbá bargá buohcciviesus ja vuolgá juohke iđida togain gávpogii."),
+    ("se", "Mánát stohket šiljus dan botta go sin áhčči ráhkada gaskabeaivvi."),
+    ("an", "A mía chirmana treballa en o espital y cada maitino prene o tren ta ir t'a ciudat."),
+    ("an", "Os ninos chugan en o patio mientres o suyo pai fa o chentar."),
+    ("ast", "La mio hermana trabaya nel hospital y toles mañanes coyo'l tren pa dir a la ciudá."),
+    ("ast", "Los nenos xueguen nel patiu mentes el so pá fai la xinta."),
+    ("wa", "Mi soûr bouteye e l' ospitå et tos les maténs ele prind l' trin po-z aler al veye."),
+    ("wa", "Les efants djouwnut el coûr tins ki leu pa aprestêye li dinner."),
+    ("zh-tw", "我妹妹在醫院工作，每天早上坐火車去城裡。"),
+    ("zh-tw", "孩子們在院子裡玩，他們的爸爸正在準備午飯。"),
+    ("pa", "ਮੇਰੀ ਭੈਣ ਹਸਪਤਾਲ ਵਿੱਚ ਕੰਮ ਕਰਦੀ ਹੈ ਅਤੇ ਹਰ ਸਵੇਰ ਰੇਲ ਰਾਹੀਂ ਸ਼ਹਿਰ ਜਾਂਦੀ ਹੈ।"),
+    ("kn", "ನನ್ನ ಸಹೋದರಿ ಆಸ್ಪತ್ರೆಯಲ್ಲಿ ಕೆಲಸ ಮಾಡುತ್ತಾಳೆ ಮತ್ತು ಪ್ರತಿದಿನ ರೈಲಿನಲ್ಲಿ ನಗರಕ್ಕೆ ಹೋಗುತ್ತಾಳೆ."),
+    ("ml", "എന്റെ സഹോദരി ആശുപത്രിയിൽ ജോലി ചെയ്യുന്നു, എല്ലാ ദിവസവും ട്രെയിനിൽ നഗരത്തിലേക്ക് പോകുന്നു."),
+    ("km", "បងស្រីរបស់ខ្ញុំធ្វើការនៅមន្ទីរពេទ្យ ហើយធ្វើដំណើរទៅទីក្រុងរៀងរាល់ព្រឹក។"),
 ]
 
 

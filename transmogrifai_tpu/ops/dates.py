@@ -53,30 +53,43 @@ class DateVectorizerModel(SequenceVectorizerModel):
     def blocks_for(self, col: Column, i: int):
         assert isinstance(col, NumericColumn)
         feat = self.input_features[i]
-        blocks, metas = [], []
+        blocks = []
         for p in self.periods:
             frac = period_fraction(col.values, p)
             rad = 2.0 * np.pi * frac
-            for trig, name in ((np.sin, "sin"), (np.cos, "cos")):
-                v = np.where(col.mask, trig(rad), 0.0)
-                blocks.append(v)
-                metas.append(
-                    VectorColumnMeta(
-                        parent_feature_name=feat.name,
-                        parent_feature_type=feat.ftype.type_name(),
-                        descriptor_value=f"{p}_{name}",
-                    )
-                )
+            for trig in (np.sin, np.cos):
+                blocks.append(np.where(col.mask, trig(rad), 0.0))
         if self.track_nulls:
             blocks.append((~col.mask).astype(np.float64))
-            metas.append(
+
+        def build():
+            tname = feat.ftype.type_name()
+            ms = [
                 VectorColumnMeta(
                     parent_feature_name=feat.name,
-                    parent_feature_type=feat.ftype.type_name(),
-                    grouping=feat.name,
-                    indicator_value=NULL_STRING,
+                    parent_feature_type=tname,
+                    descriptor_value=f"{p}_{name}",
                 )
-            )
+                for p in self.periods
+                for name in ("sin", "cos")
+            ]
+            if self.track_nulls:
+                ms.append(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
+                    )
+                )
+            return ms
+
+        metas = self.cached_metas(
+            i,
+            (feat.name, feat.ftype.type_name(), self.periods,
+             self.track_nulls),
+            build,
+        )
         return np.stack(blocks, axis=1), metas
 
 

@@ -28,24 +28,33 @@ class GeolocationVectorizerModel(SequenceVectorizerModel):
         feat = self.input_features[i]
         filled = np.where(col.mask[:, None], col.values, self.fill_values[i][None, :])
         blocks = [filled]
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                descriptor_value=d,
-            )
-            for d in ("lat", "lon", "accuracy")
-        ]
         if self.track_nulls:
             blocks.append((~col.mask).astype(np.float64)[:, None])
-            metas.append(
+
+        def build():
+            tname = feat.ftype.type_name()
+            ms = [
                 VectorColumnMeta(
                     parent_feature_name=feat.name,
-                    parent_feature_type=feat.ftype.type_name(),
-                    grouping=feat.name,
-                    indicator_value=NULL_STRING,
+                    parent_feature_type=tname,
+                    descriptor_value=d,
                 )
-            )
+                for d in ("lat", "lon", "accuracy")
+            ]
+            if self.track_nulls:
+                ms.append(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
+                    )
+                )
+            return ms
+
+        metas = self.cached_metas(
+            i, (feat.name, feat.ftype.type_name(), self.track_nulls), build
+        )
         return np.concatenate(blocks, axis=1), metas
 
 

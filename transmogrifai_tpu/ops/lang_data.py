@@ -9,14 +9,19 @@ register prose, original to this repo), scored by log-weight likelihood
 (_profile_score), plus Unicode-script routing for languages whose script
 is decisive on its own (Greek/Arabic/CJK/Hangul/Thai/Devanagari/...).
 
-Coverage: 40 Latin-script + 3 Cyrillic-script profiled languages + the
-script-decided set (~57 total).  The corpora are deliberately generic
-prose - weather, family, work, travel - so the profiles capture
-function-word n-grams (the Cavnar-Trenkle signal) rather than topical
-vocabulary; close pairs (pt/gl, cs/sk, id/ms, sv/no/da, ru/bg/uk) carry
-supplementary parallel sentences that differ exactly where the pair
-differs.  Accuracy: 96.6% on the 148-sample held-out fixture
-(tests/test_text_accuracy.py, floor 90%)."""
+Coverage (round 5, reference parity): 62 profiled languages - 46
+Latin-script, 7 Cyrillic (ru/uk/bg/be/mk/sr/kk), 4 Arabic-script
+(ar/fa/ur/ckb), 2 Hebrew-script (he/yi), 3 Devanagari (hi/mr/ne) - plus
+zh-cn/zh-tw split by script variant and the script-decided singletons
+(el/hy/bn/pa/gu/ta/te/kn/ml/th/ka/km/ja/ko): ~79 detectable, a superset
+of the reference's ~70-language Optimaize set.  The corpora are
+deliberately generic prose - weather, family, work, travel - so the
+profiles capture function-word n-grams (the Cavnar-Trenkle signal)
+rather than topical vocabulary; close pairs (pt/gl, cs/sk, id/ms,
+sv/no/da, ru/bg/uk) carry supplementary parallel sentences that differ
+exactly where the pair differs.  Accuracy: held-out fixture in
+tests/test_text_accuracy.py (floor 90%), independent-register fixture
+alongside it."""
 from __future__ import annotations
 
 from collections import Counter
@@ -559,13 +564,279 @@ CORPORA: dict[str, str] = {
         "страната, когато пристигнах. Важно е да се пие достатъчно вода "
         "всеки ден, особено през лятото."
     ),
+    # round-5 breadth to reference parity (LangDetector.scala:44-60):
+    # remaining Latin minority languages, the wider Cyrillic set, and the
+    # profiled Arabic-script / Hebrew-script / Devanagari families
+    "an": (
+        "O tiempo ye muito bueno hue y imos t'o parque con os ninos. "
+        "Querria saber a qué hora sale o tren maitin por o maitino. Ella "
+        "dició que fan tres anyadas que treballan en iste prochecto. Bi "
+        "ha una casa chicota amán d'o río an viviba a mía lola. Me "
+        "podrías dicir án ye a estación más cercana? Habríanos de cenar "
+        "chuntos bella vegada a semana que viene. O gubierno anunció "
+        "nuevas mesuras ta aduyar a os negocios locals. A mayoría d'a "
+        "chent creye que a ciudat ha cambiau muito en as zagueras diez "
+        "anyadas. Ye important beber prou augua cada día, más que más "
+        "en verano."
+    ),
+    "ast": (
+        "El tiempu ta perbonu güei y vamos dir al parque colos nenos. "
+        "Prestaríame saber a qué hora sal el tren mañana pela mañana. "
+        "Ella dixo que lleven trés años trabayando nesti proyeutu. Hai "
+        "una casina cerca del ríu onde vivía la mio güela. Podríesme "
+        "dicir ónde ta la estación más averada? Tendríemos de cenar "
+        "xuntos dalguna vegada la selmana que vien. El gobiernu anunció "
+        "nueves midíes p'ayudar a los negocios llocales. La mayoría de "
+        "la xente cree que la ciudá camudó muncho nos caberos diez "
+        "años. Ye importante beber abonda agua tolos díes, sobre too "
+        "pel branu."
+    ),
+    "br": (
+        "Brav-tre eo an amzer hiziv hag emaomp o vont d'ar park gant ar "
+        "vugale. Me a garfe gouzout da bet eur e loc'h an tren warc'hoazh "
+        "vintin. Lavaret he deus emaint o labourat war ar raktres-se "
+        "abaoe tri bloaz. Un ti bihan a zo e-kichen ar stêr e-lec'h ma "
+        "veve va mamm-gozh. Gallout a rafes lavarout din pelec'h emañ ar "
+        "porzh-houarn tostañ? Dleout a rafemp koaniañ asambles ur wech "
+        "bennak er sizhun a zeu. Ar gouarnamant en deus embannet "
+        "diarbennoù nevez evit skoazellañ ar stalioù lec'hel. An darn "
+        "vrasañ eus an dud a gav dezho eo cheñchet kalz kêr e-pad an dek "
+        "vloaz diwezhañ. Pouezus eo evañ dour a-walc'h bemdez, "
+        "dreist-holl en hañv."
+    ),
+    "oc": (
+        "Uèi fa un temps fòrça polit e anam al parc amb los enfants. "
+        "Voldriái saber a quina ora part lo tren deman de matin. Ela "
+        "diguèt que trabalhan sus aqueste projècte dempuèi tres ans. I a "
+        "una ostaleta prèp del riu ont vivia ma grand. Me poiriás dire "
+        "ont es la gara mai pròcha? Nos caldriá sopar ensems un còp la "
+        "setmana que ven. Lo govèrn anoncièt de mesuras novèlas per "
+        "ajudar los comèrcis locals. La màger part de la gent pensa que "
+        "la vila a plan cambiat dins las darrièras detz annadas. Es "
+        "important de beure pro d'aiga cada jorn, subretot l'estiu."
+    ),
+    "wa": (
+        "Li tins est foirt bea ouy et nos alans å pårc avou les efants. "
+        "Dji vôreu bén saveur a kéne eure li trin s' va-t i dmwin å "
+        "matén. Ele a dit k' i boutnut so ci prodjet la dispoy troes "
+        "ans. I gn a ene pitite måjhon adlé l' aiwe wice ki m' "
+        "grand-mere dimoreut. Mi sårîz vos dire wice k' est l' gåre li "
+        "pus près? Nos dvrîns soper eshonne on côp li samwinne ki vént. "
+        "Li govienmint a anoncî des noveles mezeures po-z aidî les "
+        "botikes del plaece. Li pupårt des djins pinsèt ki l' veye a "
+        "bråmint candjî dins les dierinnès dijh ans. C' est consecant "
+        "di boere assez d' aiwe tos les djoûs, copurade e l' esté."
+    ),
+    "se": (
+        "Dálki lea hui buorre odne ja mii mannat párkii mánáiguin. Mun "
+        "háliidivččen diehtit goas toga vuolgá ihttin iđđes. Son celkkii "
+        "ahte sii leat bargan dáinna prošeavttain golbma jagi. Joga "
+        "lahka lea unna viessu gos mu áhkku orui. Sáhtášitgo muitalit "
+        "munnje gos lagamus stašuvdna lea? Mii galggašeimmet boradit "
+        "ovttas boahtte vahkus. Ráđđehus almmuhii ođđa doaibmabijuid "
+        "veahkehit báikkálaš fitnodagaid. Eatnasat olbmot jáhkket ahte "
+        "gávpot lea rievdan ollu maŋimus logi jagis. Lea deaŧalaš juhkat "
+        "doarvái čázi juohke beaivvi, erenoamážit geasset."
+    ),
+    "be": (
+        "Сёння вельмі добрае надвор'е, і мы ідзём у парк з дзецьмі. Я "
+        "хацеў бы даведацца, а якой гадзіне заўтра раніцай адпраўляецца "
+        "цягнік. Яна сказала, што яны працуюць над гэтым праектам ужо "
+        "тры гады. Каля ракі стаіць маленькі дом, дзе жыла мая бабуля. "
+        "Ці не маглі б вы сказаць, дзе знаходзіцца найбліжэйшая "
+        "станцыя? Нам варта павячэраць разам на наступным тыдні. Урад "
+        "абвясціў пра новыя меры падтрымкі мясцовых прадпрыемстваў. "
+        "Большасць людзей лічыць, што горад моцна змяніўся за апошнія "
+        "дзесяць гадоў. Ён чытаў кнігу пра гісторыю краіны, калі я "
+        "прыехаў. Важна піць дастаткова вады кожны дзень, асабліва "
+        "ўлетку."
+    ),
+    "mk": (
+        "Денес времето е многу убаво и одиме во паркот со децата. Би "
+        "сакал да знам во колку часот тргнува возот утре наутро. Таа "
+        "рече дека работат на овој проект веќе три години. Покрај "
+        "реката има мала куќа каде што живееше баба ми. Може ли да ми "
+        "кажете каде се наоѓа најблиската станица? Треба да вечераме "
+        "заедно следната недела. Владата објави нови мерки за поддршка "
+        "на локалните бизниси. Повеќето луѓе мислат дека градот многу "
+        "се променил во последните десет години. Тој читаше книга за "
+        "историјата на земјата кога пристигнав. Важно е да се пие "
+        "доволно вода секој ден, особено во лето."
+    ),
+    "sr": (
+        "Данас је време веома лепо и идемо у парк са децом. Желео бих "
+        "да знам у колико сати сутра ујутру полази воз. Рекла је да већ "
+        "три године раде на овом пројекту. Поред реке се налази мала "
+        "кућа у којој је живела моја бака. Да ли бисте могли да ми "
+        "кажете где је најближа станица? Требало би да вечерамо заједно "
+        "следеће недеље. Влада је објавила нове мере подршке локалним "
+        "предузећима. Већина људи сматра да се град много променио у "
+        "последњих десет година. Читао је књигу о историји земље када "
+        "сам стигао. Важно је пити довољно воде сваког дана, нарочито "
+        "лети."
+    ),
+    "kk": (
+        "Бүгін ауа райы өте жақсы, біз балалармен саябаққа барамыз. "
+        "Ертең таңертең пойыз нешеде жүретінін білгім келеді. Ол бұл "
+        "жобамен үш жылдан бері айналысып жатқандарын айтты. Өзеннің "
+        "жанында әжем тұрған шағын үй бар. Ең жақын бекет қайда екенін "
+        "айта аласыз ба? Келесі аптада бірге кешкі ас ішуіміз керек. "
+        "Үкімет жергілікті кәсіпорындарды қолдаудың жаңа шараларын "
+        "жариялады. Көп адамдар соңғы он жылда қала қатты өзгерді деп "
+        "санайды. Мен келгенде ол елдің тарихы туралы кітап оқып "
+        "отырды. Күн сайын жеткілікті су ішу маңызды, әсіресе жазда."
+    ),
+    "ar": (
+        "الطقس جميل جدا اليوم ونحن ذاهبون إلى الحديقة مع الأطفال. أود "
+        "أن أعرف في أي ساعة يغادر القطار غدا صباحا. قالت إنهم يعملون "
+        "على هذا المشروع منذ ثلاث سنوات. يوجد بيت صغير قرب النهر حيث "
+        "كانت تعيش جدتي. هل يمكنك أن تخبرني أين أقرب محطة؟ يجب أن "
+        "نتناول العشاء معا في الأسبوع القادم. أعلنت الحكومة عن إجراءات "
+        "جديدة لدعم الأعمال المحلية. يعتقد معظم الناس أن المدينة تغيرت "
+        "كثيرا خلال السنوات العشر الماضية. كان يقرأ كتابا عن تاريخ "
+        "البلاد عندما وصلت. من المهم شرب ما يكفي من الماء كل يوم وخاصة "
+        "في الصيف."
+    ),
+    "fa": (
+        "امروز هوا خیلی خوب است و ما با بچه‌ها به پارک می‌رویم. دوست "
+        "دارم بدانم قطار فردا صبح ساعت چند حرکت می‌کند. او گفت که سه "
+        "سال است روی این پروژه کار می‌کنند. نزدیک رودخانه خانه کوچکی "
+        "هست که مادربزرگم در آن زندگی می‌کرد. می‌توانید به من بگویید "
+        "نزدیک‌ترین ایستگاه کجاست؟ باید هفته آینده با هم شام بخوریم. "
+        "دولت تدابیر جدیدی برای حمایت از کسب‌وکارهای محلی اعلام کرد. "
+        "بیشتر مردم فکر می‌کنند که شهر در ده سال گذشته خیلی تغییر کرده "
+        "است. وقتی رسیدم داشت کتابی درباره تاریخ کشور می‌خواند. مهم "
+        "است که هر روز به اندازه کافی آب بنوشیم، مخصوصا در تابستان."
+    ),
+    "ur": (
+        "آج موسم بہت اچھا ہے اور ہم بچوں کے ساتھ پارک جا رہے ہیں۔ میں "
+        "جاننا چاہتا ہوں کہ کل صبح ٹرین کتنے بجے روانہ ہوتی ہے۔ اس نے "
+        "کہا کہ وہ تین سال سے اس منصوبے پر کام کر رہے ہیں۔ دریا کے "
+        "قریب ایک چھوٹا سا گھر ہے جہاں میری دادی رہتی تھیں۔ کیا آپ "
+        "مجھے بتا سکتے ہیں کہ قریب ترین اسٹیشن کہاں ہے؟ ہمیں اگلے ہفتے "
+        "ساتھ کھانا کھانا چاہیے۔ حکومت نے مقامی کاروباروں کی مدد کے "
+        "لیے نئے اقدامات کا اعلان کیا۔ زیادہ تر لوگ سمجھتے ہیں کہ "
+        "پچھلے دس سالوں میں شہر بہت بدل گیا ہے۔ جب میں پہنچا تو وہ ملک "
+        "کی تاریخ کے بارے میں کتاب پڑھ رہا تھا۔ ہر روز کافی پانی پینا "
+        "ضروری ہے، خاص طور پر گرمیوں میں۔"
+    ),
+    "ckb": (
+        "ئەمڕۆ کەشوهەوا زۆر خۆشە و لەگەڵ منداڵەکان دەچینە پارکەکە. "
+        "دەمەوێت بزانم شەمەندەفەرەکە بەیانی سبەینێ کاتژمێر چەند "
+        "دەڕوات. ئەو گوتی کە سێ ساڵە لەسەر ئەم پڕۆژەیە کار دەکەن. "
+        "لە نزیک ڕووبارەکە خانوویەکی بچووک هەیە کە داپیرم تێیدا "
+        "دەژیا. دەتوانیت پێم بڵێیت نزیکترین وێستگە لە کوێیە؟ دەبێت "
+        "هەفتەی داهاتوو پێکەوە نانی ئێوارە بخۆین. حکومەت چەند "
+        "ڕێوشوێنێکی نوێی ڕاگەیاند بۆ پشتگیری بازرگانییە خۆجێیەکان. "
+        "زۆربەی خەڵک پێیان وایە شارەکە لە دە ساڵی ڕابردوودا زۆر "
+        "گۆڕاوە. کاتێک گەیشتم ئەو کتێبێکی دەخوێندەوە دەربارەی مێژووی "
+        "وڵاتەکە. گرنگە هەموو ڕۆژێک ئاوی پێویست بخۆینەوە بەتایبەتی "
+        "لە هاویندا."
+    ),
+    "he": (
+        "מזג האוויר יפה מאוד היום ואנחנו הולכים לפארק עם הילדים. הייתי "
+        "רוצה לדעת באיזו שעה יוצאת הרכבת מחר בבוקר. היא אמרה שהם "
+        "עובדים על הפרויקט הזה כבר שלוש שנים. ליד הנהר יש בית קטן שבו "
+        "גרה סבתא שלי. תוכל להגיד לי איפה התחנה הקרובה ביותר? אנחנו "
+        "צריכים לאכול ארוחת ערב יחד בשבוע הבא. הממשלה הודיעה על צעדים "
+        "חדשים לתמיכה בעסקים מקומיים. רוב האנשים חושבים שהעיר השתנתה "
+        "מאוד בעשר השנים האחרונות. הוא קרא ספר על ההיסטוריה של המדינה "
+        "כשהגעתי. חשוב לשתות מספיק מים כל יום, במיוחד בקיץ."
+    ),
+    "yi": (
+        "דער וועטער איז הײַנט זייער שיין און מיר גייען אין פּאַרק מיט "
+        "די קינדער. איך וואָלט געוואָלט וויסן ווען די באַן פֿאָרט אַוועק "
+        "מאָרגן אין דער פֿרי. זי האָט געזאָגט אַז זיי אַרבעטן אויף דעם "
+        "פּראָיעקט שוין דרײַ יאָר. לעבן דעם טײַך שטייט אַ קליין הויז וווּ "
+        "עס האָט געוווינט מײַן באָבע. קענסטו מיר זאָגן וווּ עס געפֿינט "
+        "זיך די נאָענטסטע סטאַנציע? מיר דאַרפֿן עסן וועטשערע צוזאַמען "
+        "די קומענדיקע וואָך. די רעגירונג האָט אָנגעזאָגט נײַע מיטלען צו "
+        "שטיצן די אָרטיקע געשעפֿטן. רובֿ מענטשן מיינען אַז די שטאָט האָט "
+        "זיך שטאַרק געביטן אין די לעצטע צען יאָר. ער האָט געלייענט אַ "
+        "בוך וועגן דער געשיכטע פֿון לאַנד ווען איך בין אָנגעקומען. עס "
+        "איז וויכטיק צו טרינקען גענוג וואַסער יעדן טאָג, בפֿרט זומער."
+    ),
+    "hi": (
+        "आज मौसम बहुत अच्छा है और हम बच्चों के साथ पार्क जा रहे हैं। "
+        "मैं जानना चाहता हूँ कि कल सुबह ट्रेन कितने बजे छूटती है। उसने "
+        "कहा कि वे तीन साल से इस परियोजना पर काम कर रहे हैं। नदी के "
+        "पास एक छोटा सा घर है जहाँ मेरी दादी रहती थीं। क्या आप मुझे बता "
+        "सकते हैं कि सबसे नज़दीकी स्टेशन कहाँ है? हमें अगले हफ़्ते साथ "
+        "में खाना खाना चाहिए। सरकार ने स्थानीय व्यवसायों की मदद के लिए "
+        "नए उपायों की घोषणा की। ज़्यादातर लोग मानते हैं कि पिछले दस "
+        "सालों में शहर बहुत बदल गया है। जब मैं पहुँचा तो वह देश के "
+        "इतिहास के बारे में किताब पढ़ रहा था। हर दिन पर्याप्त पानी पीना "
+        "ज़रूरी है, ख़ासकर गर्मियों में।"
+    ),
+    "mr": (
+        "आज हवामान खूप छान आहे आणि आम्ही मुलांसोबत उद्यानात जात आहोत. "
+        "उद्या सकाळी गाडी किती वाजता सुटते हे मला जाणून घ्यायचे आहे. ती "
+        "म्हणाली की ते तीन वर्षांपासून या प्रकल्पावर काम करत आहेत. "
+        "नदीजवळ एक लहानसे घर आहे जिथे माझी आजी राहत असे. सर्वात जवळचे "
+        "स्थानक कुठे आहे ते मला सांगू शकाल का? आपण पुढच्या आठवड्यात "
+        "एकत्र जेवायला हवे. सरकारने स्थानिक व्यवसायांना मदत करण्यासाठी "
+        "नवीन उपाय जाहीर केले. गेल्या दहा वर्षांत शहर खूप बदलले आहे असे "
+        "बहुतेक लोकांना वाटते. मी पोहोचलो तेव्हा तो देशाच्या "
+        "इतिहासाबद्दल पुस्तक वाचत होता. दररोज पुरेसे पाणी पिणे महत्त्वाचे "
+        "आहे, विशेषतः उन्हाळ्यात."
+    ),
+    "ne": (
+        "आज मौसम धेरै राम्रो छ र हामी बालबालिकासँग पार्क जाँदैछौं। भोलि "
+        "बिहान रेल कति बजे छुट्छ भनेर म जान्न चाहन्छु। उनले भनिन् कि "
+        "उनीहरू तीन वर्षदेखि यो परियोजनामा काम गरिरहेका छन्। नदी नजिकै "
+        "एउटा सानो घर छ जहाँ मेरी हजुरआमा बस्नुहुन्थ्यो। सबैभन्दा नजिकको "
+        "स्टेसन कहाँ छ भनेर मलाई भन्न सक्नुहुन्छ? हामीले अर्को हप्ता "
+        "सँगै खाना खानुपर्छ। सरकारले स्थानीय व्यवसायलाई सहयोग गर्न नयाँ "
+        "उपायहरू घोषणा गर्‍यो। धेरैजसो मानिसहरू विचार गर्छन् कि पछिल्लो "
+        "दस वर्षमा सहर धेरै परिवर्तन भएको छ। म आइपुग्दा उनी देशको "
+        "इतिहासबारे किताब पढ्दै थिए। हरेक दिन प्रशस्त पानी पिउनु "
+        "महत्त्वपूर्ण छ, विशेष गरी गर्मीमा।"
+    ),
 }
 
 # Supplementary prose for the CLOSE pairs (pt/gl, cs/sk, id/ms, sv/no/da,
-# ru/bg/uk): parallel everyday sentences whose function words and
-# orthography differ exactly where the pair differs, so the profiles pull
-# apart where it matters.
+# ru/bg/uk; round 5 adds es/oc, an/gl, hi/ne): parallel everyday
+# sentences whose function words and orthography differ exactly where
+# the pair differs, so the profiles pull apart where it matters.
 _SUPPLEMENTS: dict[str, str] = {
+    "es": (
+        "Mi hermano compró un coche nuevo el mes pasado y lo conduce al "
+        "trabajo todos los días. Los niños juegan en el patio mientras "
+        "su padre prepara la comida. ¿Ya fuiste a la tienda a comprar "
+        "pan y queso para el desayuno? Mañana vamos a visitar a "
+        "nuestros amigos que viven en el centro de la ciudad. No sé si "
+        "ellos van a llegar a tiempo, pero vamos a esperar un poco más."
+    ),
+    "oc": (
+        "Mon fraire crompèt una veitura novèla lo mes passat e la mena "
+        "al trabalh cada jorn. Los dròlles jògan dins la cort mentre "
+        "que lor paire prepara lo repais. Ja anères a la botiga crompar "
+        "de pan e de formatge per lo dejunar? Deman anam visitar "
+        "nòstres amics que demòran al centre de la vila."
+    ),
+    "an": (
+        "O mío chirmán crompó un auto nuevo o mes pasau y lo leva ta o "
+        "treballo cada día. No sé si els plegarán a tiempo, pero "
+        "asperaremos una mica más. Ya fues t'a botiga a crompar pan y "
+        "queso t'almorzar? Maitin imos a vesitar a os nuestros amigos "
+        "que viven en o centro d'a ciudat."
+    ),
+    "hi": (
+        "मेरे भाई ने पिछले महीने नई गाड़ी खरीदी और वह रोज़ उसे काम पर ले "
+        "जाता है। बच्चे आँगन में खेल रहे हैं और उनके पिता खाना बना रहे "
+        "हैं। क्या तुम दुकान से रोटी और पनीर ले आए हो? हम कल अपने "
+        "दोस्तों से मिलने जाएँगे जो शहर के बीच में रहते हैं। मुझे नहीं "
+        "पता कि वे समय पर पहुँचेंगे या नहीं, लेकिन हम थोड़ा और इंतज़ार "
+        "करेंगे।"
+    ),
+    "ne": (
+        "मेरो भाइले गत महिना नयाँ गाडी किन्यो र ऊ हरेक दिन त्यसैमा काममा "
+        "जान्छ। केटाकेटीहरू आँगनमा खेल्दैछन् र उनीहरूका बुबा खाना "
+        "पकाउँदै हुनुहुन्छ। के तिमी पसलबाट रोटी र पनीर ल्याइसकेका छौ? "
+        "हामी भोलि सहरको बीचमा बस्ने साथीहरूलाई भेट्न जानेछौं। उनीहरू "
+        "समयमै आइपुग्छन् कि आइपुग्दैनन् थाहा छैन, तर हामी अझै केही बेर "
+        "पर्खनेछौं।"
+    ),
     "pt": (
         "Não sei se eles vão conseguir chegar a tempo, mas vamos esperar "
         "mais um pouco. As crianças estão a brincar no jardim enquanto o "
@@ -689,22 +960,49 @@ del _l, _s
 # the language outright, or a family name when profiles disambiguate
 SCRIPT_RANGES = [
     (0x0370, 0x03FF, "el"),
-    (0x0400, 0x04FF, "cyrillic"),   # ru/uk/bg via profiles
+    (0x0400, 0x04FF, "cyrillic"),   # ru/uk/bg/be/mk/sr/kk via profiles
     (0x0530, 0x058F, "hy"),
-    (0x0590, 0x05FF, "he"),
-    (0x0600, 0x06FF, "ar"),
-    (0x0900, 0x097F, "hi"),
+    (0x0590, 0x05FF, "hebrew"),      # he/yi via profiles
+    (0x0600, 0x06FF, "arabic"),      # ar/fa/ur/ckb via profiles
+    (0x0700, 0x074F, "unknown"),     # Syriac: Arabic-adjacent block the
+                                     # reference set does not cover -
+                                     # honest unknown, not a wrong ar
+    (0x0750, 0x077F, "arabic"),      # Arabic Supplement (fa/ur extras)
+    (0x0900, 0x097F, "devanagari"),  # hi/mr/ne via profiles
     (0x0980, 0x09FF, "bn"),
+    (0x0A00, 0x0A7F, "pa"),          # gurmukhi
     (0x0A80, 0x0AFF, "gu"),
     (0x0B80, 0x0BFF, "ta"),
     (0x0C00, 0x0C7F, "te"),
+    (0x0C80, 0x0CFF, "kn"),
+    (0x0D00, 0x0D7F, "ml"),
     (0x0E00, 0x0E7F, "th"),
     (0x10A0, 0x10FF, "ka"),
+    (0x1780, 0x17FF, "km"),
     (0x3040, 0x309F, "ja"),          # hiragana is decisive vs chinese
     (0x30A0, 0x30FF, "ja"),          # katakana
-    (0x4E00, 0x9FFF, "zh"),          # han without kana -> chinese
+    (0x4E00, 0x9FFF, "han"),         # han without kana -> zh-cn / zh-tw
     (0xAC00, 0xD7AF, "ko"),
 ]
+
+# Simplified/traditional discriminators: each pair is the SAME everyday
+# word in the two orthographies, so presence of either side is decisive
+# (reference Optimaize distinguishes zh-cn vs zh-tw the same way - by
+# script variant, not dialect).
+_ZH_SIMPLIFIED = set(
+    "们这说对时会过还没样张习书车马鸟语门问间飞东见长现观钱银点战爱无众网页径经变让"
+    "开关记读写听买卖饭饮处厅应个区里为几机关争发动务专业难题亲热万与从众优伤传"
+)
+_ZH_TRADITIONAL = set(
+    "們這說對時會過還沒樣張習書車馬鳥語門問間飛東見長現觀錢銀點戰愛無眾網頁徑經變讓"
+    "開關記讀寫聽買賣飯飲處廳應個區裡為幾機關爭發動務專業難題親熱萬與從眾優傷傳"
+)
+
+
+def _zh_variant(text: str) -> str:
+    s = sum(1 for ch in text if ch in _ZH_SIMPLIFIED)
+    t = sum(1 for ch in text if ch in _ZH_TRADITIONAL)
+    return "zh-tw" if t > s else "zh-cn"
 
 
 _GRAM_SIZES = (1, 2, 3, 4, 5)  # the original Cavnar-Trenkle mixed scheme
@@ -740,9 +1038,20 @@ PROFILES: dict[str, dict[str, int]] = {
     lang: _trigram_ranks(text) for lang, text in CORPORA.items()
 }
 
-_CYRILLIC_LANGS = ("ru", "uk", "bg")
+_CYRILLIC_LANGS = ("ru", "uk", "bg", "be", "mk", "sr", "kk")
+# script-family -> profiled candidates within the family (the script vote
+# narrows to the family, the n-gram profiles pick the language)
+_FAMILY_LANGS = {
+    "cyrillic": _CYRILLIC_LANGS,
+    "arabic": ("ar", "fa", "ur", "ckb"),
+    "hebrew": ("he", "yi"),
+    "devanagari": ("hi", "mr", "ne"),
+}
+_NON_LATIN = frozenset(
+    lang for langs in _FAMILY_LANGS.values() for lang in langs
+)
 _LATIN_LANGS = tuple(
-    lang for lang in CORPORA if lang not in _CYRILLIC_LANGS
+    lang for lang in CORPORA if lang not in _NON_LATIN
 )
 
 
@@ -762,7 +1071,7 @@ def dominant_script(text: str) -> str:
     if not votes:
         return "latin"
     # hiragana/katakana decide japanese even when han dominates raw counts
-    if votes.get("ja") and votes.get("zh"):
+    if votes.get("ja") and votes.get("han"):
         return "ja"
     return votes.most_common(1)[0][0]
 
@@ -805,8 +1114,10 @@ def detect(text: str) -> dict[str, float]:
     script = dominant_script(text)
     if script == "latin":
         cands = _LATIN_LANGS
-    elif script == "cyrillic":
-        cands = _CYRILLIC_LANGS
+    elif script in _FAMILY_LANGS:
+        cands = _FAMILY_LANGS[script]
+    elif script == "han":
+        return {_zh_variant(text): 1.0}
     else:
         return {script: 1.0}
     doc = _gram_counts(text)

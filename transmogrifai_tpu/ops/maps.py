@@ -58,19 +58,43 @@ class MapVectorizerModel(SequenceVectorizerModel):
         self.track_nulls = track_nulls
         self.clean_text = clean_text
 
+    def _plan_state(self, i: int) -> tuple:
+        """Hashable digest of every fitted field the metas derive from
+        (fill values change arrays, not metas, so they are excluded)."""
+        return tuple(
+            (p["key"] if "key" in p else None, p["kind"],
+             tuple(p.get("labels") or ()), tuple(p.get("periods") or ()),
+             tuple(p.get("keys") or ()), p.get("dims"))
+            for p in self.plans[i]
+        )
+
     def blocks_for(self, col: Column, i: int):
         assert isinstance(col, MapColumn)
         feat = self.input_features[i]
         tname = feat.ftype.type_name()
         blocks: list[np.ndarray] = []
-        metas: list[VectorColumnMeta] = []
+        # metas interleave with the plan walk, so the memo guards the
+        # SAME loop instead of a mirror builder: on a hit the appends are
+        # skipped and the cached list is returned (serving hot path)
+        memo = getattr(self, "_metas_memo", None)
+        if memo is None:
+            memo = self._metas_memo = {}
+        state = (feat.name, tname, self.track_nulls, self.clean_text,
+                 self._plan_state(i))
+        hit = memo.get(i)
+        need_metas = hit is None or hit[0] != state
+        metas: list[VectorColumnMeta] = [] if need_metas else hit[1]
+
+        def add_meta(**kw) -> None:
+            if need_metas:
+                metas.append(VectorColumnMeta(
+                    parent_feature_name=feat.name, parent_feature_type=tname,
+                    **kw))
 
         def null_block(mask: np.ndarray, key: str) -> None:
             if self.track_nulls:
                 blocks.append((~mask).astype(np.float64)[:, None])
-                metas.append(VectorColumnMeta(
-                    parent_feature_name=feat.name, parent_feature_type=tname,
-                    grouping=key, indicator_value=NULL_STRING))
+                add_meta(grouping=key, indicator_value=NULL_STRING)
 
         for plan in self.plans[i]:
             key, kind = plan["key"], plan["kind"]
@@ -78,9 +102,7 @@ class MapVectorizerModel(SequenceVectorizerModel):
                 arr, mask = _numeric_key_arrays(col, key)
                 filled = np.where(mask, arr, plan["fill"])
                 blocks.append(filled[:, None])
-                metas.append(VectorColumnMeta(
-                    parent_feature_name=feat.name, parent_feature_type=tname,
-                    grouping=key))
+                add_meta(grouping=key)
                 null_block(mask, key)
             elif kind == "pivot":
                 vals = _key_values(col, key)
@@ -105,9 +127,7 @@ class MapVectorizerModel(SequenceVectorizerModel):
                             arr[r, j] = 1.0
                 blocks.append(arr)
                 for lab in labels + ["OTHER"]:
-                    metas.append(VectorColumnMeta(
-                        parent_feature_name=feat.name, parent_feature_type=tname,
-                        grouping=key, indicator_value=lab))
+                    add_meta(grouping=key, indicator_value=lab)
                 null_block(mask, key)
             elif kind == "date":
                 arr, mask = _numeric_key_arrays(col, key)
@@ -115,10 +135,7 @@ class MapVectorizerModel(SequenceVectorizerModel):
                     rad = 2.0 * np.pi * period_fraction(arr, p)
                     for trig, nm in ((np.sin, "sin"), (np.cos, "cos")):
                         blocks.append(np.where(mask, trig(rad), 0.0)[:, None])
-                        metas.append(VectorColumnMeta(
-                            parent_feature_name=feat.name,
-                            parent_feature_type=tname,
-                            grouping=key, descriptor_value=f"{p}_{nm}"))
+                        add_meta(grouping=key, descriptor_value=f"{p}_{nm}")
                 null_block(mask, key)
             elif kind == "geo":
                 vals = _key_values(col, key)
@@ -129,9 +146,7 @@ class MapVectorizerModel(SequenceVectorizerModel):
                 filled = np.where(mask[:, None], dense, np.asarray(plan["fill"])[None, :])
                 blocks.append(filled)
                 for d in ("lat", "lon", "accuracy"):
-                    metas.append(VectorColumnMeta(
-                        parent_feature_name=feat.name, parent_feature_type=tname,
-                        grouping=key, descriptor_value=d))
+                    add_meta(grouping=key, descriptor_value=d)
                 null_block(mask, key)
             elif kind == "hash":
                 # shared hash block for this feature's high-cardinality
@@ -156,21 +171,15 @@ class MapVectorizerModel(SequenceVectorizerModel):
                         )
                     docs.append(toks)
                 blocks.append(hashing_tf(docs, dims, seed=plan["seed"]))
-                metas.extend(
-                    VectorColumnMeta(
-                        parent_feature_name=feat.name,
-                        parent_feature_type=tname,
-                        descriptor_value=f"hash_{j}")
-                    for j in range(dims)
-                )
+                for j in range(dims):
+                    add_meta(descriptor_value=f"hash_{j}")
                 if self.track_nulls:
                     blocks.append((~any_mask).astype(np.float64)[:, None])
-                    metas.append(VectorColumnMeta(
-                        parent_feature_name=feat.name,
-                        parent_feature_type=tname,
-                        indicator_value=NULL_STRING))
+                    add_meta(indicator_value=NULL_STRING)
             else:  # pragma: no cover
                 raise ValueError(kind)
+        if need_metas:
+            memo[i] = (state, metas)
         if not blocks:
             return np.zeros((len(col), 0)), []
         return np.concatenate(blocks, axis=1), metas
@@ -312,15 +321,19 @@ class TextMapLenModel(SequenceVectorizerModel):
                 v = cleaned.get(k)
                 if v is not None:
                     arr[r, j] = float(sum(len(t) for t in tokenize(str(v))))
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                grouping=k,
-                descriptor_value="TextLen",
-            )
-            for k in keys
-        ]
+        metas = self.cached_metas(
+            i,
+            (feat.name, feat.ftype.type_name(), tuple(keys)),
+            lambda: [
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    grouping=k,
+                    descriptor_value="TextLen",
+                )
+                for k in keys
+            ],
+        )
         return arr, metas
 
 
@@ -377,15 +390,19 @@ class TextMapNullModel(SequenceVectorizerModel):
             for j, k in enumerate(keys):
                 if k not in present:
                     arr[r, j] = 1.0
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                grouping=k,
-                indicator_value=NULL_STRING,
-            )
-            for k in keys
-        ]
+        metas = self.cached_metas(
+            i,
+            (feat.name, feat.ftype.type_name(), tuple(keys)),
+            lambda: [
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    grouping=k,
+                    indicator_value=NULL_STRING,
+                )
+                for k in keys
+            ],
+        )
         return arr, metas
 
 

@@ -31,22 +31,31 @@ class NumericVectorizerModel(SequenceVectorizerModel):
         feat = self.input_features[i]
         filled = np.where(col.mask, col.values, self.fill_values[i])
         blocks = [filled]
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-            )
-        ]
         if self.track_nulls:
             blocks.append((~col.mask).astype(np.float64))
-            metas.append(
+
+        def build():
+            tname = feat.ftype.type_name()
+            ms = [
                 VectorColumnMeta(
                     parent_feature_name=feat.name,
-                    parent_feature_type=feat.ftype.type_name(),
-                    grouping=feat.name,
-                    indicator_value=NULL_STRING,
+                    parent_feature_type=tname,
                 )
-            )
+            ]
+            if self.track_nulls:
+                ms.append(
+                    VectorColumnMeta(
+                        parent_feature_name=feat.name,
+                        parent_feature_type=tname,
+                        grouping=feat.name,
+                        indicator_value=NULL_STRING,
+                    )
+                )
+            return ms
+
+        metas = self.cached_metas(
+            i, (feat.name, feat.ftype.type_name(), self.track_nulls), build
+        )
         return np.stack(blocks, axis=1), metas
 
 

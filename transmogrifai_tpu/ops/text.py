@@ -215,14 +215,18 @@ class TextListHashModel(SequenceVectorizerModel):
         arr = hashing_tf(
             [list(v) for v in col.values], self.hash_dims, seed=self.seed
         )
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                descriptor_value=f"hash_{j}",
-            )
-            for j in range(self.hash_dims)
-        ]
+        metas = self.cached_metas(
+            i,
+            (feat.name, feat.ftype.type_name(), self.hash_dims),
+            lambda: [
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    descriptor_value=f"hash_{j}",
+                )
+                for j in range(self.hash_dims)
+            ],
+        )
         return arr, metas
 
 
@@ -342,15 +346,19 @@ class CountVectorizerModel(SequenceVectorizerModel):
             for t, c in counts.items():
                 if c >= thr:
                     arr[r, index[t]] = 1.0 if self.binary else float(c)
-        metas = [
-            VectorColumnMeta(
-                parent_feature_name=feat.name,
-                parent_feature_type=feat.ftype.type_name(),
-                grouping=feat.name,
-                indicator_value=term,
-            )
-            for term in self.vocabulary
-        ]
+        metas = self.cached_metas(
+            i,
+            (feat.name, feat.ftype.type_name(), tuple(self.vocabulary)),
+            lambda: [
+                VectorColumnMeta(
+                    parent_feature_name=feat.name,
+                    parent_feature_type=feat.ftype.type_name(),
+                    grouping=feat.name,
+                    indicator_value=term,
+                )
+                for term in self.vocabulary
+            ],
+        )
         return arr, metas
 
 

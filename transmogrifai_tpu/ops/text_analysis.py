@@ -62,12 +62,15 @@ class TextLenTransformer(Transformer):
 
 def detect_language(text: Optional[str]) -> dict[str, float]:
     """Language -> confidence scores (reference: LangDetector.scala via
-    the Optimaize profiles).  Unicode-script routing decides non-Latin
-    scripts outright; Latin- and Cyrillic-script text is identified by
-    mixed 1-5-gram profile likelihoods built from the embedded seed
-    corpora in ops.lang_data (40 Latin + 3 Cyrillic profiled languages +
-    the script-decided set, ~57 total; accuracy pinned at >=90% on the
-    148-sample held-out fixture in tests/test_text_accuracy.py)."""
+    the Optimaize profiles).  Unicode-script routing narrows to a script
+    family (Latin, Cyrillic, Arabic, Hebrew, Devanagari - or decides
+    outright for single-language scripts and the zh-cn/zh-tw variant
+    split); within a family, mixed 1-5-gram profile likelihoods built
+    from the embedded seed corpora in ops.lang_data pick the language.
+    62 profiled + ~17 script-decided languages (~79 total, a superset of
+    the reference's ~70); accuracy pinned at >=90% on the 204-sample
+    held-out fixture in tests/test_text_accuracy.py, with an
+    independent-register fixture alongside."""
     if not text:
         return {}
     from .lang_data import detect
