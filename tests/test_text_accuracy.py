@@ -592,3 +592,28 @@ def test_mime_pgp_armor_subtypes():
     assert detect_mime_type(_b64(
         b"-----BEGIN PGP SIGNATURE-----\nwsBc"
     )) == "application/pgp-signature"
+
+
+def test_mime_review_r5_hardening():
+    """Second review pass: XML routing keys on the DOCUMENT element only
+    (roots in comments/nested elements must not route), the LHA level
+    byte is validated, and the MATLAB magic is the full header."""
+    assert detect_mime_type(_b64(
+        b'<?xml version="1.0"?>\n<!-- exported to <html> viewer -->\n'
+        b'<config a="1"/>'
+    )) == "application/xml"
+    assert detect_mime_type(_b64(
+        b'<?xml version="1.0"?><report><svg width="10"/></report>'
+    )) == "application/xml"
+    assert detect_mime_type(_b64(
+        b'<?xml version="1.0"?>\n<!DOCTYPE svg>\n<svg width="4">'
+    )) == "image/svg+xml"
+    assert detect_mime_type(_b64(
+        b"ab-lhx-prose with a fake level byte"
+    )) == "text/plain"
+    assert detect_mime_type(_b64(
+        b"MATLAB 5.0 introduced cell arrays and structs"
+    )) == "text/plain"
+    assert detect_mime_type(_b64(
+        b"MATLAB 5.0 MAT-file, Platform: GLNXA64" + b"\x00" * 100
+    )) == "application/x-matlab-data"

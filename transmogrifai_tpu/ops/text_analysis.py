@@ -246,7 +246,7 @@ _MAGIC = [
     (b"BC\xc0\xde", "application/x-llvm-bitcode"),
     (b"\x93NUMPY", "application/x-npy"),
     (b"ARROW1", "application/vnd.apache.arrow.file"),
-    (b"MATLAB 5.0", "application/x-matlab-data"),
+    (b"MATLAB 5.0 MAT-file", "application/x-matlab-data"),
     (b"CDF\x01", "application/x-netcdf"),
     (b"CDF\x02", "application/x-netcdf"),
     # PGP armor: specific block types before the encrypted-message forms
@@ -358,16 +358,37 @@ def detect_mime_type(b64: Optional[str]) -> Optional[str]:
                 return mime
         return "audio/ogg"
     if raw.lstrip()[:5].lower() == b"<?xml":
+        # route on the DOCUMENT element only: the first '<' that opens a
+        # real element (skipping PIs and comments/doctype), with a name
+        # boundary after the token - "<feedback" must not ride the
+        # "<feed" (atom) route, and "<svg>" inside a comment or nested in
+        # some other document must not route the whole file
         rl = raw.lower()
-        for root, mime in _XML_ROOTS:
-            # element-name boundary required: "<feedback" must not ride
-            # the "<feed" (atom) route, "<kmlexport" not the kml route
-            idx = rl.find(root)
-            if idx != -1 and (
-                idx + len(root) >= len(rl)
-                or rl[idx + len(root): idx + len(root) + 1] in b" >/\r\n\t"
-            ):
-                return mime
+        pos = 0
+        while True:
+            lt = rl.find(b"<", pos)
+            if lt == -1:
+                break
+            nxt = rl[lt + 1: lt + 2]
+            if nxt in (b"?", b"!"):
+                # skip the WHOLE prolog construct - a '<root>' inside a
+                # comment body must not be scanned as an element
+                closer = b"-->" if rl[lt + 1: lt + 4] == b"!--" else (
+                    b"?>" if nxt == b"?" else b">"
+                )
+                end_c = rl.find(closer, lt)
+                if end_c == -1:
+                    break  # construct truncated by the visible head
+                pos = end_c + len(closer)
+                continue
+            for root, mime in _XML_ROOTS:
+                tok = root[1:]  # the element name, '<' stripped
+                end = lt + 1 + len(tok)
+                if rl[lt + 1: end] == tok and (
+                    end >= len(rl) or rl[end: end + 1] in b" >/\r\n\t"
+                ):
+                    return mime
+            break  # document element seen and unrecognized
         return "application/xml"
     for magic, mime in _MAGIC:
         if raw.startswith(magic):
@@ -376,9 +397,13 @@ def detect_mime_type(b64: Optional[str]) -> Optional[str]:
         return _RIFF_SUBTYPES.get(raw[8:12], "application/octet-stream")
     if raw[:4] == b"FORM" and len(raw) >= 12:  # IFF: aiff/aifc/ilbm
         return _FORM_SUBTYPES.get(raw[8:12], "application/octet-stream")
-    if raw[2:5] == b"-lh" and raw[6:7] == b"-":
-        # LHA: the full "-lh<level>-" token after a 2-byte header size
-        # ("my-lhasa ..." prose must not match)
+    if (
+        raw[2:5] == b"-lh"
+        and raw[5:6] in b"01234567ds"
+        and raw[6:7] == b"-"
+    ):
+        # LHA: the full "-lh<level>-" token after a 2-byte header size,
+        # level byte validated ("ab-lhx- ..." prose must not match)
         return "application/x-lzh-compressed"
     if len(raw) >= 68 and raw[60:68] in (b"BOOKMOBI", b"TEXtREAd"):
         return "application/x-mobipocket-ebook"
