@@ -117,9 +117,8 @@ def _synth_section(result: dict) -> None:
     t_cv_warm = time.time() - t0
     assert abs(res_warm.best_metric - res.best_metric) < 1e-6
 
-    # FLOPs accounting for the CV fan-out (dominant terms of the batched
-    # Newton fit, logistic_regression._lr_fit_kernel: XtWX 2nd^2 + two
-    # [n,d] matvecs per iteration, plus the d^3 solve), and the 1024-bin
+    # FLOPs accounting for the CV fan-out (_lr_cv_flops, shared with the
+    # 2M tier so cross-tier TFLOP/s compare), and the 1024-bin
     # rank-metric outer-product histograms when the device path ran.
     # Constants come FROM the estimator/validator so reported TFLOPs track
     # reality if defaults change (advisor r2 finding).
@@ -127,7 +126,7 @@ def _synth_section(result: dict) -> None:
     k_folds = int(cv.num_folds)
     B = k_folds * len(grid)  # folds x grid replicas
     iters = int(est.params["max_iter"])
-    fit_flops = B * iters * (2.0 * n * d * d + 4.0 * n * d + (2 / 3) * d**3)
+    fit_flops = _lr_cv_flops(n, d, B, iters)
     approx_used = any(
         r.get("rank_metric_mode") == "approx" for r in res.all_results
     )
@@ -212,21 +211,10 @@ def _synth_section(result: dict) -> None:
     except Exception as e:
         result["synth_gbt_error"] = f"{type(e).__name__}: {e}"
 
-    # planted-truth gate (examples/synthetic.py PLANTED): one LR refit at
-    # grid-typical regularization, coefficients checked against the
-    # generator's known ground truth + Bayes AuROC ceiling - proves the
-    # scale run is CORRECT, not just fast
-    try:
-        from transmogrifai_tpu.examples.synthetic import planted_truth_report
-
-        gate = OpLogisticRegression(reg_param=1e-3, max_iter=25)
-        gp = gate.fit_arrays(X, y)  # device-resident X stays on device
-        report = planted_truth_report(
-            gp["beta"], meta, res.best_metric
-        )
-        result.update({f"planted_{k}": v for k, v in report.items()})
-    except Exception as e:
-        result["planted_error"] = f"{type(e).__name__}: {e}"
+    # planted-truth gate (examples/synthetic.py PLANTED) - proves the
+    # scale run is CORRECT, not just fast; device-resident X stays on
+    # device through the shared gate helper
+    _planted_gate(result, "planted_", X, y, meta, res.best_metric)
     peak_chip = _peak_flops_of(jax.devices()[0])
     if on_tpu and peak_chip:
         # the CV fit shards over every local device, so the denominator is
@@ -251,6 +239,32 @@ def _synth_section(result: dict) -> None:
             total_flops / t_cv_warm / peak, 5
         )
         result["mfu_peak_flops_assumed"] = peak
+
+
+def _lr_cv_flops(n: int, d: int, B: int, iters: int) -> float:
+    """Dominant Newton-fit terms per the batched kernel
+    (logistic_regression._lr_fit_kernel): XtWX 2nd^2 + two [n, d]
+    matvecs per iteration, plus the d^3 solve.  ONE definition serves
+    every synth tier so cross-tier TFLOP/s stay comparable."""
+    return B * iters * (2.0 * n * d * d + 4.0 * n * d + (2 / 3) * d**3)
+
+
+def _planted_gate(result: dict, prefix: str, X, y, meta, best_metric) -> None:
+    """Planted-truth correctness gate shared by the synth tiers: one LR
+    refit at grid-typical regularization, coefficients checked against
+    the generator's ground truth + Bayes AuROC ceiling."""
+    from transmogrifai_tpu.examples.synthetic import planted_truth_report
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+
+    try:
+        gate = OpLogisticRegression(reg_param=1e-3, max_iter=25)
+        gp = gate.fit_arrays(X, y)
+        report = planted_truth_report(gp["beta"], meta, best_metric)
+        result.update({f"{prefix}{k}": v for k, v in report.items()})
+    except Exception as e:
+        result[f"{prefix}error"] = f"{type(e).__name__}: {e}"
 
 
 def _synth2m_section(result: dict) -> None:
@@ -304,9 +318,9 @@ def _synth2m_section(result: dict) -> None:
     t0 = time.time()
     res = cv.validate([(est, grid)], X, y)
     t_cv = time.time() - t0
-    B = 3 * len(grid)
+    B = int(cv.num_folds) * len(grid)
     iters = int(est.params["max_iter"])
-    fit_flops = B * iters * (2.0 * n2 * d * d + 4.0 * n2 * d + (2 / 3) * d**3)
+    fit_flops = _lr_cv_flops(n2, d, B, iters)
     result.update(
         synth2m_rows=n2,
         synth2m_gen_wall_s=round(t_gen, 3),
@@ -329,22 +343,9 @@ def _synth2m_section(result: dict) -> None:
     except Exception as e:
         result["synth2m_rf_error"] = f"{type(e).__name__}: {e}"
     # planted-truth gate at 2M: the tier proves CORRECTNESS at scale,
-    # not just speed (same gate as the 200k/10M tier; the per-block
-    # seeds share one generator structure, so the planted coefficients
-    # and Bayes ceiling are unchanged)
-    try:
-        from transmogrifai_tpu.examples.synthetic import (
-            planted_truth_report,
-        )
-
-        gate = OpLogisticRegression(reg_param=1e-3, max_iter=25)
-        gp = gate.fit_arrays(X, y)
-        report = planted_truth_report(gp["beta"], meta, res.best_metric)
-        result.update(
-            {f"synth2m_planted_{k}": v for k, v in report.items()}
-        )
-    except Exception as e:
-        result["synth2m_planted_error"] = f"{type(e).__name__}: {e}"
+    # not just speed (the per-block seeds share one generator structure,
+    # so the planted coefficients and Bayes ceiling are unchanged)
+    _planted_gate(result, "synth2m_planted_", X, y, meta, res.best_metric)
 
 
 def _ingest_section(result: dict) -> None:
