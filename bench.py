@@ -253,12 +253,17 @@ def _planted_gate(result: dict, prefix: str, X, y, meta, best_metric) -> None:
     """Planted-truth correctness gate shared by the synth tiers: one LR
     refit at grid-typical regularization, coefficients checked against
     the generator's ground truth + Bayes AuROC ceiling."""
-    from transmogrifai_tpu.examples.synthetic import planted_truth_report
-    from transmogrifai_tpu.models.logistic_regression import (
-        OpLogisticRegression,
-    )
-
     try:
+        # imports inside the guard: a gate-only failure must record
+        # {prefix}error and leave the caller's later fields (MFU etc.)
+        # intact, not abort the whole section
+        from transmogrifai_tpu.examples.synthetic import (
+            planted_truth_report,
+        )
+        from transmogrifai_tpu.models.logistic_regression import (
+            OpLogisticRegression,
+        )
+
         gate = OpLogisticRegression(reg_param=1e-3, max_iter=25)
         gp = gate.fit_arrays(X, y)
         report = planted_truth_report(gp["beta"], meta, best_metric)

@@ -48,7 +48,9 @@ class CSVReader:
         self.key_col = key_col
 
     def read_raw(self) -> dict[str, list]:
-        with open(self.path, newline="", encoding="utf-8") as f:
+        # utf-8-sig: an Excel-style BOM must not leak into the first
+        # column name (no-op for BOM-less files)
+        with open(self.path, newline="", encoding="utf-8-sig") as f:
             rows = list(csv.reader(f))
         if not rows:
             return {}

@@ -91,6 +91,9 @@ def _decode_text_column(
 def _parse_header(path: str) -> list[str]:
     with open(path, "rb") as f:
         line = f.readline()
+    if line.startswith(b"\xef\xbb\xbf"):
+        # Excel-style UTF-8 BOM must not leak into the first column name
+        line = line[3:]
     if not line.strip():
         return []
     ncols = line.count(b",") + 1
@@ -139,6 +142,11 @@ def read_csv_columnar(
     modes: Optional[np.ndarray] = None
     names: list[str] = []
     for chunk in _aligned_chunks(path, chunk_bytes):
+        if first and chunk.startswith(b"\xef\xbb\xbf"):
+            # strip the BOM on the data path too: headerless files never
+            # call _parse_header, and the scanner would otherwise read
+            # '﻿1' in the first cell (python fallback uses utf-8-sig)
+            chunk = chunk[3:]
         if first and has_header:
             nl = chunk.find(b"\n")
             # nl == -1: header-only file with no trailing newline
@@ -270,6 +278,9 @@ class DeviceCSVIngest:
             first = True
             for chunk in _aligned_chunks(self.path, self.chunk_bytes):
                 if first:
+                    if chunk.startswith(b"\xef\xbb\xbf"):
+                        chunk = chunk[3:]  # same BOM strip as the
+                        # columnar path (headerless files especially)
                     if self.has_header:
                         nl = chunk.find(b"\n")
                         header = _parse_header(self.path)
