@@ -158,3 +158,48 @@ def test_ner_structural_ceiling_is_honest():
         "The Monday meeting covered the Quarterly Report in detail."
     )
     assert got["person"] == ["quarterly report"]  # honest: this is wrong
+
+
+def test_ner_document_level_surname_carry():
+    """A lone surname with no cue of its own tags when an EARLIER
+    strong-evidence person mention in the same text introduced it as
+    their final token (round 5 - the trained-model behavior the
+    gazetteer tagger lacked); a never-introduced lone token stays
+    dropped, particles never carry, rule-6 default persons seed nothing,
+    a later introduction does not retro-tag, and the person list keeps
+    first-appearance order."""
+    ents = tag_entities(
+        "Thandiwe Mabaso resigned from the board last week. A day "
+        "later Mabaso announced a new venture."
+    )
+    assert "thandiwe mabaso" in ents["person"]
+    assert "mabaso" in ents["person"]
+    # never-introduced single token still dropped (scope note intact)
+    ents2 = tag_entities("The committee thanked Okonjo for the work.")
+    assert ents2["person"] == []
+    # the carry must not promote location/org tokens
+    ents3 = tag_entities(
+        "Dr. Okonkwo flew from Nairobi to Lagos. Nairobi was rainy."
+    )
+    assert "okonkwo" in ents3["person"]
+    assert "nairobi" not in ents3["person"]
+    # review r5: introduction must precede the lone mention
+    r = tag_entities(
+        "Mabaso was away. Thandiwe Mabaso resigned last week."
+    )
+    assert "mabaso" not in r["person"]
+    # particles (non-final name tokens) never carry
+    r = tag_entities("Ludwig van Beethoven resigned. Van went home.")
+    assert "van" not in r["person"]
+    # rule-6 default persons cannot seed carries
+    r = tag_entities(
+        "The Monday meeting covered the Quarterly Report in detail. "
+        "Report authors were absent."
+    )
+    assert "report" not in r["person"]
+    # first-appearance ordering survives the carry
+    r = tag_entities(
+        "Thandiwe Mabaso resigned. Mabaso left early. "
+        "Priya Sharma resigned too."
+    )
+    assert r["person"] == ["thandiwe mabaso", "mabaso", "priya sharma"]

@@ -14,7 +14,11 @@ text, but this tagger's quality is MEASURED, not assumed.
 
 Scope note (documented limit): single-token chunks with no gazetteer or
 context evidence are dropped - sentence-initial capitalization is
-otherwise the dominant false-positive source in rule-based NER.
+otherwise the dominant false-positive source in rule-based NER.  One
+exception (round 5): a lone token that an earlier tagged person in the
+SAME text introduced carries the person label (document-level surname
+carry, the coreference-lite behavior trained models exhibit); tokens
+never introduced stay dropped.
 """
 from __future__ import annotations
 
@@ -268,10 +272,15 @@ def _chunk_key(chunk: list[str]) -> str:
 
 
 def _classify(chunk: list[str], prev: list[str], nxt: list[str],
-              at_sentence_start: bool) -> Optional[str]:
-    """Ordered evidence -> 'person' | 'location' | 'organization' | None.
-    ``prev``/``nxt`` carry up to TWO context tokens each (a period may sit
-    between an abbreviated honorific and the name: "Mr. Smith")."""
+              at_sentence_start: bool) -> tuple[Optional[str], bool]:
+    """Ordered evidence -> ('person'|'location'|'organization'|None,
+    strong).  ``strong`` is True when a positive cue fired (honorific,
+    gazetteer, suffix shape, context rule) and False for the rule-6
+    multiword Title-Case person default - the document-level surname
+    carry only trusts strong persons, so a default-tagged common-noun
+    phrase cannot seed carries.  ``prev``/``nxt`` carry up to TWO context
+    tokens each (a period may sit between an abbreviated honorific and
+    the name: "Mr. Smith")."""
     toks = [_norm(t) for t in chunk]
     if toks and toks[0] == "the" and len(toks) > 1:
         toks = toks[1:]  # leading article is never class signal
@@ -283,50 +292,50 @@ def _classify(chunk: list[str], prev: list[str], nxt: list[str],
 
     # 0. temporal words are never entities ("in June")
     if all(t in TEMPORAL for t in toks):
-        return None
+        return None, False
     # 1. honorific immediately before (possibly across its period:
     #    "Mr. Smith" tokenizes Mr / . / Smith) or leading the chunk
     # (raw comparison: _norm strips periods, so "." normalizes to "")
     if prev1 in HONORIFICS or (
         prev and prev[-1] == "." and prev2 in HONORIFICS
     ):
-        return "person"
+        return "person", True
     if toks[0] in HONORIFICS and len(toks) > 1:
-        return "person"
+        return "person", True
     # 1b. "Surname, Mr. First Last" (the comma-inverted name shape)
     if next1 == "," and next2 in HONORIFICS:
-        return "person"
+        return "person", True
     # 2. org suffix / standalone / of-shapes
     if toks[-1] in ORG_SUFFIXES and (len(toks) > 1 or not at_sentence_start):
-        return "organization"
+        return "organization", True
     if any(t in ORG_STANDALONE for t in toks):
-        return "organization"
+        return "organization", True
     if "of" in toks and any(t in _OF_HOSTS for t in toks):
-        return "organization"
+        return "organization", True
     # 3. location gazetteer (whole phrase, else every token)
     if key in LOCATION_PHRASES or key in LOCATIONS:
-        return "location"
+        return "location", True
     if len(toks) > 1 and all(t in LOCATIONS for t in toks):
-        return "location"
+        return "location", True
     # 4. given-name gazetteer -> person
     if toks[0] in GIVEN_NAMES:
-        return "person"
+        return "person", True
     # 5. context cues
     if prev1 in LOCATIVE_PREPS:
         # "in Paris", "from Wakanda" - unknown places ride the preposition
-        return "location"
+        return "location", True
     if next1 in PERSON_VERBS and len(toks) <= 3:
-        return "person"
+        return "person", True
     if prev1 in {"with", "by"} and len(toks) == 2:
-        return "person"
+        return "person", True
     # 6. unmatched: multiword Title-Case defaults to person (the dominant
     #    open class); single tokens are dropped when sentence-initial
     #    with no other evidence (see module docstring)
     if len(toks) >= 2:
-        return "person"
+        return "person", False
     if not at_sentence_start:
-        return None  # lone mid-sentence capitals: too weak either way
-    return None
+        return None, False  # lone mid-sentence capitals: too weak
+    return None, False
 
 
 def tag_entities(text: Optional[str]) -> dict[str, list[str]]:
@@ -345,8 +354,21 @@ def tag_entities(text: Optional[str]) -> dict[str, list[str]]:
             sentence_start.add(idx + 1)
     seen = set()
     last_end, last_label = -10, None
+    # document-level surname carry (round 5; the OpenNLP models do this
+    # implicitly via sentence context): a lone capitalized token with no
+    # cue of its own is NOT dropped when an EARLIER (by chunk order)
+    # STRONG-evidence multi-token person introduced it as their final
+    # token - "Thandiwe Mabaso resigned... Mabaso said" tags both.
+    # Restrictions keep the known failure modes out: surname-position
+    # only (particles like "van" never carry), strong persons only (a
+    # rule-6 default like "Quarterly Report" cannot seed carries), and
+    # introduction must PRECEDE the lone mention.
+    surname_intro: dict[str, int] = {}  # final token -> intro chunk order
+    deferred: list[tuple[int, str]] = []  # (chunk order, token)
+    person_order: list[tuple[int, str]] = []  # rebuild in appearance order
+    order = 0
     for start, end, chunk in _chunks(tokens):
-        label = _classify(
+        label, strong = _classify(
             chunk,
             tokens[max(0, start - 2) : start],
             tokens[end : end + 2],
@@ -361,6 +383,7 @@ def tag_entities(text: Optional[str]) -> dict[str, list[str]]:
             and tokens[start - 1].lower() in {"and", ","}
         ):
             label = last_label
+        order += 1
         if label:
             key = _chunk_key(chunk)
             parts = key.split()
@@ -372,8 +395,26 @@ def tag_entities(text: Optional[str]) -> dict[str, list[str]]:
             key = " ".join(parts)
             if key and (label, key) not in seen:
                 seen.add((label, key))
-                out[label].append(key)
+                if label == "person":
+                    person_order.append((order, key))
+                    if strong and len(parts) >= 2:
+                        surname_intro.setdefault(parts[-1], order)
+                else:
+                    out[label].append(key)
+        elif len(chunk) == 1:
+            deferred.append((order, _norm(chunk[0])))
         last_end, last_label = end, label
+    for at, tok in deferred:
+        intro = surname_intro.get(tok)
+        if (
+            intro is not None
+            and intro < at  # introduced BEFORE the lone mention
+            and ("person", tok) not in seen
+            and tok not in HONORIFICS
+        ):
+            seen.add(("person", tok))
+            person_order.append((at, tok))
+    out["person"] = [k for _, k in sorted(person_order)]
     return out
 
 
