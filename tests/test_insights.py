@@ -153,3 +153,89 @@ def test_loco_on_multiclass_lr(rng, family):
     parsed = parse_insights(dout.values[0])
     # per-class deltas: 3 classes -> 3 (prediction_index, delta) pairs
     assert all(len(deltas) == 3 for _, deltas in parsed)
+
+
+def test_model_insights_label_summary_and_stage_info(rng):
+    """Round-5 parity fields (ModelInsights.scala:72-79, 291-323): the
+    label's own summary (name, lineage, sample size, Discrete/Continuous
+    distribution) and per-stage settings keyed by uid."""
+    n = 120
+    yv = np.repeat([0.0, 1.0], n // 2)
+    data = {"y": yv.tolist(), "a": rng.randn(n).tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fa = FeatureBuilder(ft.Real, "a").as_predictor()
+    vec = transmogrify([fa])
+    pred = OpLogisticRegression(reg_param=0.01).set_input(fy, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    )
+    ins = model.model_insights()
+
+    ls = ins.label_summary
+    assert ls["label_name"] == "y"
+    assert "y" in ls["raw_feature_names"]
+    assert ls["sample_size"] == n
+    assert ls["distribution"]["type"] == "discrete"
+    assert ls["distribution"]["domain"] == ["0.0", "1.0"]
+    assert ls["distribution"]["prob"] == pytest.approx([0.5, 0.5])
+
+    si = ins.stage_info
+    assert len(si) >= 2  # vectorizer + predictor at minimum
+    pred_uid = model.stages[-1].uid
+    assert si[pred_uid]["class"] == "OpLogisticRegression"
+    assert si[pred_uid]["params"]["reg_param"] == 0.01
+    assert "y" in si[pred_uid]["inputs"]
+    # the new fields survive the JSON report
+    j = ins.to_json()
+    assert j["label_summary"]["label_name"] == "y"
+    assert pred_uid in j["stage_info"]
+
+
+def test_model_insights_continuous_label_distribution(rng):
+    """A regression label with >30 unique values reports the Continuous
+    shape (min/max/mean/variance)."""
+    n = 150
+    yv = rng.randn(n) * 2.0 + 1.0
+    data = {"y": yv.tolist(), "a": rng.randn(n).tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fa = FeatureBuilder(ft.Real, "a").as_predictor()
+    vec = transmogrify([fa])
+    from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+
+    pred = OpLinearRegression(reg_param=0.01).set_input(fy, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    )
+    d = model.model_insights().label_summary["distribution"]
+    assert d["type"] == "continuous"
+    assert d["min"] == pytest.approx(yv.min())
+    assert d["mean"] == pytest.approx(yv.mean(), abs=1e-9)
+
+
+def test_model_insights_loaded_model_label_stats_honest(tmp_path, rng):
+    """A model restored via load_model has no training cache: the label
+    summary keeps name/lineage but marks the distribution unavailable
+    instead of pretending (review r5)."""
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    n = 100
+    yv = np.repeat([0.0, 1.0], n // 2)
+    data = {"y": yv.tolist(), "a": rng.randn(n).tolist()}
+
+    def build():
+        fy = FeatureBuilder(ft.RealNN, "y").as_response()
+        fa = FeatureBuilder(ft.Real, "a").as_predictor()
+        vec = transmogrify([fa])
+        pred = (
+            OpLogisticRegression(reg_param=0.01)
+            .set_input(fy, vec).get_output()
+        )
+        return OpWorkflow().set_result_features(pred).set_input_dataset(data)
+
+    m1 = build().train()
+    m1.save(str(tmp_path / "m"))
+    m2 = OpWorkflowModel.load(str(tmp_path / "m"), build())
+    ls = m2.model_insights().label_summary
+    assert ls["label_name"] == "y"
+    assert "distribution" not in ls
+    assert "distribution_unavailable" in ls

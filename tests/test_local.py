@@ -181,3 +181,48 @@ def test_fitted_transform_metadata_is_memoized(rng):
         assert "_meta_cache" not in state
         assert "_combine_cache" not in state
         assert "_select_cache" not in state
+
+
+def test_multinomial_model_serves_single_rows(rng):
+    """The round-5 softmax model must serve through BOTH single-row
+    surfaces (full-DAG score_function and the engine-free local scorer)
+    with jointly-normalized probabilities."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.local import score_function
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 240
+    centers = np.array([[2.5, 0.0], [-2.5, 1.0], [0.0, -3.0]])
+    yv = np.repeat(np.arange(3.0), n // 3)
+    data = {
+        "y": yv.tolist(),
+        "a": (centers[yv.astype(int), 0] + 0.4 * rng.randn(n)).tolist(),
+        "b": (centers[yv.astype(int), 1] + 0.4 * rng.randn(n)).tolist(),
+    }
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fa = FeatureBuilder(ft.Real, "a").as_predictor()
+    fb = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([fa, fb])
+    pred = OpLogisticRegression(reg_param=0.01).set_input(fy, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    )
+    assert model.stages[-1].model_params["family"] == "multinomial"
+
+    for fn in (model.score_function(), score_function(model)):
+        out = fn({"a": 2.5, "b": 0.0})
+        pcol = next(
+            v for v in out.values()
+            if isinstance(v, dict) and "prediction" in v
+        )
+        probs = [v for k, v in sorted(pcol.items())
+                 if k.startswith("probability")]
+        assert len(probs) == 3
+        assert abs(sum(probs) - 1.0) < 1e-9
+        assert pcol["prediction"] == 0.0

@@ -60,6 +60,109 @@ class ModelInsights:
     feature_insights: list[FeatureInsight]
     splitter_summary: dict
     n_rows: int
+    # reference parity (ModelInsights.scala:72-79): the label's own
+    # summary + every stage's settings keyed by uid
+    label_summary: dict = field(default_factory=dict)
+    stage_info: dict = field(default_factory=dict)
+
+    @staticmethod
+    def _label_summary(model) -> dict:
+        """Label name, lineage, sample size and distribution (reference
+        LabelSummary + Continuous/Discrete, ModelInsights.scala:291-323).
+        Distribution is computed from the model's stored input dataset by
+        replaying the fitted DAG up to the prediction - a one-off cost at
+        insights time, not retained training state."""
+        import numpy as np
+
+        pred_f = None
+        label_f = None
+        for f in getattr(model, "result_features", ()):
+            st = f.origin_stage
+            ins = getattr(st, "input_features", ()) if st else ()
+            if len(ins) >= 2 and ins[0].is_response:
+                pred_f, label_f = f, ins[0]
+                break
+        if label_f is None:
+            return {}
+        out = {
+            "label_name": label_f.name,
+            "raw_feature_names": label_f.history()["originFeatures"],
+            "stages_applied": label_f.history()["stages"],
+        }
+        # the training cache holds the fully-transformed columns - the
+        # label included.  A model restored via load_model has no cache
+        # (and no data to replay), so the distribution is honestly
+        # unavailable there rather than recomputed from nothing.
+        ds = getattr(model, "_train_data_cache", None)
+        if ds is None:
+            out["distribution_unavailable"] = (
+                "no training cache (loaded model): label stats are "
+                "computed from the fit-time data"
+            )
+            return out
+        try:
+            col = ds.columns().get(label_f.name)
+            vals = np.asarray(
+                [v for v in col.to_list() if v is not None], dtype=float
+            )
+            out["sample_size"] = int(len(vals))
+            uniq, cnts = np.unique(vals, return_counts=True)
+            if len(uniq) <= 30:
+                out["distribution"] = {
+                    "type": "discrete",
+                    "domain": [str(u) for u in uniq],
+                    "prob": (cnts / max(len(vals), 1)).tolist(),
+                }
+            else:
+                out["distribution"] = {
+                    "type": "continuous",
+                    "min": float(vals.min()),
+                    "max": float(vals.max()),
+                    "mean": float(vals.mean()),
+                    "variance": float(vals.var(ddof=1)),
+                }
+        except Exception as e:
+            out["distribution_error"] = f"{type(e).__name__}: {e}"
+        return out
+
+    @staticmethod
+    def _stage_info(model) -> dict:
+        """Every fitted stage's settings keyed by uid (reference
+        ModelInsights stageInfo map); params scrub to JSON-safe strings
+        so exotic values never break the report."""
+        def safe(v):
+            if isinstance(v, (bool, int, float, str, type(None))):
+                return v
+            if isinstance(v, (list, tuple)) and len(v) <= 32:
+                return [safe(x) for x in v]
+            if (
+                isinstance(v, dict)
+                and len(v) <= 32
+                and all(isinstance(k, str) for k in v)
+            ):
+                return {k: safe(x) for k, x in v.items()}
+            return str(v)[:200]
+
+        info = {}
+        for s in getattr(model, "stages", ()):
+            # fitted predictor wrappers report their estimator's type
+            # (PredictorModel alone says nothing about WHICH model)
+            cls = (
+                getattr(s, "model_type", None)
+                or getattr(
+                    getattr(s, "estimator_ref", None), "model_type", None
+                )
+                or type(s).__name__
+            )
+            params = getattr(s, "params", None) or getattr(
+                getattr(s, "estimator_ref", None), "params", None
+            ) or {}
+            info[s.uid] = {
+                "class": cls,
+                "inputs": [f.name for f in getattr(s, "input_features", ())],
+                "params": {k: safe(v) for k, v in params.items()},
+            }
+        return info
 
     @staticmethod
     def from_model(model, feature=None) -> "ModelInsights":
@@ -111,6 +214,8 @@ class ModelInsights:
             feature_insights=insights,
             splitter_summary=ms.get("splitter_summary", {}),
             n_rows=ms.get("n_rows", 0),
+            label_summary=ModelInsights._label_summary(model),
+            stage_info=ModelInsights._stage_info(model),
         )
 
     def to_json(self) -> dict:
@@ -124,6 +229,8 @@ class ModelInsights:
             "feature_insights": [f.to_json() for f in self.feature_insights],
             "splitter_summary": self.splitter_summary,
             "n_rows": self.n_rows,
+            "label_summary": self.label_summary,
+            "stage_info": self.stage_info,
         }
 
     def json(self) -> str:
