@@ -229,14 +229,13 @@ def test_regression_cv_stays_on_batched_path(rng, monkeypatch):
     assert calls["single"] == 0
 
 
-def test_multiclass_labels_still_demote_classifier_batched_path(
+def test_multiclass_labels_never_ride_binary_batched_kernel(
     rng, monkeypatch
 ):
-    """3-class y through OpLogisticRegression must keep falling back to the
-    OVR per-candidate route (the binary batched kernel would fit sigmoid on
-    {0,1,2} garbage).  Pinned by call counting, same as the regression
-    sibling: the generic loop calls fit_arrays k*g times; the batched
-    branch would call it zero times."""
+    """3-class y through OpLogisticRegression must never reach the binary
+    fit_arrays_batched kernel (sigmoid on {0,1,2} garbage); it rides the
+    fold-vmapped multinomial route instead - one fit_arrays_folds call
+    per grid config, zero per-(fold, config) fit_arrays calls."""
     from transmogrifai_tpu.evaluators.multiclass import (
         OpMultiClassificationEvaluator,
     )
@@ -250,20 +249,71 @@ def test_multiclass_labels_still_demote_classifier_batched_path(
     est = OpLogisticRegression()
     assert est.batched_needs_binary_y is True
 
-    calls = {"single": 0}
-    orig = OpLogisticRegression.fit_arrays
+    calls = {"single": 0, "batched": 0, "folds": 0}
+    orig_single = OpLogisticRegression.fit_arrays
+    orig_batched = OpLogisticRegression.fit_arrays_batched
+    orig_folds = OpLogisticRegression.fit_arrays_folds
 
-    def counting_fit(self, Xa, ya, w=None):
+    def c_single(self, Xa, ya, w=None):
         calls["single"] += 1
-        return orig(self, Xa, ya, w)
+        return orig_single(self, Xa, ya, w)
 
-    monkeypatch.setattr(OpLogisticRegression, "fit_arrays", counting_fit)
+    def c_batched(self, *a, **k):
+        calls["batched"] += 1
+        return orig_batched(self, *a, **k)
+
+    def c_folds(self, *a, **k):
+        calls["folds"] += 1
+        return orig_folds(self, *a, **k)
+
+    monkeypatch.setattr(OpLogisticRegression, "fit_arrays", c_single)
+    monkeypatch.setattr(OpLogisticRegression, "fit_arrays_batched", c_batched)
+    monkeypatch.setattr(OpLogisticRegression, "fit_arrays_folds", c_folds)
     cv = OpCrossValidation(
         num_folds=3, evaluator=OpMultiClassificationEvaluator(), seed=0,
         stratify=True,
     )
     res = cv.validate([(est, [{"reg_param": 0.01}, {"reg_param": 0.1}])], X, y3)
     best = res.best_params
-    assert calls["single"] == 3 * 2  # demoted: per-(fold, config) fits
-    assert res.best_metric > 0.5  # OVR fits real 3-class models
+    assert calls["batched"] == 0  # the binary kernel is never touched
+    assert calls["folds"] == 2  # one fold-vmapped dispatch per config
+    assert calls["single"] == 0  # no per-(fold, config) demotion left
+    assert res.best_metric > 0.5  # real 3-class models
     assert best["reg_param"] in (0.01, 0.1)
+
+
+def test_lr_fit_arrays_folds_matches_per_fold(rng):
+    """Fold-vmapped LR fits (binary AND multinomial) must agree with
+    independent per-fold fit_arrays calls."""
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+
+    X, y, z = _data(rng, n=300)
+    W = _fold_weights(y)
+    est = OpLogisticRegression(reg_param=0.01)
+    batched = est.fit_arrays_folds(X, y, W)
+    for f in range(W.shape[0]):
+        single = est.fit_arrays(X, y, W[f])
+        np.testing.assert_allclose(batched[f]["beta"], single["beta"],
+                                   atol=1e-6)
+        np.testing.assert_allclose(batched[f]["intercept"],
+                                   single["intercept"], atol=1e-6)
+
+    y3 = np.digitize(z, np.quantile(z, [1 / 3, 2 / 3])).astype(float)
+    W3 = stratified_kfold_masks(y3, 3, seed=0, stratify=True).astype(
+        np.float64
+    )
+    b3 = est.fit_arrays_folds(X, y3, W3)
+    for f in range(3):
+        single = est.fit_arrays(X, y3, W3[f])
+        assert b3[f]["family"] == single["family"] == "multinomial"
+        np.testing.assert_allclose(b3[f]["betas"], single["betas"],
+                                   atol=1e-5)
+        # intercepts are unregularized, so each solve may drift along
+        # the softmax shift-invariance direction (adding a constant to
+        # every class changes nothing): compare shift-invariantly
+        ib = np.asarray(b3[f]["intercepts"])
+        isg = np.asarray(single["intercepts"])
+        np.testing.assert_allclose(ib - ib.mean(), isg - isg.mean(),
+                                   atol=1e-5)

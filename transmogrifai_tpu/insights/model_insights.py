@@ -74,20 +74,20 @@ class ModelInsights:
         insights time, not retained training state."""
         import numpy as np
 
-        pred_f = None
         label_f = None
         for f in getattr(model, "result_features", ()):
             st = f.origin_stage
             ins = getattr(st, "input_features", ()) if st else ()
             if len(ins) >= 2 and ins[0].is_response:
-                pred_f, label_f = f, ins[0]
+                label_f = ins[0]
                 break
         if label_f is None:
             return {}
+        hist = label_f.history()
         out = {
             "label_name": label_f.name,
-            "raw_feature_names": label_f.history()["originFeatures"],
-            "stages_applied": label_f.history()["stages"],
+            "raw_feature_names": hist["originFeatures"],
+            "stages_applied": hist["stages"],
         }
         # the training cache holds the fully-transformed columns - the
         # label included.  A model restored via load_model has no cache

@@ -155,6 +155,19 @@ def _lr_fit_batched(X, y, W, regs, ens, iters: int = 25):
 
 
 @partial(jax.jit, static_argnames=("iters",))
+def _softmax_fit_folds(X, Yoh, W, reg, elastic_net, iters: int = 25):
+    """Fold-vmapped softmax fits: W [k, n] per-fold sample weights over
+    one shared (X, Yoh).  MEMORY NOTE: unlike the binary kernel's folded
+    standardization, _softmax_fit_kernel materializes a standardized
+    [n, d] copy per replica, so the fold axis multiplies that copy (and
+    the [n, K, K] curvature tensor) k times - fit_arrays_folds gates on
+    an element budget and falls back to a per-fold host loop past it."""
+    return jax.vmap(
+        lambda w: _softmax_fit_kernel(X, Yoh, w, reg, elastic_net, iters)
+    )(W)
+
+
+@partial(jax.jit, static_argnames=("iters",))
 def _softmax_fit_kernel(X, Yoh, w, reg, elastic_net, iters: int = 25):
     """Weighted multinomial (softmax) logistic regression via full Newton.
 
@@ -173,9 +186,10 @@ def _softmax_fit_kernel(X, Yoh, w, reg, elastic_net, iters: int = 25):
 
     Same conditioning contract as _lr_fit_kernel: global pre-centering,
     weighted standardization, near-constant column exclusion, approximate
-    L1 via iterated reweighting.  Unlike the binary kernel this fit is
-    per-candidate (no vmap fan-out shares X across replicas), so the
-    standardized copy is materialized once instead of folded.
+    L1 via iterated reweighting.  Unlike the binary kernel the
+    standardization is materialized, not folded - cheap for a single fit,
+    but under the fold vmap (_softmax_fit_folds) the copy multiplies per
+    replica, hence the element budget in fit_arrays_folds.
     Returns (betas [K, d] raw scale, intercepts [K]).
     """
     n, d = X.shape
@@ -284,6 +298,25 @@ def _lr_predict_kernel(X: jnp.ndarray, beta: jnp.ndarray, intercept: jnp.ndarray
     return pred, raw, prob
 
 
+def _one_hot(y: np.ndarray, classes: np.ndarray) -> np.ndarray:
+    idx = np.searchsorted(classes, y)
+    Yoh = np.zeros((len(y), len(classes)), np.float32)
+    Yoh[np.arange(len(y)), idx] = 1.0
+    return Yoh
+
+
+def _multinomial_params(betas, b0s, classes: np.ndarray) -> dict:
+    """ONE param-dict schema for every multinomial fit path (single,
+    fold-vmapped) so CV-fitted fold params can never drift from
+    final-fit params."""
+    return {
+        "betas": np.asarray(betas, np.float64),
+        "intercepts": np.asarray(b0s, np.float64),
+        "classes": classes.astype(np.float64),
+        "family": "multinomial",
+    }
+
+
 class OpLogisticRegression(PredictorEstimator):
     """(reference: OpLogisticRegression.scala; default grid in
     DefaultSelectorParams.scala:36-61 - regParam {0.001,0.01,0.1,0.2},
@@ -340,22 +373,15 @@ class OpLogisticRegression(PredictorEstimator):
             K = len(classes)
             d = np.shape(X)[1]
             if self._multiclass_family(K, d) == "multinomial":
-                idx = np.searchsorted(classes, np.asarray(y))
-                Yoh = np.zeros((n, K), np.float32)
-                Yoh[np.arange(n), idx] = 1.0
                 betas, b0s = _softmax_fit_kernel(
-                    jnp.asarray(X, jnp.float32), jnp.asarray(Yoh),
+                    jnp.asarray(X, jnp.float32),
+                    jnp.asarray(_one_hot(np.asarray(y), classes)),
                     jnp.asarray(w, jnp.float32),
                     jnp.asarray(float(self.params["reg_param"])),
                     jnp.asarray(float(self.params["elastic_net_param"])),
                     iters=int(self.params["max_iter"]),
                 )
-                return {
-                    "betas": np.asarray(betas, np.float64),
-                    "intercepts": np.asarray(b0s, np.float64),
-                    "classes": classes.astype(np.float64),
-                    "family": "multinomial",
-                }
+                return _multinomial_params(betas, b0s, classes)
             # one-vs-rest over the SAME binary Newton kernel (kept as the
             # family='ovr' option + the large-K*d fallback).  K is small,
             # so a host loop of jitted fits is fine; each fit reuses the
@@ -415,6 +441,62 @@ class OpLogisticRegression(PredictorEstimator):
                 jnp.asarray(regs), jnp.asarray(ens), iters=iters,
             )
         return np.asarray(beta), np.asarray(b0)
+
+    def fit_arrays_folds(self, X, y, W):
+        """One config, k folds in one vmapped dispatch: W [k, n] per-fold
+        sample weights -> list of per-fold param dicts.  The validator's
+        fold-batched branch picks this up for MULTICLASS labels (binary
+        grids ride the fully-batched fold x grid route instead), so a
+        3-class CV runs k softmax Newtons as one computation rather than
+        a per-(fold, config) host loop."""
+        import os
+
+        reg = float(self.params["reg_param"])
+        en = float(self.params["elastic_net_param"])
+        iters = int(self.params["max_iter"])
+        y_np = np.asarray(y)
+        classes = np.unique(y_np)
+        n, d = np.shape(X)
+        k = np.asarray(W).shape[0]
+        if len(classes) > 2 and self._multiclass_family(
+            len(classes), d
+        ) == "multinomial":
+            K = len(classes)
+            # the softmax kernel materializes per-replica standardized
+            # copies + the [n, K, K] curvature tensor; past this element
+            # budget the fold vmap would multiply that by k, so fall
+            # back to a per-fold host loop (TX_LR_FOLDS_ELEMS overrides)
+            budget = int(os.environ.get("TX_LR_FOLDS_ELEMS", 1 << 27))
+            if k * n * (d + K * K) > budget:
+                return [
+                    self.fit_arrays(X, y, np.asarray(W)[f])
+                    for f in range(k)
+                ]
+            betas, b0s = _softmax_fit_folds(
+                jnp.asarray(X, jnp.float32),
+                jnp.asarray(_one_hot(y_np, classes)),
+                jnp.asarray(W, jnp.float32),
+                jnp.asarray(reg), jnp.asarray(en), iters=iters,
+            )
+            betas, b0s = np.asarray(betas), np.asarray(b0s)
+            return [
+                _multinomial_params(betas[f], b0s[f], classes)
+                for f in range(k)
+            ]
+        if len(classes) > 2:  # ovr (or the large-K*d fallback): per fold
+            return [
+                self.fit_arrays(X, y, np.asarray(W)[f]) for f in range(k)
+            ]
+        # binary: reuse the fully-batched kernel with the config tiled
+        # per fold (no separate fold entry point to keep in sync)
+        betas, b0s = _lr_fit_batched(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
+            jnp.full((k,), reg), jnp.full((k,), en), iters=iters,
+        )
+        betas, b0s = np.asarray(betas), np.asarray(b0s)
+        return [
+            {"beta": betas[f], "intercept": float(b0s[f])} for f in range(k)
+        ]
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         if "betas" in params:  # one-vs-rest multiclass
