@@ -636,3 +636,26 @@ def test_logistic_family_contract():
         OpLogisticRegression(family="auto")._multiclass_family(3, 1023)
         == "ovr"
     )
+
+
+def test_gbt_refuses_multiclass_labels():
+    """Logistic-loss GBT is binary-only (Spark GBTClassifier contract):
+    3-class labels previously fit sigmoid-on-{0,1,2} silently at chance
+    accuracy - every fit entry point must raise instead (round 5)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(90, 3)
+    y3 = np.repeat(np.arange(3.0), 30)
+    est = OpGBTClassifier(num_trees=3, max_depth=3)
+    W = np.ones((2, 90))
+    with pytest.raises(ValueError, match="only binary"):
+        est.fit_arrays(X, y3)
+    with pytest.raises(ValueError, match="only binary"):
+        est.fit_arrays_folds(X, y3, W)
+    with pytest.raises(ValueError, match="only binary"):
+        est.fit_arrays_folds_grid(X, y3, W, [{}])
+    # regressors and binary labels stay unaffected
+    from transmogrifai_tpu.models.trees import OpGBTRegressor
+
+    OpGBTRegressor(num_trees=2, max_depth=2).fit_arrays(X, y3)
+    est2 = OpGBTClassifier(num_trees=2, max_depth=2)
+    est2.fit_arrays(X, (y3 > 0).astype(float))

@@ -524,6 +524,21 @@ class _GBT(_TreeEnsembleBase):
         super().__init__(num_trees=num_trees, **kw)
         self.params.setdefault("step_size", step_size)
 
+    def _check_labels(self, y) -> None:
+        """Logistic-loss boosting is binary: >2 classes must fail loudly
+        (Spark: 'GBTClassifier currently only supports binary
+        classification'), not silently fit sigmoid on {0,1,2}.  RF/DT/NB
+        are the reference's multiclass tree family."""
+        if self.is_classification:
+            k = len(np.unique(np.asarray(y)))
+            if k > 2:
+                raise ValueError(
+                    f"{self.model_type} supports only binary "
+                    f"classification; the label column has {k} classes "
+                    "(use OpRandomForestClassifier / "
+                    "OpDecisionTreeClassifier for multiclass)"
+                )
+
     def _fit_native(self, X, y, w, edges, bins=None) -> Optional[Any]:
         """C++ boosting path (native/txtrees.cpp tx_fit_gbt_hist); same
         init margin / loss / Newton leaf values as the JAX scan below.
@@ -564,6 +579,7 @@ class _GBT(_TreeEnsembleBase):
         }
 
     def fit_arrays(self, X, y, w=None) -> Any:
+        self._check_labels(y)
         n, d = X.shape
         p = self.params
         w = np.ones(n, dtype=np.float32) if w is None else np.asarray(w, np.float32)
@@ -615,6 +631,7 @@ class _GBT(_TreeEnsembleBase):
         )
 
     def fit_arrays_folds(self, X, y, W) -> list:
+        self._check_labels(y)
         """CV fan-out: one fold-vmapped boosting scan sharing the binning
         and the design matrix (folds are weight masks, like the forests).
         On the native host backend the C++ learner loops folds but still
@@ -665,6 +682,7 @@ class _GBT(_TreeEnsembleBase):
         ]
 
     def fit_arrays_folds_grid(self, X, y, W, grid) -> Optional[list]:
+        self._check_labels(y)
         """Whole-grid GBT CV: grid points sharing static shapes
         (num_trees, effective depth, max_bins) batch as one dispatch over
         a traced (step_size, min_instances, min_info_gain) axis - the GBT
