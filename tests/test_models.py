@@ -659,3 +659,49 @@ def test_gbt_refuses_multiclass_labels():
     OpGBTRegressor(num_trees=2, max_depth=2).fit_arrays(X, y3)
     est2 = OpGBTClassifier(num_trees=2, max_depth=2)
     est2.fit_arrays(X, (y3 > 0).astype(float))
+
+
+def test_linear_svc_refuses_multiclass_labels():
+    """Squared-hinge SVC is binary-only (Spark LinearSVC contract):
+    3-class labels must raise at both fit entry points, and an MLP -
+    the reference's multiclass-capable neural family - must actually
+    learn the same 3-class problem."""
+    from transmogrifai_tpu.models.mlp import (
+        OpMultilayerPerceptronClassifier,
+    )
+
+    rng = np.random.RandomState(0)
+    n = 300
+    centers = np.array([[2.5, 0.0], [-2.5, 1.0], [0.0, -3.0]])
+    y3 = np.repeat(np.arange(3.0), n // 3)
+    X = centers[y3.astype(int)] + 0.5 * rng.randn(n, 2)
+    with pytest.raises(ValueError, match="only binary"):
+        OpLinearSVC().fit_arrays(X, y3)
+    with pytest.raises(ValueError, match="only binary"):
+        OpLinearSVC().fit_arrays_batched(
+            X, y3, np.ones((2, n)), np.zeros(2), np.zeros(2)
+        )
+    mlp = OpMultilayerPerceptronClassifier(hidden_layers=(8,), max_iter=60)
+    p = mlp.fit_arrays(X, y3)
+    pred, _, prob = mlp.predict_arrays(p, X)
+    assert (pred == y3).mean() > 0.95
+    assert prob.shape == (n, 3)
+
+
+def test_binary_guard_rejects_nonstandard_encodings():
+    """Count-only checks miss y in {1,2} (both classes map to the
+    positive hinge side); the shared base guard validates VALUES too,
+    and skips device-resident labels (the validator pre-guards those) -
+    review r5."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 3)
+    y12 = np.repeat([1.0, 2.0], 30)
+    with pytest.raises(ValueError, match="labels in"):
+        OpLinearSVC().fit_arrays(X, y12)
+    with pytest.raises(ValueError, match="labels in"):
+        OpGBTClassifier(num_trees=2, max_depth=2).fit_arrays(X, y12)
+    # device-resident labels skip the host scan (pre-guarded callers)
+    est = OpLinearSVC()
+    est._check_binary_labels(jnp.asarray(y12))  # no raise by design

@@ -90,6 +90,30 @@ class PredictorEstimator(Estimator):
     # and never pays an np.unique scan over the full label column.
     batched_needs_binary_y: bool = True
 
+    def _check_binary_labels(self, y, hint: str = "") -> None:
+        """Binary-loss kernels (hinge, logistic boosting) must fail
+        loudly on labels they cannot represent - >2 classes OR values
+        outside {0,1} (y in {1,2} passes a count-only check yet maps both
+        classes to the positive hinge side).  Device-resident labels skip
+        the scan: the validator pre-guards its batched dispatches, and
+        pulling a (possibly mesh-sharded) label column to host would
+        block dispatch at 10M-row scale."""
+        import jax
+
+        if isinstance(y, jax.Array):
+            return
+        vals = np.unique(np.asarray(y))
+        if len(vals) > 2:
+            raise ValueError(
+                f"{self.model_type} supports only binary classification; "
+                f"the label column has {len(vals)} classes{hint}"
+            )
+        if len(vals) and not np.isin(vals, (0.0, 1.0)).all():
+            raise ValueError(
+                f"{self.model_type} expects labels in {{0, 1}}; got "
+                f"values {vals.tolist()} (index the label first)"
+            )
+
     def fit_arrays(
         self, X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None
     ) -> Any:

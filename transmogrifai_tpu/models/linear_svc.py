@@ -104,6 +104,8 @@ class OpLinearSVC(PredictorEstimator):
         self.params.setdefault("max_iter", max_iter)
 
     def fit_arrays(self, X, y, w=None) -> Any:
+        # Spark contract: 'LinearSVC only supports binary classification'
+        self._check_binary_labels(y)
         n = len(y)
         w = np.ones(n) if w is None else w
         beta, b0 = _svc_fit_kernel(
@@ -120,6 +122,7 @@ class OpLinearSVC(PredictorEstimator):
         term, so ``ens`` is accepted and ignored).  TPU inputs ride the
         MXU-packed explicit batch (packed_newton.py); mesh-sharded inputs
         keep packing via the shard_map Gram."""
+        self._check_binary_labels(y)
         from .logistic_regression import _hessian_bf16
         from .packed_newton import (
             packed_mesh_or_none,

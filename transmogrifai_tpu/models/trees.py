@@ -525,19 +525,15 @@ class _GBT(_TreeEnsembleBase):
         self.params.setdefault("step_size", step_size)
 
     def _check_labels(self, y) -> None:
-        """Logistic-loss boosting is binary: >2 classes must fail loudly
-        (Spark: 'GBTClassifier currently only supports binary
-        classification'), not silently fit sigmoid on {0,1,2}.  RF/DT/NB
-        are the reference's multiclass tree family."""
+        """Logistic-loss boosting is binary (Spark: 'GBTClassifier
+        currently only supports binary classification'); regressors take
+        any y.  The shared base guard also rejects non-{0,1} encodings."""
         if self.is_classification:
-            k = len(np.unique(np.asarray(y)))
-            if k > 2:
-                raise ValueError(
-                    f"{self.model_type} supports only binary "
-                    f"classification; the label column has {k} classes "
-                    "(use OpRandomForestClassifier / "
-                    "OpDecisionTreeClassifier for multiclass)"
-                )
+            self._check_binary_labels(
+                y,
+                hint=" (use OpRandomForestClassifier / "
+                "OpDecisionTreeClassifier for multiclass)",
+            )
 
     def _fit_native(self, X, y, w, edges, bins=None) -> Optional[Any]:
         """C++ boosting path (native/txtrees.cpp tx_fit_gbt_hist); same
