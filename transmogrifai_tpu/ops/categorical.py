@@ -32,8 +32,26 @@ from ..types.vector_metadata import (
 from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=65536)
+def _clean_cached(v: str) -> str:
+    return v.strip().lower().replace(" ", "")
+
+
 def _clean_value(v: str, clean_text: bool) -> str:
-    return v.strip().lower().replace(" ", "") if clean_text else v
+    # categorical domains are tiny relative to row counts, so this is
+    # one strip/lower/replace per DISTINCT value instead of per cell -
+    # the top tottime line of the batch-scoring profile (one call per
+    # row x categorical column).  str keys only; anything else cleans
+    # uncached.
+    if not clean_text:
+        return v
+    try:
+        return _clean_cached(v)
+    except TypeError:  # unhashable or non-str oddity: clean directly
+        return v.strip().lower().replace(" ", "")
 
 
 def top_k_labels(
