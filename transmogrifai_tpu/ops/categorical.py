@@ -10,6 +10,7 @@ sorted pivots.
 from __future__ import annotations
 
 from collections import Counter
+from functools import lru_cache
 from typing import Optional, Sequence
 
 import numpy as np
@@ -30,9 +31,6 @@ from ..types.vector_metadata import (
     VectorColumnMeta,
 )
 from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
-
-
-from functools import lru_cache
 
 
 @lru_cache(maxsize=65536)
