@@ -705,3 +705,67 @@ def test_binary_guard_rejects_nonstandard_encodings():
     # device-resident labels skip the host scan (pre-guarded callers)
     est = OpLinearSVC()
     est._check_binary_labels(jnp.asarray(y12))  # no raise by design
+
+
+@pytest.mark.parametrize("seed,K", [(1, 3), (2, 4), (3, 5), (4, 3)])
+def test_multinomial_property_sweep_vs_scipy(seed, K):
+    """Seeded sweep of random multiclass problems: the softmax Newton's
+    probabilities must match an independent scipy L-BFGS optimum of the
+    same penalized objective (one fixed problem proves little; the sweep
+    covers class counts and geometries)."""
+    from scipy.optimize import minimize
+
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.logistic_regression import (
+        _softmax_fit_kernel,
+    )
+
+    rng = np.random.RandomState(seed)
+    n, d = 400, 6
+    X = rng.randn(n, d).astype(np.float32)
+    Bt = rng.randn(K, d) * 1.2
+    z = X @ Bt.T
+    P = np.exp(z - z.max(1, keepdims=True))
+    P /= P.sum(1, keepdims=True)
+    y = np.array([rng.choice(K, p=pp) for pp in P])
+    if len(np.unique(y)) < K:
+        pytest.skip("degenerate draw")
+    Yoh = np.zeros((n, K), np.float32)
+    Yoh[np.arange(n), y] = 1.0
+    w = (rng.rand(n) + 0.5).astype(np.float32)
+    reg = 0.03
+
+    betas, b0 = _softmax_fit_kernel(
+        jnp.asarray(X), jnp.asarray(Yoh), jnp.asarray(w),
+        jnp.asarray(reg), jnp.asarray(0.0), iters=30,
+    )
+    betas, b0 = np.asarray(betas, np.float64), np.asarray(b0, np.float64)
+
+    wsum = w.sum()
+    mu = (w @ X) / wsum
+    var = (w @ (X * X)) / wsum - mu**2
+    sd = np.sqrt(np.maximum(var, 1e-12))
+    Xs = (X - mu) / sd
+
+    def nll(theta):
+        B = theta[: K * d].reshape(K, d)
+        zz = Xs @ B.T + theta[K * d:]
+        zz = zz - zz.max(axis=1, keepdims=True)
+        logp = zz - np.log(np.exp(zz).sum(axis=1, keepdims=True))
+        return (
+            -(w * logp[np.arange(n), y]).sum() / wsum
+            + 0.5 * reg * (B**2).sum()
+        )
+
+    res = minimize(nll, np.zeros(K * d + K), method="L-BFGS-B",
+                   options={"maxiter": 5000, "ftol": 1e-15, "gtol": 1e-11})
+    beta_ref = res.x[: K * d].reshape(K, d) / sd
+    b0_ref = res.x[K * d:] - beta_ref @ mu
+    z1 = X @ betas.T + b0
+    z2 = X @ beta_ref.T + b0_ref
+    p1 = np.exp(z1 - z1.max(1, keepdims=True))
+    p1 /= p1.sum(1, keepdims=True)
+    p2 = np.exp(z2 - z2.max(1, keepdims=True))
+    p2 /= p2.sum(1, keepdims=True)
+    assert np.abs(p1 - p2).max() < 3e-3, np.abs(p1 - p2).max()

@@ -395,3 +395,36 @@ def test_packed_mesh_or_none_rejects_indivisible_shapes(problem):
     assert (
         packed_mesh_or_none(FakeArr((904, d)), FakeArr((8, 904))) is mesh
     )
+
+
+@pytest.mark.parametrize("shape", [(1, 8), (2, 4), (4, 2), (8, 1)])
+def test_packed_mesh_parity_across_mesh_shapes(problem, shape):
+    """Sharded == unsharded LR coefficients for every (replica, data)
+    factorization of the 8 virtual devices - the driver may hand any of
+    these to the dryrun, and cv_mesh_or_none picks different r per grid
+    size."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+
+    r, nd = shape
+    mesh = make_mesh(axis_names=("replica", "data"), shape=shape)
+    X, y, W, regs, ens = problem
+    n = X.shape[0] - (X.shape[0] % nd)
+    B = W.shape[0] - (W.shape[0] % r)
+    X, y, W, regs, ens = X[:n], y[:n], W[:B, :n], regs[:B], ens[:B]
+    Xs = jax.device_put(X, NamedSharding(mesh, P("data", None)))
+    ys = jax.device_put(y, NamedSharding(mesh, P("data")))
+    Ws = jax.device_put(W, NamedSharding(mesh, P("replica", "data")))
+    rs = jax.device_put(jnp.asarray(regs), NamedSharding(mesh, P("replica")))
+    es = jax.device_put(jnp.asarray(ens), NamedSharding(mesh, P("replica")))
+    b0, i0 = lr_fit_batched_packed(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
+        jnp.asarray(regs), jnp.asarray(ens), iters=6, hess_bf16=False,
+    )
+    b1, i1 = lr_fit_batched_packed(
+        Xs, ys, Ws, rs, es, iters=6, hess_bf16=False, mesh=mesh,
+    )
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), atol=5e-5)
+    np.testing.assert_allclose(np.asarray(i1), np.asarray(i0), atol=5e-5)
