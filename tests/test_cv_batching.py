@@ -317,3 +317,30 @@ def test_lr_fit_arrays_folds_matches_per_fold(rng):
         isg = np.asarray(single["intercepts"])
         np.testing.assert_allclose(ib - ib.mean(), isg - isg.mean(),
                                    atol=1e-5)
+
+
+def test_lr_folds_memory_budget_fallback(rng, monkeypatch):
+    """Past the TX_LR_FOLDS_ELEMS budget the multinomial fold vmap falls
+    back to a per-fold host loop; results must be identical either way
+    (the budget is a memory decision, not a numerics one)."""
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+
+    X, y, z = _data(rng, n=240)
+    y3 = np.digitize(z, np.quantile(z, [1 / 3, 2 / 3])).astype(float)
+    W = stratified_kfold_masks(y3, 3, seed=0, stratify=True).astype(
+        np.float64
+    )
+    est = OpLogisticRegression(reg_param=0.01)
+    vmapped = est.fit_arrays_folds(X, y3, W)
+    monkeypatch.setenv("TX_LR_FOLDS_ELEMS", "10")  # force the fallback
+    looped = est.fit_arrays_folds(X, y3, W)
+    for f in range(3):
+        assert looped[f]["family"] == vmapped[f]["family"] == "multinomial"
+        np.testing.assert_allclose(looped[f]["betas"],
+                                   vmapped[f]["betas"], atol=1e-5)
+        iv = np.asarray(vmapped[f]["intercepts"])
+        il = np.asarray(looped[f]["intercepts"])
+        np.testing.assert_allclose(il - il.mean(), iv - iv.mean(),
+                                   atol=1e-5)
