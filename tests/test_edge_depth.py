@@ -237,3 +237,25 @@ def test_csv_bom_headerless_numeric_first_cell(tmp_path):
         str(p), {"c0": ft.Real, "c1": ft.Real}, has_header=False
     )
     assert bool(cols["c0"].mask[0]) and cols["c0"].values[0] == 1.0
+
+
+def test_geolocation_column_validates_ranges():
+    """The reference validates coordinates at construction
+    (Geolocation.scala:50); (95, 200) must raise with the offending rows
+    named, masked rows are exempt, and boundary values pass."""
+    from transmogrifai_tpu.types.columns import GeolocationColumn
+
+    with pytest.raises(ValueError, match="rows \\[1\\]"):
+        GeolocationColumn(
+            np.array([[45.0, -120.0, 1.0], [95.0, 200.0, 1.0]]),
+            np.array([True, True]),
+        )
+    # masked garbage is fine (missing rows carry placeholder zeros)
+    GeolocationColumn(
+        np.array([[999.0, 999.0, 0.0], [45.0, -120.0, 1.0]]),
+        np.array([False, True]),
+    )
+    GeolocationColumn(
+        np.array([[90.0, 180.0, 1.0], [-90.0, -180.0, 0.0]]),
+        np.array([True, True]),
+    )

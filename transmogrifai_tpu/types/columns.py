@@ -150,6 +150,24 @@ class GeolocationColumn(Column):
     def __post_init__(self) -> None:
         self.values = np.asarray(self.values, dtype=np.float64).reshape(-1, 3)
         self.mask = np.asarray(self.mask, dtype=bool)
+        # the reference validates at construction (Geolocation.scala:50
+        # Geolocation.validate: lat in [-90, 90], lon in [-180, 180]);
+        # silent (95, 200) passthrough would poison every downstream
+        # distance/vectorizer computation
+        if self.mask.any():
+            lat = self.values[self.mask, 0]
+            lon = self.values[self.mask, 1]
+            bad = ~(
+                (lat >= -90) & (lat <= 90) & (lon >= -180) & (lon <= 180)
+            )
+            if bad.any():
+                rows = np.flatnonzero(self.mask)[bad][:5]
+                raise ValueError(
+                    "invalid geolocation coordinates (lat must be in "
+                    "[-90, 90], lon in [-180, 180]) at rows "
+                    f"{rows.tolist()}: "
+                    f"{self.values[rows].tolist()}"
+                )
 
     def __len__(self) -> int:
         return len(self.mask)
