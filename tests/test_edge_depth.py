@@ -259,3 +259,98 @@ def test_geolocation_column_validates_ranges():
         np.array([[90.0, 180.0, 1.0], [-90.0, -180.0, 0.0]]),
         np.array([True, True]),
     )
+
+
+def test_avro_reader_gnarly_schema(tmp_path):
+    """Hand-built OCF with the schema shapes the easy tests skip: nested
+    records, enum decoding to symbols, a THREE-branch union
+    (null|double|string), fixed, and map-of-arrays - byte-level encoding
+    written here independently of the reader under test."""
+    import io
+    import json
+    import struct
+
+    from transmogrifai_tpu.readers.avro_reader import read_avro_records
+
+    schema = {
+        "type": "record", "name": "R", "fields": [
+            {"name": "id", "type": "long"},
+            {"name": "tag", "type": {"type": "enum", "name": "E",
+                                     "symbols": ["A", "B", "C"]}},
+            {"name": "val", "type": ["null", "double", "string"]},
+            {"name": "inner", "type": {
+                "type": "record", "name": "Inner",
+                "fields": [
+                    {"name": "x", "type": "float"},
+                    {"name": "ys",
+                     "type": {"type": "array", "items": "int"}},
+                ]}},
+            {"name": "fx",
+             "type": {"type": "fixed", "name": "F", "size": 4}},
+            {"name": "m", "type": {"type": "map",
+                                   "values": {"type": "array",
+                                              "items": "string"}}},
+        ],
+    }
+
+    def zz(n):
+        n = (n << 1) ^ (n >> 63)
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            if n:
+                out += bytes([b7 | 0x80])
+            else:
+                return out + bytes([b7])
+
+    def enc_str(s):
+        b = s.encode()
+        return zz(len(b)) + b
+
+    def enc_rec(i):
+        out = zz(i)
+        out += zz(i % 3)
+        if i % 3 == 0:
+            out += zz(0)
+        elif i % 3 == 1:
+            out += zz(1) + struct.pack("<d", i * 1.5)
+        else:
+            out += zz(2) + enc_str(f"s{i}")
+        out += struct.pack("<f", i * 0.5)
+        out += zz(2) + zz(i) + zz(i + 1) + zz(0)
+        out += bytes([i % 256] * 4)
+        out += zz(1) + enc_str("k") + (
+            zz(1) + enc_str(f"v{i}") + zz(0)
+        ) + zz(0)
+        return out
+
+    sync = b"S" * 16
+    block = b"".join(enc_rec(i) for i in range(5))
+    buf = io.BytesIO()
+    buf.write(b"Obj\x01")
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": b"null"}
+    buf.write(zz(len(meta)))
+    for k, v in meta.items():
+        buf.write(enc_str(k))
+        buf.write(zz(len(v)) + v)
+    buf.write(zz(0))
+    buf.write(sync)
+    buf.write(zz(5))
+    buf.write(zz(len(block)))
+    buf.write(block)
+    buf.write(sync)
+    path = str(tmp_path / "gnarly.avro")
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+    _, recs = read_avro_records(path)
+    assert len(recs) == 5
+    assert recs[0]["val"] is None
+    assert recs[1]["val"] == 1.5
+    assert recs[2]["val"] == "s2"
+    assert recs[3]["inner"]["ys"] == [3, 4]
+    assert recs[4]["tag"] == "B"
+    assert recs[1]["m"]["k"] == ["v1"]
+    assert recs[2]["fx"] == b"\x02\x02\x02\x02"
