@@ -354,3 +354,70 @@ def test_avro_reader_gnarly_schema(tmp_path):
     assert recs[4]["tag"] == "B"
     assert recs[1]["m"]["k"] == ["v1"]
     assert recs[2]["fx"] == b"\x02\x02\x02\x02"
+
+
+def test_workflow_survives_all_null_feature(rng):
+    """A 100% null predictor must flow through transmogrification +
+    SanityChecker + fit without crashing (the checker drops or zeroes it;
+    reference SanityCheckerTest covers the same degeneracy)."""
+    import transmogrifai_tpu.dsl  # noqa: F401
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    n = 200
+    data = {"y": (rng.rand(n) > 0.5).astype(float).tolist(),
+            "a": [None] * n,
+            "b": rng.randn(n).tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fa = FeatureBuilder(ft.Real, "a").as_predictor()
+    fb = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([fa, fb])
+    checked = fy.sanity_check(vec)
+    pred = (
+        OpLogisticRegression(reg_param=0.01)
+        .set_input(fy, checked).get_output()
+    )
+    model = (
+        OpWorkflow().set_result_features(pred)
+        .set_input_dataset(data).train()
+    )
+    out = model.score(data)
+    pcol = [c for c in out.columns().values()
+            if hasattr(c, "prediction")][0]
+    assert np.isfinite(np.asarray(pcol.prediction)).all()
+
+
+def test_selector_survives_single_positive_label(rng):
+    """One positive among 200 rows through the balancer + 2-fold CV must
+    train without crashing (folds may see zero positives; metrics stay
+    finite - reference DataBalancer handles the same edge)."""
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector.factories import (
+        BinaryClassificationModelSelector,
+    )
+
+    n = 200
+    y2 = np.zeros(n)
+    y2[0] = 1.0
+    data = {"y": y2.tolist(), "b": rng.randn(n).tolist()}
+    fy = FeatureBuilder(ft.RealNN, "y").as_response()
+    fb = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([fb])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models_and_parameters=[(OpLogisticRegression(max_iter=5), [{}])],
+    )
+    pred = sel.set_input(fy, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred)
+        .set_input_dataset(data).train()
+    )
+    ins = model.model_insights()
+    assert ins.label_summary["distribution"]["type"] == "discrete"
