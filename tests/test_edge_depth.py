@@ -421,3 +421,71 @@ def test_selector_survives_single_positive_label(rng):
     )
     ins = model.model_insights()
     assert ins.label_summary["distribution"]["type"] == "discrete"
+
+
+def test_kitchen_sink_workflow_save_load(tmp_path, rng):
+    """One workflow combining the round-5 surfaces - multinomial softmax
+    winner, language detection over the widened profile set, NER with the
+    surname carry - saved and reloaded with bit-identical probabilities
+    (the graph re-pairing must survive multi-output workflows, not just
+    single-prediction ones)."""
+    import transmogrifai_tpu.dsl  # noqa: F401
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    n = 180
+    centers = np.array([[2.5, 0.0], [-2.5, 1.0], [0.0, -3.0]])
+    yv = np.repeat(np.arange(3.0), n // 3)
+    texts = [
+        "Dr. Okonkwo met the board in Nairobi last week. Okonkwo was "
+        "pleased.",
+        "La banque centrale a relevé ses taux ce jeudi à Paris.",
+        "میری بہن ہسپتال میں کام کرتی ہے اور روز ٹرین سے شہر جاتی ہے۔",
+    ] * (n // 3)
+    data = {
+        "y": yv.tolist(),
+        "a": (centers[yv.astype(int), 0] + 0.4 * rng.randn(n)).tolist(),
+        "b": (centers[yv.astype(int), 1] + 0.4 * rng.randn(n)).tolist(),
+        "txt": texts[:n],
+    }
+
+    def build():
+        fy = FeatureBuilder(ft.RealNN, "y").as_response()
+        fa = FeatureBuilder(ft.Real, "a").as_predictor()
+        fb = FeatureBuilder(ft.Real, "b").as_predictor()
+        ftxt = FeatureBuilder(ft.Text, "txt").as_predictor()
+        langs = ftxt.detect_languages()
+        ents = ftxt.recognize_entities()
+        vec = transmogrify([fa, fb])
+        pred = (
+            OpLogisticRegression(reg_param=0.01)
+            .set_input(fy, vec).get_output()
+        )
+        return (
+            OpWorkflow()
+            .set_result_features(pred, langs, ents)
+            .set_input_dataset(data)
+        )
+
+    m1 = build().train()
+    assert m1.stages[-1].model_params["family"] == "multinomial"
+    m1.save(str(tmp_path / "ks"))
+    m2 = OpWorkflowModel.load(str(tmp_path / "ks"), build())
+    s1, s2 = m1.score(data), m2.score(data)
+    p1 = [c for c in s1.columns().values() if hasattr(c, "prediction")][0]
+    p2 = [c for c in s2.columns().values() if hasattr(c, "prediction")][0]
+    np.testing.assert_array_equal(
+        np.asarray(p1.probability), np.asarray(p2.probability)
+    )
+    langs_out = [v for k, v in s2.columns().items()
+                 if "lang" in k.lower()][0]
+    assert max(langs_out.values[0], key=langs_out.values[0].get) == "en"
+    assert max(langs_out.values[1], key=langs_out.values[1].get) == "fr"
+    assert max(langs_out.values[2], key=langs_out.values[2].get) == "ur"
+    ner_out = [v for k, v in s2.columns().items()
+               if "ner" in k.lower() or "entit" in k.lower()][0]
+    assert "okonkwo" in ner_out.values[0]
