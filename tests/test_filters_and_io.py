@@ -268,3 +268,59 @@ def test_feature_distribution_js_divergence_properties(rng):
     m = a.merge(dist(a.histogram))
     assert m.count == 2 * a.count
     assert m.js_divergence(a) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_model_load_failure_modes_are_loud(tmp_path, rng):
+    """Corrupted or mismatched saved models must raise clearly, never
+    load partially: missing arrays.npz, truncated model.json, and a
+    workflow whose stage set differs from the saved graph."""
+    import json as _json
+    import shutil
+
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    n = 80
+    data = {"y": (rng.rand(n) > 0.5).astype(float).tolist(),
+            "a": rng.randn(n).tolist()}
+
+    def build(extra=False):
+        fy = FeatureBuilder(ft.RealNN, "y").as_response()
+        preds = [FeatureBuilder(ft.Real, "a").as_predictor()]
+        if extra:
+            preds.append(FeatureBuilder(ft.Real, "extra").as_predictor())
+        vec = transmogrify(preds)
+        pred = (
+            OpLogisticRegression(reg_param=0.01)
+            .set_input(fy, vec).get_output()
+        )
+        wf = OpWorkflow().set_result_features(pred)
+        return wf.set_input_dataset(data) if not extra else wf
+
+    m = build().train()
+    base = tmp_path / "m"
+    m.save(str(base))
+
+    broken1 = tmp_path / "m1"
+    shutil.copytree(base, broken1)
+    (broken1 / "arrays.npz").unlink()
+    with pytest.raises(FileNotFoundError):
+        OpWorkflowModel.load(str(broken1), build())
+
+    broken2 = tmp_path / "m2"
+    shutil.copytree(base, broken2)
+    (broken2 / "model.json").write_text(
+        (broken2 / "model.json").read_text()[:50]
+    )
+    with pytest.raises(_json.JSONDecodeError):
+        OpWorkflowModel.load(str(broken2), build())
+
+    with pytest.raises(ValueError, match="same code-defined workflow"):
+        OpWorkflowModel.load(str(base), build(extra=True))
