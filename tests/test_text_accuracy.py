@@ -230,6 +230,27 @@ LANG_SAMPLES = [
     ("kn", "ನನ್ನ ಸಹೋದರಿ ಆಸ್ಪತ್ರೆಯಲ್ಲಿ ಕೆಲಸ ಮಾಡುತ್ತಾಳೆ ಮತ್ತು ಪ್ರತಿದಿನ ರೈಲಿನಲ್ಲಿ ನಗರಕ್ಕೆ ಹೋಗುತ್ತಾಳೆ."),
     ("ml", "എന്റെ സഹോദരി ആശുപത്രിയിൽ ജോലി ചെയ്യുന്നു, എല്ലാ ദിവസവും ട്രെയിനിൽ നഗരത്തിലേക്ക് പോകുന്നു."),
     ("km", "បងស្រីរបស់ខ្ញុំធ្វើការនៅមន្ទីរពេទ្យ ហើយធ្វើដំណើរទៅទីក្រុងរៀងរាល់ព្រឹក។"),
+    # third held-out template for the round-5 languages (school/market
+    # register, matching the depth the round-4 languages already have)
+    ("sr", "Деца су јутрос пешачила до школе кроз стару пијацу."),
+    ("mk", "Децата утрово пешачеа до училиштето низ стариот пазар."),
+    ("be", "Дзеці сёння раніцай ішлі ў школу праз стары рынак."),
+    ("kk", "Балалар бүгін таңертең ескі базар арқылы мектепке жаяу барды."),
+    ("ar", "مشى الأطفال هذا الصباح إلى المدرسة عبر السوق القديم."),
+    ("fa", "بچه‌ها امروز صبح از میان بازار قدیمی پیاده به مدرسه رفتند."),
+    ("ur", "بچے آج صبح پرانے بازار سے ہو کر پیدل اسکول گئے۔"),
+    ("ckb", "منداڵەکان ئەمڕۆ بەیانی بە ناو بازاڕە کۆنەکەدا بە پێ چوونە قوتابخانە."),
+    ("he", "הילדים הלכו הבוקר ברגל לבית הספר דרך השוק הישן."),
+    ("yi", "די קינדער זענען הײַנט אין דער פֿרי געגאַנגען צו פֿוס אין שול דורכן אַלטן מאַרק."),
+    ("hi", "बच्चे आज सुबह पुराने बाज़ार से होकर पैदल स्कूल गए।"),
+    ("mr", "मुले आज सकाळी जुन्या बाजारातून चालत शाळेत गेली."),
+    ("ne", "केटाकेटीहरू आज बिहान पुरानो बजार हुँदै हिँडेर विद्यालय गए।"),
+    ("oc", "Los enfants son anats a pè a l'escòla aqueste matin per lo mercat vièlh."),
+    ("br", "Ar vugale a zo aet war droad d'ar skol dre ar marc'had kozh ar mintin-mañ."),
+    ("se", "Mánát vázze odne iđđes skuvlii boares márkana čađa."),
+    ("an", "Os ninos son itos a piet ta la escuela iste maitino por o mercau viello."),
+    ("ast", "Los nenos foron esta mañana a pie a la escuela pel mercáu vieyu."),
+    ("wa", "Les efants ont roté disqu' a scole ci matén chal pa l' vî martchî."),
 ]
 
 
