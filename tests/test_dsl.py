@@ -295,3 +295,58 @@ def test_to_date_list_and_to_multi_pick_list(rng):
         frozenset({"red"}), frozenset({"blue"}), frozenset()]
     assert list(scored[token_set.name].values) == [
         frozenset({"a", "b"}), frozenset({"c"}), frozenset()]
+
+
+def test_prediction_descale_dispatch(rng):
+    """prediction.descale(scaled_label) must route to PredictionDescaler
+    (round 5 - the Real-only DescalerTransformer made the natural
+    regression-on-scaled-label spelling a TypeError), and recover the
+    raw-scale target."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.linear_regression import (
+        OpLinearRegression,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    n = 100
+    a_vals = rng.rand(n) * 10 + 1
+    data = {"y": (a_vals * 3).tolist(), "a": a_vals.tolist()}
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    # NON-identity scaling: with the default slope=1/intercept=0 the
+    # inverse is a no-op and the test could not catch a broken descale
+    scaled = y.scale(slope=2.5, intercept=7.0)
+    vec = transmogrify([a])
+    pred = (
+        OpLinearRegression(reg_param=0.001)
+        .set_input(scaled, vec).get_output()
+    )
+    de = pred.descale(scaled)
+    model = (
+        OpWorkflow().set_result_features(de)
+        .set_input_dataset(data).train()
+    )
+    dv = np.asarray(model.score(data)[de.name].values, dtype=float)
+    target = a_vals * 3
+    r2 = 1 - ((dv - target) ** 2).sum() / (
+        (target - target.mean()) ** 2
+    ).sum()
+    assert r2 > 0.999
+
+
+def test_feature_division_null_divisor_propagates(rng):
+    """a / b with a null b row yields a null output row, not 0 or inf."""
+    n = 20
+    data = {"a": (rng.rand(n) + 1).tolist(), "b": (rng.rand(n) + 1).tolist()}
+    data["b"][3] = None
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    ratio = a / b
+    model = (
+        OpWorkflow().set_result_features(ratio)
+        .set_input_dataset(data).train()
+    )
+    out = model.score(data)[ratio.name].to_list()
+    assert out[3] is None
+    assert abs(out[0] - data["a"][0] / data["b"][0]) < 1e-12

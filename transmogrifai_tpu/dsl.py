@@ -208,12 +208,20 @@ def _patch_feature() -> None:
 
     def descale(self: Feature, scaled_feature: Feature) -> Feature:
         """(reference: RichNumericFeature.descale:372 - reads the scaler
-        args from the scaled feature's metadata)"""
-        from .ops.collections import DescalerTransformer
+        args from the scaled feature's metadata).  Dispatches on the
+        receiver's type: a Prediction routes to PredictionDescaler (the
+        regression-on-scaled-label round trip, DescalerTransformer.
+        scala:92) so ``prediction.descale(scaled_label)`` works the way
+        users naturally write it."""
+        from .ops.collections import DescalerTransformer, PredictionDescaler
+        from .types.feature_types import Prediction
 
-        return (
-            DescalerTransformer().set_input(self, scaled_feature).get_output()
+        stage = (
+            PredictionDescaler()
+            if issubclass(self.ftype, Prediction)
+            else DescalerTransformer()
         )
+        return stage.set_input(self, scaled_feature).get_output()
 
     def to_percentile(self: Feature, buckets: int = 100) -> Feature:
         from .ops.scalers import PercentileCalibrator
