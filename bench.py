@@ -600,11 +600,26 @@ def main() -> None:
 
     insights = model.model_insights()
     dev0 = jax.devices()[0]
+    try:
+        # evidence traceability: the artifact names the exact code it
+        # measured, so a delayed watcher capture provably ran CURRENT
+        # code rather than whatever was checked out when it was armed
+        import subprocess as _sp
+
+        _git = _sp.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        bench_commit = _git.stdout.strip() or "unknown"
+    except Exception:
+        bench_commit = "unknown"
     result = {
         "metric": "titanic_cv_holdout_auroc",
         "value": auroc,
         "unit": "AuROC",
         "vs_baseline": auroc / REFERENCE_HOLDOUT_AUROC,
+        "bench_commit": bench_commit,
         "platform": jax.default_backend(),
         "device": str(getattr(dev0, "device_kind", dev0)),
         "n_devices": jax.device_count(),
