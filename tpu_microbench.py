@@ -10,6 +10,7 @@ Run via tpu_probe.py when the axon tunnel is healthy; safe to run by hand.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -39,8 +40,20 @@ def main() -> int:
     from transmogrifai_tpu.parallel import pallas_kernels as pk
 
     dev = jax.devices()[0]
+    try:
+        import subprocess as _sp
+
+        _git = _sp.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        bench_commit = _git.stdout.strip() or "unknown"
+    except Exception:
+        bench_commit = "unknown"
     result = {
         "metric": "pallas_microbench",
+        "bench_commit": bench_commit,
         "platform": jax.default_backend(),
         "device": str(getattr(dev, "device_kind", dev)),
         "n_devices": jax.device_count(),
