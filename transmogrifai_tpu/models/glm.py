@@ -49,7 +49,7 @@ def _check_var_power(p: float) -> float:
 
 @partial(jax.jit, static_argnames=("family", "iters"))
 def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25,
-                    var_power=1.5):
+                    var_power=1.5, link_power=0.0):
     """Standardization folded into the algebra (identities documented in
     logistic_regression._lr_fit_kernel): no standardized copy of X is
     materialized, so a vmap over CV fold weight vectors reads the shared
@@ -67,7 +67,15 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25,
     sd = jnp.where(active, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
 
     ybar = (w @ y) / wsum
-    if family in ("poisson", "gamma", "tweedie"):
+    if family == "tweedie":
+        # link_power 0 = log link; else the power link eta = mu^lp
+        # (Spark GLR's default tweedie link is lp = 1 - variancePower)
+        b0_init = jnp.where(
+            link_power == 0.0,
+            jnp.log(jnp.maximum(ybar, 1e-6)),
+            jnp.maximum(ybar, 1e-6) ** link_power,
+        )
+    elif family in ("poisson", "gamma"):
         b0_init = jnp.log(jnp.maximum(ybar, 1e-6))
     elif family == "binomial":
         p = jnp.clip(ybar, 1e-6, 1 - 1e-6)
@@ -89,14 +97,32 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25,
             # log link: dmu/deta = mu, V = mu^2 -> weight 1, score /mu
             return mu, jnp.ones_like(mu), 1.0 / jnp.maximum(mu, 1e-12)
         if family == "tweedie":
-            mu = jnp.exp(jnp.clip(eta, -30, 30))
-            # log link: V = mu^p -> weight mu^(2-p), score mu^(1-p)
-            mu_safe = jnp.maximum(mu, 1e-12)
-            return (
-                mu,
-                mu_safe ** (2.0 - var_power),
-                mu_safe ** (1.0 - var_power),
-            )
+            # V = mu^p.  Log link (lp=0): dmu/deta = mu -> weight
+            # mu^(2-p), score mu^(1-p).  Power link eta = mu^lp:
+            # dmu/deta = mu^(1-lp)/lp -> weight mu^(2-2lp-p)/lp^2,
+            # score mu^(1-lp-p)/lp.  lax.cond keeps one jitted kernel.
+            def _log_link(e):
+                mu = jnp.exp(jnp.clip(e, -30, 30))
+                ms = jnp.maximum(mu, 1e-12)
+                return (mu, ms ** (2.0 - var_power),
+                        ms ** (1.0 - var_power))
+
+            def _pow_link(e):
+                lp = jnp.where(link_power == 0.0, 1.0, link_power)
+                # a Newton iterate can push eta out of the link's domain
+                # (eta = mu^lp > 0); clamp mu to a sane range or a single
+                # bad step explodes the weights into NaN (seed-42 repro)
+                mu = jnp.clip(
+                    jnp.maximum(e, 1e-6) ** (1.0 / lp), 1e-6, 1e8
+                )
+                return (
+                    mu,
+                    mu ** (2.0 - 2.0 * lp - var_power) / (lp * lp),
+                    mu ** (1.0 - lp - var_power) / lp,
+                )
+
+            return jax.lax.cond(link_power == 0.0, _log_link, _pow_link,
+                                eta)
         if family == "binomial":
             mu = jax.nn.sigmoid(eta)
             return mu, mu * (1 - mu), jnp.ones_like(mu)
@@ -123,7 +149,12 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25,
         g0 = sr / wsum
         h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
-        return (beta - delta, b0 - g0 / h0), None
+        # a non-finite step (singular H after a domain excursion) must
+        # not poison the carry - same guard as the softmax kernel
+        delta = jnp.where(jnp.isfinite(delta), delta, 0.0)
+        step0 = g0 / h0
+        step0 = jnp.where(jnp.isfinite(step0), step0, 0.0)
+        return (beta - delta, b0 - step0), None
 
     (beta_s, b0), _ = jax.lax.scan(
         step, (jnp.zeros((d,)), b0_init), None, length=iters
@@ -134,9 +165,10 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25,
 
 @partial(jax.jit, static_argnames=("family", "iters"))
 def _glm_fit_folds_kernel(X, y, W, reg, family: str, iters: int,
-                          var_power=1.5):
+                          var_power=1.5, link_power=0.0):
     return jax.vmap(
-        lambda w: _glm_fit_kernel(X, y, w, reg, family, iters, var_power)
+        lambda w: _glm_fit_kernel(X, y, w, reg, family, iters, var_power,
+                                  link_power)
     )(W)
 
 
@@ -145,12 +177,17 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
 
     def __init__(
         self, family: str = "gaussian", reg_param: float = 0.0,
-        max_iter: int = 25, variance_power: float = 1.5, **kw,
+        max_iter: int = 25, variance_power: float = 1.5,
+        link_power: float = 0.0, **kw,
     ) -> None:
         super().__init__(**kw)
         self.params.setdefault("family", _norm_family(family))
         self.params.setdefault("reg_param", reg_param)
         self.params.setdefault("max_iter", max_iter)
+        # tweedie link: 0 = log (our default), else the power link
+        # eta = mu^lp (Spark GLR defaults lp = 1 - variancePower; pass
+        # link_power=1-p to reproduce it exactly)
+        self.params.setdefault("link_power", float(link_power))
         # tweedie variance power (reference variancePower, used only for
         # family='tweedie'; link is log - documented divergence from the
         # reference's default power link 1-p)
@@ -169,11 +206,15 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
             var_power=jnp.asarray(
                 _check_var_power(self.params.get("variance_power", 1.5))
             ),
+            link_power=jnp.asarray(
+                float(self.params.get("link_power", 0.0))
+            ),
         )
         return {
             "beta": np.asarray(beta),
             "intercept": float(b0),
             "family": self.params["family"],
+            "link_power": float(self.params.get("link_power", 0.0)),
         }
 
     def fit_arrays_folds(self, X, y, W) -> list:
@@ -188,18 +229,29 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
             var_power=jnp.asarray(
                 _check_var_power(self.params.get("variance_power", 1.5))
             ),
+            link_power=jnp.asarray(
+                float(self.params.get("link_power", 0.0))
+            ),
         )
         betas, b0s = np.asarray(betas), np.asarray(b0s)
         return [
             {"beta": betas[f], "intercept": float(b0s[f]),
-             "family": self.params["family"]}
+             "family": self.params["family"],
+             "link_power": float(self.params.get("link_power", 0.0))}
             for f in range(len(W))
         ]
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         eta = X @ params["beta"] + params["intercept"]
         fam = _norm_family(params["family"])
-        if fam in ("poisson", "gamma", "tweedie"):
+        lp = float(params.get("link_power", 0.0))
+        if fam == "tweedie" and lp != 0.0:
+            # same domain clamp as the kernel: eta outside the power
+            # link's range must not explode the mean
+            pred = np.clip(
+                np.maximum(eta, 1e-6) ** (1.0 / lp), 1e-6, 1e8
+            )
+        elif fam in ("poisson", "gamma", "tweedie"):
             pred = np.exp(np.clip(eta, -30, 30))
         elif fam == "binomial":
             pred = 1.0 / (1.0 + np.exp(-eta))
