@@ -4,9 +4,9 @@ Counterpart of OpGeneralizedLinearRegression (reference: core/.../impl/
 regression/OpGeneralizedLinearRegression.scala wrapping Spark GLR; default
 grid families gaussian/poisson - DefaultSelectorParams.DistFamily).
 Links: gaussian-identity, poisson-log, gamma-log (non-canonical but
-standard), binomial-logit, tweedie-log (the reference's default tweedie
-link is the power link 1-p; log is the standard practical choice and the
-documented divergence).  Each family's IRLS uses the proper score
+standard), binomial-logit, tweedie log (default) or power link via
+``link_power`` (pass link_power = 1 - variance_power to reproduce the
+reference's Spark GLR default exactly).  Each family's IRLS uses the proper score
 (y - mu) * (dmu/deta) / V(mu) and Fisher weight (dmu/deta)^2 / V(mu).
 Same weighted-Newton shape as the logistic kernel, so the CV fan-out
 batches identically.
@@ -188,9 +188,8 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
         # eta = mu^lp (Spark GLR defaults lp = 1 - variancePower; pass
         # link_power=1-p to reproduce it exactly)
         self.params.setdefault("link_power", float(link_power))
-        # tweedie variance power (reference variancePower, used only for
-        # family='tweedie'; link is log - documented divergence from the
-        # reference's default power link 1-p)
+        # tweedie variance power (reference variancePower, used only
+        # for family='tweedie')
         self.params.setdefault(
             "variance_power", _check_var_power(variance_power)
         )
