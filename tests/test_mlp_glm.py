@@ -197,3 +197,41 @@ def test_glm_tweedie_power_link(rng):
                              var_power=jnp.asarray(1.0),
                              link_power=jnp.asarray(0.0))
     np.testing.assert_allclose(np.asarray(bt1), np.asarray(bp), atol=1e-4)
+
+
+def test_glm_tweedie_power_link_save_load(tmp_path, rng):
+    """The power-link tweedie model must survive the model writer with
+    identical predictions (link_power rides the fitted params)."""
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow.workflow import OpWorkflowModel
+
+    n = 150
+    a_vals = rng.rand(n) * 2 + 0.5
+    eta = 0.3 * a_vals + 1.5
+    mu = eta ** (1.0 / -0.5)
+    data = {"y": rng.gamma(2.0, mu / 2.0).tolist(), "a": a_vals.tolist()}
+
+    def build():
+        fy = FeatureBuilder(ft.RealNN, "y").as_response()
+        fa = FeatureBuilder(ft.Real, "a").as_predictor()
+        vec = transmogrify([fa])
+        pred = (
+            OpGeneralizedLinearRegression(
+                family="tweedie", variance_power=1.5, link_power=-0.5
+            ).set_input(fy, vec).get_output()
+        )
+        return OpWorkflow().set_result_features(pred).set_input_dataset(data)
+
+    m1 = build().train()
+    assert m1.stages[-1].model_params["link_power"] == -0.5
+    m1.save(str(tmp_path / "tw"))
+    m2 = OpWorkflowModel.load(str(tmp_path / "tw"), build())
+    p1 = [c for c in m1.score(data).columns().values()
+          if hasattr(c, "prediction")][0]
+    p2 = [c for c in m2.score(data).columns().values()
+          if hasattr(c, "prediction")][0]
+    np.testing.assert_array_equal(np.asarray(p1.prediction),
+                                  np.asarray(p2.prediction))
