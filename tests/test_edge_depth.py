@@ -489,3 +489,23 @@ def test_kitchen_sink_workflow_save_load(tmp_path, rng):
     ner_out = [v for k, v in s2.columns().items()
                if "ner" in k.lower() or "entit" in k.lower()][0]
     assert "okonkwo" in ner_out.values[0]
+
+
+def test_index_deindex_unseen_semantics(rng):
+    """StringIndexer reserves the tail slot for unseen values (NoFilter
+    scoring semantics); deindexing that reserved index yields null, and
+    missing values stay missing through the round trip."""
+    import transmogrifai_tpu.dsl  # noqa: F401
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+
+    data = {"c": ["a", "b", "a", "c", "b", "a"]}
+    f = FeatureBuilder(ft.PickList, "c").as_predictor()
+    idx = f.indexed()
+    back = idx.deindexed(["a", "b", "c"])
+    model = (
+        OpWorkflow().set_result_features(idx, back)
+        .set_input_dataset(data).train()
+    )
+    out = model.score({"c": ["a", "zzz", None, "b"]})
+    assert out[idx.name].to_list() == [0.0, 3.0, None, 1.0]
+    assert list(out[back.name].values) == ["a", None, None, "b"]
