@@ -769,3 +769,30 @@ def test_multinomial_property_sweep_vs_scipy(seed, K):
     p2 = np.exp(z2 - z2.max(1, keepdims=True))
     p2 /= p2.sum(1, keepdims=True)
     assert np.abs(p1 - p2).max() < 3e-3, np.abs(p1 - p2).max()
+
+
+def test_multinomial_bf16_hessian_branch(monkeypatch):
+    """The bf16-Hessian branch of the softmax kernel (the TPU default)
+    must stay finite and accurate under ill-conditioned columns - on CPU
+    it is only reachable via the env override, so pin it here rather
+    than discover a broken trace on the chip."""
+    import jax
+
+    monkeypatch.setenv("TX_LR_HESSIAN_BF16", "1")
+    jax.clear_caches()  # the env is read at trace time
+    try:
+        rng = np.random.RandomState(3)
+        n = 450
+        centers = np.array([[2.5, 0.0], [-2.5, 1.0], [0.0, -3.0]])
+        y = np.repeat(np.arange(3.0), n // 3)
+        X = centers[y.astype(int)] + 0.5 * rng.randn(n, 2)
+        X[:, 0] = X[:, 0] * 20 + 100
+        est = OpLogisticRegression(reg_param=0.01)
+        params = est.fit_arrays(X, y)
+        pred, _, prob = est.predict_arrays(params, X)
+        assert params["family"] == "multinomial"
+        assert np.isfinite(params["betas"]).all()
+        assert (pred == y).mean() > 0.95
+        assert np.isfinite(prob).all()
+    finally:
+        jax.clear_caches()  # don't leak bf16-traced kernels to others
