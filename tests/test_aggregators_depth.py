@@ -1,0 +1,276 @@
+"""Monoid-law depth tests for the event aggregators.
+
+The reference delegates these laws to algebird's immutable monoids
+(reference: features/.../aggregators/MonoidAggregatorDefaults.scala:56-118);
+our hand-rolled ones must uphold them explicitly: identity, associativity
+(any partition grouping of the same event stream gives the same answer),
+and non-mutation of arguments (partition merges reuse accumulators).
+Window/cutoff boundary semantics follow FeatureAggregator.scala:114-123.
+"""
+from __future__ import annotations
+
+import copy
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.aggregators import (
+    ConcatList,
+    ConcatText,
+    CutOffTime,
+    Event,
+    FeatureAggregator,
+    GeolocationMidpoint,
+    LogicalOr,
+    MaxNumeric,
+    MeanNumeric,
+    ModeText,
+    SumNumeric,
+    UnionMap,
+    UnionSet,
+    default_aggregator,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+# (aggregator factory, raw event values) — values chosen so order/grouping
+# would change the answer if the law were violated
+_CASES = [
+    (lambda: SumNumeric, [1.0, 2.5, -3.0, 4.0]),
+    (lambda: MaxNumeric, [3.0, 1.0, 9.0, 2.0]),
+    (lambda: LogicalOr, [False, False, True, False]),
+    (lambda: ConcatText, ["a", "b", "c", "d"]),
+    (lambda: UnionSet, [frozenset({"x"}), frozenset({"y"}), frozenset({"x", "z"})]),
+    (lambda: ConcatList, [(1,), (2, 3), (4,)]),
+    (lambda: MeanNumeric(), [1.0, 2.0, 4.0, 9.0]),
+    (lambda: ModeText(), ["a", "b", "a", "c", "b", "a"]),
+    (
+        lambda: UnionMap(ModeText()),
+        [{"k": "a"}, {"k": "b", "j": "x"}, {"k": "a"}],
+    ),
+    (
+        lambda: UnionMap(SumNumeric),
+        [{"k": 1.0}, {"k": 2.0, "j": 5.0}, {"j": -1.0}],
+    ),
+    (
+        lambda: GeolocationMidpoint(),
+        [[37.77, -122.42, 1.0], [40.71, -74.0, 3.0], [51.5, -0.13, 5.0]],
+    ),
+]
+
+
+def _fold_groupings(agg, values):
+    """Aggregate the same stream under several partition groupings: flat
+    fold, pairwise tree merge, and singleton-lift merge."""
+    outs = []
+    # flat
+    outs.append(agg.aggregate(values))
+    # tree: aggregate halves separately (as raw partial accumulators), merge
+    def lift(vals):
+        acc = agg.zero()
+        for v in vals:
+            acc = agg.plus(acc, v)
+        return acc
+    mid = len(values) // 2
+    outs.append(agg.present(agg.plus(lift(values[:mid]), lift(values[mid:]))))
+    # right-heavy merge of singleton lifts
+    acc = agg.zero()
+    for v in reversed(values):
+        acc = agg.plus(lift([v]), acc)
+    outs.append(agg.present(acc))
+    return outs
+
+
+@pytest.mark.parametrize("case_i", range(len(_CASES)))
+def test_grouping_invariance(case_i):
+    make, values = _CASES[case_i]
+    flat, tree, right = _fold_groupings(make(), values)
+
+    def norm(x):
+        if isinstance(x, list) and x and isinstance(x[0], float):
+            return np.round(x, 9).tolist()
+        return x
+
+    assert norm(tree) == norm(flat)
+    assert norm(right) == norm(flat)
+
+
+@pytest.mark.parametrize("case_i", range(len(_CASES)))
+def test_identity_element(case_i):
+    """plus with zero on EITHER side must present like the single value —
+    raw values may arrive on either side of a partition merge."""
+    make, values = _CASES[case_i]
+    agg = make()
+    v = values[0]
+
+    def norm(x):
+        if isinstance(x, list) and x and isinstance(x[0], float):
+            return np.round(x, 9).tolist()
+        return x
+
+    single = norm(agg.aggregate([v]))
+    assert norm(agg.present(agg.plus(agg.zero(), v))) == single
+    assert norm(agg.present(agg.plus(v, agg.zero()))) == single
+    assert agg.aggregate([]) is None
+
+
+@pytest.mark.parametrize("case_i", range(len(_CASES)))
+def test_plus_does_not_mutate_arguments(case_i):
+    """Partition merges hand accumulators back into plus; an in-place
+    update corrupts re-used partials (this caught ModeText mutating its
+    left Counter through UnionMap's shallow dict copy)."""
+    make, values = _CASES[case_i]
+    agg = make()
+    acc_a = agg.zero()
+    for v in values[:2]:
+        acc_a = agg.plus(acc_a, v)
+    acc_b = agg.zero()
+    for v in values[2:]:
+        acc_b = agg.plus(acc_b, v)
+    snap_a, snap_b = copy.deepcopy(acc_a), copy.deepcopy(acc_b)
+    agg.plus(acc_a, acc_b)
+
+    def eq(x, y):
+        if isinstance(x, np.ndarray):
+            return np.array_equal(x, y)
+        return x == y
+
+    assert eq(acc_a, snap_a)
+    assert eq(acc_b, snap_b)
+
+
+def test_union_map_merge_keeps_left_accumulator_intact():
+    """The exact aliasing path: PickListMap partials share inner Counters
+    via dict(a); merging must not change the left partial's counts."""
+    agg = default_aggregator(ft.PickListMap)
+    assert isinstance(agg, UnionMap)
+    left = agg.plus(agg.plus(agg.zero(), {"color": "red"}), {"color": "red"})
+    right = agg.plus(agg.zero(), {"color": "blue"})
+    left_snapshot = {k: Counter(v) for k, v in left.items()}
+    merged = agg.plus(left, right)
+    assert {k: Counter(v) for k, v in left.items()} == left_snapshot
+    assert agg.present(merged) == {"color": "red"}  # 2 red vs 1 blue
+
+
+def test_mode_tie_breaks_to_min():
+    agg = ModeText()
+    assert agg.aggregate(["b", "a", "b", "a"]) == "a"
+    assert agg.aggregate(["z"]) == "z"
+
+
+def test_mode_falsy_raw_values_are_real_observations():
+    """'' / 0 / False are values, not absence — the present() guard must
+    check emptiness after lifting, not truthiness of the raw value."""
+    agg = ModeText()
+    assert agg.present(agg.plus(agg.zero(), "")) == ""
+    assert agg.present(agg.plus(agg.zero(), 0)) == 0
+    um = UnionMap(ModeText())
+    assert um.present(um.plus(um.zero(), {"k": ""})) == {"k": ""}
+
+
+def test_geo_raw_value_as_ndarray_is_not_mistaken_for_accumulator():
+    """A raw (lat, lon, accuracy) arriving as np.array must lift like a
+    list — only the 5-vector accumulator shape passes through."""
+    agg = GeolocationMidpoint()
+    out = agg.aggregate([np.array([10.0, 20.0, 1.0])])
+    assert out[0] == pytest.approx(10.0, abs=1e-9)
+    assert out[1] == pytest.approx(20.0, abs=1e-9)
+    merged = agg.plus(agg.plus(None, [0.0, 10.0, 1.0]),
+                      np.array([0.0, 20.0, 3.0]))
+    assert agg.present(merged)[1] == pytest.approx(15.0, abs=1e-9)
+
+
+def test_mean_handles_merged_pairs_and_raw_values():
+    agg = MeanNumeric()
+    # a merged partial (sum, count) must combine with raw values correctly
+    partial = agg.plus(agg.plus(None, 2.0), 4.0)  # (6.0, 2)
+    assert agg.present(agg.plus(partial, 6.0)) == pytest.approx(4.0)
+    assert agg.present(agg.plus(partial, partial)) == pytest.approx(3.0)
+
+
+def test_geo_midpoint_single_point_identity_and_accuracy_mean():
+    agg = GeolocationMidpoint()
+    out = agg.aggregate([[12.5, 45.25, 3.0]])
+    assert out[0] == pytest.approx(12.5, abs=1e-9)
+    assert out[1] == pytest.approx(45.25, abs=1e-9)
+    assert out[2] == pytest.approx(3.0)
+    two = agg.aggregate([[0.0, 10.0, 1.0], [0.0, 20.0, 3.0]])
+    assert two[0] == pytest.approx(0.0, abs=1e-9)
+    assert two[1] == pytest.approx(15.0, abs=1e-9)
+    assert two[2] == pytest.approx(2.0)
+
+
+def test_geo_midpoint_dateline_wrap():
+    """Averaging +179 and -179 longitude must land near 180, not 0 — the
+    3D unit-vector mean handles the wrap the naive degree-mean cannot."""
+    agg = GeolocationMidpoint()
+    out = agg.aggregate([[0.0, 179.0, 1.0], [0.0, -179.0, 1.0]])
+    assert abs(out[1]) == pytest.approx(180.0, abs=1e-6)
+
+
+def test_default_aggregator_dispatch_table():
+    """Per-type defaults mirror MonoidAggregatorDefaults.scala:56-118."""
+    assert default_aggregator(ft.Real) is SumNumeric
+    assert default_aggregator(ft.Integral) is SumNumeric
+    assert default_aggregator(ft.Currency) is SumNumeric
+    assert isinstance(default_aggregator(ft.Percent), MeanNumeric)
+    assert default_aggregator(ft.Binary) is LogicalOr
+    assert default_aggregator(ft.Date) is MaxNumeric
+    assert default_aggregator(ft.DateTime) is MaxNumeric
+    assert isinstance(default_aggregator(ft.PickList), ModeText)
+    assert default_aggregator(ft.Text) is ConcatText
+    assert default_aggregator(ft.MultiPickList) is UnionSet
+    assert default_aggregator(ft.TextList) is ConcatList
+    assert default_aggregator(ft.DateList) is ConcatList
+    assert isinstance(default_aggregator(ft.Geolocation), GeolocationMidpoint)
+    for map_t in (ft.RealMap, ft.PickListMap, ft.BinaryMap, ft.TextMap):
+        agg = default_aggregator(map_t)
+        assert isinstance(agg, UnionMap)
+        inner = default_aggregator(map_t.value_type)
+        assert type(agg.value_agg) is type(inner)
+
+
+# --- cutoff / window boundary semantics (FeatureAggregator.scala:114-123) ---
+
+
+def _events(*ts):
+    return [Event(t, 1.0) for t in ts]
+
+
+def test_predictor_strictly_before_cutoff():
+    fa = FeatureAggregator(ft.Real)
+    cut = CutOffTime(100.0)
+    # the event AT the cutoff belongs to the response side
+    assert fa.extract(_events(98.0, 99.0, 100.0), cut) == 2.0
+    resp = FeatureAggregator(ft.Real, is_response=True)
+    assert resp.extract(_events(98.0, 99.0, 100.0), cut) == 1.0
+
+
+def test_predictor_window_is_closed_on_the_far_edge():
+    fa = FeatureAggregator(ft.Real, window=10.0)
+    cut = CutOffTime(100.0)
+    # keep [cutoff - window, cutoff): 90 in, 89.999 out, 100 out
+    assert fa.extract(_events(89.999, 90.0, 95.0, 100.0), cut) == 2.0
+
+
+def test_response_window_is_closed_on_the_far_edge():
+    fa = FeatureAggregator(ft.Real, is_response=True, window=10.0)
+    cut = CutOffTime(100.0)
+    # keep [cutoff, cutoff + window]: 100 in, 110 in, 110.001 out
+    assert fa.extract(_events(100.0, 110.0, 110.001), cut) == 2.0
+
+
+def test_no_cutoff_keeps_everything_for_both_sides():
+    cut = CutOffTime(None)
+    fa = FeatureAggregator(ft.Real, window=5.0)
+    resp = FeatureAggregator(ft.Real, is_response=True, window=5.0)
+    ev = _events(0.0, 50.0, 1000.0)
+    assert fa.extract(ev, cut) == 3.0
+    assert resp.extract(ev, cut) == 3.0
+
+
+def test_empty_and_all_none_event_streams_present_none():
+    fa = FeatureAggregator(ft.Real)
+    assert fa.extract([], CutOffTime(10.0)) is None
+    assert fa.extract([Event(1.0, None), Event(2.0, None)], CutOffTime(10.0)) is None

@@ -335,6 +335,27 @@ def test_prediction_descale_dispatch(rng):
     assert r2 > 0.999
 
 
+def test_feature_math_nonfinite_results_null_without_warnings(rng):
+    """x/0 and overflow results become nulls with NO RuntimeWarning —
+    the errstate must cover divide, invalid, AND over."""
+    import warnings
+
+    data = {"a": [1e200, 3.0, 5.0], "b": [1e200, 2.0, 0.0]}
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    prod = a * b   # 1e200 * 1e200 overflows
+    ratio = a / b  # 5/0 divides by zero
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        model = (
+            OpWorkflow().set_result_features(prod, ratio)
+            .set_input_dataset(data).train()
+        )
+        scored = model.score(data)
+    assert scored[prod.name].to_list() == [None, 6.0, 0.0]
+    assert scored[ratio.name].to_list()[2] is None
+
+
 def test_feature_division_null_divisor_propagates(rng):
     """a / b with a null b row yields a null output row, not 0 or inf."""
     n = 20

@@ -33,7 +33,12 @@ Number = Union[int, float]
 def _numeric_binary(op_name: str, fn) -> LambdaTransformer:
     def col_fn(a: Column, b: Column) -> Column:
         assert isinstance(a, NumericColumn) and isinstance(b, NumericColumn)
-        vals = fn(a.values, b.values)
+        # non-finite results (x/0, 0/0, inf-inf, overflow) become nulls
+        # below, same as the reference's option-valued feature math —
+        # silence the interim numpy warning rather than pay a pre-check pass
+        with np.errstate(divide="ignore", invalid="ignore",
+                         over="ignore", under="ignore"):
+            vals = fn(a.values, b.values)
         mask = a.mask & b.mask
         ok = np.isfinite(vals)
         return NumericColumn(np.where(mask & ok, vals, 0.0), mask & ok, ft.Real)
@@ -44,7 +49,9 @@ def _numeric_binary(op_name: str, fn) -> LambdaTransformer:
 def _numeric_unary(op_name: str, fn, out_type=ft.Real) -> LambdaTransformer:
     def col_fn(a: Column) -> Column:
         assert isinstance(a, NumericColumn)
-        vals = fn(a.values)
+        with np.errstate(divide="ignore", invalid="ignore",
+                         over="ignore", under="ignore"):
+            vals = fn(a.values)
         ok = np.isfinite(vals)
         return NumericColumn(np.where(a.mask & ok, vals, 0.0), a.mask & ok, out_type)
 

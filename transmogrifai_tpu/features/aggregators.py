@@ -47,14 +47,27 @@ class CutOffTime:
 
 
 class MonoidAggregator:
-    """zero + plus over raw python values; None = absent."""
+    """zero + plus over raw python values; None = absent.
+
+    ``plus`` is PURE — it never mutates or requires ownership of its
+    arguments, so partition merges can re-use partial accumulators freely
+    and raw values may appear on either side (``_lift`` normalizes them).
+    ``aggregate`` folds through ``_fold_into`` over a locally-owned
+    accumulator, which subclasses may mutate for O(N) flat folds.
+    """
 
     name = "agg"
 
     def zero(self) -> Any:
         return None
 
+    def _lift(self, v: Any) -> Any:
+        """Normalize a raw value into accumulator representation
+        (identity for aggregators whose accumulator IS the value)."""
+        return v
+
     def plus(self, a: Any, b: Any) -> Any:
+        a, b = self._lift(a), self._lift(b)
         if a is None:
             return b
         if b is None:
@@ -68,11 +81,17 @@ class MonoidAggregator:
         """Finalize the accumulator into the feature value."""
         return acc
 
+    def _fold_into(self, acc: Any, v: Any) -> Any:
+        """Fold one raw value into an accumulator OWNED by the caller;
+        defaults to the pure ``plus``.  Subclasses whose pure combine
+        copies (e.g. Counter-based mode) override this to mutate."""
+        return self.plus(acc, v)
+
     def aggregate(self, values: Sequence[Any]) -> Any:
         acc = self.zero()
         for v in values:
             if v is not None:
-                acc = self.plus(acc, v)
+                acc = self._fold_into(acc, v)
         return self.present(acc)
 
 
@@ -101,35 +120,61 @@ ConcatList = _Fn("ConcatList", lambda a, b: tuple(a) + tuple(b))
 class MeanNumeric(MonoidAggregator):
     name = "Mean"
 
-    def plus(self, a, b):
-        if b is None:
-            return a
-        pair = b if isinstance(b, tuple) and len(b) == 2 and isinstance(b[1], int) \
-            else (float(b), 1)
-        if a is None:
-            return pair
-        return (a[0] + pair[0], a[1] + pair[1])
+    def _lift(self, v):
+        # accumulator repr is (sum, count); a raw value is one observation
+        if v is None or (isinstance(v, tuple) and len(v) == 2
+                         and isinstance(v[1], int)):
+            return v
+        return (float(v), 1)
+
+    def _combine(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
 
     def present(self, acc):
         if acc is None:
             return None
-        s, n = acc
+        s, n = self._lift(acc)
         return s / n if n else None
 
 
 class ModeText(MonoidAggregator):
     name = "Mode"
 
-    def plus(self, a, b):
-        if b is None:
-            return a
-        c = b if isinstance(b, Counter) else Counter([b])
-        if a is None:
-            return c
-        a.update(c)
-        return a
+    def _lift(self, x):
+        # a raw value is a SINGLE observation — Counter([x]), never
+        # Counter(x), which would letter-count a string.  UnionMap seeds
+        # inner accumulators with raw values, so both plus sides lift.
+        if x is None or isinstance(x, Counter):
+            return x
+        return Counter([x])
+
+    def _combine(self, a: Counter, b: Counter) -> Counter:
+        # pure: UnionMap's shallow dict copy shares the inner Counters
+        # with the left accumulator, so an in-place update here would
+        # corrupt `a` on partition merges
+        out = Counter(a)
+        out.update(b)
+        return out
+
+    def _fold_into(self, acc, v):
+        # flat folds own their accumulator: mutate instead of copying
+        # (pure _combine would make an N-event fold O(N * unique))
+        if v is None:
+            return acc
+        if acc is None:
+            acc = Counter()
+        if isinstance(v, Counter):  # a partition partial
+            acc.update(v)
+        else:  # the common raw-event case: no per-event allocation
+            acc[v] += 1
+        return acc
 
     def present(self, acc):
+        if acc is None:
+            return None
+        # guard AFTER lifting: a falsy raw value ('' / 0 / False) is a
+        # real single observation, only an empty Counter means absent
+        acc = self._lift(acc)
         if not acc:
             return None
         # min on ties like the reference's mode semantics
@@ -143,20 +188,28 @@ class GeolocationMidpoint(MonoidAggregator):
 
     name = "GeoMidpoint"
 
-    def plus(self, a, b):
-        if b is None:
-            return a
-        if not isinstance(b, np.ndarray):
-            lat, lon = np.radians(b[0]), np.radians(b[1])
-            acc = np.array(
-                [np.cos(lat) * np.cos(lon), np.cos(lat) * np.sin(lon),
-                 np.sin(lat), b[2] if len(b) > 2 else 0.0, 1.0]
-            )
-        else:
-            acc = b
-        return acc if a is None else a + acc
+    # raw (lat, lon[, accuracy]) inputs have 2-3 entries, never 5, so the
+    # accumulator length discriminates even when a raw value arrives as an
+    # ndarray
+    _ACC_LEN = 5
+
+    def _lift(self, v):
+        # accumulator repr is the 5-vector [x, y, z, acc_sum, count];
+        # a raw (lat, lon[, accuracy]) lifts to one unit vector
+        if v is None or (isinstance(v, np.ndarray)
+                         and v.shape == (self._ACC_LEN,)):
+            return v
+        lat, lon = np.radians(v[0]), np.radians(v[1])
+        return np.array(
+            [np.cos(lat) * np.cos(lon), np.cos(lat) * np.sin(lon),
+             np.sin(lat), v[2] if len(v) > 2 else 0.0, 1.0]
+        )
+
+    def _combine(self, a, b):
+        return a + b
 
     def present(self, acc):
+        acc = self._lift(acc)
         if acc is None or acc[4] == 0:
             return None
         x, y, z = acc[0] / acc[4], acc[1] / acc[4], acc[2] / acc[4]
