@@ -391,7 +391,7 @@ def _specs():
         ToOccurTransformer,
     )
     from transmogrifai_tpu.ops.combiner import AliasTransformer
-    from transmogrifai_tpu.ops.dates import DateVectorizer
+    from transmogrifai_tpu.ops.dates import DateListVectorizer, DateVectorizer
     from transmogrifai_tpu.ops.geo import GeolocationVectorizer
     from transmogrifai_tpu.ops.maps import (
         MapVectorizer,
@@ -470,6 +470,10 @@ def _specs():
             OneHotVectorizer, ft.PickList,
             ctor=lambda: OneHotVectorizer(top_k=10, min_support=2)),
         "DateVectorizer": _wire_vectorizer(DateVectorizer, ft.Date),
+        "DateListVectorizer": _wire_vectorizer(
+            DateListVectorizer, ft.DateList,
+            ctor=lambda: DateListVectorizer(
+                pivot="SinceLast", reference_date_ms=1.6e12)),
         "GeolocationVectorizer": _wire_vectorizer(
             GeolocationVectorizer, ft.Geolocation),
         "MapVectorizer": (lambda n, rng: (

@@ -1,0 +1,255 @@
+"""Exact-calendar date semantics + DateList pivots.
+
+The unit-circle encoder must use EXACT UTC calendar fields like the
+reference's Joda lookups (reference: DateToUnitCircleTransformer.scala:
+117-130) — day-of-month 1 at angle 0, ISO weekOfWeekyear — and the
+DateList pivots mirror DateListVectorizer.scala:49-260 (SinceFirst/
+SinceLast whole-day distances, modal-field one-hots with ties to the
+smallest value, empty-list fill + null tracking).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.ops.dates import (
+    DateListVectorizer,
+    DateVectorizer,
+    MS_PER_DAY,
+    PERIOD_SIZES,
+    day_of_month0,
+    day_of_week0,
+    day_of_year0,
+    hour_of_day,
+    iso_week_of_year,
+    month_of_year0,
+    period_fraction,
+    period_value,
+)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.workflow import OpWorkflow
+
+
+def _ms(y, m, d, h=0, mi=0):
+    return _dt.datetime(y, m, d, h, mi, tzinfo=_dt.timezone.utc).timestamp() * 1000.0
+
+
+# --- exact calendar fields, pinned against python's datetime/isocalendar ---
+
+
+def test_reference_docstring_example():
+    """'timestamp 01/01/2018 6:37 maps to angle 2*pi*6/24' — integer hour
+    (DateToUnitCircleTransformer.scala:68-69)."""
+    ts = np.array([_ms(2018, 1, 1, 6, 37)])
+    assert hour_of_day(ts)[0] == 6
+    assert period_fraction(ts, "HourOfDay")[0] == pytest.approx(6 / 24)
+
+
+def test_first_of_month_is_angle_zero():
+    for y, m in [(2018, 1), (2019, 2), (2020, 12), (1969, 7)]:
+        ts = np.array([_ms(y, m, 1)])
+        assert day_of_month0(ts)[0] == 0, (y, m)
+        assert period_fraction(ts, "DayOfMonth")[0] == 0.0
+
+
+@pytest.mark.parametrize("date", [
+    (2018, 1, 1), (2019, 12, 31), (2020, 2, 29), (2021, 3, 14),
+    (1970, 1, 1), (1969, 12, 31), (2000, 2, 29), (2024, 9, 30),
+])
+def test_calendar_fields_match_stdlib(date):
+    y, m, d = date
+    ts = np.array([_ms(y, m, d, 13)])
+    py = _dt.date(y, m, d)
+    assert day_of_month0(ts)[0] == d - 1
+    assert month_of_year0(ts)[0] == m - 1
+    assert day_of_week0(ts)[0] == py.weekday()  # Monday=0
+    assert day_of_year0(ts)[0] == py.timetuple().tm_yday - 1
+    assert iso_week_of_year(ts)[0] == py.isocalendar()[1]
+    assert hour_of_day(ts)[0] == 13
+
+
+def test_iso_week_boundary_cases():
+    """2019-12-30 (Mon) is week 1 of ISO year 2020; 2021-01-01 (Fri) is
+    week 53 of ISO year 2020 — the Thursday rule."""
+    assert iso_week_of_year(np.array([_ms(2019, 12, 30)]))[0] == 1
+    assert iso_week_of_year(np.array([_ms(2021, 1, 1)]))[0] == 53
+    assert iso_week_of_year(np.array([_ms(2016, 1, 1)]))[0] == 53
+
+
+def test_week_of_month_reference_semantics():
+    """weekOfWeekyear - weekOfWeekyear(first of month), raw difference
+    (DateToUnitCircleTransformer.scala:125-126)."""
+    ts = np.array([_ms(2021, 3, 14)])  # week 10; Mar 1 2021 is week 9
+    assert period_value(ts, "WeekOfMonth")[0] == 1
+    assert period_value(np.array([_ms(2021, 3, 1)]), "WeekOfMonth")[0] == 0
+
+
+def test_period_sizes_match_reference():
+    assert PERIOD_SIZES == {
+        "HourOfDay": 24, "DayOfWeek": 7, "DayOfMonth": 31,
+        "DayOfYear": 366, "MonthOfYear": 12, "WeekOfMonth": 6,
+        "WeekOfYear": 53,
+    }
+
+
+def test_pre_epoch_dates_stay_in_range():
+    ts = np.array([_ms(1969, 12, 31, 23)])
+    assert hour_of_day(ts)[0] == 23
+    assert day_of_week0(ts)[0] == 2  # Wednesday
+    assert day_of_month0(ts)[0] == 30
+    for p, size in PERIOD_SIZES.items():
+        if p == "WeekOfMonth":
+            continue  # raw difference, deliberately unbounded
+        v = period_value(ts, p)[0]
+        assert 0 <= v < size, (p, v)
+
+
+def test_unit_circle_continuity_hour_wrap():
+    """23:xx and 00:xx land adjacent on the circle (the encoding's whole
+    point); integer-hour parity means the circle has 24 discrete points."""
+    late = np.array([_ms(2018, 5, 5, 23)])
+    early = np.array([_ms(2018, 5, 6, 0)])
+    for f in (np.sin, np.cos):
+        a = f(2 * np.pi * period_fraction(late, "HourOfDay"))
+        b = f(2 * np.pi * period_fraction(early, "HourOfDay"))
+        assert abs(a - b) < 2 * np.sin(np.pi / 24) + 1e-9
+
+
+# --- DateList pivots -------------------------------------------------------
+
+
+def _fit_datelist(values, **kw):
+    f = FeatureBuilder(ft.DateList, "dates").as_predictor()
+    vec = DateListVectorizer(**kw).set_input(f).get_output()
+    data = {"dates": values}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    return np.asarray(model.score(data)[vec.name].to_list(), dtype=float), model
+
+
+REF = _ms(2021, 6, 15, 12)  # reference date for Since* pivots
+
+
+def test_since_last_whole_days():
+    vals = [
+        [_ms(2021, 6, 1), _ms(2021, 6, 10)],   # last = Jun 10 -> 5 days
+        [_ms(2021, 6, 14, 13)],                # 0 full days (23h)
+        [],                                    # empty -> fill + null flag
+    ]
+    out, _ = _fit_datelist(vals, pivot="SinceLast", reference_date_ms=REF,
+                           fill_value=-1.0)
+    assert out.shape == (3, 2)  # days + null indicator
+    assert out[0].tolist() == [5.0, 0.0]
+    assert out[1].tolist() == [0.0, 0.0]
+    assert out[2].tolist() == [-1.0, 1.0]
+
+
+def test_since_first_and_future_events_negative():
+    vals = [[_ms(2021, 6, 1), _ms(2021, 6, 10)],
+            [_ms(2021, 6, 20)]]  # after the reference -> negative days
+    out, _ = _fit_datelist(vals, pivot="SinceFirst", reference_date_ms=REF)
+    assert out[0, 0] == 14.0
+    assert out[1, 0] == -4.0
+
+
+def test_mode_day_one_hot_with_tie_to_smallest():
+    monday, tuesday = _ms(2021, 6, 14), _ms(2021, 6, 15)
+    vals = [
+        [monday, monday, tuesday],   # mode Monday
+        [tuesday, monday],           # tie -> smallest (Monday)
+        [],
+    ]
+    out, model = _fit_datelist(vals, pivot="ModeDay", reference_date_ms=REF)
+    assert out.shape == (3, 8)  # 7 days + null
+    assert out[0, :7].tolist() == [1, 0, 0, 0, 0, 0, 0]
+    assert out[1, :7].tolist() == [1, 0, 0, 0, 0, 0, 0]
+    assert out[2].tolist() == [0] * 7 + [1]
+    # metadata names the day columns
+    vec_name = model.result_features[0].name
+    col = model.score({"dates": vals})[vec_name]
+    assert [c.indicator_value for c in col.metadata.columns][:2] == [
+        "Monday", "Tuesday"]
+
+
+def test_mode_month_and_mode_hour():
+    vals = [[_ms(2021, 3, 2), _ms(2021, 3, 9), _ms(2021, 4, 1)]]
+    out, _ = _fit_datelist(vals, pivot="ModeMonth", reference_date_ms=REF,
+                           track_nulls=False)
+    assert out.shape == (1, 12)
+    assert out[0, 2] == 1.0 and out.sum() == 1.0  # March
+    vals = [[_ms(2021, 3, 2, 7), _ms(2021, 3, 9, 7), _ms(2021, 4, 1, 22)]]
+    out, model = _fit_datelist(vals, pivot="ModeHour", reference_date_ms=REF,
+                               track_nulls=False)
+    assert out.shape == (1, 24)
+    assert out[0, 7] == 1.0 and out.sum() == 1.0
+    # hour columns are named like the reference: "0:00".."23:00"
+    # (DateListVectorizer.scala:275)
+    name = model.result_features[0].name
+    col = model.score({"dates": vals})[name]
+    assert col.metadata.columns[7].indicator_value == "7:00"
+
+
+def test_scalar_date_vectorize_includes_days_since():
+    """Scalar Date transmogrification combines the unit circles with the
+    SinceLast days column (RichDateFeature.vectorize:97-110)."""
+    f = FeatureBuilder(ft.Date, "d").as_predictor()
+    vec = DateVectorizer(
+        periods=("HourOfDay",), with_time_since=True,
+        reference_date_ms=REF,
+    ).set_input(f).get_output()
+    data = {"d": [_ms(2021, 6, 10), None]}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    col = model.score(data)[vec.name]
+    out = np.asarray(col.to_list(), dtype=float)
+    assert out.shape == (2, 4)  # sin, cos, days, null
+    assert out[0, 2] == 5.0  # Jun 10 -> Jun 15 reference
+    assert out[1].tolist() == [0.0, 0.0, 0.0, 1.0]
+    descs = [c.descriptor_value for c in col.metadata.columns]
+    assert descs[2] == "SinceLast"
+
+
+def test_invalid_pivot_rejected():
+    with pytest.raises(ValueError, match="pivot"):
+        DateListVectorizer(pivot="SinceForever")
+
+
+def test_transmogrify_routes_datelist():
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    f = FeatureBuilder(ft.DateList, "dates").as_predictor()
+    r = FeatureBuilder(ft.Real, "x").as_predictor()
+    vec = transmogrify([f, r])
+    data = {"dates": [[_ms(2021, 6, 1)], []], "x": [1.0, 2.0]}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    col = model.score(data)[vec.name]
+    out = np.asarray(col.to_list(), dtype=float)
+    assert out.shape[1] >= 4  # since-days + null + real + real-null
+    assert any(c.descriptor_value == "SinceLast" for c in col.metadata.columns)
+
+
+def test_datelist_save_load_roundtrip_pins_reference_date(tmp_path):
+    vals = [[_ms(2021, 6, 1)], [_ms(2021, 6, 10)]]
+    f = FeatureBuilder(ft.DateList, "dates").as_predictor()
+    vec = DateListVectorizer(pivot="SinceLast").set_input(f).get_output()
+    data = {"dates": vals}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    before = model.score(data)[vec.name].to_list()
+    model.save(str(tmp_path / "m"))
+    from transmogrifai_tpu.serialization.model_io import load_model
+
+    f2 = FeatureBuilder(ft.DateList, "dates").as_predictor()
+    vec2 = DateListVectorizer(pivot="SinceLast").set_input(f2).get_output()
+    wf2 = OpWorkflow().set_result_features(vec2).set_input_dataset(data)
+    m2 = load_model(str(tmp_path / "m"), wf2)
+    after = m2.score(data)[vec2.name].to_list()
+    assert before == after  # captured now() must round-trip, not re-capture

@@ -132,7 +132,7 @@ def _patch_feature() -> None:
         RichNumericFeature.vectorize:325, RichTextFeature.vectorize:130,
         RichDateFeature/RichMapFeature/RichSetFeature/.vectorize)."""
         from .ops.categorical import OneHotVectorizer as _OneHot
-        from .ops.dates import DateVectorizer
+        from .ops.dates import DateListVectorizer, DateVectorizer
         from .ops.geo import GeolocationVectorizer
         from .ops.maps import MapVectorizer
         from .ops.numeric import (
@@ -148,7 +148,12 @@ def _patch_feature() -> None:
             stage = MapVectorizer(**kw)
         elif issubclass(t, ft.Geolocation):
             stage = GeolocationVectorizer(**kw)
+        elif issubclass(t, ft.DateList):  # before TextList (both OPList)
+            stage = DateListVectorizer(**kw)
         elif issubclass(t, ft.Date):  # Date/DateTime (subtype of Integral)
+            # reference parity: circular reps + SinceLast days
+            # (RichDateFeature.vectorize:97-110)
+            kw.setdefault("with_time_since", True)
             stage = DateVectorizer(**kw)
         elif issubclass(t, ft.Binary):
             stage = BinaryVectorizer(**kw)

@@ -18,7 +18,7 @@ from ..features.feature import Feature
 from ..types import feature_types as ft
 from .categorical import OneHotVectorizer
 from .combiner import VectorsCombiner
-from .dates import DateVectorizer
+from .dates import DateListVectorizer, DateVectorizer
 from .geo import GeolocationVectorizer
 from .maps import transmogrify_map_group
 from .numeric import (
@@ -41,6 +41,10 @@ class TransmogrifierDefaults:
     track_nulls: bool = True
     clean_text: bool = True
     date_periods: tuple = ("HourOfDay", "DayOfWeek", "DayOfMonth", "WeekOfYear")
+    date_list_pivot: str = "SinceLast"  # DateListDefault, Transmogrifier.scala:57
+    # None = capture fit-time now (TransmogrifierDefaults.ReferenceDate);
+    # pin it for reproducible retrains / golden outputs
+    reference_date_ms: Optional[float] = None
     min_info_gain: float = 0.01  # label-aware auto-bucketize threshold
 
 
@@ -71,6 +75,8 @@ def _group_key(t: Type[ft.FeatureType]) -> str:
         return "real"
     if issubclass(t, _SMART_TEXT_TYPES):
         return "smarttext"
+    if issubclass(t, ft.DateList):  # before TextList (both are OPLists)
+        return "datelist"
     if issubclass(t, ft.TextList):
         return "textlist"
     if issubclass(t, ft.Geolocation):
@@ -135,7 +141,12 @@ def _stage_for(key: str, d: TransmogrifierDefaults):
             track_nulls=d.track_nulls, clean_text=d.clean_text,
         )
     if key == "date":
-        return DateVectorizer(periods=d.date_periods, track_nulls=d.track_nulls)
+        # reference parity: circular reps + days-since-SinceLast
+        # (Transmogrifier.scala:159 via RichDateFeature.vectorize)
+        return DateVectorizer(
+            periods=d.date_periods, track_nulls=d.track_nulls,
+            with_time_since=True, reference_date_ms=d.reference_date_ms,
+        )
     if key == "realnn":
         return RealNNVectorizer()
     if key == "binary":
@@ -150,6 +161,11 @@ def _stage_for(key: str, d: TransmogrifierDefaults):
             top_k=d.top_k, min_support=d.min_support,
             hash_dims=d.hash_dims, track_nulls=d.track_nulls,
             clean_text=d.clean_text,
+        )
+    if key == "datelist":
+        return DateListVectorizer(
+            pivot=d.date_list_pivot, track_nulls=d.track_nulls,
+            reference_date_ms=d.reference_date_ms,
         )
     if key == "textlist":
         return TextListHashingVectorizer(hash_dims=d.hash_dims)
