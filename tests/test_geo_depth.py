@@ -1,0 +1,98 @@
+"""Geolocation vectorizer depth: geographic-centroid fill semantics.
+
+The reference imputes missing triples with the GeolocationMidpoint
+monoid's 3D unit-vector mean, not an arithmetic lat/lon mean (reference:
+GeolocationVectorizer.scala:70-93 'Only supports filling with geographic
+centroid'), and offers constant fill (fillWithConstant, default
+Geolocation(0, 0, Unknown), Transmogrifier.scala:77).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.ops.geo import GeolocationVectorizer, geographic_midpoint
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.workflow import OpWorkflow
+
+
+def _fit(values, **kw):
+    f = FeatureBuilder(ft.Geolocation, "loc").as_predictor()
+    vec = GeolocationVectorizer(**kw).set_input(f).get_output()
+    data = {"loc": values}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    return np.asarray(model.score(data)[vec.name].to_list(), dtype=float)
+
+
+def test_fill_uses_geographic_centroid_across_dateline():
+    """Points at +179 and -179 longitude must fill near 180, not 0 —
+    the arithmetic mean lands on the wrong side of the planet."""
+    vals = [(10.0, 179.0, 1.0), (10.0, -179.0, 3.0), None]
+    out = _fit(vals, track_nulls=True)
+    assert out.shape == (3, 4)
+    fill_lat, fill_lon = out[2, 0], out[2, 1]
+    assert abs(abs(fill_lon) - 180.0) < 1e-6
+    assert fill_lat == pytest.approx(10.0, abs=0.1)
+    assert out[2, 2] == pytest.approx(2.0)  # accuracy averages plainly
+    assert out[2, 3] == 1.0  # null indicator
+    assert out[0, 3] == 0.0
+
+
+def test_constant_fill():
+    vals = [(40.0, -75.0, 1.0), None]
+    out = _fit(vals, fill_with_constant=True, fill_value=(37.0, -122.0, 5.0))
+    assert out[1, :3].tolist() == [37.0, -122.0, 5.0]
+    out0 = _fit(vals, fill_with_constant=True)
+    assert out0[1, :3].tolist() == [0.0, 0.0, 0.0]  # DefaultGeolocation
+
+
+def test_midpoint_helper_matches_aggregator_single_point():
+    mid = geographic_midpoint(np.array([[48.85, 2.35, 2.0]]))
+    assert mid[0] == pytest.approx(48.85, abs=1e-9)
+    assert mid[1] == pytest.approx(2.35, abs=1e-9)
+    assert mid[2] == pytest.approx(2.0)
+
+
+def test_midpoint_helper_matches_monoid_aggregator(rng=np.random.RandomState(7)):
+    """The vectorized fit-path helper and the event-aggregation monoid are
+    the same math — pin them against each other on random points."""
+    from transmogrifai_tpu.features.aggregators import GeolocationMidpoint
+
+    pts = np.column_stack([
+        rng.uniform(-80, 80, 50), rng.uniform(-180, 180, 50),
+        rng.uniform(0, 10, 50),
+    ])
+    fast = geographic_midpoint(pts)
+    slow = GeolocationMidpoint().aggregate([list(p) for p in pts])
+    np.testing.assert_allclose(fast, slow, atol=1e-9)
+
+
+def test_bad_constant_fill_fails_fast():
+    with pytest.raises(ValueError, match="lat, lon, accuracy"):
+        GeolocationVectorizer(fill_with_constant=True,
+                              fill_value=(37.0, -122.0))
+
+
+def test_geo_map_key_fill_uses_centroid():
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+
+    f = FeatureBuilder(ft.GeolocationMap, "g").as_predictor()
+    vec = MapVectorizer().set_input(f).get_output()
+    data = {"g": [
+        {"home": (0.0, 179.0, 1.0)},
+        {"home": (0.0, -179.0, 1.0)},
+        {},
+    ]}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    col = model.score(data)[vec.name]
+    out = np.asarray(col.to_list(), dtype=float)
+    lon_idx = next(
+        j for j, c in enumerate(col.metadata.columns)
+        if c.descriptor_value == "lon"
+    )
+    assert abs(abs(out[2, lon_idx]) - 180.0) < 1e-6

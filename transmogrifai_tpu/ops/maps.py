@@ -237,9 +237,13 @@ class MapVectorizer(SequenceVectorizer):
                         {"key": key, "kind": "date", "periods": self.date_periods}
                     )
                 elif issubclass(vt, ft.Geolocation):
+                    from .geo import geographic_midpoint
+
                     vals = [v for v in _key_values(col, key) if v is not None]
                     fill = (
-                        np.mean([list(v)[:3] for v in vals], axis=0)
+                        geographic_midpoint(
+                            np.array([list(v)[:3] for v in vals])
+                        )
                         if vals else np.zeros(3)
                     ).tolist()
                     feature_plans.append({"key": key, "kind": "geo", "fill": fill})
