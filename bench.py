@@ -614,6 +614,26 @@ def main() -> None:
         bench_commit = _git.stdout.strip() or "unknown"
     except Exception:
         bench_commit = "unknown"
+    def _checkpoint(res: dict) -> None:
+        """Persist the partial result after every section: a tunnel wedge
+        (or the watcher's subprocess timeout) mid-run must not destroy the
+        sections already measured.  The stdout contract (ONE final JSON
+        line) is unchanged; this is a side file."""
+        path = os.environ.get(
+            "TX_BENCH_PARTIAL_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "TPU_EVIDENCE_bench_partial.json"),
+        )
+        try:
+            snap = dict(res, partial_wall_s=round(time.time() - t_start, 1))
+            snap["partial"] = snap.get("partial", True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(snap) + "\n")
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
     result = {
         "metric": "titanic_cv_holdout_auroc",
         "value": auroc,
@@ -635,23 +655,30 @@ def main() -> None:
     fb = os.environ.get("TX_BENCH_FALLBACK_REASON")
     if fb:
         result["platform_fallback_reason"] = fb
+    _checkpoint(result)
     try:
         _default_grid_section(result)
     except Exception as e:
         result["default_grid_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
     _boston_iris_sections(result)
+    _checkpoint(result)
     try:
         _synth_section(result)
     except Exception as e:  # synth is best-effort; Titanic is THE metric
         result["synth_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
     try:
         _synth2m_section(result)
     except Exception as e:
         result["synth2m_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
     try:
         _ingest_section(result)
     except Exception as e:
         result["ingest_error"] = f"{type(e).__name__}: {e}"
+    result["partial"] = False
+    _checkpoint(result)
     print(json.dumps(result))
 
 

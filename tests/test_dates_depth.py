@@ -71,6 +71,29 @@ def test_calendar_fields_match_stdlib(date):
     assert hour_of_day(ts)[0] == 13
 
 
+def test_calendar_fields_random_sweep_vs_stdlib():
+    """500 random dates 1950-2050: every exact field agrees with python's
+    datetime/isocalendar (vectorized batch, one call per field)."""
+    rng = np.random.RandomState(11)
+    days = rng.randint(-7305, 29220, size=500)  # 1950..2050 in epoch days
+    hours = rng.randint(0, 24, size=500)
+    ts = days * MS_PER_DAY + hours * 3600_000.0
+    dom = day_of_month0(ts)
+    moy = month_of_year0(ts)
+    dow = day_of_week0(ts)
+    doy = day_of_year0(ts)
+    week = iso_week_of_year(ts)
+    hod = hour_of_day(ts)
+    for i, (d, h) in enumerate(zip(days, hours)):
+        py = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(d))
+        assert dom[i] == py.day - 1
+        assert moy[i] == py.month - 1
+        assert dow[i] == py.weekday()
+        assert doy[i] == py.timetuple().tm_yday - 1
+        assert week[i] == py.isocalendar()[1]
+        assert hod[i] == h
+
+
 def test_iso_week_boundary_cases():
     """2019-12-30 (Mon) is week 1 of ISO year 2020; 2021-01-01 (Fri) is
     week 53 of ISO year 2020 — the Thursday rule."""
