@@ -1,0 +1,99 @@
+"""Map vectorizer key-handling depth.
+
+Reference semantics (OPMapVectorizer.scala:77-130, MapVectorizerFuns):
+keys optionally cleaned (whitespace), filtered by white/blacklists at fit
+time; fitted key set is FROZEN - keys first seen at scoring time are
+ignored, keys missing in a row impute like nulls.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.ops.maps import MapVectorizer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.workflow import OpWorkflow
+
+
+def _fit(values, map_type=ft.RealMap, **kw):
+    f = FeatureBuilder(map_type, "m").as_predictor()
+    vec = MapVectorizer(**kw).set_input(f).get_output()
+    data = {"m": values}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    col = model.score(data)[vec.name]
+    return np.asarray(col.to_list(), dtype=float), col.metadata, model, vec
+
+
+def test_block_keys_removed_allow_keys_filter():
+    vals = [{"a": 1.0, "b": 2.0, "c": 3.0}, {"a": 4.0, "c": 5.0}]
+    out, meta, _, _ = _fit(vals, block_keys=["b"], track_nulls=False)
+    groups = {c.grouping for c in meta.columns}
+    assert groups == {"a", "c"}
+    out2, meta2, _, _ = _fit(vals, allow_keys=["a"], track_nulls=False)
+    assert {c.grouping for c in meta2.columns} == {"a"}
+    assert out2.shape[1] == 1
+
+
+def test_block_and_allow_lists_live_in_cleaned_key_space():
+    """Whitespace-padded allow/block entries must still filter when keys
+    are cleaned (' b ' blocks the cleaned 'b')."""
+    vals = [{" b ": 1.0, "a": 2.0}, {"b": 3.0, "a": 4.0}]
+    _, meta, _, _ = _fit(vals, block_keys=[" b "], track_nulls=False)
+    assert {c.grouping for c in meta.columns} == {"a"}
+    _, meta2, _, _ = _fit(vals, allow_keys=[" b "], track_nulls=False)
+    assert {c.grouping for c in meta2.columns} == {"b"}
+
+
+def test_key_whitespace_cleaning_merges_keys():
+    vals = [{" a ": 1.0}, {"a": 3.0}]
+    out, meta, _, _ = _fit(vals, clean_keys=True, track_nulls=False)
+    assert {c.grouping for c in meta.columns} == {"a"}
+    assert out[:, 0].tolist() == [1.0, 3.0]
+    # cleaning off: distinct keys, each missing in the other row
+    out2, meta2, _, _ = _fit(vals, clean_keys=False, track_nulls=False)
+    assert {c.grouping for c in meta2.columns} == {" a ", "a"}
+
+
+def test_unseen_scoring_keys_are_ignored_fitted_keys_frozen():
+    vals = [{"a": 1.0}, {"a": 2.0}]
+    _, meta, model, vec = _fit(vals, track_nulls=False)
+    scored = model.score({"m": [{"a": 7.0, "brand_new": 9.0}]})
+    out = np.asarray(scored[vec.name].to_list(), dtype=float)
+    assert out.shape == (1, 1)  # brand_new silently dropped
+    assert out[0, 0] == 7.0
+
+
+def test_missing_key_imputes_mean_with_null_indicator():
+    vals = [{"a": 2.0}, {"a": 4.0}, {}]
+    out, meta, _, _ = _fit(vals, track_nulls=True)
+    cols = list(meta.columns)
+    val_idx = next(i for i, c in enumerate(cols) if not c.is_null_indicator)
+    null_idx = next(i for i, c in enumerate(cols) if c.is_null_indicator)
+    assert out[2, val_idx] == pytest.approx(3.0)  # mean of 2, 4
+    assert out[:, null_idx].tolist() == [0.0, 0.0, 1.0]
+
+
+def test_picklist_map_keys_pivot_topk():
+    vals = [{"color": "red"}, {"color": "red"}, {"color": "blue"}, {}]
+    out, meta, _, _ = _fit(
+        vals, map_type=ft.PickListMap, top_k=10, min_support=1,
+        track_nulls=True,
+    )
+    labels = [c.indicator_value for c in meta.columns]
+    assert "red" in labels and "blue" in labels
+    # rows one-hot over the pivot labels; empty row hits the null slot
+    null_idx = next(
+        i for i, c in enumerate(meta.columns) if c.is_null_indicator
+    )
+    assert out[3, null_idx] == 1.0
+
+
+def test_binary_map_keys_impute_mode():
+    vals = [{"f": True}, {"f": True}, {"f": False}, {}]
+    out, meta, _, _ = _fit(vals, map_type=ft.BinaryMap, track_nulls=True)
+    cols = list(meta.columns)
+    val_idx = next(i for i, c in enumerate(cols) if not c.is_null_indicator)
+    assert out[3, val_idx] == 1.0  # mode of {1,1,0}

@@ -32,8 +32,28 @@ from .dates import period_fraction
 from .vectorizer_base import SequenceVectorizer, SequenceVectorizerModel
 
 
-def _clean_key(k: str, clean_keys: bool) -> str:
-    return k.strip() if clean_keys else k
+def _clean_key(k, clean_keys: bool = True):
+    return k.strip() if clean_keys and isinstance(k, str) else k
+
+
+def _cleaned_col(col: MapColumn, clean_keys: bool) -> MapColumn:
+    """Key-cleaned view of a map column; BOTH fit and transform must read
+    through this so ' a ' and 'a' merge into one fitted key (reference:
+    cleanKeys in OPMapVectorizer.scala:77 applied via cleanMap on every
+    pass).  Returns the column unchanged when no key needs cleaning."""
+    if not clean_keys:
+        return col
+    changed = False
+    rows = []
+    for d in col.values:
+        nd = {}
+        for k, v in d.items():
+            ck = _clean_key(k)
+            if ck != k:
+                changed = True
+            nd[ck] = v
+        rows.append(nd)
+    return MapColumn(rows, col.feature_type) if changed else col
 
 
 def _key_values(col: MapColumn, key: str) -> list:
@@ -52,11 +72,12 @@ class MapVectorizerModel(SequenceVectorizerModel):
     {"key", "kind", "fill", "labels", "periods"}."""
 
     def __init__(self, plans: Sequence[list[dict]], track_nulls: bool,
-                 clean_text: bool, **kw) -> None:
+                 clean_text: bool, clean_keys: bool = True, **kw) -> None:
         super().__init__(**kw)
         self.plans = list(plans)
         self.track_nulls = track_nulls
         self.clean_text = clean_text
+        self.clean_keys = clean_keys
 
     def _plan_state(self, i: int) -> tuple:
         """Hashable digest of every fitted field the metas derive from
@@ -70,6 +91,7 @@ class MapVectorizerModel(SequenceVectorizerModel):
 
     def blocks_for(self, col: Column, i: int):
         assert isinstance(col, MapColumn)
+        col = _cleaned_col(col, getattr(self, "clean_keys", True))
         feat = self.input_features[i]
         tname = feat.ftype.type_name()
         blocks: list[np.ndarray] = []
@@ -211,8 +233,14 @@ class MapVectorizer(SequenceVectorizer):
         self.track_nulls = track_nulls
         self.clean_text = clean_text
         self.clean_keys = clean_keys
-        self.allow_keys = set(allow_keys) if allow_keys else None
-        self.block_keys = set(block_keys or ())
+        # allow/block entries must live in the same (cleaned) key space the
+        # fitted keys do, or whitespace-padded entries silently stop
+        # filtering once the column is cleaned
+        self.allow_keys = (
+            {_clean_key(k, clean_keys) for k in allow_keys}
+            if allow_keys else None
+        )
+        self.block_keys = {_clean_key(k, clean_keys) for k in (block_keys or ())}
         self.date_periods = tuple(date_periods)
         self.max_cardinality = max_cardinality
         self.hash_dims = hash_dims
@@ -228,6 +256,7 @@ class MapVectorizer(SequenceVectorizer):
         plans = []
         for i, col in enumerate(cols):
             assert isinstance(col, MapColumn)
+            col = _cleaned_col(col, self.clean_keys)
             vt = self.input_features[i].ftype.value_type or ft.Real
             feature_plans = []
             hash_keys: list[str] = []
@@ -283,7 +312,10 @@ class MapVectorizer(SequenceVectorizer):
                     "seed": self.seed,
                 })
             plans.append(feature_plans)
-        return MapVectorizerModel(plans, self.track_nulls, self.clean_text)
+        return MapVectorizerModel(
+            plans, self.track_nulls, self.clean_text,
+            clean_keys=self.clean_keys,
+        )
 
 
 def transmogrify_map_group(feats: Sequence[Feature], defaults) -> Feature:
