@@ -25,7 +25,7 @@ from typing import Any, Iterable, Mapping, Optional, Sequence
 from ..models.base import PredictorModel
 from ..types.columns import column_from_list
 from ..types.dataset import Dataset
-from ..workflow.workflow import OpWorkflowModel, apply_transformations_dag
+from ..workflow.workflow import OpWorkflowModel
 
 
 class LocalScorer:
@@ -49,6 +49,23 @@ class LocalScorer:
                     stage.prefer_numpy = True
                 new_layer.append(stage)
             self._dag.append(new_layer)
+        # the per-request hot loop is precompiled: stage order flattened,
+        # input/output names resolved once (output_name walks get_output()
+        # per call), transformer-ness validated here instead of per row
+        from ..stages.base import Transformer
+
+        self._steps = []
+        for layer in self._dag:
+            for stage in layer:
+                if not isinstance(stage, Transformer):
+                    raise ValueError(
+                        f"cannot score with unfitted estimator {stage.uid}; "
+                        "train first"
+                    )
+                self._steps.append(
+                    (stage, [f.name for f in stage.input_features],
+                     stage.output_name)
+                )
 
     # -- scoring ------------------------------------------------------------
     def score_batch(
@@ -61,12 +78,30 @@ class LocalScorer:
             )
             for f in self.raw_features
         }
-        out = apply_transformations_dag(self._dag, Dataset(cols))
+        # mutate the scorer-owned Dataset in place: the functional
+        # with_column path re-validates and copies the whole column dict
+        # per stage (~16 Dataset builds per scored row), half the serving
+        # latency at profile
+        out = Dataset(cols)
+        for stage, in_names, out_name in self._steps:
+            out.set_column(
+                out_name,
+                stage.transform_columns([out[n] for n in in_names], out),
+                validate=False,
+            )
         names = [f.name for f in self.result_features if f.name in out]
-        lists = {name: out[name].to_list() for name in names}
+        n = len(records)
+        lists = {}
+        for name in names:
+            vals = out[name].to_list()
+            if len(vals) != n:  # the validate=False escape hatch's guard
+                raise ValueError(
+                    f"result column {name!r} has {len(vals)} rows for "
+                    f"{n} scored records"
+                )
+            lists[name] = vals
         return [
-            {name: lists[name][i] for name in names}
-            for i in range(len(records))
+            {name: lists[name][i] for name in names} for i in range(n)
         ]
 
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:

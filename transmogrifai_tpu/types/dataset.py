@@ -47,6 +47,18 @@ class Dataset:
     def columns(self) -> Dict[str, Column]:
         return dict(self._columns)
 
+    def set_column(self, name: str, col: Column,
+                   validate: bool = True) -> None:
+        """In-place column write for OWNED datasets (the serving hot loop:
+        the functional with_column path rebuilds the dict and re-validates
+        every column per stage).  validate=False skips the length check -
+        callers own the no-ragged invariant and must re-check results."""
+        if validate and self._columns and len(col) != len(self):
+            raise ValueError(
+                f"column {name!r} has {len(col)} rows, dataset has {len(self)}"
+            )
+        self._columns[name] = col
+
     # -- functional updates -------------------------------------------------
     def with_column(self, name: str, col: Column) -> "Dataset":
         if self._columns and len(col) != len(self):
