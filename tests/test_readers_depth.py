@@ -1,0 +1,140 @@
+"""Conditional + joined reader depth.
+
+Reference semantics: ConditionalDataReader cuts each key at its first (or
+last) event matching targetCondition, keys with no match are dropped
+(DataReader.scala:283-345), responses confined to responseWindow after
+the cutoff; JoinedDataReader inner/left/outer joins align on the
+aggregation key with nulls for unmatched rows (JoinedDataReader.scala:
+124-214).
+"""
+from __future__ import annotations
+
+import pytest
+
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.readers.events import (
+    AggregateReader,
+    ConditionalReader,
+    JoinedReader,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+EVENTS = [
+    {"u": "a", "ts": 1.0, "page": "home", "spend": 1.0},
+    {"u": "a", "ts": 5.0, "page": "buy", "spend": 10.0},   # condition
+    {"u": "a", "ts": 6.0, "page": "home", "spend": 2.0},
+    {"u": "a", "ts": 50.0, "page": "home", "spend": 4.0},  # beyond window
+    {"u": "b", "ts": 2.0, "page": "home", "spend": 7.0},   # never converts
+    {"u": "c", "ts": 3.0, "page": "buy", "spend": 5.0},    # converts at first event
+    {"u": "c", "ts": 9.0, "page": "buy", "spend": 6.0},    # second match ignored (use_first)
+]
+
+
+def _features():
+    pre = FeatureBuilder(ft.Real, "spend").extract(
+        lambda r: r["spend"]
+    ).as_predictor()
+    post = FeatureBuilder(ft.Real, "spend_after").extract(
+        lambda r: r["spend"]
+    ).as_response()
+    return pre, post
+
+
+def _reader(**kw):
+    return ConditionalReader(
+        EVENTS, key_fn=lambda r: r["u"], time_fn=lambda r: r["ts"],
+        target_condition=lambda r: r["page"] == "buy", **kw
+    )
+
+
+def test_conditional_drops_keys_without_condition():
+    r = _reader()
+    assert r.row_keys() == ["a", "c"]  # b never matched
+
+
+def test_conditional_keeps_unmatched_keys_when_not_dropping():
+    r = _reader(drop_if_no_condition=False)
+    assert r.row_keys() == ["a", "b", "c"]
+    pre, post = _features()
+    ds = r.generate_dataset([pre, post])
+    i = r.row_keys().index("b")
+    # no cutoff for b: everything is both predictor- and response-side
+    assert ds["spend"].values[i] == 7.0
+
+
+def test_conditional_cutoff_splits_predictors_and_responses():
+    pre, post = _features()
+    r = _reader(response_window=10.0)
+    ds = r.generate_dataset([pre, post])
+    keys = r.row_keys()
+    a = keys.index("a")
+    # predictors strictly before ts=5 (the buy): only ts=1 -> 1.0
+    assert ds["spend"].values[a] == 1.0
+    # responses in [5, 15]: 10 + 2; the ts=50 event is out of window
+    assert ds["spend_after"].values[a] == 12.0
+
+
+def test_conditional_first_vs_last_match():
+    pre, post = _features()
+    r_last = _reader(use_first=False, response_window=100.0)
+    ds = r_last.generate_dataset([pre, post])
+    c = r_last.row_keys().index("c")
+    # cutoff at the LAST buy (ts=9): predictor side sums ts=3 event
+    assert ds["spend"].values[c] == 5.0
+    assert ds["spend_after"].values[c] == 6.0
+
+
+def test_conditional_key_converting_at_first_event_has_null_predictors():
+    pre, post = _features()
+    r = _reader(response_window=100.0)
+    ds = r.generate_dataset([pre, post])
+    c = r.row_keys().index("c")
+    assert not ds["spend"].mask[c]  # nothing strictly before the cutoff
+    assert ds["spend_after"].values[c] == 11.0  # both buys in window
+
+
+# --- joins -----------------------------------------------------------------
+
+
+def _join_readers():
+    sends = [
+        {"u": "a", "ts": 1.0, "n": 1.0},
+        {"u": "a", "ts": 2.0, "n": 1.0},
+        {"u": "b", "ts": 1.5, "n": 1.0},
+    ]
+    clicks = [
+        {"u": "a", "ts": 1.1, "c": 1.0},
+        {"u": "z", "ts": 1.2, "c": 1.0},  # clicker with no sends
+    ]
+    l = AggregateReader(sends, key_fn=lambda r: r["u"], time_fn=lambda r: r["ts"])
+    r = AggregateReader(clicks, key_fn=lambda r: r["u"], time_fn=lambda r: r["ts"])
+    n = FeatureBuilder(ft.Real, "n").extract(lambda rec: rec.get("n")).as_predictor()
+    c = FeatureBuilder(ft.Real, "c").extract(lambda rec: rec.get("c")).as_predictor()
+    return l, r, n, c
+
+
+@pytest.mark.parametrize("join_type,expect_pattern", [
+    # (n present, c present) per joined row, as a sorted multiset:
+    ("inner", [(True, True)]),                           # a only
+    ("left", [(True, False), (True, True)]),             # a, b
+    ("outer", [(False, True), (True, False), (True, True)]),  # a, b, z
+])
+def test_join_types_null_patterns(join_type, expect_pattern):
+    l, r, n, c = _join_readers()
+    jr = JoinedReader(l, r, left_key="u", join_type=join_type)
+    ds = jr.generate_dataset([n, c])
+    n_col, c_col = ds["n"], ds["c"]
+    pattern = sorted(zip(n_col.mask.tolist(), c_col.mask.tolist()))
+    assert pattern == sorted(expect_pattern)
+
+
+def test_left_join_nulls_for_unmatched_right():
+    l, r, n, c = _join_readers()
+    jr = JoinedReader(l, r, left_key="u", join_type="left")
+    ds = jr.generate_dataset([n, c])
+    n_col, c_col = ds["n"], ds["c"]
+    vals = sorted(zip(n_col.mask.tolist(), c_col.mask.tolist()))
+    # user b: has sends (n=1), no clicks -> c null
+    assert (True, False) in vals
+    # user a: both sides present
+    assert (True, True) in vals

@@ -1,0 +1,123 @@
+"""SmartTextVectorizer boundary + TextStats monoid depth.
+
+Reference semantics (SmartTextVectorizer.scala:79-99): per-feature
+TextStats value counts decide pivot-vs-hash at EXACTLY maxCardinality
+(<= pivots, > hashes); hashing is seeded and deterministic; the stats
+monoid caps accumulation for huge-cardinality features.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.ops.text import SmartTextVectorizer, TextStats
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.workflow import OpWorkflow
+
+
+def _fit(values, **kw):
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    vec = SmartTextVectorizer(**kw).set_input(f).get_output()
+    data = {"t": values}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    col = model.score(data)[vec.name]
+    return np.asarray(col.to_list(), dtype=float), col.metadata, model, vec
+
+
+def test_cardinality_boundary_pivots_at_max_hashes_above():
+    vals_at = [f"v{i}" for i in range(5)] * 4  # 5 distinct
+    out, meta, _, _ = _fit(vals_at, max_cardinality=5, top_k=10,
+                           min_support=1, hash_dims=16)
+    labels = {c.indicator_value for c in meta.columns if c.indicator_value}
+    assert {"v0", "v1", "v2", "v3", "v4"} <= labels  # pivoted
+    vals_above = [f"v{i}" for i in range(6)] * 4  # 6 distinct > 5
+    out2, meta2, _, _ = _fit(vals_above, max_cardinality=5, top_k=10,
+                             min_support=1, hash_dims=16)
+    descs = {c.descriptor_value for c in meta2.columns if c.descriptor_value}
+    assert any(d.startswith("hash_") for d in descs)  # hashed
+    assert out2.shape[1] == 17  # 16 hash dims + null indicator
+
+
+def test_all_null_column_pivots_to_other_plus_null_indicator():
+    """No labels survive, but the pivot keeps its Other column (reference
+    one-hot always emits Other+Null, OpOneHotVectorizer semantics)."""
+    out, meta, _, _ = _fit([None, None, None], min_support=1)
+    assert out.shape == (3, 2)
+    assert [c.indicator_value for c in meta.columns][0] == "OTHER"
+    assert meta.columns[1].is_null_indicator
+    assert out[:, 0].tolist() == [0.0, 0.0, 0.0]  # nulls are not Other
+    assert out[:, 1].tolist() == [1.0, 1.0, 1.0]
+
+
+def test_hash_mode_deterministic_across_refits():
+    vals = [f"tok{i} tok{i+1} common" for i in range(40)]
+    out1, _, _, _ = _fit(vals, max_cardinality=3, hash_dims=32)
+    out2, _, _, _ = _fit(vals, max_cardinality=3, hash_dims=32)
+    np.testing.assert_array_equal(out1, out2)
+
+
+def test_hash_mode_survives_save_load(tmp_path):
+    from transmogrifai_tpu.serialization.model_io import load_model
+
+    vals = [f"text number {i}" for i in range(50)]
+    f = FeatureBuilder(ft.Text, "t").as_predictor()
+    vec = SmartTextVectorizer(max_cardinality=3, hash_dims=32).set_input(f).get_output()
+    data = {"t": vals}
+    model = (
+        OpWorkflow().set_result_features(vec).set_input_dataset(data).train()
+    )
+    before = model.score(data)[vec.name].to_list()
+    model.save(str(tmp_path / "m"))
+    f2 = FeatureBuilder(ft.Text, "t").as_predictor()
+    vec2 = SmartTextVectorizer(max_cardinality=3, hash_dims=32).set_input(f2).get_output()
+    wf2 = OpWorkflow().set_result_features(vec2).set_input_dataset(data)
+    m2 = load_model(str(tmp_path / "m"), wf2)
+    assert m2.score(data)[vec2.name].to_list() == before
+
+
+def test_textstats_cap_stops_accumulating_but_counts_known_values():
+    st = TextStats(max_card=3)
+    for v in ("a", "b", "c", "d"):
+        st.update(v)
+    # cap is max_card + 1 distinct (the reference's early-stop contract)
+    assert st.cardinality == 4
+    st.update("e")  # beyond cap: new values ignored
+    assert st.cardinality == 4
+    st.update("a")  # known values still count
+    assert st.value_counts["a"] == 2
+    assert st.n_present == 6  # presence counts everything
+
+
+def test_textstats_merge_combines_counts():
+    a, b = TextStats(), TextStats()
+    for v in ("x", "x", "y"):
+        a.update(v)
+    for v in ("y", "z"):
+        b.update(v)
+    a.merge(b)
+    assert a.value_counts == {"x": 2, "y": 2, "z": 1}
+    assert a.n_present == 5
+
+
+def test_textstats_merge_respects_cap():
+    """Merging partition partials must not re-grow unbounded cardinality:
+    the cap applies to the merge path too."""
+    a, b = TextStats(max_card=2), TextStats(max_card=2)
+    for v in ("a", "b", "c"):  # fills a to its cap (max_card + 1)
+        a.update(v)
+    for v in ("d", "e", "a"):
+        b.update(v)
+    a.merge(b)
+    assert a.cardinality == 3  # d/e dropped, known 'a' still counted
+    assert a.value_counts["a"] == 2
+    assert a.n_present == 6
+
+
+def test_min_support_filters_pivot_labels():
+    vals = ["common"] * 10 + ["rare"]
+    out, meta, _, _ = _fit(vals, max_cardinality=30, top_k=20, min_support=2)
+    labels = {c.indicator_value for c in meta.columns if c.indicator_value}
+    assert "common" in labels
+    assert "rare" not in labels  # below minSupport -> Other bucket

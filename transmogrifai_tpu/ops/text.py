@@ -119,7 +119,12 @@ class TextStats:
         return len(self.value_counts)
 
     def merge(self, other: "TextStats") -> "TextStats":
-        self.value_counts.update(other.value_counts)
+        # the cap applies on the distributed-merge path too, or combining
+        # partition partials re-grows unbounded cardinality
+        for v, c in other.value_counts.items():
+            if (len(self.value_counts) <= self.max_card
+                    or v in self.value_counts):
+                self.value_counts[v] += c
         self.n_present += other.n_present
         return self
 
