@@ -1,0 +1,139 @@
+"""Whole-workflow fuzz: random schemas through the full AutoML path.
+
+The contract harness stresses stages in isolation; this suite stresses
+their COMPOSITION the way the reference's integration tests do
+(OpWorkflowTest + the helloworld apps): a random feature set covering
+every major type family -> transmogrify -> sanity check -> selector ->
+score -> save/load -> bit-identical rescore, across seeds and null
+densities.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import dsl  # noqa: F401 - activates feature DSL
+from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.features.feature_builder import FeatureBuilder
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.selector.model_selector import ModelSelector
+from transmogrifai_tpu.selector.validator import OpTrainValidationSplit
+from transmogrifai_tpu.serialization.model_io import load_model
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.workflow import OpWorkflow
+
+_MS0 = _dt.datetime(2021, 1, 1, tzinfo=_dt.timezone.utc).timestamp() * 1000.0
+_DAY_MS = 86400_000.0
+
+
+def _random_data(rng: np.random.RandomState, n: int, p_null: float):
+    """One row dict per raw feature name, covering the type families."""
+    def maybe(v):
+        return None if rng.rand() < p_null else v
+
+    colors = ["red", "green", "blue", "teal"]
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+    data = {
+        "amount": [maybe(float(rng.randn() * 10 + 50)) for _ in range(n)],
+        "count": [maybe(int(rng.randint(0, 9))) for _ in range(n)],
+        "flag": [maybe(bool(rng.rand() < 0.5)) for _ in range(n)],
+        "color": [maybe(colors[rng.randint(len(colors))]) for _ in range(n)],
+        "note": [
+            maybe(" ".join(words[rng.randint(len(words))] for _ in range(4)))
+            for _ in range(n)
+        ],
+        "joined": [
+            maybe(_MS0 + float(rng.randint(0, 400)) * _DAY_MS)
+            for _ in range(n)
+        ],
+        "visits": [
+            maybe([_MS0 + float(rng.randint(0, 100)) * _DAY_MS
+                   for _ in range(rng.randint(0, 4))])
+            for _ in range(n)
+        ],
+        "site": [
+            maybe((float(rng.uniform(-60, 60)),
+                   float(rng.uniform(-179, 179)), 1.0))
+            for _ in range(n)
+        ],
+        "attrs": [
+            {k: float(rng.randn())
+             for k in ("height", "width") if rng.rand() > p_null}
+            for _ in range(n)
+        ],
+        "tags": [
+            maybe(frozenset(colors[rng.randint(len(colors))]
+                            for _ in range(rng.randint(0, 3))))
+            for _ in range(n)
+        ],
+    }
+    # a learnable label: depends on amount + flag
+    amounts = [v if v is not None else 50.0 for v in data["amount"]]
+    flags = [1.0 if v else 0.0 for v in data["flag"]]
+    z = np.asarray(amounts) / 20.0 + np.asarray(flags) - 3.0
+    data["label"] = (1 / (1 + np.exp(-z)) > rng.rand(n)).astype(float).tolist()
+    return data
+
+
+def _features():
+    return [
+        FeatureBuilder(ft.Real, "amount").as_predictor(),
+        FeatureBuilder(ft.Integral, "count").as_predictor(),
+        FeatureBuilder(ft.Binary, "flag").as_predictor(),
+        FeatureBuilder(ft.PickList, "color").as_predictor(),
+        FeatureBuilder(ft.Text, "note").as_predictor(),
+        FeatureBuilder(ft.Date, "joined").as_predictor(),
+        FeatureBuilder(ft.DateList, "visits").as_predictor(),
+        FeatureBuilder(ft.Geolocation, "site").as_predictor(),
+        FeatureBuilder(ft.RealMap, "attrs").as_predictor(),
+        FeatureBuilder(ft.MultiPickList, "tags").as_predictor(),
+    ]
+
+
+@pytest.mark.parametrize("seed,p_null", [(1, 0.1), (2, 0.35), (3, 0.02)])
+def test_full_pipeline_fuzz(tmp_path, seed, p_null):
+    rng = np.random.RandomState(seed)
+    n = 120
+    data = _random_data(rng, n, p_null)
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        checked = label.sanity_check(vec, remove_bad_features=True)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+        )
+        pred = selector.set_input(label, checked).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    scored = model.score(data)[pred.name].to_list()
+    assert len(scored) == n
+    probs = [r["probability_1"] for r in scored]
+    assert all(0.0 <= p <= 1.0 for p in probs)
+    # the label depends on amount+flag: the fit must beat chance in-sample
+    m = model.evaluate(OpBinaryClassificationEvaluator())
+    assert float(m.AuROC) > 0.55, float(m.AuROC)
+
+    # save / load into a freshly built identical workflow -> bit-identical
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    rescored = m2.score(data)[pred2.name].to_list()
+    assert rescored == scored
+
+    # unseen data with fresh nulls scores without error, identical between
+    # the original and the loaded model
+    unseen = _random_data(np.random.RandomState(seed + 100), 40, p_null)
+    a = model.score(unseen)[pred.name].to_list()
+    b = m2.score(unseen)[pred2.name].to_list()
+    assert a == b
