@@ -137,3 +137,92 @@ def test_full_pipeline_fuzz(tmp_path, seed, p_null):
     a = model.score(unseen)[pred.name].to_list()
     b = m2.score(unseen)[pred2.name].to_list()
     assert a == b
+
+
+def test_multiclass_pipeline_fuzz(tmp_path):
+    """Same random schema, 3-class label through the multiclass selector
+    (stratified CV + DataCutter + softmax LR)."""
+    from transmogrifai_tpu.evaluators.multiclass import (
+        OpMultiClassificationEvaluator,
+    )
+    from transmogrifai_tpu.selector.factories import (
+        MultiClassificationModelSelector,
+    )
+
+    rng = np.random.RandomState(7)
+    n = 150
+    data = _random_data(rng, n, 0.1)
+    amounts = np.asarray(
+        [v if v is not None else 50.0 for v in data["amount"]]
+    )
+    data["label"] = np.digitize(amounts, [45.0, 55.0]).astype(float).tolist()
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = MultiClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models_and_parameters=[
+                (OpLogisticRegression(), [{"reg_param": 0.01}]),
+            ],
+        )
+        pred = selector.set_input(label, vec).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    scored = model.score(data)[pred.name].to_list()
+    # jointly-normalized class probabilities (multinomial family)
+    for r in scored[:20]:
+        ps = [v for k, v in r.items() if k.startswith("probability_")]
+        assert len(ps) == 3
+        assert abs(sum(ps) - 1.0) < 1e-6
+    m = model.evaluate(OpMultiClassificationEvaluator())
+    assert float(m.F1) > 0.5
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
+def test_regression_pipeline_fuzz(tmp_path):
+    """Continuous label through the regression selector (no balancing,
+    DataSplitter prep) - regression CV must stay on the batched path."""
+    from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+    from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+    from transmogrifai_tpu.selector.factories import RegressionModelSelector
+
+    rng = np.random.RandomState(11)
+    n = 150
+    data = _random_data(rng, n, 0.1)
+    amounts = np.asarray(
+        [v if v is not None else 50.0 for v in data["amount"]]
+    )
+    flags = np.asarray([1.0 if v else 0.0 for v in data["flag"]])
+    data["label"] = (
+        2.0 * amounts + 5.0 * flags + rng.randn(n)
+    ).tolist()
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = RegressionModelSelector.with_cross_validation(
+            num_folds=2,
+            models_and_parameters=[
+                (OpLinearRegression(), [{"reg_param": 0.01}]),
+            ],
+        )
+        pred = selector.set_input(label, vec).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    m = model.evaluate(OpRegressionEvaluator())
+    assert float(m.R2) > 0.9  # amount is in the design matrix
+    scored = model.score(data)[pred.name].to_list()
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored

@@ -145,7 +145,11 @@ def _glm_fit_kernel(X, y, w, reg, family: str, iters: int = 25,
             + s * jnp.outer(mu_x, mu_x)
         ) / jnp.outer(sd, sd) / wsum
         Hs = Hs * jnp.outer(active, active)
-        H = Hs + jnp.diag(jnp.full((d,), reg + 1e-9) + (1.0 - active))
+        # dimension-aware f32 ridge, same hardening as the LR kernels
+        from .packed_newton import pd_jitter
+
+        ridge = pd_jitter(jnp.trace(Hs) / d, d, hess_bf16=False)
+        H = Hs + jnp.diag(jnp.full((d,), reg) + ridge + (1.0 - active))
         g0 = sr / wsum
         h0 = s / wsum
         delta = jax.scipy.linalg.solve(H, g, assume_a="pos")

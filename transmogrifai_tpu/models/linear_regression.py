@@ -46,12 +46,19 @@ def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
     r = w * (y - ybar)
     c = (((X.T @ r) - mu * r.sum()) / sd / wsum) * active
 
+    # dimension-aware f32 ridge (Cholesky rounding ~eps*d*||G||), same
+    # hardening as the logistic kernels; G is fixed so it prices once
+    from .packed_newton import pd_jitter
+
+    ridge = pd_jitter(jnp.trace(G) / d, d, hess_bf16=False)
+
     def step(beta, _):
         l1_diag = lam_l1 / (jnp.abs(beta) + 1e-3)
         H = G + jnp.diag(
-            lam_l2 + l1_diag + jnp.full((d,), 1e-9) + (1.0 - active)
+            lam_l2 + l1_diag + ridge + (1.0 - active)
         )
-        return jax.scipy.linalg.solve(H, c, assume_a="pos"), None
+        new = jax.scipy.linalg.solve(H, c, assume_a="pos")
+        return jnp.where(jnp.isfinite(new), new, beta), None
 
     beta_s, _ = jax.lax.scan(step, jnp.zeros((d,)), None, length=l1_iters)
     beta = beta_s / sd

@@ -67,18 +67,20 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
             XtAX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
         ) / jnp.outer(sd, sd) / wsum
         Hs = Hs * jnp.outer(active, active)
-        # trace-scaled jitter when the Gram is bf16-quantized (same
-        # PD-safety argument as logistic_regression: curvature-only)
-        jitter = 1e-8 + (
-            1e-3 * jnp.trace(Hs) / d if hess_bf16 else 0.0
-        )
+        # curvature-relative, dimension-aware PD jitter + guarded step
+        # (packed_newton.pd_jitter/guarded_step: shared constants)
+        from .packed_newton import guarded_step, pd_jitter
+
+        jitter = pd_jitter(jnp.trace(Hs) / d, d, hess_bf16, base=1e-8)
         H = (
             Hs + jnp.diag(jnp.full((d,), 2.0 * reg)) + jitter * jnp.eye(d)
             + jnp.diag(1.0 - active)
         )
         g0 = sr / wsum
         h0 = s / wsum + 1e-8
-        delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+        delta = guarded_step(
+            jax.scipy.linalg.solve(H, g, assume_a="pos"), g
+        )
         return (beta - delta, b0 - g0 / h0), None
 
     (beta_s, b0), _ = jax.lax.scan(

@@ -119,24 +119,25 @@ def _lr_fit_kernel(
         Hs = (
             XtWX - jnp.outer(mu, a) - jnp.outer(a, mu) + s * jnp.outer(mu, mu)
         ) / jnp.outer(sd, sd) / wsum
-        # bf16 Gram error (~0.4% relative) can push a near-singular H
-        # indefinite past the tiny base jitter and NaN the pos-assumed
-        # solve; scale the jitter with the curvature magnitude when the
-        # quantized Gram is in play (jitter is curvature-only - the f32
+        # curvature-relative, dimension-aware PD jitter + guarded step:
+        # see packed_newton.pd_jitter/guarded_step (shared by all six
+        # Newton kernels; the jitter steers only the step, the f32
         # gradient still defines the fixed point)
-        jitter = 1e-9 + (
-            1e-3 * jnp.trace(Hs) / d if hess_bf16 else 0.0
-        )
+        from .packed_newton import guarded_step, pd_jitter
+
+        jitter = pd_jitter(jnp.trace(Hs) / d, d, hess_bf16)
         # excluded columns: identity row/col so the solve leaves them 0
         amask = jnp.outer(active, active)
-        Hs = Hs * amask
+        Hs_m = Hs * amask
         H = (
-            Hs + jnp.diag(lam_l2 + l1_diag) + jitter * jnp.eye(d)
+            Hs_m + jnp.diag(lam_l2 + l1_diag) + jitter * jnp.eye(d)
             + jnp.diag(1.0 - active)
         )
         g0 = sr / wsum
         h0 = s / wsum
-        delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+        delta = guarded_step(
+            jax.scipy.linalg.solve(H, g, assume_a="pos"), g
+        )
         return (beta - delta, b0 - g0 / h0), None
 
     (beta_s, b0), _ = jax.lax.scan(
@@ -249,13 +250,19 @@ def _softmax_fit_kernel(X, Yoh, w, reg, elastic_net, iters: int = 25):
         # the ridge only bounds the step).  The ridge must be RELATIVE to
         # the curvature scale: an absolute 1e-8 leaves the f32 Cholesky a
         # ~5e7 condition number (> 1/eps_f32) and it NaNs - found on the
-        # Iris design matrix.  bf16 Grams add the same trace-scaled slack
-        # as the binary kernel.
+        # Iris design matrix.  It must ALSO grow with the matrix
+        # dimension: f32 Cholesky rounding error scales ~eps*dim*||H||,
+        # and at K*d+K ~ 1.6k (a 550-wide transmogrified matrix, K=3) a
+        # 1e-6*s ridge sat BELOW the rounding noise - the very first
+        # solve NaN'd and the isfinite guard silently froze the fit at
+        # zero (found by the workflow fuzz).  pd_jitter is the shared
+        # point of truth for the constants.
+        from .packed_newton import pd_jitter
+
         tr = jnp.trace(H)  # pure curvature scale, before any diag terms
-        s = tr / (K * d + K)
-        jitter = (
-            1e-9 + 1e-6 * s + (1e-3 * s if hess_bf16 else 0.0)
-        )
+        dim = K * d + K
+        s = tr / dim
+        jitter = pd_jitter(s, dim, hess_bf16)
         # the excluded-column identity diag is SCALED to the curvature
         # (not a flat 1.0): on separable data with reg=0 the active-block
         # curvature decays exponentially as probabilities saturate, and a
@@ -268,13 +275,14 @@ def _softmax_fit_kernel(X, Yoh, w, reg, elastic_net, iters: int = 25):
         H = H + jnp.diag(jnp.concatenate([diagB, jnp.zeros((K,))]))
         H = H + jitter * jnp.eye(K * d + K)
         g = jnp.concatenate([gB.reshape(K * d), g0])
-        delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+        from .packed_newton import guarded_step
+
         # converged fits take a ZERO step: once |g| is at f32 noise the
         # remaining iterations only exercise the collapsed-curvature
         # solve, whose output (even NaN) must not touch the answer
-        ok = jnp.max(jnp.abs(g)) > 1e-7
-        delta = jnp.where(ok, delta, 0.0)
-        delta = jnp.where(jnp.isfinite(delta), delta, 0.0)
+        delta = guarded_step(
+            jax.scipy.linalg.solve(H, g, assume_a="pos"), g
+        )
         return (
             B - delta[: K * d].reshape(K, d),
             b0 - delta[K * d:],

@@ -204,6 +204,35 @@ def _batched_diag(v):
 _psolve = jax.vmap(partial(jax.scipy.linalg.solve, assume_a="pos"))
 
 
+def pd_jitter(s_curv, dim: int, hess_bf16: bool, base: float = 1e-9):
+    """PD-safety ridge for the Newton kernels' f32/bf16 Cholesky solves,
+    the ONE point of truth for the magic constants (retuned twice already;
+    six kernels share it).  ``s_curv`` = trace(H)/dim, the mean curvature:
+    the ridge must be RELATIVE to it, must grow with the matrix dimension
+    (f32 Cholesky rounding ~eps*dim*||H|| - an absolute 1e-9 froze a
+    551-wide softmax refit at zero), and bf16-quantized Grams add ~0.4%
+    relative error needing the larger slack."""
+    return (
+        base
+        + (1e-6 + 1.2e-7 * dim) * s_curv
+        + (1e-3 * s_curv if hess_bf16 else 0.0)
+    )
+
+
+def guarded_step(delta, g, axis=None):
+    """A converged fit takes a ZERO step, and a non-finite solve must not
+    poison the scan carry (the silent alternative - freezing at zero - is
+    exactly what the relative ridge prevents; this guard is the backstop).
+    ``axis``: reduction axis of |g| for batched kernels (None = scalar)."""
+    import jax.numpy as _jnp
+
+    if axis is None:
+        ok = _jnp.max(_jnp.abs(g)) > 1e-7
+    else:
+        ok = (_jnp.max(_jnp.abs(g), axis=axis) > 1e-7)[:, None]
+    return _jnp.where(ok & _jnp.isfinite(delta), delta, 0.0)
+
+
 @partial(jax.jit, static_argnames=("iters", "hess_bf16", "mesh"))
 def lr_fit_batched_packed(
     X, y, W, regs, ens, iters: int, hess_bf16: bool, mesh=None
@@ -261,17 +290,17 @@ def lr_fit_batched_packed(
             + s[:, None, None] * (mu[:, :, None] * mu[:, None, :])
         ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
         Hs = Hs * (active[:, :, None] * active[:, None, :])
-        # same trace-scaled PD-safety jitter as the vmap kernel
-        tr = jnp.trace(Hs, axis1=1, axis2=2)
-        jitter = 1e-9 + (1e-3 * tr / d if hess_bf16 else 0.0)
+        jitter = pd_jitter(
+            jnp.trace(Hs, axis1=1, axis2=2) / d, d, hess_bf16
+        )
         H = (
             Hs
             + _batched_diag(lam_l2[:, None] + l1_diag + (1.0 - active))
-            + (jitter[:, None, None] * eye if hess_bf16 else 1e-9 * eye)
+            + jitter[:, None, None] * eye
         )
         g0 = sr / wsum
         h0 = s / wsum
-        delta = _psolve(H, g)
+        delta = guarded_step(_psolve(H, g), g, axis=1)
         return (beta - delta, b0 - g0 / h0), None
 
     (beta_s, b0), _ = jax.lax.scan(
@@ -327,12 +356,9 @@ def svc_fit_batched_packed(
             + s[:, None, None] * (mu[:, :, None] * mu[:, None, :])
         ) / (sd[:, :, None] * sd[:, None, :]) / wsum[:, None, None]
         Hs = Hs * (active[:, :, None] * active[:, None, :])
-        tr = jnp.trace(Hs, axis1=1, axis2=2)
-        jitter = (
-            (1e-8 + 1e-3 * tr / d)[:, None, None] * eye
-            if hess_bf16
-            else 1e-8 * eye
-        )
+        jitter = pd_jitter(
+            jnp.trace(Hs, axis1=1, axis2=2) / d, d, hess_bf16, base=1e-8
+        )[:, None, None] * eye
         H = (
             Hs
             + _batched_diag(
@@ -343,7 +369,7 @@ def svc_fit_batched_packed(
         )
         g0 = sr / wsum
         h0 = s / wsum + 1e-8
-        delta = _psolve(H, g)
+        delta = guarded_step(_psolve(H, g), g, axis=1)
         return (beta - delta, b0 - g0 / h0), None
 
     (beta_s, b0), _ = jax.lax.scan(
@@ -388,12 +414,18 @@ def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8, mesh=None):
         ((X.T @ r.T).T - mu * r.sum(axis=1)[:, None]) / sd / wsum[:, None]
     ) * active
 
+    # G is fixed across l1 steps, so the dimension-aware ridge prices once
+    ridge = pd_jitter(
+        jnp.trace(G, axis1=1, axis2=2) / d, d, hess_bf16=False
+    )[:, None]
+
     def step(beta, _):
         l1_diag = lam_l1[:, None] / (jnp.abs(beta) + 1e-3)
         H = G + _batched_diag(
-            lam_l2[:, None] + l1_diag + 1e-9 + (1.0 - active)
+            lam_l2[:, None] + l1_diag + ridge + (1.0 - active)
         )
-        return _psolve(H, c), None
+        new = _psolve(H, c)
+        return jnp.where(jnp.isfinite(new), new, beta), None
 
     beta_s, _ = jax.lax.scan(step, jnp.zeros((B, d)), None, length=l1_iters)
     beta = beta_s / sd
