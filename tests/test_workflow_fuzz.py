@@ -186,6 +186,39 @@ def test_multiclass_pipeline_fuzz(tmp_path):
     assert m2.score(data)[pred2.name].to_list() == scored
 
 
+def test_multiclass_wide_matrix_stress():
+    """K=4 over a ~1.1k-wide design (K*d+K ~ 4.4k Hessian): the
+    dimension-aware ridge must keep the softmax Cholesky finite well past
+    the 1.6k dim where the flat ridge froze (kernel-level stress of the
+    fuzz-caught failure)."""
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+
+    rng = np.random.RandomState(3)
+    n, d_dense = 220, 24
+    Xd = rng.randn(n, d_dense)
+    # one-hot blocks + sparse hashed-ish columns mimic transmogrified
+    # structure (collinear groups, mostly-zero columns)
+    groups = []
+    for g in range(40):
+        onehot = np.zeros((n, 8))
+        onehot[np.arange(n), rng.randint(0, 8, n)] = 1.0
+        groups.append(onehot)
+    sparse = (rng.rand(n, 760) < 0.02) * rng.rand(n, 760)
+    X = np.concatenate([Xd] + groups + [sparse], axis=1)
+    y = np.digitize(Xd[:, 0] + 0.5 * Xd[:, 1], [-1.0, 0.0, 1.0]).astype(float)
+    # family='auto' would take the large-K*d OVR fallback here; force the
+    # softmax kernel - the stress target is ITS Cholesky at dim ~ 4.4k
+    lr = OpLogisticRegression(reg_param=0.01, family="multinomial")
+    params = lr.fit_arrays(X, y, np.ones(n))
+    assert params["family"] == "multinomial"
+    assert np.abs(params["betas"]).max() > 0.01  # did not freeze
+    pred, _, prob = lr.predict_arrays_np(params, X)
+    assert float((pred == y).mean()) > 0.8
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-6)
+
+
 def test_regression_pipeline_fuzz(tmp_path):
     """Continuous label through the regression selector (no balancing,
     DataSplitter prep) - regression CV must stay on the batched path."""
