@@ -186,6 +186,75 @@ def test_multiclass_pipeline_fuzz(tmp_path):
     assert m2.score(data)[pred2.name].to_list() == scored
 
 
+def test_workflow_cv_and_rff_compose_on_fuzz_schema(tmp_path):
+    """The auxiliary systems compose over the random schema: a
+    RawFeatureFilter gate (with a drifted scoring set), workflow-level CV
+    (SanityChecker refit inside each fold), save/load, and the engine-free
+    row scorer - all on one pipeline."""
+    from transmogrifai_tpu.filters.raw_feature_filter import RawFeatureFilter
+
+    rng = np.random.RandomState(21)
+    n = 140
+    data = _random_data(rng, n, 0.15)
+    # a drifted scoring set: 'count' becomes mostly-null so the filter
+    # flags its fill difference
+    scoring = _random_data(np.random.RandomState(22), 90, 0.15)
+    scoring["count"] = [None] * 85 + scoring["count"][85:]
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        checked = label.sanity_check(vec, remove_bad_features=True)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+        )
+        pred = selector.set_input(label, checked).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    from transmogrifai_tpu.types.dataset import Dataset as _DS
+    from transmogrifai_tpu.types.columns import column_from_list
+
+    scoring_ds = _DS({
+        f.name: column_from_list(scoring[f.name], f.ftype)
+        for f in _features()
+    })
+    wf = wf.with_raw_feature_filter(
+        RawFeatureFilter(scoring_data=scoring_ds, max_fill_difference=0.3)
+    ).with_workflow_cv()
+    model = wf.set_input_dataset(data).train()
+    # the drifted feature was filtered out of the raw set
+    dropped = {f.name for f in wf.blacklisted_features}
+    assert "count" in dropped
+    scored = model.score(data)[pred.name].to_list()
+    probs = [r["probability_1"] for r in scored]
+    assert all(0.0 <= p <= 1.0 for p in probs)
+    # engine-free row scorer parity on the full fuzz schema (maps,
+    # datelists, geo, multipicklists all ride transform_columns); the
+    # row path predicts in f64 numpy vs the batch path's device f32, so
+    # probabilities agree to f32 resolution, not bitwise
+    row_fn = model.score_function()
+    for i in (0, 3, 17):
+        row = {k: data[k][i] for k in data}
+        got = row_fn(row)[pred.name]
+        assert got["prediction"] == scored[i]["prediction"]
+        for k in got:
+            assert got[k] == pytest.approx(scored[i][k], rel=2e-5, abs=1e-6)
+    # save/load round-trip with the filtered DAG
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    wf2 = wf2.with_raw_feature_filter(
+        RawFeatureFilter(scoring_data=scoring_ds, max_fill_difference=0.3)
+    ).with_workflow_cv()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
 def test_multiclass_wide_matrix_stress():
     """K=4 over a ~1.1k-wide design (K*d+K ~ 4.4k Hessian): the
     dimension-aware ridge must keep the softmax Cholesky finite well past

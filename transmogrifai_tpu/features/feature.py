@@ -43,6 +43,15 @@ class Feature:
         """True when produced by a FeatureGeneratorStage / no origin (raw data)."""
         return not self.parents
 
+    def _current_parents(self) -> tuple:
+        """The feature's parents per the CURRENT stage graph: blacklist
+        surgery rewires origin_stage.input_features in place, so every
+        traversal (raw_features, parent_stages, history) must read the
+        stage's inputs, not the construction-time ``parents`` tuple."""
+        st = self.origin_stage
+        parents = getattr(st, "input_features", None) if st is not None else None
+        return tuple(parents) if parents else self.parents
+
     def raw_features(self) -> list["Feature"]:
         """All raw ancestors (reference: FeatureLike.scala:338), name-sorted."""
         seen: dict[str, Feature] = {}
@@ -55,7 +64,7 @@ class Feature:
             visited.add(f.uid)
             if f.is_raw():
                 seen[f.uid] = f
-            stack.extend(f.parents)
+            stack.extend(f._current_parents())
         return sorted(seen.values(), key=lambda f: f.name)
 
     def parent_stages(self) -> dict["PipelineStage", int]:
@@ -76,7 +85,12 @@ class Feature:
                 if st is not None:
                     if dist.get(st, -1) < d:
                         dist[st] = d
-                    for p in f.parents:
+                    # traverse the CURRENT stage graph (see
+                    # _current_parents): blacklist surgery rewires
+                    # stage.input_features in place, and the DAG must
+                    # follow the rewired graph or cascaded-away stages
+                    # keep riding in via stale parent links
+                    for p in f._current_parents():
                         nxt.append((p, d + 1))
             frontier = nxt
         return dist

@@ -119,6 +119,14 @@ def save_model(model, path: str) -> None:
             {"name": f.name, "type": f.ftype.__name__, "is_response": f.is_response}
             for f in model.raw_features
         ],
+        # the RAW blacklist re-derives the whole DAG surgery at load
+        # (cascaded drops are a deterministic function of it); without it
+        # a fresh workflow still carries the pre-surgery stage count and
+        # load cannot pair stages (reference: OpWorkflowModelWriter saves
+        # blacklistedFeatures, reader reapplies setBlacklist)
+        "blacklisted_raw": [
+            f.name for f in model.blacklisted_features if f.is_raw()
+        ],
         "parameters": _encode(model.parameters, arrays, "wf.params"),
         "train_time_s": model.train_time_s,
         "stages": stages_doc,
@@ -146,6 +154,31 @@ def load_model(path: str, workflow):
     with open(os.path.join(path, MODEL_JSON)) as f:
         doc = json.load(f)
     arrays = np.load(os.path.join(path, ARRAYS_NPZ), allow_pickle=False)
+
+    # reapply the saved blacklist surgery to the fresh workflow so its
+    # DAG matches the trained one (cascades re-derive deterministically).
+    # A workflow whose stage graph was ALREADY surgered differently
+    # cannot be reconciled - re-running surgery on mutated stages would
+    # produce a DAG matching neither side - so mismatches reject loudly.
+    bl_names = set(doc.get("blacklisted_raw", ()))
+    already = {f.name for f in workflow.blacklisted_features if f.is_raw()}
+    if bl_names != already:
+        if already:
+            raise ValueError(
+                "target workflow already carries a different blacklist "
+                f"({sorted(already)}) than the saved model "
+                f"({sorted(bl_names)}); load needs a freshly built "
+                "workflow"
+            )
+        by_name = {f.name: f for f in workflow.raw_features}
+        missing = bl_names - set(by_name)
+        if missing:
+            raise ValueError(
+                f"saved model blacklists raw features {sorted(missing)} "
+                "absent from the target workflow"
+            )
+        workflow.blacklisted_features = [by_name[n] for n in sorted(bl_names)]
+        workflow._apply_blacklist()
 
     dag = compute_dag(workflow.result_features)
     dag_stages = flatten(dag)
@@ -205,5 +238,6 @@ def load_model(path: str, workflow):
         stages=fitted,
         parameters=_decode(doc["parameters"], arrays),
         train_time_s=doc.get("train_time_s", 0.0),
+        blacklisted_features=workflow.blacklisted_features,
     )
     return model

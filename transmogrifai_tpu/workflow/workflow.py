@@ -227,21 +227,43 @@ class OpWorkflow:
     def _apply_blacklist(self) -> None:
         """DAG surgery after RawFeatureFilter (reference: OpWorkflow.
         setBlacklist:112-154): drop blacklisted raw features from every
-        stage's inputs where arity allows, error when a response or a
-        binary-stage input would be removed."""
+        stage's inputs; a stage left with no valid inputs is removed and
+        its OUTPUT cascades onto the blacklist (the reference's Failure
+        branch adds oldOutput to allBlacklisted), walking the DAG in
+        topological order so downstream stages shed the dead vector too.
+        Errors only when a response or a result feature would be cut."""
         bl = {f.uid for f in self.blacklisted_features}
         bad_resp = [f for f in self.blacklisted_features if f.is_response]
         if bad_resp:
             raise ValueError(f"cannot blacklist response features: {bad_resp}")
+        result_uids = {f.uid for f in self.result_features}
         dag = compute_dag(self.result_features)
         for stage in flatten(dag):
             kept = tuple(f for f in stage.input_features if f.uid not in bl)
-            if len(kept) != len(stage.input_features):
-                if not kept:
-                    raise ValueError(
-                        f"all inputs of stage {stage.uid} were blacklisted"
-                    )
+            if len(kept) == len(stage.input_features):
+                continue
+            ok = bool(kept)
+            if ok:
+                try:
+                    stage.check_input_types(kept)
+                except TypeError:
+                    ok = False  # reduced arity the stage cannot accept
+            out = stage.get_output()
+            if ok:
                 stage.input_features = kept
+            elif out.uid in result_uids:
+                raise ValueError(
+                    "RawFeatureFilter blacklisted features critical to "
+                    f"result feature {out.name!r} (via stage {stage.uid})"
+                )
+            else:
+                bl.add(out.uid)  # cascade: the stage's output dies too
+        merged = {f.uid: f for f in self.blacklisted_features}
+        for s in flatten(dag):
+            out = s.get_output()
+            if out.uid in bl:
+                merged.setdefault(out.uid, out)
+        self.blacklisted_features = list(merged.values())
         self.raw_features = tuple(
             f for f in self.raw_features if f.uid not in bl
         )
