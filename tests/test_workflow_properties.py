@@ -287,3 +287,41 @@ def test_training_is_deterministic(seed):
             np.testing.assert_array_equal(v1, v2), name
         else:
             assert (v1 == v2).all(), name
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_blacklist_cascade_invariants_on_random_graphs(seed):
+    """_apply_blacklist over random graphs + random raw blacklists:
+    after surgery (reference setBlacklist semantics, cascade included)
+    no surviving stage references a blacklisted feature, cascaded
+    outputs are themselves blacklisted, and the workflow either trains
+    clean on the reduced raw set or rejected the cut loudly."""
+    rng = np.random.RandomState(1000 + seed)
+    data, y, selectors, results, _ = _random_graph(rng)
+    wf = OpWorkflow().set_result_features(*results)
+    raw_preds = [f for f in wf.raw_features if not f.is_response]
+    k = int(rng.randint(1, max(2, len(raw_preds))))
+    cut = list(rng.choice(len(raw_preds), size=k, replace=False))
+    wf.blacklisted_features = [raw_preds[i] for i in cut]
+    try:
+        wf._apply_blacklist()
+    except ValueError:
+        # legal only when the cut reaches a result feature
+        return
+    bl_uids = {f.uid for f in wf.blacklisted_features}
+    dag = compute_dag(wf.result_features)
+    for stage in flatten(dag):
+        for f in stage.input_features:
+            assert f.uid not in bl_uids, (
+                f"stage {stage.uid} still reads blacklisted {f.name}"
+            )
+    # surviving raw set excludes every blacklisted raw
+    raw_names = {f.name for f in wf.raw_features}
+    for i in cut:
+        assert raw_preds[i].name not in raw_names
+    # the reduced workflow still trains and scores on the reduced data
+    reduced = {k_: v for k_, v in data.items() if k_ in raw_names or k_ == "y"}
+    model = wf.set_input_dataset(reduced).train()
+    out = model.score(reduced)
+    for rf in wf.result_features:
+        assert rf.name in out
