@@ -10,9 +10,18 @@ densities.
 from __future__ import annotations
 
 import datetime as _dt
+import os
 
 import numpy as np
 import pytest
+
+# TX_FUZZ_SEED_OFFSET shifts every generator seed (the contract-harness
+# sweep trick): CI runs offset 0; ad-hoc sweeps explore fresh draws
+_OFF = int(os.environ.get("TX_FUZZ_SEED_OFFSET", "0"))
+
+
+def _rs(seed: int) -> np.random.RandomState:
+    return np.random.RandomState(seed + _OFF)
 
 from transmogrifai_tpu import dsl  # noqa: F401 - activates feature DSL
 from transmogrifai_tpu.evaluators.binary import OpBinaryClassificationEvaluator
@@ -95,7 +104,7 @@ def _features():
 
 @pytest.mark.parametrize("seed,p_null", [(1, 0.1), (2, 0.35), (3, 0.02)])
 def test_full_pipeline_fuzz(tmp_path, seed, p_null):
-    rng = np.random.RandomState(seed)
+    rng = _rs(seed)
     n = 120
     data = _random_data(rng, n, p_null)
 
@@ -133,7 +142,7 @@ def test_full_pipeline_fuzz(tmp_path, seed, p_null):
 
     # unseen data with fresh nulls scores without error, identical between
     # the original and the loaded model
-    unseen = _random_data(np.random.RandomState(seed + 100), 40, p_null)
+    unseen = _random_data(_rs(seed + 100), 40, p_null)
     a = model.score(unseen)[pred.name].to_list()
     b = m2.score(unseen)[pred2.name].to_list()
     assert a == b
@@ -149,7 +158,7 @@ def test_multiclass_pipeline_fuzz(tmp_path):
         MultiClassificationModelSelector,
     )
 
-    rng = np.random.RandomState(7)
+    rng = _rs(7)
     n = 150
     data = _random_data(rng, n, 0.1)
     amounts = np.asarray(
@@ -193,12 +202,12 @@ def test_workflow_cv_and_rff_compose_on_fuzz_schema(tmp_path):
     row scorer - all on one pipeline."""
     from transmogrifai_tpu.filters.raw_feature_filter import RawFeatureFilter
 
-    rng = np.random.RandomState(21)
+    rng = _rs(21)
     n = 140
     data = _random_data(rng, n, 0.15)
     # a drifted scoring set: 'count' becomes mostly-null so the filter
     # flags its fill difference
-    scoring = _random_data(np.random.RandomState(22), 90, 0.15)
+    scoring = _random_data(_rs(22), 90, 0.15)
     scoring["count"] = [None] * 85 + scoring["count"][85:]
 
     def build():
@@ -261,7 +270,7 @@ def test_streaming_and_loco_on_fuzz_schema():
     full 10-type random schema."""
     from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
 
-    rng = np.random.RandomState(31)
+    rng = _rs(31)
     n = 100
     data = _random_data(rng, n, 0.12)
     feats = _features()
@@ -311,7 +320,7 @@ def test_streaming_and_loco_on_fuzz_schema():
 def test_warm_start_skips_refit_on_fuzz_schema():
     """with_model_stages: a second train on the same workflow skips
     refitting warm stages and reproduces identical scores."""
-    rng = np.random.RandomState(41)
+    rng = _rs(41)
     n = 90
     data = _random_data(rng, n, 0.1)
     feats = _features()
@@ -350,7 +359,7 @@ def test_multiclass_wide_matrix_stress():
         OpLogisticRegression,
     )
 
-    rng = np.random.RandomState(3)
+    rng = _rs(3)
     n, d_dense = 220, 24
     Xd = rng.randn(n, d_dense)
     # one-hot blocks + sparse hashed-ish columns mimic transmogrified
@@ -381,7 +390,7 @@ def test_regression_pipeline_fuzz(tmp_path):
     from transmogrifai_tpu.models.linear_regression import OpLinearRegression
     from transmogrifai_tpu.selector.factories import RegressionModelSelector
 
-    rng = np.random.RandomState(11)
+    rng = _rs(11)
     n = 150
     data = _random_data(rng, n, 0.1)
     amounts = np.asarray(
