@@ -350,6 +350,60 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+@pytest.mark.parametrize("corr_type,exclusion", [
+    ("pearson", "none"),
+    ("spearman", "none"),
+    ("pearson", "hashed_text"),
+    ("spearman", "hashed_text"),
+])
+def test_sanity_checker_option_matrix_on_fuzz_schema(corr_type, exclusion,
+                                                     tmp_path):
+    """Every correlation-type x exclusion combination trains, drops a
+    planted leaker, keeps the hash block when excluded, and survives
+    save/load with identical vector slicing."""
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+
+    rng = _rs(61)
+    n = 130
+    data = _random_data(rng, n, 0.1)
+    # planted label-leaker: an exact copy of the label
+    data["leak"] = list(data["label"])
+
+    def build():
+        feats = _features() + [FeatureBuilder(ft.Real, "leak").as_predictor()]
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        checker = SanityChecker(
+            remove_bad_features=True,
+            correlation_type=corr_type,
+            correlation_exclusion=exclusion,
+            max_correlation=0.9,
+        )
+        checked = checker.set_input(label, vec).get_output()
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+        )
+        pred = selector.set_input(label, checked).get_output()
+        return OpWorkflow().set_result_features(pred), pred, checked
+
+    wf, pred, checked = build()
+    model = wf.set_input_dataset(data).train()
+    out = model.score(data)
+    kept = out[checked.name].metadata.columns
+    kept_parents = {c.parent_feature_name for c in kept}
+    assert "leak" not in kept_parents  # the leaker was dropped
+    assert len(kept) > 0
+    scored = out[pred.name].to_list()
+    model.save(str(tmp_path / "m"))
+    wf2, pred2, _ = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
 def test_multiclass_wide_matrix_stress():
     """K=4 over a ~1.1k-wide design (K*d+K ~ 4.4k Hessian): the
     dimension-aware ridge must keep the softmax Cholesky finite well past
