@@ -49,3 +49,16 @@ def _reset_uids():
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+@pytest.fixture
+def subprocess_env():
+    """Environment for tests that spawn python subprocesses: the repo on
+    PYTHONPATH (the package is not pip-installed), CPU jax, and the axon
+    plugin neutralized so a wedged tunnel cannot hang the child."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
