@@ -1,0 +1,63 @@
+"""Hand-computed ThresholdMetrics pin (reference:
+OpMultiClassificationEvaluator.scala:79-151): per topN in {1, 3} and
+threshold t in the 0..1 step-0.01 grid, counts of correct (top-prob >= t
+AND true label within topN by probability), incorrect (confident, not
+within), and no-prediction (top-prob < t) - verified on a 4-row example
+where every count is computable by eye.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu.evaluators.multiclass import (
+    OpMultiClassificationEvaluator,
+)
+from transmogrifai_tpu.types.columns import PredictionColumn
+
+
+def _pc(prob):
+    prob = np.asarray(prob, dtype=np.float64)
+    pred = prob.argmax(axis=1).astype(np.float64)
+    raw = np.log(np.maximum(prob, 1e-12))
+    return pred, PredictionColumn(pred, raw, prob)
+
+
+def test_threshold_metrics_hand_example():
+    # 4 rows, 3 classes; true labels 0, 1, 2, 0
+    y = np.array([0.0, 1.0, 2.0, 0.0])
+    prob = [
+        [0.70, 0.20, 0.10],  # correct at top1; conf 0.70
+        [0.40, 0.35, 0.25],  # top1 wrong (pred 0), top3 contains 1; conf 0.40
+        [0.05, 0.15, 0.80],  # correct at top1; conf 0.80
+        [0.30, 0.45, 0.25],  # top1 wrong (pred 1), top3 contains 0; conf 0.45
+    ]
+    _, pc = _pc(prob)
+    m = OpMultiClassificationEvaluator().evaluate_arrays(y, pc).to_json()
+    tm = m["threshold_metrics"]
+    ths = tm["thresholds"]
+    assert len(ths) == 101 and ths[0] == 0.0 and ths[-1] == 1.0
+    c1, i1, n1 = (tm["correct_counts"]["1"], tm["incorrect_counts"]["1"],
+                  tm["no_prediction_counts"]["1"])
+    c3, i3, n3 = (tm["correct_counts"]["3"], tm["incorrect_counts"]["3"],
+                  tm["no_prediction_counts"]["3"])
+
+    def at(t):
+        return ths.index(round(t, 2))
+
+    # t = 0: everyone confident; top1 correct rows {0, 2}
+    assert (c1[at(0.0)], i1[at(0.0)], n1[at(0.0)]) == (2, 2, 0)
+    # top3 of a 3-class problem always contains the label
+    assert (c3[at(0.0)], i3[at(0.0)], n3[at(0.0)]) == (4, 0, 0)
+    # t = 0.42: rows with conf >= 0.42 are {0 (.70), 2 (.80), 3 (.45)}
+    assert (c1[at(0.42)], i1[at(0.42)], n1[at(0.42)]) == (2, 1, 1)
+    assert (c3[at(0.42)], i3[at(0.42)], n3[at(0.42)]) == (3, 0, 1)
+    # t = 0.75: only row 2 stays confident
+    assert (c1[at(0.75)], i1[at(0.75)], n1[at(0.75)]) == (1, 0, 3)
+    # t = 1.0: nobody reaches confidence 1
+    assert (c1[at(1.0)], i1[at(1.0)], n1[at(1.0)]) == (0, 0, 4)
+    # counts partition n at every threshold, monotone no-prediction
+    for j in range(101):
+        assert c1[j] + i1[j] + n1[j] == 4
+        assert c3[j] + i3[j] + n3[j] == 4
+        if j:
+            assert n1[j] >= n1[j - 1]
