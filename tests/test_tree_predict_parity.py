@@ -87,3 +87,41 @@ def test_bin_data_agrees_native_vs_python():
     b_nat = native_trees.bin_data(X, edges)
     if b_nat is not None:
         np.testing.assert_array_equal(b_py, b_nat)
+
+
+@pytest.mark.skipif(
+    not native_trees.available(), reason="native tree kernels unavailable"
+)
+@pytest.mark.parametrize("seed", [11, 12, 13, 14, 15])
+def test_native_jax_predict_parity_fuzz(seed, monkeypatch):
+    """Random shapes/depths over TIE-HEAVY data (small integer grids make
+    most cells land exactly on bin edges) plus constant and duplicated
+    columns - the backends must agree on every row."""
+    rng = np.random.default_rng(seed)
+    n = 300
+    d = int(rng.integers(3, 9))
+    depth = int(rng.integers(2, 6))
+    trees = int(rng.integers(2, 7))
+    X = rng.integers(-3, 4, size=(n, d)).astype(np.float32)
+    X[:, d - 1] = 1.0  # constant column: no splits available
+    if d >= 4:
+        X[:, d - 2] = X[:, 0]  # duplicated column
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    for cls, kw in (
+        (OpRandomForestClassifier, dict(num_trees=trees, max_depth=depth)),
+        (OpGBTClassifier, dict(num_trees=max(trees // 2, 2),
+                               max_depth=max(depth - 1, 2))),
+    ):
+        est = cls(backend="jax", **kw)
+        params = est.fit_arrays(X, y)
+        Xs = _tricky_inputs(X, params["edges"], rng)
+        # mixed scoring batch: fitted rows + tricky rows
+        Xs = np.concatenate([X[:50], Xs], axis=0)
+        monkeypatch.setitem(est.params, "backend", "native")
+        monkeypatch.setenv("TX_TREE_NATIVE_ROWS", str(10**9))
+        pred_n, _, prob_n = est.predict_arrays(params, Xs)
+        monkeypatch.setitem(est.params, "backend", "jax")
+        pred_j, _, prob_j = est.predict_arrays(params, Xs)
+        np.testing.assert_array_equal(pred_n, pred_j)
+        if prob_n is not None:
+            np.testing.assert_allclose(prob_n, prob_j, atol=1e-6)
