@@ -1,0 +1,79 @@
+"""Garbage-in robustness fuzz for every text-analysis function.
+
+None of the detectors/parsers may raise on arbitrary input - random
+bytes, lone surrogate-free unicode from hostile planes, control
+characters, pathological lengths, malformed base64 - and outputs stay in
+their contracted domains (probabilities, Optional[bool], domain strings,
+similarity in [0, 1]).
+"""
+from __future__ import annotations
+
+import base64
+import string
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.lang_data import detect
+from transmogrifai_tpu.ops.ner import tag_entities
+from transmogrifai_tpu.ops.text import tokenize
+from transmogrifai_tpu.ops.text_analysis import (
+    detect_mime_type,
+    is_valid_phone,
+    ngrams,
+    parse_phone,
+)
+
+
+def _garbage_strings(rng, k=120):
+    pools = [
+        string.printable,
+        "".join(chr(c) for c in range(0x20)),              # control chars
+        "αβγδεζηθικλμνξοπρστυφχψω中文字符日本語한국어",        # multi-script
+        "\U0001F600\U0001F4A9\U0001F680‍​﻿",  # emoji + ZWJ/BOM
+        "ÀÈÌÒÙàèìòùÄÖÜäöüßÿñçœæ",
+        "().,;:!?-_'\"@#$%^&*[]{}|\\/<>~`+=",
+    ]
+    out = [None, "", " ", "\n", "\t\t\t", "a" * 10_000, "\x00"]
+    for _ in range(k):
+        pool = pools[rng.randint(len(pools))]
+        n = int(rng.randint(1, 60))
+        out.append("".join(pool[rng.randint(len(pool))] for _ in range(n)))
+    return out
+
+
+@pytest.mark.parametrize("seed", [81, 82])
+def test_detectors_never_raise_and_stay_in_domain(seed):
+    rng = np.random.RandomState(seed)
+    for s in _garbage_strings(rng):
+        scores = detect(s or "")
+        for lang, p in scores.items():
+            assert isinstance(lang, str) and 0.0 <= p <= 1.0 + 1e-9
+        ents = tag_entities(s)
+        assert isinstance(ents, dict)
+        v = is_valid_phone(s)
+        assert v is None or isinstance(v, bool)
+        parsed = parse_phone(s)
+        assert parsed is None or isinstance(parsed, str)
+        toks = tokenize(s)
+        assert all(isinstance(t, str) for t in toks)
+        g = ngrams(s or "")
+        assert isinstance(g, set)
+
+
+@pytest.mark.parametrize("seed", [83, 84])
+def test_mime_detector_on_random_bytes(seed):
+    rng = np.random.RandomState(seed)
+    cases = [b"", b"\x00", bytes(rng.randint(0, 256, 4).tolist())]
+    for _ in range(60):
+        n = int(rng.randint(1, 4096))
+        cases.append(bytes(rng.randint(0, 256, n).tolist()))
+    # truncated real signatures: a PNG magic cut mid-way, a half ZIP
+    cases += [b"\x89PN", b"PK\x03", b"%PD", b"GIF8", b"\xff\xd8"]
+    for raw in cases:
+        mt = detect_mime_type(base64.b64encode(raw).decode("ascii"))
+        assert mt is None or (isinstance(mt, str) and "/" in mt)
+    # non-base64 garbage must not raise either
+    for junk in ("!!!", "%%%", "not base64 at all", "ab=cd=="):
+        mt = detect_mime_type(junk)
+        assert mt is None or isinstance(mt, str)
