@@ -133,6 +133,47 @@ def test_correlation_exclusion_hashed_text(rng):
     assert summary2["correlation_excluded_columns"] == 0
 
 
+def test_pmi_and_rule_confidence_hand_examples():
+    """Hand-computed PMI / association-rule values (reference:
+    OpStatistics.contingencyStats PMI + maxConfidences)."""
+    from transmogrifai_tpu.utils.stats import (
+        max_rule_confidences,
+        pointwise_mutual_info,
+    )
+
+    # perfect association: diagonal cells carry ALL their row/col mass
+    perfect = np.array([[50.0, 0.0], [0.0, 50.0]])
+    pmi = pointwise_mutual_info(perfect)
+    assert pmi[0, 0] == pytest.approx(1.0)   # log2(0.5 / 0.25)
+    assert pmi[1, 1] == pytest.approx(1.0)
+    assert pmi[0, 1] == 0.0 and pmi[1, 0] == 0.0  # zero cells -> 0
+    conf, supp = max_rule_confidences(perfect)
+    assert conf.tolist() == [1.0, 1.0]
+    assert supp.tolist() == [0.5, 0.5]
+
+    # independence: every pmi exactly 0
+    ind = np.array([[20.0, 30.0], [40.0, 60.0]])
+    np.testing.assert_allclose(pointwise_mutual_info(ind), 0.0, atol=1e-12)
+
+    # asymmetric case, verified by hand: n=100
+    # col 0: 30+10=40, max 30 -> conf .75, support .4
+    # col 1: 20+40=60, max 40 -> conf 2/3, support .6
+    c = np.array([[30.0, 20.0], [10.0, 40.0]])
+    conf, supp = max_rule_confidences(c)
+    assert conf[0] == pytest.approx(0.75)
+    assert conf[1] == pytest.approx(2 / 3)
+    assert supp.tolist() == [0.4, 0.6]
+    pmi = pointwise_mutual_info(c)
+    # pmi[0,0] = log2( .3 / (.5 * .4) ) = log2(1.5)
+    assert pmi[0, 0] == pytest.approx(np.log2(1.5))
+    assert pmi[1, 1] == pytest.approx(np.log2(0.4 / (0.5 * 0.6)))
+
+    # degenerate: all-zero table and an empty column
+    assert pointwise_mutual_info(np.zeros((2, 2))).tolist() == [[0, 0], [0, 0]]
+    conf0, supp0 = max_rule_confidences(np.array([[5.0, 0.0], [5.0, 0.0]]))
+    assert conf0[1] == 0.0 and supp0[1] == 0.0
+
+
 def test_cramers_v_edge_cases():
     """Reference parity for the association statistic's edge behavior
     (OpStatistics.cramersV; SURVEY §4 names these cases): perfect
