@@ -350,6 +350,86 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+def test_runner_five_run_types_on_fuzz_schema(tmp_path):
+    """All five reference run types (Train/Score/Evaluate/Features/
+    StreamingScore, OpWorkflowRunner.scala:296-313) execute over the
+    10-type random schema, with avro score output."""
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    rng = _rs(91)
+    data = _random_data(rng, 100, 0.1)
+
+    class ListReader:
+        def generate_dataset(self, raw_features, params):
+            from transmogrifai_tpu.types.columns import column_from_list
+            from transmogrifai_tpu.types.dataset import Dataset as _DS
+
+            return _DS({
+                f.name: column_from_list(data[f.name], f.ftype)
+                for f in raw_features
+            })
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+        )
+        pred = selector.set_input(label, vec).get_output()
+        wf = OpWorkflow().set_result_features(pred).set_reader(ListReader())
+        return wf, pred
+
+    params = OpParams(
+        model_location=str(tmp_path / "model"),
+        write_location=str(tmp_path / "scores"),
+        metrics_location=str(tmp_path / "metrics"),
+        write_format="avro",
+    )
+    wf, pred = build()
+    runner = OpWorkflowRunner(wf, evaluator=OpBinaryClassificationEvaluator())
+    r = runner.run("train", params)
+    assert r.model is not None
+
+    wf2, pred2 = build()
+    r2 = OpWorkflowRunner(
+        wf2, evaluator=OpBinaryClassificationEvaluator()
+    ).run("score", params)
+    assert r2.scores is not None and pred2.name in r2.scores
+    import glob as _glob
+
+    avro_written = _glob.glob(str(tmp_path / "scores" / "*.avro"))
+    assert avro_written, "write_format=avro must write an OCF"
+    from transmogrifai_tpu.readers.avro_reader import read_avro_records
+
+    _, recs = read_avro_records(avro_written[0])
+    assert len(recs) == 100
+
+    wf3, _ = build()
+    r3 = OpWorkflowRunner(
+        wf3, evaluator=OpBinaryClassificationEvaluator()
+    ).run("evaluate", params)
+    assert "AuROC" in r3.metrics
+
+    wf4, _ = build()
+    r4 = OpWorkflowRunner(wf4).run("features", params)
+    assert r4.scores is not None  # the vectorized frame
+
+    wf5, pred5 = build()
+    runner5 = OpWorkflowRunner(wf5, evaluator=OpBinaryClassificationEvaluator())
+    batches = [
+        {k: v[i:i + 40] for k, v in data.items()} for i in (0, 40, 80)
+    ]
+    outs = list(runner5.streaming_score(batches, params))
+    assert len(outs) == 3
+    assert sum(len(o[pred5.name]) for o in outs) == 100
+
+
 @pytest.mark.parametrize("corr_type,exclusion", [
     ("pearson", "none"),
     ("spearman", "none"),
