@@ -98,3 +98,34 @@ def test_native_bridges_degenerate_inputs(rng):
     p2 = est.fit_arrays(X2, y2)
     pred2, _, _ = est.predict_arrays(p2, X2)
     assert (pred2 == y2).mean() == 1.0
+
+
+def test_tokenize_hash_tf_unicode_parity_with_python():
+    """The fused native path must hash EXACTLY like the python
+    tokenizer+hasher on non-ASCII text (unicode lowercasing, emoji are
+    not \\w, >4096-byte tokens) - cross-backend model portability.
+    Before the routing fix the native kernel byte-lowercased ('Ü' stayed
+    uppercase), kept emoji as tokens, and hashed truncated long tokens."""
+    import numpy as np
+
+    from transmogrifai_tpu.ops.text import tokenize
+    from transmogrifai_tpu.utils.hashing import hashing_tf
+    from transmogrifai_tpu.utils.native import tokenize_hash_tf
+
+    rng = np.random.RandomState(9)
+    texts = [
+        "Ünïcødé tökens über alles", "emoji \U0001F600 in \U0001F600 text",
+        "a" * 5000 + " tail", "mixed ASCII und Ümlaut wörter",
+        "中文 分词 测试 中文", "pure ascii stays native", "", None,
+    ]
+    pools = "abc déf 中文 \U0001F600 xyz,;!"
+    texts += [
+        "".join(pools[rng.randint(len(pools))]
+                for _ in range(rng.randint(1, 60)))
+        for _ in range(100)
+    ]
+    nat = tokenize_hash_tf(texts, 64, seed=42)
+    if nat is None:
+        pytest.skip("native lib unavailable")
+    py = hashing_tf([tokenize(t) for t in texts], 64, seed=42)
+    np.testing.assert_array_equal(np.asarray(nat), np.asarray(py))
