@@ -277,10 +277,12 @@ inline void csv_row_cells(const uint8_t* buf, int64_t row_begin,
     if (mode == 0) continue;      // unwanted column: no writes at all
     if (ce > cb && buf[ce - 1] == '\r') ce--;  // CRLF tail on last cell
     const int64_t slot = static_cast<int64_t>(col) * nrows + row;
-    if (mode == 2) {
-      cell_begin[slot] = cb;
-      cell_end[slot] = ce;
-    } else {
+    // offsets are recorded for EVERY materialized column (numeric too):
+    // the python side retries masked numeric cells through float() when
+    // the chunk carries non-ASCII bytes, without a second scan
+    cell_begin[slot] = cb;
+    cell_end[slot] = ce;
+    if (mode != 2) {
       parse_num_cell(buf, cb, ce, num_out + slot, num_mask + slot);
     }
   }

@@ -114,6 +114,28 @@ def fast_path_available() -> bool:
     return native.csv_scan(b"x\n", 1, np.zeros(1, np.uint8)) is not None
 
 
+def _retry_masked_unicode_cells(
+    chunk: bytes, cb: np.ndarray, ce: np.ndarray,
+    vals: np.ndarray, mask: np.ndarray,
+) -> None:
+    """Masked numeric cells re-tried through python float(): the C++
+    parser rejects any non-ASCII byte, but float() accepts unicode
+    decimal digits ('١٢٣' -> 123.0) and the python reader path uses
+    float() - both native ingest routes must agree with it on every
+    cell.  Mutates vals/mask in place; ASCII junk stays masked.  Callers
+    gate on chunk.isascii() so pure-ASCII chunks never reach here."""
+    for r in np.nonzero(~mask)[0]:
+        cell = chunk[cb[r]:ce[r]]
+        if not cell or cell.isascii():
+            continue
+        try:
+            v = float(cell.decode("utf-8").strip())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        vals[r] = v
+        mask[r] = True
+
+
 def read_csv_columnar(
     path: str,
     schema: Mapping[str, Type[FeatureType]],
@@ -176,11 +198,20 @@ def read_csv_columnar(
         nrows, num_vals, num_mask, cb, ce = res
         if nrows == 0:
             continue
+        # pure-ASCII chunks (the hot path) skip the unicode retry check
+        # entirely; isascii() short-circuits at the first high byte
+        retry = not chunk.isascii()
         for n in names:
             c = col_idx[n]
             if modes[c] == 1:
-                num_parts.setdefault(n, []).append(num_vals[c].copy())
-                mask_parts.setdefault(n, []).append(num_mask[c].copy())
+                vals_c = num_vals[c].copy()
+                mask_c = num_mask[c].copy()
+                if retry:
+                    _retry_masked_unicode_cells(
+                        chunk, cb[c], ce[c], vals_c, mask_c
+                    )
+                num_parts.setdefault(n, []).append(vals_c)
+                mask_parts.setdefault(n, []).append(mask_c)
             else:
                 text_parts.setdefault(n, []).append(
                     _decode_text_column(chunk, cb[c], ce[c])
@@ -297,9 +328,17 @@ class DeviceCSVIngest:
                 res = native.csv_scan(chunk, len(header), modes)
                 if res is None:
                     raise RuntimeError("native CSV kernels unavailable")
-                nrows, num_vals, num_mask, _, _ = res
+                nrows, num_vals, num_mask, cb, ce = res
                 if nrows == 0:
                     continue
+                if not chunk.isascii():
+                    # same unicode-digit float() retry as the columnar
+                    # path: both native ingest routes must agree with the
+                    # python reader on every cell
+                    for c in idx:
+                        _retry_masked_unicode_cells(
+                            chunk, cb[c], ce[c], num_vals[c], num_mask[c]
+                        )
                 block = np.ascontiguousarray(
                     num_vals[idx].T, dtype=np.float32
                 )  # [rows, d]

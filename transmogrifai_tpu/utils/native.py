@@ -231,13 +231,13 @@ def csv_scan(
     nrows = int(
         lib.tx_csv_index(data.ctypes.data, data.size, row_starts.ctypes.data)
     )
-    any_text = bool((modes8 == 2).any())
+    any_mat = bool((modes8 != 0).any())
     num_vals = np.zeros((ncols, nrows), dtype=np.float64)
     num_mask = np.zeros((ncols, nrows), dtype=np.uint8)
-    off_rows = nrows if any_text else 0
-    # the kernel never touches offset slots of non-text columns, but slot
-    # indexing is col*nrows - so the buffer must be full-shape when any
-    # text column exists, and can be an empty dummy otherwise
+    # the kernel records offsets for EVERY materialized column (numeric
+    # included, feeding the unicode float() retry); slot indexing is
+    # col*nrows, so the buffer is full-shape when anything materializes
+    off_rows = nrows if any_mat else 0
     cell_begin = np.zeros((ncols, off_rows), dtype=np.int64)
     cell_end = np.zeros((ncols, off_rows), dtype=np.int64)
     lib.tx_csv_cells(
