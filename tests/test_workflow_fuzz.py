@@ -240,6 +240,11 @@ def test_workflow_cv_and_rff_compose_on_fuzz_schema(tmp_path):
     # the drifted feature was filtered out of the raw set
     dropped = {f.name for f in wf.blacklisted_features}
     assert "count" in dropped
+    # ...and stays out of the interpretability lineage too
+    ins = model.model_insights()
+    assert ins.selected_model_type is not None
+    assert not any("count" in fi.pretty_name for fi in ins.feature_insights)
+    assert len(ins.pretty()) > 100
     scored = model.score(data)[pred.name].to_list()
     probs = [r["probability_1"] for r in scored]
     assert all(0.0 <= p <= 1.0 for p in probs)
