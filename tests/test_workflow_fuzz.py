@@ -355,6 +355,46 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+def test_tree_families_pipeline_fuzz(tmp_path):
+    """RF + GBT ride the same composition (fold/grid-batched tree CV over
+    the transmogrified fuzz matrix), save/load bit-parity included."""
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier,
+        OpRandomForestClassifier,
+    )
+
+    rng = _rs(55)
+    n = 130
+    data = _random_data(rng, n, 0.1)
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[
+                (OpRandomForestClassifier(num_trees=8, max_depth=4), [{}]),
+                (OpGBTClassifier(num_trees=6, max_depth=3), [{}]),
+            ],
+        )
+        pred = selector.set_input(label, vec).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    scored = model.score(data)[pred.name].to_list()
+    m = model.evaluate(OpBinaryClassificationEvaluator())
+    assert float(m.AuROC) > 0.6
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
 def test_runner_five_run_types_on_fuzz_schema(tmp_path):
     """All five reference run types (Train/Score/Evaluate/Features/
     StreamingScore, OpWorkflowRunner.scala:296-313) execute over the
