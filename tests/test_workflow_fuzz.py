@@ -355,6 +355,49 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+def test_glm_poisson_pipeline_fuzz(tmp_path):
+    """A Poisson GLM through the regression composition: count-like label
+    from the fuzz schema, finite coefficients, save/load parity."""
+    from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+    from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+
+    rng = _rs(65)
+    n = 140
+    data = _random_data(rng, n, 0.1)
+    amounts = np.asarray(
+        [v if v is not None else 50.0 for v in data["amount"]]
+    )
+    lam = np.exp((amounts - 50.0) / 25.0)
+    data["label"] = rng.poisson(lam).astype(float).tolist()
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75, evaluator=OpRegressionEvaluator()
+            ),
+            models=[
+                (OpGeneralizedLinearRegression(family="poisson"), [{}]),
+            ],
+        )
+        pred = selector.set_input(label, vec).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    scored = model.score(data)[pred.name].to_list()
+    preds = np.asarray([r["prediction"] for r in scored])
+    assert np.isfinite(preds).all() and (preds >= 0).all()
+    # the log-link fit must recover the amount signal direction
+    assert np.corrcoef(preds, np.asarray(data["label"]))[0, 1] > 0.3
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
 def test_tree_families_pipeline_fuzz(tmp_path):
     """RF + GBT ride the same composition (fold/grid-batched tree CV over
     the transmogrified fuzz matrix), save/load bit-parity included."""
