@@ -355,6 +355,45 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+def test_nb_and_mlp_pipeline_fuzz(tmp_path):
+    """NaiveBayes + MLP (the remaining classifier families) through the
+    composition with save/load parity."""
+    from transmogrifai_tpu.models.mlp import OpMultilayerPerceptronClassifier
+    from transmogrifai_tpu.models.naive_bayes import OpNaiveBayes
+
+    rng = _rs(75)
+    n = 130
+    data = _random_data(rng, n, 0.1)
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[
+                (OpNaiveBayes(), [{}]),
+                (OpMultilayerPerceptronClassifier(
+                    hidden_layers=(8,), max_iter=40), [{}]),
+            ],
+        )
+        pred = selector.set_input(label, vec).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    scored = model.score(data)[pred.name].to_list()
+    probs = [r["probability_1"] for r in scored]
+    assert all(np.isfinite(p) and 0.0 <= p <= 1.0 for p in probs)
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
 def test_glm_poisson_pipeline_fuzz(tmp_path):
     """A Poisson GLM through the regression composition: count-like label
     from the fuzz schema, finite coefficients, save/load parity."""
