@@ -98,24 +98,62 @@ class OneHotModel(SequenceVectorizerModel):
     def blocks_for(self, col: Column, i: int):
         feat = self.input_features[i]
         labels = self.labels_per_feature[i]
-        vals, present = self._values_of(col)
         n = len(col)
         width = len(labels) + 1 + (1 if self.track_nulls else 0)
         arr = np.zeros((n, width), dtype=np.float64)
-        idx = {v: j for j, v in enumerate(labels)}
         other_j = len(labels)
-        for r, vset in enumerate(vals):
-            if vset is None:
-                continue
-            hit_other = False
-            for v in vset:
-                j = idx.get(v)
-                if j is not None:
-                    arr[r, j] = 1.0
-                else:
-                    hit_other = True
-            if hit_other:
-                arr[r, other_j] = 1.0
+        if isinstance(col, TextColumn):
+            # single-value pivot hot path (batch-scoring profile top
+            # line): memoize raw value -> column code per feature, so
+            # repeat values skip cleaning AND the label lookup; the
+            # scatter is one fancy-indexed write
+            memos = getattr(self, "_code_memos", None)
+            if memos is None:
+                memos = self._code_memos = {}
+            key = (tuple(labels), self.clean_text)
+            hit = memos.get(i)
+            if hit is None or hit[0] != key:
+                memos[i] = hit = (key, {})
+            memo = hit[1]
+            if len(memo) > 65536:
+                # same bound as _clean_cached: a high-cardinality text
+                # feature must not grow the memo without limit in a
+                # long-lived scoring process
+                memo.clear()
+            idx = {v: j for j, v in enumerate(labels)}
+            codes = np.empty(n, dtype=np.int64)
+            for r, x in enumerate(col.values):
+                if x is None:
+                    codes[r] = -1
+                    continue
+                try:
+                    c = memo.get(x)
+                    hashable = True
+                except TypeError:  # non-str oddity: clean uncached
+                    c, hashable = None, False
+                if c is None:
+                    j = idx.get(_clean_value(x, self.clean_text))
+                    c = other_j if j is None else j
+                    if hashable:
+                        memo[x] = c
+                codes[r] = c
+            present = codes >= 0
+            arr[np.nonzero(present)[0], codes[present]] = 1.0
+        else:
+            vals, present = self._values_of(col)
+            idx = {v: j for j, v in enumerate(labels)}
+            for r, vset in enumerate(vals):
+                if vset is None:
+                    continue
+                hit_other = False
+                for v in vset:
+                    j = idx.get(v)
+                    if j is not None:
+                        arr[r, j] = 1.0
+                    else:
+                        hit_other = True
+                if hit_other:
+                    arr[r, other_j] = 1.0
         def build():
             tname = feat.ftype.type_name()
             ms = [
