@@ -355,6 +355,57 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+def test_data_balancer_pipeline_fuzz(tmp_path):
+    """A ~7%-positive label through the selector with DataBalancer: the
+    minority up-weighting rides the CV weight vectors (no data copies),
+    the splitter summary lands in metadata, and save/load holds."""
+    from transmogrifai_tpu.selector.splitters import DataBalancer
+
+    rng = _rs(85)
+    n = 160
+    data = _random_data(rng, n, 0.1)
+    amounts = np.asarray(
+        [v if v is not None else 50.0 for v in data["amount"]]
+    )
+    data["label"] = (amounts > np.percentile(amounts, 93)).astype(
+        float
+    ).tolist()
+
+    def build():
+        feats = _features()
+        label = FeatureBuilder(ft.RealNN, "label").as_response()
+        vec = transmogrify(feats)
+        selector = ModelSelector(
+            validator=OpTrainValidationSplit(
+                train_ratio=0.75,
+                evaluator=OpBinaryClassificationEvaluator(),
+            ),
+            models=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+            splitter=DataBalancer(sample_fraction=0.3),
+        )
+        pred = selector.set_input(label, vec).get_output()
+        return OpWorkflow().set_result_features(pred), pred
+
+    wf, pred = build()
+    model = wf.set_input_dataset(data).train()
+    summary = model.summary_json()
+    sel_summary = next(
+        st["metadata"]["model_selector_summary"]
+        for st in summary["stages"]
+        if "model_selector_summary" in st.get("metadata", {})
+    )
+    sp = sel_summary["splitter_summary"]
+    assert sp["splitter"] == "DataBalancer" and sp["upSampled"]
+    assert sp["minorityWeight"] > 1.0
+    m = model.evaluate(OpBinaryClassificationEvaluator())
+    assert float(m.AuROC) > 0.7  # amount drives the label outright
+    scored = model.score(data)[pred.name].to_list()
+    model.save(str(tmp_path / "m"))
+    wf2, pred2 = build()
+    m2 = load_model(str(tmp_path / "m"), wf2.set_input_dataset(data))
+    assert m2.score(data)[pred2.name].to_list() == scored
+
+
 def test_nb_and_mlp_pipeline_fuzz(tmp_path):
     """NaiveBayes + MLP (the remaining classifier families) through the
     composition with save/load parity."""
