@@ -355,6 +355,59 @@ def test_warm_start_skips_refit_on_fuzz_schema():
     assert not (fit_uids(model2) & fit_uids(model))
 
 
+def test_data_cutter_drops_rare_class_fuzz():
+    """Multiclass with a 3%-frequency class under DataCutter
+    min_label_fraction: the rare label is cut before CV, the summary
+    names it, and the fitted model never predicts it."""
+    from transmogrifai_tpu.evaluators.multiclass import (
+        OpMultiClassificationEvaluator,
+    )
+    from transmogrifai_tpu.selector.factories import (
+        MultiClassificationModelSelector,
+    )
+    from transmogrifai_tpu.selector.splitters import DataCutter
+
+    rng = _rs(95)
+    n = 160
+    data = _random_data(rng, n, 0.1)
+    amounts = np.asarray(
+        [v if v is not None else 50.0 for v in data["amount"]]
+    )
+    labels = np.digitize(amounts, [48.0]).astype(float)  # classes 0/1
+    rare = rng.choice(n, size=4, replace=False)
+    labels[rare] = 2.0  # ~3% class
+    data["label"] = labels.tolist()
+
+    feats = _features()
+    label = FeatureBuilder(ft.RealNN, "label").as_response()
+    vec = transmogrify(feats)
+    selector = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models_and_parameters=[(OpLogisticRegression(), [{"reg_param": 0.01}])],
+        splitter=DataCutter(min_label_fraction=0.1,
+                            reserve_test_fraction=0.1),
+    )
+    pred = selector.set_input(label, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred)
+        .set_input_dataset(data).train()
+    )
+    sel_summary = next(
+        st["metadata"]["model_selector_summary"]
+        for st in model.summary_json()["stages"]
+        if "model_selector_summary" in st.get("metadata", {})
+    )
+    sp = sel_summary["splitter_summary"]
+    assert sp["splitter"] == "DataCutter"
+    assert 2.0 in sp["labelsDropped"]
+    assert sp["rowsDropped"] == 4
+    scored = model.score(data)[pred.name].to_list()
+    preds = {r["prediction"] for r in scored}
+    assert preds <= {0.0, 1.0}  # the cut class can never be predicted
+    m = model.evaluate(OpMultiClassificationEvaluator())
+    assert float(m.F1) > 0.5
+
+
 def test_data_balancer_pipeline_fuzz(tmp_path):
     """A ~7%-positive label through the selector with DataBalancer: the
     minority up-weighting rides the CV weight vectors (no data copies),
