@@ -539,6 +539,184 @@ def _boston_iris_sections(result: dict) -> None:
         result["iris_error"] = f"{type(e).__name__}: {e}"
 
 
+def _serving_pipeline(est):
+    """Workflow for the serving bench: the full Titanic pipeline when the
+    reference CSV is on this host, else a synthetic mixed-type stand-in
+    with the same stage classes (picklists + reals + integrals through
+    transmogrify -> sanity check -> predictor) so the serving numbers are
+    still full-pipeline, clearly labeled in the artifact."""
+    from transmogrifai_tpu.examples.titanic import (
+        TITANIC_CSV,
+        titanic_workflow,
+    )
+
+    if os.path.exists(TITANIC_CSV):
+        wf, _, _ = titanic_workflow(selector=est, reserve_test_fraction=0.0)
+        return wf, "titanic (PassengerDataAll.csv, 891 rows)"
+    import numpy as np
+
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    rng = np.random.RandomState(7)
+    n = 891
+    cabins = ["A1", "B2", "C3", "D4", None]
+    data = {
+        "label": (rng.rand(n) > 0.6).astype(float).tolist(),
+        "klass": [str(rng.randint(1, 4)) for _ in range(n)],
+        "sex": [("male", "female")[rng.randint(2)] for _ in range(n)],
+        "age": [float(a) if rng.rand() > 0.2 else None
+                for a in rng.uniform(1, 80, n)],
+        "fare": rng.uniform(5, 500, n).round(2).tolist(),
+        "sibs": rng.randint(0, 5, n).astype(float).tolist(),
+        "cabin": [cabins[rng.randint(len(cabins))] for _ in range(n)],
+    }
+    label = FeatureBuilder(ft.RealNN, "label").as_response()
+    klass = FeatureBuilder(ft.PickList, "klass").as_predictor()
+    sex = FeatureBuilder(ft.PickList, "sex").as_predictor()
+    age = FeatureBuilder(ft.Real, "age").as_predictor()
+    fare = FeatureBuilder(ft.Real, "fare").as_predictor()
+    sibs = FeatureBuilder(ft.Integral, "sibs").as_predictor()
+    cabin = FeatureBuilder(ft.PickList, "cabin").as_predictor()
+    vec = transmogrify(
+        [klass, sex, age.fill_missing_with_mean().z_normalize(), fare,
+         sibs, cabin]
+    )
+    checked = label.sanity_check(vec, remove_bad_features=True)
+    pred = est.set_input(label, checked).get_output()
+    wf = (
+        OpWorkflow()
+        .set_result_features(pred)
+        .set_input_dataset(data)
+    )
+    return wf, (
+        "synthetic mixed-type stand-in, 891 rows, 6 raw features "
+        "(titanic csv unavailable on this host)"
+    )
+
+
+def serving_bench(n_requests: int = 2000) -> dict:
+    """Fast serving microbench -> SERVING_BENCH.json (VERDICT r5 Weak #4 /
+    next #4: the RF-winner serving path must clear 1000 rows/s, with the
+    model config NAMED next to the number).
+
+    Three surfaces per model, all on the Titanic pipeline:
+    * batch        - CompiledEndpoint.score_batch, bucketed flat-heap path
+    * row          - endpoint(record) one record per call (the old
+                     score_row_fn contract, batch-of-1 through the bucket)
+    * scheduler    - requests pumped through the MicroBatchScheduler, so
+                     the p50/p95/p99 include queueing + batch formation
+
+    Models: the CV-selected RF winner config (reference README winning
+    family: RandomForest maxDepth=12/numTrees=50/maxBins=32) and the
+    showcased LR pipeline (reg_param=0.01) the 2310 rows/s figure used.
+    """
+    import jax
+
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    from transmogrifai_tpu.serving import (
+        MicroBatchScheduler,
+        RowScoringError,
+        ServingTelemetry,
+        compile_endpoint,
+        records_from_dataset,
+    )
+
+    out: dict = {
+        "platform": jax.default_backend(),
+        "n_requests": n_requests,
+    }
+    configs = [
+        (
+            "rf_winner",
+            OpRandomForestClassifier(num_trees=50, max_depth=12),
+            "OpRandomForestClassifier(num_trees=50, max_depth=12, "
+            "max_bins=32) behind the full stage pipeline (the CV-selected "
+            "winner family/config, reference README.md:61-78)",
+        ),
+        (
+            "lr",
+            OpLogisticRegression(reg_param=0.01),
+            "OpLogisticRegression(reg_param=0.01) behind the full stage "
+            "pipeline (the CPU_MICROBENCH serving_fastpath config)",
+        ),
+    ]
+    for key, est, config_name in configs:
+        wf, dataset_name = _serving_pipeline(est)
+        model = wf.train()
+        base = records_from_dataset(wf.generate_raw_data(),
+                                    model.raw_features)
+        n_rows = len(base)
+        records = (base * (n_requests // n_rows + 1))[:n_requests]
+
+        endpoint = compile_endpoint(model)
+        # batch surface: one timed pass over all requests
+        t0 = time.perf_counter()
+        scored = endpoint.score_batch(records)
+        t_batch = max(time.perf_counter() - t0, 1e-9)
+        assert len(scored) == n_requests
+        assert not any(isinstance(r, RowScoringError) for r in scored)
+        # row surface (batch-of-1 through the bucketed path)
+        n_single = 300
+        t0 = time.perf_counter()
+        for r in records[:n_single]:
+            endpoint(r)
+        t_row = max(time.perf_counter() - t0, 1e-9)
+        # scheduler surface: request-level latency incl. queue + batching
+        # (fresh telemetry shared by endpoint AND scheduler, so batch-fill
+        # stats cover exactly the scheduler-driven phase)
+        sched_tel = ServingTelemetry()
+        endpoint.telemetry = sched_tel
+        with MicroBatchScheduler(
+            endpoint, max_wait_us=2000, telemetry=sched_tel
+        ) as scheduler:
+            results = list(scheduler.score_stream(records, window=256))
+        assert len(results) == n_requests
+        snap = sched_tel.snapshot()
+        out[key] = {
+            "config": config_name,
+            "dataset": dataset_name,
+            "pipeline_rows": n_rows,
+            "batch_rows_per_s": round(n_requests / t_batch, 1),
+            "row_rows_per_s": round(n_single / t_row, 1),
+            "scheduler_rows_per_s": snap["rows_per_s"],
+            "latency_ms": snap["latency_ms"],
+            "mean_batch_size": snap["mean_batch_size"],
+            "batch_fill_histogram": snap["batch_fill_histogram"],
+            "shape_misses": endpoint.shape_misses,
+        }
+    return out
+
+
+def _serving_section(result: dict) -> None:
+    """Run the serving microbench inside the full bench: fields prefix
+    serving_*, artifact side-written to SERVING_BENCH.json."""
+    bench = serving_bench()
+    path = os.environ.get(
+        "TX_SERVING_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "SERVING_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    for key in ("rf_winner", "lr"):
+        sec = bench.get(key, {})
+        result[f"serving_{key}_batch_rows_per_s"] = sec.get(
+            "batch_rows_per_s"
+        )
+        result[f"serving_{key}_row_rows_per_s"] = sec.get("row_rows_per_s")
+        result[f"serving_{key}_p99_ms"] = sec.get(
+            "latency_ms", {}
+        ).get("p99")
+
+
 def main() -> None:
     _ensure_working_backend()
     t_start = time.time()
@@ -674,6 +852,11 @@ def main() -> None:
         result["synth2m_error"] = f"{type(e).__name__}: {e}"
     _checkpoint(result)
     try:
+        _serving_section(result)
+    except Exception as e:
+        result["serving_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
+    try:
         _ingest_section(result)
     except Exception as e:
         result["ingest_error"] = f"{type(e).__name__}: {e}"
@@ -683,4 +866,22 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--serving" in sys.argv:
+        # fast standalone serving microbench: writes SERVING_BENCH.json
+        # and prints it, without the multi-minute full-bench sections
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _serving_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
     sys.exit(main())

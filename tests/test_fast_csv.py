@@ -7,6 +7,7 @@ readers/.../DataReader.scala:173 generateDataFrame.
 """
 import csv as _csv
 import io
+import os
 
 import numpy as np
 import pytest
@@ -205,6 +206,9 @@ def test_titanic_through_fast_reader():
     path inside the example workflow's reader."""
     from transmogrifai_tpu.examples.titanic import TITANIC_CSV
     from transmogrifai_tpu.readers.csv_reader import CSVReader
+
+    if not os.path.exists(TITANIC_CSV):
+        pytest.skip("titanic csv not available on this host")
 
     headers = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
                "parCh", "ticket", "fare", "cabin", "embarked"]

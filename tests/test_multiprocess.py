@@ -17,7 +17,15 @@ real contracts:
   the single-process heaps,
 * (round 5) the MXU-packed shard_map Gram runs with 'data' spanning the
   process boundary - its psum crosses hosts over Gloo - and matches the
-  single-process vmap route's coefficients.
+  single-process vmap route's coefficients,
+* (this round, VERDICT r5 next #9) FOUR processes of one device each form
+  a 2x2 ('data', 'replica') mesh - coordinator address via the
+  JAX_COORDINATOR_ADDRESS env half of the bootstrap - and the packed
+  Gram + GBT fold fits match the single-process answers.
+
+Hosts whose jax CPU backend lacks cross-process collectives ("Multiprocess
+computations aren't implemented on the CPU backend") skip rather than
+fail: the contract is exercised wherever the runtime supports it.
 """
 import os
 import socket
@@ -185,9 +193,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_and_moments(tmp_path):
+_NO_MULTIPROC = "Multiprocess computations aren't implemented"
+
+
+def _run_workers(tmp_path, worker_src: str, n_procs: int,
+                 timeout: int = 280) -> list[str]:
+    """Spawn n worker processes; returns their outputs.  Skips the test
+    when the host's jax CPU backend cannot run cross-process collectives
+    (environment capability, not a code defect)."""
     script = tmp_path / "worker.py"
-    script.write_text(WORKER.format(repo=REPO))
+    script.write_text(worker_src.format(repo=REPO))
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = ""
@@ -197,18 +212,153 @@ def test_two_process_mesh_and_moments(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=280)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:  # a wedged worker must not outlive the test
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    if any(_NO_MULTIPROC in out for out in outs):
+        pytest.skip("jax CPU backend lacks multiprocess collectives here")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert f"proc {i} OK" in out
+    return outs
+
+
+def test_two_process_mesh_and_moments(tmp_path):
+    _run_workers(tmp_path, WORKER, 2)
+
+
+WORKER4 = '''
+import os, sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+# the env half of the bootstrap contract: the coordinator address rides
+# JAX_COORDINATOR_ADDRESS (what a pod launcher exports); process count/id
+# stay explicit because this jax version auto-detects them only from
+# cluster schedulers (SLURM/OMPI), not generic env vars
+os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{{port}}"
+sys.path.insert(0, {repo!r})
+
+from transmogrifai_tpu.parallel.distributed import global_mesh, initialize
+
+initialize(num_processes=4, process_id=pid)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 4, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+# 2x2 ('data', 'replica') mesh: jax.devices() orders by process, so the
+# LEADING axis pairs processes {{0,1}} vs {{2,3}} - 'data' must span that
+# boundary (row psums cross it) while 'replica' splits within each pair
+mesh = global_mesh(("data", "replica"), shape=(2, 2))
+assert mesh.devices.shape == (2, 2)
+data_idx, replica_idx = pid // 2, pid % 2
+lo, hi = (0, 20) if data_idx == 0 else (20, 40)
+
+rng = np.random.RandomState(0)
+X_full = rng.randn(40, 6).astype(np.float32)
+y_full = (rng.rand(40) > 0.5).astype(np.float32)
+
+
+def to_global(local, spec, m=None):
+    return jax.make_array_from_process_local_data(
+        NamedSharding(m or mesh, P(*spec)), local)
+
+
+def replicated(a, m=None):
+    return np.asarray(jax.jit(
+        lambda x: x, out_shardings=NamedSharding(m or mesh, P())
+    )(a))
+
+
+# ---- MXU-packed Gram over the 2x2 mesh, psum crossing 4 processes ------
+from transmogrifai_tpu.models.logistic_regression import _lr_fit_batched
+from transmogrifai_tpu.models.packed_newton import lr_fit_batched_packed
+
+# DISTINCT weights/regs per replica so a replica-shard permutation or a
+# dropped psum contribution cannot cancel out
+W_lr_full = np.stack([
+    np.r_[np.ones(30, np.float32), np.zeros(10, np.float32)],
+    np.r_[np.zeros(10, np.float32), np.ones(30, np.float32)],
+    np.r_[np.ones(20, np.float32), np.zeros(20, np.float32)],
+    np.ones(40, np.float32),
+])
+regs_full = np.asarray([0.003, 0.01, 0.03, 0.1], np.float32)
+ens_full = np.asarray([0.0, 0.2, 0.0, 0.5], np.float32)
+r0 = 2 * replica_idx  # this process's replica rows [r0, r0+2)
+Xp = to_global(X_full[lo:hi], ("data", None))
+yp = to_global(y_full[lo:hi], ("data",))
+Wp = to_global(W_lr_full[r0:r0 + 2, lo:hi], ("replica", "data"))
+rp = to_global(regs_full[r0:r0 + 2], ("replica",))
+ep = to_global(ens_full[r0:r0 + 2], ("replica",))
+bp, ip = lr_fit_batched_packed(
+    Xp, yp, Wp, rp, ep, iters=6, hess_bf16=False, mesh=mesh,
+)
+bv, iv = _lr_fit_batched(
+    jnp.asarray(X_full), jnp.asarray(y_full), jnp.asarray(W_lr_full),
+    jnp.asarray(regs_full), jnp.asarray(ens_full), iters=6,
+)
+assert np.allclose(replicated(bp), np.asarray(bv), atol=5e-4), \\
+    np.abs(replicated(bp) - np.asarray(bv)).max()
+assert np.allclose(replicated(ip), np.asarray(iv), atol=5e-4)
+
+# ---- GBT fold fits row-sharded over all four processes -----------------
+# the boosting scan's level-histogram segment sums psum over 'data'; the
+# chunked margin carry must survive 4-way Gloo sharding bit-compatibly
+from transmogrifai_tpu.models.tree_kernel import (
+    bin_data, fit_gbt_folds, quantile_bin_edges)
+
+mesh_d = global_mesh(("data",))
+qlo, qhi = pid * 10, (pid + 1) * 10  # row quarter per process
+edges = quantile_bin_edges(X_full, 8)
+bins_full = bin_data(X_full, edges)
+W_full = np.stack([
+    np.r_[np.ones(30, np.float32), np.zeros(10, np.float32)],
+    np.r_[np.zeros(10, np.float32), np.ones(30, np.float32)],
+])
+kw = dict(num_trees=4, max_depth=3, max_bins=8, is_classification=True,
+          step_size=jnp.asarray(0.3),
+          min_instances_per_node=jnp.asarray(1.0),
+          min_info_gain=jnp.asarray(0.0))
+f0_g, heaps_g = fit_gbt_folds(
+    to_global(bins_full[qlo:qhi], ("data", None), mesh_d),
+    to_global(y_full[qlo:qhi], ("data",), mesh_d),
+    to_global(W_full[:, qlo:qhi], (None, "data"), mesh_d),
+    **kw,
+)
+f0_l, heaps_l = fit_gbt_folds(
+    jnp.asarray(bins_full), jnp.asarray(y_full), jnp.asarray(W_full), **kw,
+)
+assert np.allclose(replicated(f0_g, mesh_d), np.asarray(f0_l), atol=1e-5)
+for k, (hg, hl) in enumerate(zip(heaps_g, heaps_l)):
+    rep = replicated(hg, mesh_d)
+    want = np.asarray(hl)
+    if want.dtype.kind in "ib":  # tree structure: bit parity
+        assert np.array_equal(rep, want), f"gbt heap {{k}} differs"
+    else:  # float leaf stats: psum ordering tolerance
+        assert np.allclose(rep, want, atol=2e-4), \\
+            np.abs(rep - want).max()
+
+print(f"proc {{pid}} OK", flush=True)
+'''
+
+
+def test_four_process_2x2_mesh_packed_gram_and_gbt(tmp_path):
+    """VERDICT r5 next #9: the multi-host bootstrap at FOUR Gloo
+    processes - the last untested seam in parallel/distributed.py."""
+    _run_workers(tmp_path, WORKER4, 4, timeout=300)

@@ -1,0 +1,422 @@
+"""Serving subsystem: bucketed endpoint, micro-batcher, admission control.
+
+Covers the serving contracts (ISSUE: serving test coverage):
+* batch-of-1 vs batch-of-N prediction parity across LR/RF/GBT winners
+* deadline-shed + queue-overflow admission behavior
+* shape-miss fallback correctness (bad rows isolated, peers still score)
+* deterministic batch-fill scheduling (run_once, no worker thread)
+* per-request timeout surface
+* the RF-winner throughput regression floor (bench-host tier-1 gate)
+* the `serve` run type end-to-end with telemetry JSON export
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.trees import (
+    OpGBTClassifier,
+    OpRandomForestClassifier,
+)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.serving import (
+    DeadlineExceededError,
+    MicroBatchScheduler,
+    QueueFullError,
+    RequestTimeoutError,
+    RowScoringError,
+    ServingTelemetry,
+    compile_endpoint,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _mixed_pipeline(est, n=240, seed=0):
+    """Small full pipeline (numeric + picklist through transmogrify) with
+    ``est`` as the predictor; returns (model, records, prediction_name)."""
+    rng = np.random.RandomState(seed)
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "b": rng.uniform(0, 10, n).round(3).tolist(),
+        "c": [("u", "v", "w")[i % 3] for i in range(n)],
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a, b, c])
+    pred = est.set_input(y, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    )
+    records = [
+        {"a": data["a"][i], "b": data["b"][i], "c": data["c"][i]}
+        for i in range(n)
+    ]
+    return model, records, pred.name
+
+
+WINNERS = [
+    ("lr", lambda: OpLogisticRegression(reg_param=0.01)),
+    ("rf", lambda: OpRandomForestClassifier(num_trees=10, max_depth=4)),
+    ("gbt", lambda: OpGBTClassifier(num_trees=8, max_depth=3)),
+]
+
+
+@pytest.mark.parametrize("name,make", WINNERS, ids=[w[0] for w in WINNERS])
+def test_batch_of_1_vs_batch_of_n_parity(name, make):
+    """Every request must score identically whether it rides alone
+    (bucket 1/pad) or inside a full batch - the bucket padding must be
+    invisible."""
+    model, records, pred_name = _mixed_pipeline(make())
+    endpoint = compile_endpoint(model, batch_buckets=(1, 4, 16, 64))
+    records = records[:50]
+    batched = endpoint.score_batch(records)
+    assert not any(isinstance(r, RowScoringError) for r in batched)
+    singles = [endpoint(r) for r in records]
+    for one, many in zip(singles, batched):
+        po, pm = one[pred_name], many[pred_name]
+        assert po["prediction"] == pm["prediction"]
+        for k in po:
+            if k.startswith("probability"):
+                assert abs(po[k] - pm[k]) < 1e-9, (name, k)
+
+
+def test_endpoint_warmup_primes_every_bucket():
+    model, _, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model, batch_buckets=(2, 8))
+    assert endpoint.warmed_buckets == (2, 8)
+    assert endpoint.warm_error is None
+
+
+def test_oversized_batch_chunks_at_largest_bucket():
+    model, records, pred_name = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model, batch_buckets=(1, 8))
+    out = endpoint.score_batch(records[:20])  # 20 > bucket max 8
+    assert len(out) == 20
+    ref = compile_endpoint(model, batch_buckets=(32,)).score_batch(
+        records[:20]
+    )
+    for a, b in zip(out, ref):
+        assert a[pred_name]["prediction"] == b[pred_name]["prediction"]
+
+
+def test_shape_miss_fallback_isolates_bad_rows():
+    """A malformed record must degrade ITS batch to the row path and come
+    back as RowScoringError without failing its batch peers."""
+    model, records, pred_name = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    good = records[:3]
+    bad = {"a": object(), "b": 1.0, "c": "u"}  # unparseable numeric cell
+    out = endpoint.score_batch([good[0], bad, good[1], good[2]])
+    assert endpoint.shape_misses == 1
+    assert isinstance(out[1], RowScoringError)
+    clean = endpoint.score_batch(good)
+    for got, want in zip([out[0], out[2], out[3]], clean):
+        assert got[pred_name]["prediction"] == want[pred_name]["prediction"]
+    assert endpoint.telemetry.snapshot()["rows_fallback"] == 4
+
+
+def test_queue_overflow_sheds_at_the_front_door():
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    sched = MicroBatchScheduler(
+        endpoint, max_queue=4, max_wait_us=0, start=False
+    )
+    for i in range(4):
+        sched.submit(records[i])
+    with pytest.raises(QueueFullError):
+        sched.submit(records[4])
+    assert endpoint.telemetry.snapshot()["shed_queue_full"] == 1
+    assert sched.run_once() == 4  # the queue drains and recovers
+    sched.submit(records[4])
+    sched.close()
+
+
+def test_deadline_shed_never_scores_dead_requests():
+    """Requests whose deadline passed in the queue resolve with
+    DeadlineExceededError at batch formation and never reach the model."""
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    fake_now = [100.0]
+    sched = MicroBatchScheduler(
+        endpoint, max_wait_us=0, start=False, clock=lambda: fake_now[0]
+    )
+    dead = sched.submit(records[0], deadline_ms=50.0)
+    live = sched.submit(records[1], deadline_ms=10_000.0)
+    fake_now[0] += 1.0  # 1s later: first deadline (50ms) long gone
+    assert sched.run_once() == 1
+    with pytest.raises(DeadlineExceededError):
+        dead.wait(0)
+    assert live.wait(0) is not None
+    snap = endpoint.telemetry.snapshot()
+    assert snap["shed_deadline"] == 1
+    assert snap["rows_scored"] == 1
+    sched.close()
+
+
+def test_per_request_timeout_surface():
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    sched = MicroBatchScheduler(endpoint, start=False)  # nobody drains
+    with pytest.raises(RequestTimeoutError):
+        sched.score(records[0], timeout_s=0.01)
+    assert endpoint.telemetry.snapshot()["request_timeouts"] == 1
+    sched.close()
+
+
+def test_deterministic_batch_fill():
+    """run_once with no worker thread: batch formation is exact - fills
+    to max_batch_size, then drains the remainder as a partial batch."""
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    tel = ServingTelemetry()
+    endpoint = compile_endpoint(
+        model, batch_buckets=(1, 8), telemetry=tel
+    )
+    sched = MicroBatchScheduler(
+        endpoint, max_batch_size=8, max_wait_us=0, start=False,
+        telemetry=tel,
+    )
+    for r in records[:20]:
+        sched.submit(r)
+    sizes = []
+    while True:
+        n = sched.run_once()
+        if n == 0:
+            break
+        sizes.append(n)
+    assert sizes == [8, 8, 4]
+    snap = tel.snapshot()
+    assert snap["batches"] >= 3  # warm-up batches may add to the count
+    assert snap["rows_scored"] == 20
+    hist = snap["batch_fill_histogram"]
+    assert hist["75-100%"] >= 2  # the two full batches
+    sched.close()
+
+
+def test_scheduler_results_match_direct_scoring():
+    """Through-the-batcher results must equal direct endpoint scoring,
+    in submission order, with a live worker thread."""
+    model, records, pred_name = _mixed_pipeline(
+        OpRandomForestClassifier(num_trees=10, max_depth=4)
+    )
+    endpoint = compile_endpoint(model)
+    direct = endpoint.score_batch(records)
+    with MicroBatchScheduler(endpoint, max_wait_us=1000) as sched:
+        served = list(sched.score_stream(iter(records), window=64))
+    assert len(served) == len(records)
+    for s, d in zip(served, direct):
+        assert not isinstance(s, RowScoringError)
+        assert s[pred_name]["prediction"] == d[pred_name]["prediction"]
+
+
+def test_score_stream_backpressures_instead_of_dying_on_full_queue():
+    """A window larger than the admission bound must not kill the stream
+    with QueueFullError - the stream waits on its own oldest request."""
+    model, records, pred_name = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    with MicroBatchScheduler(
+        endpoint, max_queue=8, max_wait_us=200
+    ) as sched:
+        out = list(sched.score_stream(iter(records[:100]), window=64))
+    assert len(out) == 100
+    assert not any(isinstance(r, RowScoringError) for r in out)
+
+
+def test_score_stream_sheds_row_when_queue_full_of_foreign_requests():
+    """With zero in-flight requests of its own and the queue full of
+    other callers' work, the stream sheds the row as RowScoringError
+    rather than raising."""
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    sched = MicroBatchScheduler(
+        endpoint, max_queue=2, max_wait_us=0, start=False
+    )
+    sched.submit(records[0])  # foreign requests hog the queue
+    sched.submit(records[1])
+    out = list(sched.score_stream([records[2]]))
+    assert len(out) == 1
+    assert isinstance(out[0], RowScoringError)
+    assert "QueueFullError" in out[0].error
+    sched.close()
+
+
+def test_submit_after_close_raises_immediately():
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    sched = MicroBatchScheduler(endpoint, start=False)
+    sched.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        sched.submit(records[0])
+    # the admission-side gate holds even if the scheduler flag is missed
+    # (the close()/submit() race goes through the queue lock)
+    with pytest.raises(RuntimeError, match="closed"):
+        sched.admission.admit(records[0])
+
+
+def test_abandoned_request_not_double_counted():
+    """A request whose caller timed out must count once (timeout), not
+    again as a delivered 'ok' when the batch loop later scores it."""
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model)
+    sched = MicroBatchScheduler(endpoint, max_wait_us=0, start=False)
+    with pytest.raises(RequestTimeoutError):
+        sched.score(records[0], timeout_s=0.01)
+    assert sched.run_once() == 1  # the row still scores...
+    snap = endpoint.telemetry.snapshot()
+    assert snap["request_timeouts"] == 1
+    assert snap["rows_scored"] == 0  # ...but is not re-counted
+    sched.close()
+
+
+def test_unscoreable_pad_record_does_not_degrade_batches():
+    """A pipeline that cannot score the all-None pad row (warm_error set)
+    must still serve partial batches through the BATCH path - unpadded -
+    not silently fall back to per-row scoring."""
+    rng = np.random.RandomState(1)
+    n = 120
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    # a map stage that chokes on None: the pad record is unscoreable
+    a2 = a.map_values(lambda v: v * 2.0, ft.Real)
+    vec = transmogrify([a2])
+    pred = OpLogisticRegression().set_input(y, vec).get_output()
+    model = (
+        OpWorkflow().set_result_features(pred).set_input_dataset(data).train()
+    )
+    endpoint = compile_endpoint(model, batch_buckets=(1, 32))
+    assert endpoint.warm_error is not None
+    records = [{"a": data["a"][i]} for i in range(5)]
+    out = endpoint.score_batch(records)  # 5 < bucket 32: would need pads
+    assert len(out) == 5
+    assert not any(isinstance(r, RowScoringError) for r in out)
+    assert endpoint.shape_misses == 0  # batch path, not row fallback
+    assert endpoint.telemetry.snapshot()["rows_fallback"] == 0
+
+
+def test_empty_telemetry_snapshot_is_strict_json():
+    """Zero-traffic snapshots must export valid RFC 8259 JSON: the
+    empty-sample percentiles serialize as null, never a bare NaN token."""
+    snap = ServingTelemetry().snapshot()
+    text = json.dumps(snap)
+    assert "NaN" not in text
+    assert json.loads(text)["latency_ms"]["p50"] is None
+
+
+def test_rf_winner_batch_throughput_floor():
+    """Tier-1 serving regression gate (ISSUE acceptance: RF-winner >= 1000
+    rows/s through the serving endpoint).  The floor is far below the
+    measured ~15k rows/s (SERVING_BENCH.json) so only a real regression -
+    e.g. the per-tree python predict loop coming back - trips it."""
+    est = OpRandomForestClassifier(num_trees=50, max_depth=12)
+    model, records, _ = _mixed_pipeline(est, n=400)
+    endpoint = compile_endpoint(model)
+    requests = (records * 3)[:1000]
+    t0 = time.perf_counter()
+    out = endpoint.score_batch(requests)
+    wall = time.perf_counter() - t0
+    assert len(out) == 1000
+    rows_per_s = len(out) / wall
+    assert rows_per_s >= 1000, (
+        f"RF-winner serving throughput regressed: {rows_per_s:.0f} rows/s"
+    )
+
+
+def test_rf_batch_of_1_flat_heap_predict_is_fast():
+    """The VERDICT r5 Weak #4 root cause must stay fixed: batch-of-1
+    through the flat-heap predict is microseconds, not milliseconds (the
+    old per-tree python loop cost ~6 ms/row on 50 trees)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 12)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    est = OpRandomForestClassifier(num_trees=50, max_depth=12)
+    params = est.fit_arrays(X, y)
+    x1 = X[:1]
+    est.predict_arrays_np(params, x1)  # warm
+    t0 = time.perf_counter()
+    n = 100
+    for _ in range(n):
+        est.predict_arrays_np(params, x1)
+    per_call_ms = (time.perf_counter() - t0) / n * 1e3
+    assert per_call_ms < 2.0, f"batch-of-1 predict {per_call_ms:.2f} ms"
+
+
+def test_serve_run_type_exports_telemetry(tmp_path):
+    """OpWorkflowRunner 'serve': load model, pump reader rows through the
+    micro-batcher, export serving_metrics.json."""
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    rng = np.random.RandomState(3)
+    n = 120
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "c": [("u", "v")[i % 2] for i in range(n)],
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a, c])
+    pred = (
+        OpRandomForestClassifier(num_trees=8, max_depth=3)
+        .set_input(y, vec)
+        .get_output()
+    )
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    model = wf.train()
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+
+    params = OpParams(
+        model_location=model_dir,
+        metrics_location=str(tmp_path / "metrics"),
+        write_location=str(tmp_path / "scores"),
+        custom_params={"serving_max_wait_us": 500, "serving_window": 32},
+    )
+    runner = OpWorkflowRunner(wf)
+    result = runner.run("serve", params)
+    assert result.run_type == "serve"
+    assert result.metrics["rows_scored"] == n
+    assert result.metrics["rows_failed"] == 0
+    for k in ("p50", "p95", "p99"):
+        assert result.metrics["latency_ms"][k] >= 0.0
+    with open(tmp_path / "metrics" / "serving_metrics.json") as f:
+        exported = json.load(f)
+    assert exported["rows_submitted"] == n
+    with open(tmp_path / "scores" / "scores.json") as f:
+        rows = json.load(f)
+    assert len(rows) == n
+    assert all("error" not in r for r in rows)
+
+
+def test_cli_generated_project_has_serve_template(tmp_path):
+    """The project generator must emit serve.py wired to the serving
+    subsystem (parses, imports the right surface)."""
+    import ast
+
+    from transmogrifai_tpu.cli import generate
+
+    csv = tmp_path / "d.csv"
+    rows = ["y,a,c"] + [
+        f"{i % 2},{i * 0.1:.1f},{('u', 'v')[i % 2]}" for i in range(40)
+    ]
+    csv.write_text("\n".join(rows) + "\n")
+    out = tmp_path / "proj"
+    generate(str(csv), "y", "App", str(out))
+    serve_py = out / "serve.py"
+    assert serve_py.exists()
+    src = serve_py.read_text()
+    ast.parse(src)
+    assert "MicroBatchScheduler" in src
+    assert "compile_endpoint" in src

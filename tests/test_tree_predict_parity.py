@@ -65,8 +65,14 @@ def test_native_and_jax_predict_agree(cls, kw, monkeypatch):
     monkeypatch.setitem(est.params, "backend", "jax")
     pred_j, raw_j, prob_j = est.predict_arrays(params, Xs)
 
-    np.testing.assert_array_equal(pred_n, pred_j)
-    if prob_n is not None:
+    if prob_n is None:
+        # regressor means: native C++ and XLA may sum tree outputs in a
+        # different order, so exact f32 bit equality over-asserts (a 1-ULP
+        # 1.2e-7 difference was observed across hosts); class argmax
+        # predictions below stay exactly equal
+        np.testing.assert_allclose(pred_n, pred_j, rtol=1e-6, atol=1e-7)
+    else:
+        np.testing.assert_array_equal(pred_n, pred_j)
         np.testing.assert_allclose(prob_n, prob_j, atol=1e-6)
 
 

@@ -1,0 +1,42 @@
+"""Batched, compiled model serving with admission control + telemetry.
+
+The production-serving tier the reference delegates to MLeap local
+scoring (reference: local/.../OpWorkflowModelLocal.scala) rebuilt
+batch-first for this engine: a micro-batching scheduler packs concurrent
+requests into fixed shape buckets so every predict rides the vectorized
+flat-heap / jitted batch paths, admission control sheds load gracefully,
+and built-in telemetry reports p50/p95/p99 latency, batch fill, queue
+depth, and rows/s as a JSON artifact.
+
+    endpoint = compile_endpoint(model)           # warmed, bucketed
+    with MicroBatchScheduler(endpoint) as srv:
+        result = srv.score(record, timeout_s=1.0)
+    endpoint.telemetry.export("serving_metrics.json")
+"""
+from .admission import (
+    AdmissionController,
+    DeadlineExceededError,
+    QueueFullError,
+    RequestTimeoutError,
+)
+from .endpoint import (
+    CompiledEndpoint,
+    RowScoringError,
+    compile_endpoint,
+    records_from_dataset,
+)
+from .scheduler import MicroBatchScheduler
+from .telemetry import ServingTelemetry
+
+__all__ = [
+    "AdmissionController",
+    "CompiledEndpoint",
+    "DeadlineExceededError",
+    "MicroBatchScheduler",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "RowScoringError",
+    "ServingTelemetry",
+    "compile_endpoint",
+    "records_from_dataset",
+]

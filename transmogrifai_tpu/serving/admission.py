@@ -1,0 +1,182 @@
+"""Admission control for the serving queue: bounded depth + deadlines.
+
+The graceful-degradation half of the serving subsystem (reference frame:
+the reference's serving story is MLeap local scoring behind the caller's
+own RPC stack - local/.../OpWorkflowModelLocal.scala:30-120 - so
+backpressure semantics live here, not in a Spark analog; the policy
+follows TensorFlow Serving's batching-queue admission: bounded queue,
+deadline-aware shedding at dequeue time).
+
+* ``QueueFullError``      - raised at submit when the bounded queue is at
+                            capacity (load shedding at the front door)
+* ``DeadlineExceededError`` - delivered to a request whose deadline passed
+                            while it sat in the queue (shed at dequeue,
+                            never scored: scoring a dead request wastes
+                            a batch slot someone live could use)
+* ``AdmissionController`` - the bounded FIFO both ends share
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+
+class QueueFullError(RuntimeError):
+    """Serving queue at capacity - request rejected at submission."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """Request deadline elapsed before a batch picked it up."""
+
+
+class RequestTimeoutError(TimeoutError):
+    """Caller-side wait timed out (the request may still complete)."""
+
+
+@dataclass
+class _Request:
+    """One queued score request; the scheduler resolves it like a future."""
+
+    record: Mapping[str, Any]
+    enqueued_at: float
+    deadline: Optional[float] = None  # absolute monotonic time, or None
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[BaseException] = None
+    #: set when the caller stopped waiting (its wait timed out): the row
+    #: still scores, but telemetry must not double-count it as delivered.
+    #: Guarded by _state_lock so abandon vs resolve is a strict
+    #: either/or - without it the batch loop could read abandoned=False
+    #: and record 'ok' in the same instant the caller records 'timeout'.
+    abandoned: bool = False
+    _state_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def resolve(self, result: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+    def try_abandon(self) -> bool:
+        """Mark abandoned unless already resolved; True when this caller
+        owns the abandonment (and so the 'timeout' telemetry record)."""
+        with self._state_lock:
+            if self.done.is_set():
+                return False
+            self.abandoned = True
+            return True
+
+    def resolve_delivered(self, result: Any = None,
+                          error: Optional[BaseException] = None) -> bool:
+        """Resolve; True when the request was NOT abandoned (the resolver
+        owns the delivered/failed telemetry record)."""
+        with self._state_lock:
+            self.resolve(result=result, error=error)
+            return not self.abandoned
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self.done.wait(timeout):
+            raise RequestTimeoutError(
+                f"request not completed within {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class AdmissionController:
+    """Bounded FIFO with deadline-aware dequeue.
+
+    ``admit`` is the producer side (request threads); ``take`` the consumer
+    side (the scheduler's batch loop).  Expired requests are resolved with
+    DeadlineExceededError at take() time and never reach the endpoint.
+    """
+
+    def __init__(self, max_queue: int = 1024,
+                 clock=time.monotonic) -> None:
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.not_empty = threading.Condition(self._lock)
+        self._queue: deque[_Request] = deque()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def admit(self, record: Mapping[str, Any],
+              deadline_s: Optional[float] = None) -> _Request:
+        """Enqueue or raise QueueFullError.  ``deadline_s`` is relative to
+        now; the request is shed (not scored) if still queued past it."""
+        now = self.clock()
+        req = _Request(
+            record=record, enqueued_at=now,
+            deadline=None if deadline_s is None else now + deadline_s,
+        )
+        with self.not_empty:
+            if self._closed:
+                # checked under the SAME lock close() drains with, so a
+                # request can never slip in after the final drain and
+                # strand its caller
+                raise RuntimeError("scheduler closed")
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(
+                    f"serving queue full ({self.max_queue} pending)"
+                )
+            self._queue.append(req)
+            self.not_empty.notify()
+        return req
+
+    def take(self, max_n: int) -> tuple[list[_Request], list[_Request]]:
+        """Dequeue up to ``max_n`` live requests -> (live, shed).  Shed
+        requests are already resolved with DeadlineExceededError."""
+        now = self.clock()
+        live: list[_Request] = []
+        shed: list[_Request] = []
+        with self._lock:
+            while self._queue and len(live) < max_n:
+                req = self._queue.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    shed.append(req)
+                else:
+                    live.append(req)
+        for req in shed:
+            req.resolve_delivered(error=DeadlineExceededError(
+                f"deadline exceeded after "
+                f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"
+            ))
+        return live, shed
+
+    def wait_for_fill(self, n: int, timeout: Optional[float] = None) -> int:
+        """Block until >= n requests are queued or ``timeout`` elapses;
+        returns the queue depth seen (the scheduler's linger-for-fill)."""
+        with self.not_empty:
+            self.not_empty.wait_for(
+                lambda: len(self._queue) >= n, timeout
+            )
+            return len(self._queue)
+
+    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+        with self.not_empty:
+            if self._queue:
+                return True
+            return bool(self.not_empty.wait_for(
+                lambda: bool(self._queue), timeout
+            ))
+
+    def close(self) -> None:
+        """Refuse all future admissions (shutdown path; see drain)."""
+        with self._lock:
+            self._closed = True
+
+    def drain(self) -> list[_Request]:
+        """Remove and return everything pending (shutdown path)."""
+        with self._lock:
+            out, self._queue = list(self._queue), deque()
+        return out

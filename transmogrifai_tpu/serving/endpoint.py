@@ -1,0 +1,173 @@
+"""Compiled, batch-first scoring endpoint over a fitted OpWorkflowModel.
+
+The serving analog of the reference's MLeap-compiled local model
+(reference: local/.../OpWorkflowModelLocal.scala:30-120 compiles a fitted
+pipeline once into a reusable score function) built batch-FIRST: the
+single-row contract the reference exposes is the degenerate case here,
+not the design center.
+
+* the scoring DAG resolves ONCE at construction (the LocalScorer's
+  precompiled (stage, inputs, output) plan, numpy predict paths);
+* requests score through fixed shape BUCKETS (pad to the next bucket, so
+  repeated batch shapes reuse every shape-keyed cache: one-hot code
+  memos, fitted-metadata memos, and - for any stage that does dispatch
+  to jax - its jit cache);
+* tree predicts hit ONE flat-heap C++/vectorized-numpy call per batch
+  (models/trees.predict_arrays_np), never a per-row or per-tree loop;
+* construction warm-up primes each bucket ahead of traffic, so the
+  first real request never pays cold-path latency;
+* a batch that fails the compiled path degrades gracefully: rows re-score
+  individually through the row fallback, bad rows surface as
+  ``RowScoringError`` results instead of poisoning their batch peers.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional, Sequence
+
+from ..local.scorer import LocalScorer
+from .telemetry import ServingTelemetry
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+@dataclass
+class RowScoringError:
+    """Per-row failure marker returned in a batch's result list (the
+    scheduler converts it into the request's exception; direct batch
+    callers can filter)."""
+
+    error: str
+
+
+class CompiledEndpoint:
+    """Batch-first compiled scorer with shape buckets + row fallback."""
+
+    def __init__(
+        self,
+        model,
+        batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
+        warm: bool = True,
+        warm_record: Optional[Mapping[str, Any]] = None,
+        telemetry: Optional[ServingTelemetry] = None,
+    ) -> None:
+        if not batch_buckets or any(int(b) < 1 for b in batch_buckets):
+            raise ValueError("batch_buckets must be positive sizes")
+        self.batch_buckets = tuple(sorted({int(b) for b in batch_buckets}))
+        self.telemetry = telemetry if telemetry is not None else ServingTelemetry()
+        self._scorer = LocalScorer(model)
+        # the pad row: scored to fill a bucket, sliced off before return.
+        # All-None raw features ride the same missing-value handling every
+        # stage already implements; a caller-provided warm_record is used
+        # instead when the pipeline requires non-null rows.
+        self._pad_record: Mapping[str, Any] = dict(
+            warm_record
+            if warm_record is not None
+            else {f.name: None for f in self._scorer.raw_features}
+        )
+        self.shape_misses = 0
+        self.warmed_buckets: tuple[int, ...] = ()
+        self.warm_error: Optional[str] = None
+        if warm:
+            self.warm_up()
+
+    # -- warm-up ------------------------------------------------------------
+    def warm_up(self) -> tuple[int, ...]:
+        """Score one pad-batch per bucket ahead of traffic: primes the
+        one-hot/metadata memos and any jit cache for EXACTLY the shapes
+        the bucketed hot path will submit.  Best-effort: a pipeline that
+        cannot score the pad record serves cold (warm_error records why)."""
+        warmed = []
+        try:
+            for b in self.batch_buckets:
+                self._scorer.score_batch([self._pad_record] * b)
+                warmed.append(b)
+        except Exception as e:  # noqa: BLE001 - warm-up must never kill serving
+            self.warm_error = f"{type(e).__name__}: {e}"
+        self.warmed_buckets = tuple(warmed)
+        return self.warmed_buckets
+
+    # -- scoring ------------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (callers chunk at the largest bucket)."""
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    def score_batch(self, records: Sequence[Mapping[str, Any]]) -> list:
+        """Score a batch through the bucketed compiled path; element i of
+        the result aligns with records[i] (RowScoringError on failure)."""
+        out: list = []
+        step = self.batch_buckets[-1]
+        for lo in range(0, len(records), step):
+            out.extend(self._score_bucketed(records[lo:lo + step]))
+        return out
+
+    def _score_bucketed(self, records: Sequence[Mapping[str, Any]]) -> list:
+        n = len(records)
+        if n == 0:
+            return []
+        bucket = self.bucket_for(n)
+        if self.warm_error is not None:
+            # the pad record itself cannot score through this pipeline
+            # (warm-up told us): padding every partial batch with it
+            # would silently degrade ALL serving to the per-row fallback.
+            # Score the exact batch instead - no shape bucketing, but the
+            # batch path stays hot.
+            padded = list(records)
+        else:
+            padded = list(records) + [self._pad_record] * (bucket - n)
+        t0 = time.perf_counter()
+        try:
+            results = self._scorer.score_batch(padded)[:n]
+        except Exception:  # noqa: BLE001 - degrade to the row path
+            # shape miss / malformed row: the compiled batch path assumes
+            # bucket-shaped well-formed batches; anything else re-scores
+            # row by row so one bad request cannot fail its batch peers.
+            # Deliberately NOT record_batch: these rows never rode the
+            # batch path, and counting them would make batch_rows_per_s /
+            # batch-fill read nominal while serving is fully degraded -
+            # rows_fallback is the truth signal
+            self.shape_misses += 1
+            results = self._score_rows_fallback(records)
+            self.telemetry.record_fallback_rows(n)
+            return results
+        self.telemetry.record_batch(n, bucket, time.perf_counter() - t0)
+        return results
+
+    def _score_rows_fallback(self, records: Sequence[Mapping[str, Any]]) -> list:
+        out: list = []
+        for r in records:
+            try:
+                out.append(self._scorer(r))
+            except Exception as e:  # noqa: BLE001 - isolate the bad row
+                out.append(RowScoringError(f"{type(e).__name__}: {e}"))
+        return out
+
+    def __call__(self, record: Mapping[str, Any]) -> Any:
+        return self.score_batch([record])[0]
+
+    @property
+    def result_features(self):
+        return self._scorer.result_features
+
+    @property
+    def raw_features(self):
+        return self._scorer.raw_features
+
+
+def compile_endpoint(model, **kw) -> CompiledEndpoint:
+    """Compile a fitted OpWorkflowModel into a warmed batch-first endpoint
+    (the serving counterpart of local.score_function)."""
+    return CompiledEndpoint(model, **kw)
+
+
+def records_from_dataset(ds, features) -> list[dict[str, Any]]:
+    """Dataset -> per-row request dicts restricted to ``features`` (the
+    one conversion the runner's serve run and the serving bench share)."""
+    cols = ds.to_pylists()
+    names = [f.name for f in features if f.name in cols]
+    n = len(cols[names[0]]) if names else 0
+    return [{k: cols[k][i] for k in names} for i in range(n)]

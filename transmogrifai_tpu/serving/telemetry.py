@@ -1,0 +1,174 @@
+"""Serving-tier telemetry: latency/throughput/queue/batch-fill metrics.
+
+Counterpart of the per-stage AppMetrics accumulation in utils/tracing.py
+(reference: OpSparkListener / AppMetrics, utils/.../spark/
+OpSparkListener.scala:56-161) specialized to the request/response tier:
+per-request latency percentiles (p50/p95/p99), rows/s, admission-control
+outcome counters (shed/timeout/fallback), queue-depth samples, and a
+batch-fill histogram showing how well the micro-batching scheduler packs
+its shape buckets.  Snapshots export as a JSON artifact (the serving
+analog of the bench's one-JSON-line evidence contract).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..utils.tracing import percentiles
+
+log = logging.getLogger("transmogrifai_tpu.serving")
+
+LOG_PREFIX = "op_serving_metrics"
+
+#: bounded sample reservoirs - serving loops run unbounded, telemetry
+#: memory must not (beyond the cap, samples decimate 2:1, keeping every
+#: other sample so the distribution stays representative)
+_MAX_SAMPLES = 65536
+
+
+def _finite(v: float, ndigits: int):
+    """Round, mapping the empty-sample NaN to None: bare NaN tokens are
+    not valid JSON (RFC 8259) and would break strict consumers of the
+    exported artifact."""
+    return None if v != v else round(v, ndigits)
+
+
+class ServingTelemetry:
+    """Thread-safe accumulator shared by endpoint + scheduler."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+        self._latencies_s: list[float] = []
+        self._batch_sizes: list[int] = []
+        self._batch_fills: list[float] = []
+        self._queue_depths: list[int] = []
+        self.rows_ok = 0
+        self.rows_fallback = 0
+        self.rows_failed = 0
+        self.rows_batched = 0
+        self.shed_deadline = 0
+        self.shed_queue_full = 0
+        self.request_timeouts = 0
+        self.batches = 0
+        self.batch_wall_s = 0.0
+
+    # -- recording ----------------------------------------------------------
+    def _sample(self, bucket: list, value) -> None:
+        bucket.append(value)
+        if len(bucket) > _MAX_SAMPLES:
+            del bucket[::2]
+
+    def record_request(self, latency_s: float, outcome: str = "ok") -> None:
+        """Outcomes: ok | failed | shed_deadline | shed_queue_full |
+        timeout."""
+        with self._lock:
+            if outcome in ("ok", "failed"):
+                self._sample(self._latencies_s, float(latency_s))
+            if outcome == "ok":
+                self.rows_ok += 1
+            elif outcome == "failed":
+                self.rows_failed += 1
+            elif outcome == "shed_deadline":
+                self.shed_deadline += 1
+            elif outcome == "shed_queue_full":
+                self.shed_queue_full += 1
+            elif outcome == "timeout":
+                self.request_timeouts += 1
+
+    def record_batch(self, n_rows: int, bucket_size: int,
+                     wall_s: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_wall_s += float(wall_s)
+            self.rows_batched += int(n_rows)
+            self._sample(self._batch_sizes, int(n_rows))
+            self._sample(
+                self._batch_fills, n_rows / bucket_size if bucket_size else 0.0
+            )
+
+    def record_fallback_rows(self, n: int) -> None:
+        """Rows that missed the compiled bucketed path and scored through
+        the row fallback (request-level ok/failed accounting stays with
+        the caller - this only tracks the degradation count)."""
+        with self._lock:
+            self.rows_fallback += int(n)
+
+    def record_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._sample(self._queue_depths, int(depth))
+
+    # -- reporting ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat_ms = [v * 1e3 for v in self._latencies_s]
+            fills = list(self._batch_fills)
+            sizes = list(self._batch_sizes)
+            depths = list(self._queue_depths)
+            wall = max(time.time() - self.started_at, 1e-9)
+            batch_wall = max(self.batch_wall_s, 1e-9)
+            rows = self.rows_ok + self.rows_failed
+            fill_hist = {"0-25%": 0, "25-50%": 0, "50-75%": 0, "75-100%": 0}
+            for f in fills:
+                if f <= 0.25:
+                    fill_hist["0-25%"] += 1
+                elif f <= 0.5:
+                    fill_hist["25-50%"] += 1
+                elif f <= 0.75:
+                    fill_hist["50-75%"] += 1
+                else:
+                    fill_hist["75-100%"] += 1
+            return {
+                "wall_s": round(wall, 3),
+                "rows_scored": self.rows_ok,
+                "rows_failed": self.rows_failed,
+                "rows_fallback": self.rows_fallback,
+                "shed_deadline": self.shed_deadline,
+                "shed_queue_full": self.shed_queue_full,
+                "request_timeouts": self.request_timeouts,
+                "rows_per_s": round(rows / wall, 1),
+                "rows_batched": self.rows_batched,
+                "batch_rows_per_s": round(self.rows_batched / batch_wall, 1),
+                "latency_ms": {
+                    k: _finite(v, 3)
+                    for k, v in percentiles(lat_ms, (50.0, 95.0, 99.0)).items()
+                },
+                "batches": self.batches,
+                "mean_batch_size": round(
+                    sum(sizes) / len(sizes), 2) if sizes else 0.0,
+                "batch_fill_histogram": fill_hist,
+                "queue_depth": {
+                    "max": max(depths) if depths else 0,
+                    **{k: _finite(v, 1)
+                       for k, v in percentiles(depths, (50.0, 99.0)).items()},
+                },
+            }
+
+    def log_line(self) -> str:
+        snap = self.snapshot()
+        lat = snap["latency_ms"]
+        kv = {
+            "rows": snap["rows_scored"],
+            "rows_per_s": snap["rows_per_s"],
+            "p50_ms": lat["p50"],
+            "p95_ms": lat["p95"],
+            "p99_ms": lat["p99"],
+            "shed": snap["shed_deadline"] + snap["shed_queue_full"],
+            "fallback": snap["rows_fallback"],
+        }
+        return LOG_PREFIX + " " + " ".join(f"{k}={v}" for k, v in kv.items())
+
+    def export(self, path: str, extra: Optional[dict] = None) -> dict:
+        """Write the snapshot (plus caller context, e.g. the model config
+        served) as the JSON telemetry artifact; returns what was written."""
+        snap = self.snapshot()
+        if extra:
+            snap.update(extra)
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log.info(self.log_line())
+        return snap

@@ -97,6 +97,27 @@ class AppMetrics:
         }
 
 
+def percentiles(
+    values, qs: tuple = (50.0, 95.0, 99.0)
+) -> dict[str, float]:
+    """Empirical percentiles keyed 'p50'/'p95'/'p99' (linear interpolation
+    between order statistics).  The shared latency-summary helper behind
+    the serving telemetry (serving/telemetry.py) - dependency-light on
+    purpose so tracing stays importable before jax/numpy init."""
+    out: dict[str, float] = {}
+    vals = sorted(float(v) for v in values)
+    for q in qs:
+        key = f"p{q:g}"
+        if not vals:
+            out[key] = float("nan")
+            continue
+        pos = (len(vals) - 1) * (q / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, len(vals) - 1)
+        out[key] = vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+    return out
+
+
 @contextlib.contextmanager
 def profile_to(path: Optional[str]) -> Iterator[None]:
     """Wrap a block in a JAX profiler trace (xplane dump readable by

@@ -10,6 +10,10 @@ OpWorkflowRunner.scala:296-365, OpApp.scala:49-209): run types
 * streaming_score- micro-batch scoring loop over a batch iterator
                    (reference: StreamingScore over DStreams,
                    OpWorkflowRunner.scala:313-332)
+* serve          - load model, compile the batch-first serving endpoint
+                   (serving/), pump the reader's rows through the
+                   micro-batching scheduler as requests, export the
+                   latency/throughput telemetry JSON
 
 plus a CLI (``python -m transmogrifai_tpu.workflow.runner --run-type ...``)
 standing in for OpApp.main's scopt parsing.
@@ -67,6 +71,8 @@ class OpWorkflowRunner:
             result = self._features(params)
         elif run_type == "evaluate":
             result = self._evaluate(params)
+        elif run_type == "serve":
+            result = self._serve(params)
         else:
             raise ValueError(f"unknown run type {run_type!r}")
         result.wall_s = time.time() - t0
@@ -137,6 +143,73 @@ class OpWorkflowRunner:
         return OpWorkflowRunnerResult(run_type="evaluate", model=model,
                                       scores=scored, metrics=mj)
 
+    def _serve(self, params: OpParams) -> OpWorkflowRunnerResult:
+        """Request/response serving run: every reader row becomes one
+        request through the micro-batching scheduler (serving/), then the
+        built-in telemetry (p50/p95/p99, rows/s, batch fill, queue depth)
+        exports to ``<metrics_location>/serving_metrics.json``.  Knobs
+        ride OpParams.custom_params: serving_buckets, serving_max_wait_us,
+        serving_max_queue, serving_deadline_ms, serving_window."""
+        from ..serving import (
+            MicroBatchScheduler,
+            RowScoringError,
+            compile_endpoint,
+            records_from_dataset,
+        )
+
+        model = self._load_model(params)
+        reader = self._reader("score")
+        if reader is not None:
+            raw = reader.generate_dataset(
+                model.raw_features, params.reader_params
+            )
+        else:
+            # no reader: serve the workflow's attached input dataset
+            raw = self.workflow.generate_raw_data()
+        records = records_from_dataset(raw, model.raw_features)
+        n = len(records)
+        cp = params.custom_params
+        endpoint = compile_endpoint(
+            model,
+            batch_buckets=tuple(cp.get("serving_buckets", (1, 8, 32, 128))),
+        )
+        deadline = cp.get("serving_deadline_ms")
+        with MicroBatchScheduler(
+            endpoint,
+            max_wait_us=int(cp.get("serving_max_wait_us", 2000)),
+            max_queue=int(cp.get("serving_max_queue", 1024)),
+            default_deadline_ms=None if deadline is None else float(deadline),
+        ) as scheduler:
+            results = list(scheduler.score_stream(
+                records, window=int(cp.get("serving_window", 256))
+            ))
+        extra = {
+            "run_type": "serve",
+            "rows_submitted": n,
+            "model_location": params.model_location,
+        }
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            metrics = endpoint.telemetry.export(
+                os.path.join(params.metrics_location, "serving_metrics.json"),
+                extra=extra,
+            )
+        else:
+            metrics = dict(endpoint.telemetry.snapshot(), **extra)
+        if params.write_location:
+            os.makedirs(params.write_location, exist_ok=True)
+            rows = [
+                {"error": r.error} if isinstance(r, RowScoringError) else r
+                for r in results
+            ]
+            with open(
+                os.path.join(params.write_location, "scores.json"), "w"
+            ) as f:
+                json.dump(rows, f, default=str)
+        return OpWorkflowRunnerResult(
+            run_type="serve", model=model, metrics=metrics
+        )
+
     # ------------------------------------------------------------------
     def streaming_score(
         self,
@@ -193,7 +266,8 @@ def main(argv=None) -> int:
     """CLI entry (OpApp.main analog)."""
     p = argparse.ArgumentParser(description="transmogrifai_tpu workflow runner")
     p.add_argument("--run-type", required=True,
-                   choices=["train", "score", "features", "evaluate"])
+                   choices=["train", "score", "features", "evaluate",
+                            "serve"])
     p.add_argument("--params", help="path to OpParams JSON")
     p.add_argument("--workflow", required=True,
                    help="module:function returning (workflow, evaluator, readers...)")
