@@ -693,6 +693,150 @@ def serving_bench(n_requests: int = 2000) -> dict:
     return out
 
 
+def faults_bench() -> dict:
+    """Recovery drills -> FAULTS_BENCH.json (ISSUE 2 acceptance): a kill
+    during save_model leaves a loadable last-good artifact, K injected
+    batch failures open the serving breaker (then a half-open probe
+    closes it), and the supervisor backs off between re-dispatches.  The
+    artifact reports detection latency, restarts used, and requests shed
+    vs. served while the breaker was open."""
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from transmogrifai_tpu.faults import injection
+    from transmogrifai_tpu.serialization.model_io import (
+        LAST_GOOD_SUFFIX,
+        load_model,
+        verify_artifact,
+    )
+    from transmogrifai_tpu.serving import (
+        CircuitBreaker,
+        RowScoringError,
+        ServingTelemetry,
+        compile_endpoint,
+    )
+    from transmogrifai_tpu.testkit.drills import (
+        CRASH_SAVER_TEMPLATE,
+        DIE_ONCE_CHILD_TEMPLATE,
+        drill_env,
+        tiny_drill_pipeline,
+    )
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    out: dict = {"platform": jax.default_backend()}
+    env = drill_env()
+
+    # -- drill 1: crash mid-save -> checksum-verified last-good recovery
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m")
+        script = os.path.join(td, "saver.py")
+        with open(script, "w") as f:
+            f.write(CRASH_SAVER_TEMPLATE.format(
+                repo=os.path.dirname(os.path.abspath(__file__)), path=path,
+                fault="io.save_model.crash_window:on=1"))
+        proc = subprocess.run([sys.executable, script], env=env, timeout=600)
+        wf2, _data, records, _name = tiny_drill_pipeline(n=240)
+        t0 = time.perf_counter()
+        primary_damage = verify_artifact(path)
+        model = load_model(path, wf2)
+        t_recover = time.perf_counter() - t0
+        out["save_crash"] = {
+            "child_exit_code": proc.returncode,
+            "primary_artifact_damage": primary_damage or "intact",
+            "recovered_from_last_good": os.path.isdir(
+                path + LAST_GOOD_SUFFIX),
+            "detect_and_recover_ms": round(t_recover * 1e3, 2),
+        }
+    # the recovered model also serves the remaining drills
+    telemetry = ServingTelemetry()
+    fake_now = [0.0]
+    K = 5
+    breaker = CircuitBreaker(failure_threshold=K, cooldown_s=30.0,
+                             clock=lambda: fake_now[0])
+    endpoint = compile_endpoint(model, batch_buckets=(1, 8),
+                                telemetry=telemetry, breaker=breaker)
+
+    # -- drill 2: K consecutive batch failures -> breaker open -> shed
+    injection.configure(f"serving.batch:every=1:times={K}")
+    t0 = time.perf_counter()
+    degraded = 0
+    while breaker.state != "open":
+        endpoint.score_batch(records[:4])
+        degraded += 4
+    detect_s = time.perf_counter() - t0
+    shed = served = 0
+    t0 = time.perf_counter()
+    for r in records[:200]:
+        res = endpoint.score_batch([r])[0]
+        if isinstance(res, RowScoringError) and res.shed:
+            shed += 1
+        elif not isinstance(res, RowScoringError):
+            served += 1
+    shed_wall_s = max(time.perf_counter() - t0, 1e-9)
+    fake_now[0] = 31.0  # cooldown elapses -> half-open probe (clean path)
+    probe = endpoint.score_batch(records[:4])
+    snap = telemetry.snapshot()
+    out["breaker"] = {
+        "failure_threshold": K,
+        "failures_to_open": degraded // 4,
+        "detection_latency_ms": round(detect_s * 1e3, 2),
+        "shed_while_open": shed,
+        "served_while_open": served,
+        "shed_rows_per_s": round(shed / shed_wall_s, 1),
+        "probe_closed_breaker": breaker.state == "closed"
+        and not any(isinstance(r, RowScoringError) for r in probe),
+        "transitions": snap["breaker"],
+    }
+    injection.reset()
+
+    # -- drill 3: supervised child dies once -> backoff -> resume
+    with tempfile.TemporaryDirectory() as td:
+        marker = os.path.join(td, "died")
+        child = os.path.join(td, "child.py")
+        with open(child, "w") as f:
+            f.write(DIE_ONCE_CHILD_TEMPLATE.format(
+                marker=marker, first_exit=9, then_exit=0))
+        t0 = time.perf_counter()
+        res = supervise(
+            [sys.executable, child],
+            heartbeat_path=os.path.join(td, "hb"),
+            stale_after_s=60.0, max_restarts=3, poll_s=0.05,
+            backoff_base_s=0.25, backoff_jitter=0.1, backoff_seed=0,
+            env=env,
+        )
+        out["supervisor"] = {
+            "attempts": res.attempts,
+            "restarts_used": len(res.restarts),
+            "backoff_waits_s": [r[2] for r in res.restarts],
+            "recovered_wall_s": round(time.perf_counter() - t0, 2),
+        }
+    return out
+
+
+def _faults_section(result: dict) -> None:
+    """Run the fault drills: artifact side-written to FAULTS_BENCH.json,
+    headline recovery numbers folded into the main result."""
+    bench = faults_bench()
+    path = os.environ.get(
+        "TX_FAULTS_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "FAULTS_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["faults_recover_ms"] = bench["save_crash"][
+        "detect_and_recover_ms"]
+    result["faults_breaker_detect_ms"] = bench["breaker"][
+        "detection_latency_ms"]
+    result["faults_breaker_probe_closed"] = bench["breaker"][
+        "probe_closed_breaker"]
+    result["faults_supervisor_attempts"] = bench["supervisor"]["attempts"]
+
+
 def _serving_section(result: dict) -> None:
     """Run the serving microbench inside the full bench: fields prefix
     serving_*, artifact side-written to SERVING_BENCH.json."""
@@ -857,6 +1001,11 @@ def main() -> None:
         result["serving_error"] = f"{type(e).__name__}: {e}"
     _checkpoint(result)
     try:
+        _faults_section(result)
+    except Exception as e:
+        result["faults_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
+    try:
         _ingest_section(result)
     except Exception as e:
         result["ingest_error"] = f"{type(e).__name__}: {e}"
@@ -866,6 +1015,24 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--faults" in sys.argv:
+        # fast standalone fault/recovery drills: writes FAULTS_BENCH.json
+        # and prints it, without the multi-minute full-bench sections
+        _ensure_working_backend()
+        _res = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _faults_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
     if "--serving" in sys.argv:
         # fast standalone serving microbench: writes SERVING_BENCH.json
         # and prints it, without the multi-minute full-bench sections

@@ -1,0 +1,399 @@
+"""Fault-injection drill suite (ISSUE 2 tentpole).
+
+Every named injection point in transmogrifai_tpu/faults is exercised
+end-to-end against the hardening it proves out:
+
+* io.save_model.crash / crash_window  -> crash-consistent artifact swap
+  (a kill mid-save leaves a loadable, checksum-verified artifact)
+* serving.batch / nan_scores / slow_batch -> circuit breaker opens after
+  K consecutive batch failures, sheds fast, half-open probe closes it;
+  the NaN/Inf guard refuses non-finite scores
+* supervisor.child_kill + deterministic exits -> backoff between
+  re-dispatches, waits recorded, fail-fast on repeated identical codes
+* native.load -> kernel-library-unavailable degradation to pure python
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.faults.injection import FaultSpecError, InjectedFault
+from transmogrifai_tpu.serialization.model_io import (
+    LAST_GOOD_SUFFIX,
+    MANIFEST_JSON,
+    load_model,
+    verify_artifact,
+)
+from transmogrifai_tpu.serving import (
+    BreakerOpenError,
+    CircuitBreaker,
+    MicroBatchScheduler,
+    RowScoringError,
+    ServingTelemetry,
+    compile_endpoint,
+)
+from transmogrifai_tpu.testkit.drills import (
+    CRASH_SAVER_TEMPLATE,
+    DIE_ONCE_CHILD_TEMPLATE,
+    drill_env,
+    tiny_drill_pipeline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every drill arms injection explicitly; none may leak."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- the injection framework itself -----------------------------------------
+
+def test_spec_parsing_and_triggers():
+    plan = faults.configure(
+        "a.b:on=2 c.d:every=3:times=1;e.f:prob=0.5:seed=7"
+    )
+    assert plan.points() == ("a.b", "c.d", "e.f")
+    assert faults.fires("a.b") is None       # call 1
+    assert faults.fires("a.b") is not None   # call 2 == on
+    assert faults.fires("a.b") is None       # call 3
+    assert [faults.fires("c.d") is not None for _ in range(7)] == [
+        False, False, True, False, False, False, False  # times=1 caps it
+    ]
+    # unarmed points never fire
+    assert faults.fires("nope") is None
+
+
+def test_prob_trigger_is_seed_deterministic():
+    draws = []
+    for _ in range(2):
+        faults.configure("p.q:prob=0.5:seed=11")
+        draws.append([faults.fires("p.q") is not None for _ in range(20)])
+    assert draws[0] == draws[1]
+    assert any(draws[0]) and not all(draws[0])
+
+
+def test_bad_specs_are_loud():
+    with pytest.raises(FaultSpecError):
+        faults.configure("x.y:nope=1")
+    with pytest.raises(FaultSpecError):
+        faults.configure("x.y:on=zero")
+    with pytest.raises(FaultSpecError):
+        faults.configure("x.y:prob=1.5")
+    with pytest.raises(FaultSpecError, match="duplicate"):
+        faults.configure("x.y:on=1 x.y:on=5")
+
+
+def test_inject_raises_and_reset_disarms():
+    faults.configure("k.e:every=1")
+    with pytest.raises(InjectedFault):
+        faults.inject("k.e")
+    faults.reset()
+    faults.inject("k.e")  # disarmed: no-op
+
+
+# -- crash-consistent model IO ----------------------------------------------
+# the crash drills re-train the shared tiny pipeline in a child process
+# (os._exit kills the child, never the test runner), save a clean v1,
+# then die mid-save of v2 at the injected point
+
+
+@pytest.mark.parametrize("point", [
+    "io.save_model.crash", "io.save_model.crash_window",
+])
+def test_kill_during_save_leaves_loadable_artifact(tmp_path, point):
+    path = str(tmp_path / "m")
+    script = tmp_path / "saver.py"
+    script.write_text(CRASH_SAVER_TEMPLATE.format(
+        repo=REPO, path=path, fault=f"{point}:on=1"))
+    proc = subprocess.run([sys.executable, str(script)], env=drill_env(),
+                          timeout=300)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really crashed
+    if point == "io.save_model.crash":
+        # death inside the tempdir write: v1 still in place, verified
+        assert verify_artifact(path) is None
+    else:
+        # death between the swap renames: primary gone, last-good holds v1
+        assert not os.path.isdir(path)
+        assert verify_artifact(path + LAST_GOOD_SUFFIX) is None
+    wf2, data, _records, pred_name = tiny_drill_pipeline()
+    m2 = load_model(path, wf2)
+    scored = m2.score(data)[pred_name].to_list()
+    assert len(scored) == len(data["y"])
+
+
+def test_repeated_saves_keep_last_good(tmp_path):
+    wf, _data, _records, _name = tiny_drill_pipeline()
+    model = wf.train()
+    path = str(tmp_path / "m")
+    model.save(path)
+    model.save(path)  # second save swaps; first survives as last-good
+    assert verify_artifact(path) is None
+    assert verify_artifact(path + LAST_GOOD_SUFFIX) is None
+    assert os.path.exists(os.path.join(path, MANIFEST_JSON))
+
+
+# -- serving circuit breaker + output guard ---------------------------------
+
+@pytest.fixture(scope="module")
+def served_model():
+    wf, _data, records, pred_name = tiny_drill_pipeline()
+    model = wf.train()
+    return model, records, pred_name
+
+
+def test_breaker_opens_sheds_and_probe_closes(served_model):
+    model, records, _ = served_model
+    fake_now = [0.0]
+    telemetry = ServingTelemetry()
+    breaker = CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                             clock=lambda: fake_now[0])
+    endpoint = compile_endpoint(
+        model, batch_buckets=(4,), telemetry=telemetry, breaker=breaker)
+    # K=3 injected batch failures: each degrades to the row fallback
+    # (peers still score), then the breaker opens
+    faults.configure("serving.batch:every=1:times=3")
+    for i in range(3):
+        out = endpoint.score_batch(records[:2])
+        assert not any(isinstance(r, RowScoringError) for r in out), i
+        assert breaker.state == ("closed" if i < 2 else "open")
+    assert telemetry.snapshot()["rows_fallback"] == 6
+    # open: requests shed unscored, marked shed (NOT failed/fallback)
+    shed = endpoint.score_batch(records[:5])
+    assert all(isinstance(r, RowScoringError) and r.shed for r in shed)
+    snap = telemetry.snapshot()
+    assert snap["breaker"]["opens"] == 1
+    assert snap["breaker"]["rows_shed"] == 5
+    # cooldown elapses -> half-open probe rides the batch path (the
+    # injection burned its times budget, so the probe succeeds) -> closed
+    fake_now[0] = 11.0
+    ok = endpoint.score_batch(records[:2])
+    assert not any(isinstance(r, RowScoringError) for r in ok)
+    assert breaker.state == "closed"
+    snap = telemetry.snapshot()
+    assert snap["breaker"]["probes"] == 1
+    assert snap["breaker"]["closes"] == 1
+
+
+def test_half_open_probe_failure_reopens(served_model):
+    model, records, _ = served_model
+    fake_now = [0.0]
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                             clock=lambda: fake_now[0])
+    endpoint = compile_endpoint(model, batch_buckets=(4,), breaker=breaker)
+    faults.configure("serving.batch:every=1:times=2")
+    endpoint.score_batch(records[:1])     # failure #1 -> open
+    assert breaker.state == "open"
+    fake_now[0] = 6.0
+    endpoint.score_batch(records[:1])     # probe fails -> re-open
+    assert breaker.state == "open"
+    assert breaker.opens == 2
+    fake_now[0] = 12.0
+    endpoint.score_batch(records[:1])     # probe succeeds -> closed
+    assert breaker.state == "closed"
+
+
+def test_slow_probe_keeps_ownership_and_closes():
+    """A probe merely slower than cooldown_s must not lose ownership to
+    later callers - otherwise a slow-but-recovered path could never
+    close the breaker (probe churn livelock)."""
+    fake_now = [0.0]
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                             clock=lambda: fake_now[0])
+    breaker.record_failure()
+    assert breaker.state == "open"
+    fake_now[0] = 1.5
+    assert breaker.allow()          # this thread owns the probe
+    fake_now[0] = 4.0               # past cooldown, within probe_timeout_s
+    assert not breaker.allow()      # latecomer must NOT steal the probe
+    breaker.record_success()        # slow probe finishes -> closes
+    assert breaker.state == "closed"
+    fake_now[0] = 5.0
+    assert breaker.allow()          # healthy again
+
+
+def test_stale_success_cannot_close_an_open_breaker():
+    """A slow batch admitted while closed must not close the breaker
+    when it completes after the trip: only a half-open probe may close
+    (otherwise mixed-latency traffic makes the breaker flap instead of
+    shedding fast)."""
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=60.0)
+    assert breaker.allow()          # slow batch B1 admitted while closed
+    breaker.record_failure()
+    breaker.record_failure()        # concurrent failures trip it
+    assert breaker.state == "open"
+    breaker.record_success()        # B1 finishes late: stale evidence
+    assert breaker.state == "open"
+    assert breaker.closes == 0
+
+
+def test_scheduler_sheds_with_breaker_open_error(served_model):
+    model, records, _ = served_model
+    telemetry = ServingTelemetry()
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=60.0)
+    endpoint = compile_endpoint(
+        model, batch_buckets=(4,), telemetry=telemetry, breaker=breaker)
+    faults.configure("serving.batch:every=1:times=1")
+    endpoint.score_batch(records[:1])     # opens the breaker
+    assert breaker.state == "open"
+    with MicroBatchScheduler(endpoint, start=False,
+                             telemetry=telemetry) as sched:
+        req = sched.submit(records[0])
+        sched.run_once(wait_timeout_s=0.5)
+        with pytest.raises(BreakerOpenError):
+            req.wait(1.0)
+    assert telemetry.snapshot()["shed_breaker"] == 1
+
+
+def test_poison_rows_do_not_open_the_breaker(served_model):
+    """Data-borne failures (a malformed record that ALSO fails the row
+    fallback) must not trip the breaker: one bad client opening the
+    circuit would turn a per-row error into a full-endpoint outage.
+    Only batches that re-score 100% clean row-by-row indict the batch
+    path itself."""
+    model, records, _ = served_model
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=60.0)
+    endpoint = compile_endpoint(model, batch_buckets=(4,), breaker=breaker)
+    bad = {"a": object(), "c": "u"}  # unparseable numeric cell
+    for _ in range(5):
+        out = endpoint.score_batch([bad, records[0]])
+        assert isinstance(out[0], RowScoringError)      # bad row isolated
+        assert not isinstance(out[1], RowScoringError)  # peer served
+    assert breaker.state == "closed"
+    assert breaker.snapshot()["consecutive_failures"] == 0
+
+
+def test_nan_guard_refuses_nonfinite_scores(served_model):
+    model, records, _ = served_model
+    telemetry = ServingTelemetry()
+    endpoint = compile_endpoint(model, batch_buckets=(4,),
+                                telemetry=telemetry)
+    faults.configure("serving.nan_scores:on=1")
+    out = endpoint.score_batch(records[:3])
+    assert all(isinstance(r, RowScoringError) and not r.shed for r in out)
+    assert all("non-finite" in r.error for r in out)
+    snap = telemetry.snapshot()
+    assert snap["breaker"]["rows_nonfinite"] == 3
+    assert endpoint.breaker.snapshot()["consecutive_failures"] == 1
+    # next clean batch resets the failure streak
+    clean = endpoint.score_batch(records[:3])
+    assert not any(isinstance(r, RowScoringError) for r in clean)
+    assert endpoint.breaker.snapshot()["consecutive_failures"] == 0
+
+
+def test_slow_batch_injection_delays_the_batch(served_model):
+    model, records, _ = served_model
+    telemetry = ServingTelemetry()
+    endpoint = compile_endpoint(model, batch_buckets=(4,),
+                                telemetry=telemetry)
+    faults.configure("serving.slow_batch:on=1:delay=0.12")
+    t0 = time.perf_counter()
+    out = endpoint.score_batch(records[:2])
+    slow = time.perf_counter() - t0
+    assert slow >= 0.12
+    assert not any(isinstance(r, RowScoringError) for r in out)
+    # the injected slowness must be VISIBLE to batch telemetry - that is
+    # what the drill proves
+    assert telemetry.batch_wall_s >= 0.12
+
+
+# -- supervision: backoff + fail-fast + injected preemption ------------------
+
+def test_backoff_waits_are_taken_and_recorded(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    marker = tmp_path / "died"
+    # attempt 1 marks itself and dies; attempt 2 sees the marker, succeeds
+    script = tmp_path / "child.py"
+    script.write_text(DIE_ONCE_CHILD_TEMPLATE.format(
+        marker=str(marker), first_exit=7, then_exit=0))
+    t0 = time.time()
+    res = supervise(
+        [sys.executable, str(script)],
+        heartbeat_path=str(tmp_path / "hb"),
+        stale_after_s=60.0, max_restarts=2, poll_s=0.05, env=drill_env(),
+        backoff_base_s=0.3, backoff_jitter=0.5, backoff_seed=3,
+    )
+    elapsed = time.time() - t0
+    assert res.returncode == 0 and res.attempts == 2
+    attempt, reason, backoff_s = res.restarts[0]
+    assert attempt == 0 and "exit code 7" in reason
+    assert 0.3 <= backoff_s <= 0.45  # base stretched by jitter in [0,50%]
+    assert elapsed >= backoff_s      # the wait was actually taken
+
+
+def test_fail_fast_on_repeated_identical_exit_codes(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="fail-fast.*exit code 3"):
+        supervise(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            heartbeat_path=str(tmp_path / "hb"),
+            stale_after_s=30.0, max_restarts=6, poll_s=0.05, env=drill_env(),
+            backoff_base_s=0.1, backoff_jitter=0.0,
+            fail_fast_identical=2,
+        )
+    # 2 attempts + one 0.1s backoff, NOT 7 attempts with 6 growing waits
+    assert time.time() - t0 < 30.0
+
+
+def test_differing_exit_codes_do_not_fail_fast(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    flip = tmp_path / "flip"
+    child = (
+        "import os, sys; p = {p!r}\n"
+        "if not os.path.exists(p):\n"
+        "    open(p, 'w').close(); sys.exit(3)\n"
+        "sys.exit(4)\n"
+    ).format(p=str(flip))
+    script = tmp_path / "flip.py"
+    script.write_text(child)
+    with pytest.raises(RuntimeError) as exc:
+        supervise(
+            [sys.executable, str(script)],
+            heartbeat_path=str(tmp_path / "hb"),
+            stale_after_s=30.0, max_restarts=1, poll_s=0.05, env=drill_env(),
+            backoff_base_s=0.05, backoff_jitter=0.0, fail_fast_identical=2,
+        )
+    assert "fail-fast" not in str(exc.value)  # 3 then 4: exhausted normally
+
+
+def test_injected_child_kill_redispatches(tmp_path):
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    faults.configure("supervisor.child_kill:on=1")
+    res = supervise(
+        [sys.executable, "-c", "import time; time.sleep(0.4)"],
+        heartbeat_path=str(tmp_path / "hb"),
+        stale_after_s=60.0, grace_s=60.0, max_restarts=1, poll_s=0.05,
+        env=drill_env(), backoff_base_s=0.05, backoff_jitter=0.0,
+    )
+    assert res.returncode == 0 and res.attempts == 2
+    assert "injected child kill" in res.restarts[0][1]
+
+
+# -- native kernel library unavailable --------------------------------------
+
+def test_native_lib_load_failure_degrades_to_python():
+    from transmogrifai_tpu.utils import hashing, native
+
+    faults.configure("native.load:every=1")
+    assert native.get_lib() is None
+    assert native.murmur3_batch(["alpha", "beta"]) is None
+    # the pure-python fallback still hashes (what callers do with None)
+    vecs = hashing.hashing_tf([["alpha", "beta"]], 16, seed=42)
+    assert vecs.shape == (1, 16) and vecs.sum() > 0
+    # disarming restores normal behavior - the drill leaves no sticky
+    # poisoning, and the hash output is identical either way
+    faults.reset()
+    vecs2 = hashing.hashing_tf([["alpha", "beta"]], 16, seed=42)
+    assert np.array_equal(vecs, vecs2)

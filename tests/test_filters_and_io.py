@@ -273,11 +273,15 @@ def test_feature_distribution_js_divergence_properties(rng):
 def test_model_load_failure_modes_are_loud(tmp_path, rng):
     """Corrupted or mismatched saved models must raise clearly, never
     load partially: missing arrays.npz, truncated model.json, and a
-    workflow whose stage set differs from the saved graph."""
-    import json as _json
+    workflow whose stage set differs from the saved graph.  Since the
+    crash-consistent artifact format (ISSUE 2) both corruptions are
+    caught by manifest verification as ModelIntegrityError (naming the
+    damage) instead of leaking FileNotFoundError/JSONDecodeError."""
     import shutil
 
     import numpy as np
+
+    from transmogrifai_tpu.serialization.model_io import ModelIntegrityError
 
     from transmogrifai_tpu import FeatureBuilder, OpWorkflow
     from transmogrifai_tpu.models.logistic_regression import (
@@ -311,7 +315,7 @@ def test_model_load_failure_modes_are_loud(tmp_path, rng):
     broken1 = tmp_path / "m1"
     shutil.copytree(base, broken1)
     (broken1 / "arrays.npz").unlink()
-    with pytest.raises(FileNotFoundError):
+    with pytest.raises(ModelIntegrityError, match="arrays.npz"):
         OpWorkflowModel.load(str(broken1), build())
 
     broken2 = tmp_path / "m2"
@@ -319,7 +323,7 @@ def test_model_load_failure_modes_are_loud(tmp_path, rng):
     (broken2 / "model.json").write_text(
         (broken2 / "model.json").read_text()[:50]
     )
-    with pytest.raises(_json.JSONDecodeError):
+    with pytest.raises(ModelIntegrityError, match="truncated"):
         OpWorkflowModel.load(str(broken2), build())
 
     with pytest.raises(ValueError, match="same code-defined workflow"):

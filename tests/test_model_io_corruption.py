@@ -1,0 +1,229 @@
+"""Golden-corruption coverage for the crash-consistent model artifact.
+
+ISSUE 2 satellites: a truncated arrays.npz or a bit-flipped model.json
+must be REFUSED by load_model with the checksum/manifest error (never a
+deep traceback from json/zipfile), a model.json referencing an npz key
+that is not there must surface as ModelLoadError naming the stage path
+and the artifact file (not a raw KeyError), and a corrupted primary with
+an intact ``.last-good`` predecessor must recover silently.
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.serialization.model_io import (
+    ARRAYS_NPZ,
+    LAST_GOOD_SUFFIX,
+    MANIFEST_JSON,
+    MODEL_JSON,
+    ModelIntegrityError,
+    ModelLoadError,
+    load_model,
+    verify_artifact,
+)
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+
+def _build(n=100, seed=1):
+    wf, data, _records, _name = tiny_drill_pipeline(n=n, seed=seed)
+    return wf, data
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    """One trained+saved artifact per module; tests copy it, never
+    mutate it."""
+    root = tmp_path_factory.mktemp("golden")
+    wf, data = _build()
+    model = wf.train()
+    path = str(root / "m")
+    model.save(path)
+    return path, data
+
+
+def _fresh_copy(saved_path, tmp_path):
+    dst = str(tmp_path / "m")
+    shutil.copytree(saved_path, dst)
+    return dst
+
+
+def test_truncated_npz_refused(saved, tmp_path):
+    path = _fresh_copy(saved[0], tmp_path)
+    npz = os.path.join(path, ARRAYS_NPZ)
+    size = os.path.getsize(npz)
+    with open(npz, "r+b") as f:
+        f.truncate(size // 2)
+    err = verify_artifact(path)
+    assert err is not None and "truncated" in err
+    wf, _ = _build()
+    with pytest.raises(ModelIntegrityError, match="truncated"):
+        load_model(path, wf)
+
+
+def test_bitflipped_model_json_refused(saved, tmp_path):
+    path = _fresh_copy(saved[0], tmp_path)
+    jpath = os.path.join(path, MODEL_JSON)
+    with open(jpath, "r+b") as f:
+        f.seek(os.path.getsize(jpath) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x40]))  # same length, different bytes
+    err = verify_artifact(path)
+    assert err is not None and "SHA-256" in err
+    wf, _ = _build()
+    with pytest.raises(ModelIntegrityError, match="SHA-256"):
+        load_model(path, wf)
+
+
+def test_missing_npz_key_names_stage_and_file(saved, tmp_path):
+    """A checksum-VALID artifact whose arrays.npz lacks a key model.json
+    references (mismatched pair) raises ModelLoadError naming both - the
+    raw-KeyError satellite fix."""
+    import hashlib
+
+    path = _fresh_copy(saved[0], tmp_path)
+    npz_path = os.path.join(path, ARRAYS_NPZ)
+    with np.load(npz_path, allow_pickle=False) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    dropped = sorted(arrays)[0]
+    arrays.pop(dropped)
+    np.savez_compressed(npz_path, **arrays)
+    # recompute the manifest so ONLY the key mismatch remains detectable
+    with open(npz_path, "rb") as f:
+        data = f.read()
+    mpath = os.path.join(path, MANIFEST_JSON)
+    manifest = json.load(open(mpath))
+    manifest["files"][ARRAYS_NPZ] = {
+        "sha256": hashlib.sha256(data).hexdigest(), "bytes": len(data),
+    }
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    wf, _ = _build()
+    with pytest.raises(ModelLoadError) as exc:
+        load_model(path, wf)
+    msg = str(exc.value)
+    assert dropped in msg and ARRAYS_NPZ in msg
+    assert "KeyError" not in msg
+
+
+def test_corrupt_primary_recovers_from_last_good(saved, tmp_path):
+    """Primary fails checksum, .last-good intact -> load transparently
+    recovers and the recovered model scores."""
+    path = _fresh_copy(saved[0], tmp_path)
+    shutil.copytree(path, path + LAST_GOOD_SUFFIX)
+    npz = os.path.join(path, ARRAYS_NPZ)
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) - 10)
+    wf, data = _build()
+    model = load_model(path, wf)
+    scored = model.score(data)
+    assert len(next(iter(scored.columns().values()))) == len(data["y"])
+
+
+def test_both_artifacts_corrupt_is_loud(saved, tmp_path):
+    path = _fresh_copy(saved[0], tmp_path)
+    shutil.copytree(path, path + LAST_GOOD_SUFFIX)
+    for p in (path, path + LAST_GOOD_SUFFIX):
+        npz = os.path.join(p, ARRAYS_NPZ)
+        with open(npz, "r+b") as f:
+            f.truncate(os.path.getsize(npz) // 2)
+    wf, _ = _build()
+    with pytest.raises(ModelIntegrityError, match="last-good"):
+        load_model(path, wf)
+
+
+def test_missing_manifest_is_legacy_tolerated(saved, tmp_path):
+    """Pre-manifest artifacts (older saves) still load - with a warning,
+    without verification - so the format change is not a breaking one."""
+    path = _fresh_copy(saved[0], tmp_path)
+    os.remove(os.path.join(path, MANIFEST_JSON))
+    assert verify_artifact(path) is None
+    wf, data = _build()
+    model = load_model(path, wf)
+    assert model is not None
+
+
+def test_legacy_corrupt_npz_still_raises_model_load_error(saved, tmp_path):
+    """Manifest-less (legacy) + truncated npz: verification is skipped,
+    so np.load/decompress fails - but as ModelLoadError, never a raw
+    zipfile/zlib traceback."""
+    path = _fresh_copy(saved[0], tmp_path)
+    os.remove(os.path.join(path, MANIFEST_JSON))
+    npz = os.path.join(path, ARRAYS_NPZ)
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    wf, _ = _build()
+    with pytest.raises(ModelLoadError):
+        load_model(path, wf)
+
+
+def test_crashed_save_tempdirs_are_reaped(saved, tmp_path):
+    """Tempdirs leaked by a DEAD writer are removed by the next save;
+    a live writer's tempdir (concurrent save to a shared path) is left
+    alone."""
+    import subprocess
+    import sys
+
+    wf, _ = _build()
+    model = wf.train()
+    path = str(tmp_path / "m")
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    dead = f"{path}.tmp-{proc.pid}"        # provably dead pid
+    live = f"{path}.tmp-{os.getppid()}"    # provably live pid (pytest's parent)
+    for d in (dead, live):
+        os.makedirs(d)
+        open(os.path.join(d, "model.json"), "w").close()
+    model.save(path)
+    assert not os.path.isdir(dead)
+    assert os.path.isdir(live)  # concurrent writer NOT clobbered
+    assert verify_artifact(path) is None
+
+
+def test_publish_by_copy_fallback_produces_verified_artifact(saved, tmp_path):
+    """The non-atomic publish path (taken when rename(2) refuses, e.g. a
+    volume mounted at the artifact dir) still yields a checksum-valid
+    artifact, snapshots the predecessor to last-good, and removes the
+    tempdir."""
+    from transmogrifai_tpu.serialization import model_io
+
+    path = _fresh_copy(saved[0], tmp_path)
+    tmp = path + ".tmp-12345"
+    shutil.copytree(saved[0], tmp)
+    model_io._publish_by_copy(tmp, path, path + LAST_GOOD_SUFFIX,
+                              reason="drill")
+    assert verify_artifact(path) is None
+    assert verify_artifact(path + LAST_GOOD_SUFFIX) is None
+    assert not os.path.isdir(tmp)
+
+
+def test_swap_save_carries_colocated_extras(saved, tmp_path):
+    """Non-artifact files living in the model directory (the runner's
+    summary.json, user-kept reports) must survive a re-save, not vanish
+    into last-good."""
+    wf, _ = _build()
+    model = wf.train()
+    path = str(tmp_path / "m")
+    model.save(path)
+    with open(os.path.join(path, "summary.json"), "w") as f:
+        f.write('{"kept": true}')
+    model.save(path)  # swap must carry the extra forward
+    assert os.path.exists(os.path.join(path, "summary.json"))
+    assert verify_artifact(path) is None
+
+
+def test_roundtrip_scores_match_after_swap_save(saved, tmp_path):
+    """The atomic-swap save changes the write path, not the format:
+    scores from the restored model match the original exactly."""
+    wf, data = _build()
+    model = wf.train()
+    path = str(tmp_path / "m")
+    model.save(path)
+    before = model.score(data)[model.result_features[0].name].to_list()
+    wf2, _ = _build()
+    m2 = load_model(path, wf2)
+    after = m2.score(data)[m2.result_features[0].name].to_list()
+    assert before == after

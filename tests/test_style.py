@@ -8,6 +8,10 @@ Enforced invariants over every module in transmogrifai_tpu/:
   the judge-checkable parity trail the build contract requires
 - library modules print nothing (logging/metadata channels only);
   user-facing surfaces (cli, runner, examples) are exempt
+- no bare ``except:`` anywhere (it swallows KeyboardInterrupt/SystemExit)
+- every broad ``except Exception`` under serving/ and workflow/ must
+  re-raise, use the bound exception, or record telemetry/a log entry -
+  silent swallowing is exactly how serving degradation hides (ISSUE 2)
 """
 import ast
 import pathlib
@@ -55,6 +59,67 @@ def test_op_stage_citation_discipline():
                 if "reference" not in doc and "reference" not in mod_doc:
                     missing.append(f"{p}:{node.name}")
     assert not missing, missing
+
+
+def test_no_bare_except_anywhere():
+    """``except:`` catches KeyboardInterrupt/SystemExit and hides every
+    failure class behind it - always name the exception."""
+    offenders = []
+    for p in MODULES:
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                offenders.append(f"{p}:{node.lineno}")
+    assert not offenders, offenders
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+_LOGGING_ATTRS = {"exception", "error", "warning", "info", "debug"}
+
+
+def _handler_is_accounted(handler: ast.ExceptHandler) -> bool:
+    """A broad handler is acceptable when the failure leaves a trace:
+    it re-raises, uses the bound exception object (so the error reaches
+    a result/telemetry channel), or calls a record*/log method."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (handler.name is not None
+                and isinstance(node, ast.Name)
+                and node.id == handler.name):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            attr = node.func.attr
+            if attr.startswith("record") or attr in _LOGGING_ATTRS:
+                return True
+    return False
+
+
+def test_serving_and_workflow_broad_excepts_leave_a_trace():
+    """Under serving/ and workflow/ a broad ``except Exception`` must
+    re-raise, use the caught exception, or record telemetry/logging -
+    a swallowed batch failure is a silent full-fleet degradation."""
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        if rel[0] not in ("serving", "workflow"):
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node):
+                if not _handler_is_accounted(node):
+                    offenders.append(f"{p}:{node.lineno}")
+    assert not offenders, offenders
 
 
 def test_library_modules_do_not_print():

@@ -1,0 +1,54 @@
+"""Deterministic fault-injection framework (see injection.py).
+
+Named failure points threaded through serving, serialization, workflow
+and utils, armed via ``TX_FAULTS`` or :func:`configure`:
+
+========================== ==================================================
+point                      effect at the call site
+========================== ==================================================
+serving.batch              InjectedFault inside the compiled batch path
+serving.nan_scores         batch outputs poisoned to NaN (guard drill)
+serving.slow_batch         the batch path sleeps ``delay`` seconds
+io.save_model.crash        hard process kill mid-artifact-write (tempdir)
+io.save_model.crash_window hard kill between the artifact swap renames
+supervisor.child_kill      the supervisor kills its child (preemption)
+native.load                the native kernel library reports unavailable
+========================== ==================================================
+"""
+from .injection import (
+    DEFAULT_KILL_EXIT,
+    ENV_VAR,
+    FaultPlan,
+    FaultSpec,
+    FaultSpecError,
+    InjectedFault,
+    active,
+    configure,
+    fires,
+    inject,
+    inject_kill,
+    inject_sleep,
+    inject_unavailable,
+    parse_spec,
+    poison_nonfinite,
+    reset,
+)
+
+__all__ = [
+    "DEFAULT_KILL_EXIT",
+    "ENV_VAR",
+    "FaultPlan",
+    "FaultSpec",
+    "FaultSpecError",
+    "InjectedFault",
+    "active",
+    "configure",
+    "fires",
+    "inject",
+    "inject_kill",
+    "inject_sleep",
+    "inject_unavailable",
+    "parse_spec",
+    "poison_nonfinite",
+    "reset",
+]

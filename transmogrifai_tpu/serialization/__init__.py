@@ -1,0 +1,26 @@
+"""Model persistence (crash-consistent; see model_io.py)."""
+from .model_io import (
+    ARRAYS_NPZ,
+    LAST_GOOD_SUFFIX,
+    MANIFEST_JSON,
+    MODEL_JSON,
+    ModelIntegrityError,
+    ModelLoadError,
+    load_model,
+    resolve_artifact,
+    save_model,
+    verify_artifact,
+)
+
+__all__ = [
+    "ARRAYS_NPZ",
+    "LAST_GOOD_SUFFIX",
+    "MANIFEST_JSON",
+    "MODEL_JSON",
+    "ModelIntegrityError",
+    "ModelLoadError",
+    "load_model",
+    "resolve_artifact",
+    "save_model",
+    "verify_artifact",
+]

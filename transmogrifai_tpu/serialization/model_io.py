@@ -8,18 +8,80 @@ fitted state.  Loading mirrors the reference's contract: the model is
 restored INTO the same code-defined workflow (OpWorkflow.loadModel,
 OpWorkflow.scala:468) - stages are re-paired with the freshly built DAG in
 deterministic order, so feature wiring never needs serializing.
+
+Persistence is crash-consistent (the user-level-checkpointing recovery
+primitive, TensorFlow §4.2): the artifact writes into a temp directory,
+every file is fsynced, a ``manifest.json`` records per-file SHA-256 +
+sizes, and the finished directory swaps into place by rename - the
+previous artifact survives as ``<path>.last-good``.  A crash at ANY
+instant therefore leaves a loadable artifact: either the old one (crash
+before the swap) or the new one (crash after), and ``load_model``
+verifies checksums before trusting anything, falling back to the
+last-good copy when the primary is truncated, bit-flipped, or missing.
+Injection points ``io.save_model.crash`` / ``io.save_model.crash_window``
+(faults/injection.py) drill both crash windows in tests/test_faults.py.
 """
 from __future__ import annotations
 
+import glob
+import hashlib
 import importlib
 import json
+import logging
 import os
-from typing import Any
+import shutil
+import zipfile
+import zlib
+from typing import Any, Optional
 
 import numpy as np
 
+from ..faults import injection as _faults
+
+log = logging.getLogger("transmogrifai_tpu.serialization")
+
 MODEL_JSON = "model.json"
 ARRAYS_NPZ = "arrays.npz"
+MANIFEST_JSON = "manifest.json"
+LAST_GOOD_SUFFIX = ".last-good"
+
+
+class ModelLoadError(RuntimeError):
+    """A model artifact cannot be restored; the message names the
+    artifact file and (where applicable) the stage path inside it."""
+
+
+class ModelIntegrityError(ModelLoadError):
+    """Checksum/manifest verification failed and no last-good artifact
+    could recover the load (truncation, bit-flips, missing files)."""
+
+
+class _ArrayStore:
+    """arrays.npz accessor that turns a missing/mismatched key into a
+    ModelLoadError naming the stage path and the artifact file instead
+    of a raw KeyError deep inside ``_decode``."""
+
+    def __init__(self, npz, artifact: str) -> None:
+        self._npz = npz
+        self._artifact = artifact
+
+    def __getitem__(self, key: str):
+        try:
+            return self._npz[key]
+        except KeyError:
+            raise ModelLoadError(
+                f"model artifact {self._artifact} has no array for stage "
+                f"path '{key}': {os.path.basename(self._artifact)} is "
+                "truncated or belongs to a different model.json"
+            ) from None
+        except (zipfile.BadZipFile, zlib.error, OSError, ValueError) as e:
+            # npz members decompress lazily: a corrupt legacy (manifest-
+            # less) artifact surfaces HERE, not at np.load - still a
+            # ModelLoadError, never a raw zlib traceback
+            raise ModelLoadError(
+                f"model artifact {self._artifact} is corrupt at stage "
+                f"path '{key}': {type(e).__name__}: {e}"
+            ) from e
 
 
 def _encode(value: Any, arrays: dict[str, np.ndarray], path: str) -> Any:
@@ -89,8 +151,76 @@ def stage_state(stage) -> dict[str, Any]:
     return out
 
 
+def _write_fsync(path: str, data: bytes) -> None:
+    """Write + flush + fsync: the bytes are durable before any rename
+    can publish a directory that references them."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames within it are durable (best-effort:
+    some filesystems refuse directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        log.debug("directory fsync unsupported for %s", path)
+    finally:
+        os.close(fd)
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _writer_alive(tmp_dir: str) -> bool:
+    """True when the pid encoded in a ``<path>.tmp-<pid>`` save tempdir
+    still belongs to a live process on THIS host (liveness is the reap
+    guard; unparseable names count as live = never reaped)."""
+    suffix = tmp_dir.rpartition(".tmp-")[2]
+    try:
+        pid = int(suffix)
+    except ValueError:
+        return True
+    if pid == os.getpid():
+        return False  # our own leftover from a failed earlier save
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM etc: the pid exists, leave it alone
+
+
+_HASH_CHUNK = 1 << 20
+
+
+def _sha256_file(path: str) -> tuple[str, int]:
+    """Chunked (bounded-memory) file hash -> (hexdigest, byte size)."""
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_HASH_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+            size += len(chunk)
+    return h.hexdigest(), size
+
+
 def save_model(model, path: str) -> None:
-    os.makedirs(path, exist_ok=True)
+    """Crash-consistent save: tempdir write -> fsync -> manifest ->
+    atomic rename swap (the previous artifact survives as
+    ``<path>.last-good``)."""
+    path = os.path.abspath(path).rstrip(os.sep)
     arrays: dict[str, np.ndarray] = {}
     stages_doc = []
     for i, stage in enumerate(model.stages):
@@ -131,9 +261,192 @@ def save_model(model, path: str) -> None:
         "train_time_s": model.train_time_s,
         "stages": stages_doc,
     }
-    with open(os.path.join(path, MODEL_JSON), "w") as f:
-        json.dump(doc, f, indent=1, default=str)
-    np.savez_compressed(os.path.join(path, ARRAYS_NPZ), **arrays)
+    json_bytes = json.dumps(doc, indent=1, default=str).encode("utf-8")
+
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    # reap tempdirs leaked by CRASHED saves: each holds a full artifact
+    # copy.  Only dead writers' dirs are removed - a concurrent save by
+    # a live process (retried fleet jobs sharing a path) must not have
+    # its tempdir clobbered mid-write
+    for stale in glob.glob(glob.escape(path) + ".tmp-*"):
+        if os.path.isdir(stale) and not _writer_alive(stale):
+            shutil.rmtree(stale, ignore_errors=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.isdir(tmp):  # same-pid leftover (pid reuse / prior error)
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    _write_fsync(os.path.join(tmp, MODEL_JSON), json_bytes)
+    # crash drill: death here must leave the PREVIOUS artifact untouched
+    # (the half-written tempdir is invisible to load_model)
+    _faults.inject_kill("io.save_model.crash")
+    npz_tmp = os.path.join(tmp, ARRAYS_NPZ)
+    # stream the npz straight to disk (no whole-archive BytesIO), then
+    # fsync it and checksum it back in bounded-memory chunks
+    np.savez_compressed(npz_tmp, **arrays)
+    with open(npz_tmp, "rb") as f:
+        os.fsync(f.fileno())
+    npz_sha, npz_size = _sha256_file(npz_tmp)
+    manifest = {
+        "format_version": 1,
+        "files": {
+            MODEL_JSON: {"sha256": _sha256(json_bytes),
+                         "bytes": len(json_bytes)},
+            ARRAYS_NPZ: {"sha256": npz_sha, "bytes": npz_size},
+        },
+    }
+    _write_fsync(
+        os.path.join(tmp, MANIFEST_JSON),
+        json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8"),
+    )
+    _fsync_dir(tmp)
+
+    last_good = path + LAST_GOOD_SUFFIX
+    try:
+        if os.path.isdir(path):
+            if os.path.isdir(last_good):
+                shutil.rmtree(last_good)
+            os.rename(path, last_good)
+        # crash drill: death between the two renames leaves NO primary
+        # artifact - load_model must recover from <path>.last-good
+        _faults.inject_kill("io.save_model.crash_window")
+        os.rename(tmp, path)
+    except OSError as e:
+        # rename(2) refuses to move a mount point (EBUSY) - e.g. a k8s/
+        # docker volume mounted directly at the artifact path.  Publish
+        # by copy instead: payload files first, manifest LAST, each via
+        # a file-level atomic replace - a crash mid-publish leaves a
+        # manifest that mismatches the new payload, which verification
+        # detects and recovers from last-good
+        _publish_by_copy(tmp, path, last_good, reason=str(e))
+    else:
+        # the swap moved the WHOLE old directory aside; co-located
+        # non-artifact files (the runner's summary.json, user-kept eval
+        # reports) must survive the re-save, not vanish into last-good
+        _carry_extras(last_good, path)
+    _fsync_dir(parent)
+
+
+_ARTIFACT_FILES = frozenset((MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON))
+
+
+def _carry_extras(old_dir: str, new_dir: str) -> None:
+    """Copy non-artifact entries the previous save directory carried
+    into the freshly published one (best-effort: extras must never fail
+    a completed save)."""
+    if not os.path.isdir(old_dir):
+        return
+    for name in os.listdir(old_dir):
+        if name in _ARTIFACT_FILES:
+            continue
+        src = os.path.join(old_dir, name)
+        dst = os.path.join(new_dir, name)
+        if os.path.exists(dst):
+            continue
+        try:
+            if os.path.isdir(src):
+                shutil.copytree(src, dst)
+            else:
+                shutil.copy2(src, dst)
+        except OSError as e:
+            log.warning("could not carry %s into the new artifact: %s",
+                        src, e)
+
+
+def _publish_by_copy(tmp: str, path: str, last_good: str,
+                     reason: str) -> None:
+    log.warning(
+        "atomic artifact swap unavailable for %s (%s); publishing by "
+        "file copy - still crash-detectable via the manifest", path, reason,
+    )
+    if os.path.isdir(path) and verify_artifact(path) is None:
+        if os.path.isdir(last_good):
+            shutil.rmtree(last_good)
+        try:
+            shutil.copytree(path, last_good)
+        except OSError:
+            log.warning("could not snapshot %s to %s; continuing without "
+                        "a last-good copy", path, last_good)
+    os.makedirs(path, exist_ok=True)
+    # payload before manifest: until the manifest flips, verification
+    # sees old-manifest-vs-new-payload and rejects the half-published dir
+    for name in (MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON):
+        src = os.path.join(tmp, name)
+        part = os.path.join(path, name + ".part")
+        with open(src, "rb") as fsrc, open(part, "wb") as fdst:
+            shutil.copyfileobj(fsrc, fdst, _HASH_CHUNK)
+            fdst.flush()
+            os.fsync(fdst.fileno())
+        os.replace(part, os.path.join(path, name))
+    _fsync_dir(path)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def verify_artifact(path: str) -> Optional[str]:
+    """Checksum-verify a saved artifact against its manifest; returns
+    None when intact, else a human-readable description of the damage.
+    A manifest-less directory with both payload files is accepted as a
+    legacy (pre-manifest) artifact."""
+    if not os.path.isdir(path):
+        return f"artifact directory {path} missing"
+    manifest_path = os.path.join(path, MANIFEST_JSON)
+    if not os.path.exists(manifest_path):
+        missing = [
+            f for f in (MODEL_JSON, ARRAYS_NPZ)
+            if not os.path.exists(os.path.join(path, f))
+        ]
+        if missing:
+            return f"artifact {path} incomplete: missing {missing}"
+        log.warning(
+            "model artifact %s has no %s (legacy save): loading without "
+            "checksum verification", path, MANIFEST_JSON,
+        )
+        return None
+    try:
+        with open(manifest_path, "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError, UnicodeDecodeError) as e:
+        return f"manifest {manifest_path} unreadable: {e}"
+    for name, meta in files.items():
+        fpath = os.path.join(path, name)
+        try:
+            sha, size = _sha256_file(fpath)
+        except OSError as e:
+            return f"artifact file {fpath} unreadable: {e}"
+        if size != meta.get("bytes"):
+            return (
+                f"artifact file {fpath} truncated: {size} bytes, "
+                f"manifest records {meta.get('bytes')}"
+            )
+        if sha != meta.get("sha256"):
+            return (
+                f"artifact file {fpath} failed its SHA-256 checksum "
+                "(bit-flip or partial overwrite)"
+            )
+    return None
+
+
+def resolve_artifact(path: str) -> str:
+    """Return a checksum-verified artifact directory for ``path``:
+    the primary when intact, else the ``.last-good`` predecessor (a
+    crash mid-save, see save_model).  Raises ModelIntegrityError when
+    neither verifies."""
+    path = os.path.abspath(path).rstrip(os.sep)
+    err = verify_artifact(path)
+    if err is None:
+        return path
+    last_good = path + LAST_GOOD_SUFFIX
+    lg_err = verify_artifact(last_good)
+    if lg_err is None:
+        log.warning(
+            "model artifact failed verification (%s); recovering from "
+            "last-good artifact %s", err, last_good,
+        )
+        return last_good
+    raise ModelIntegrityError(
+        f"{err}; last-good recovery also failed ({lg_err})"
+    )
 
 
 def _load_class(qualname: str):
@@ -151,9 +464,21 @@ def load_model(path: str, workflow):
     from ..workflow.dag import compute_dag, flatten
     from ..workflow.workflow import OpWorkflowModel
 
-    with open(os.path.join(path, MODEL_JSON)) as f:
-        doc = json.load(f)
-    arrays = np.load(os.path.join(path, ARRAYS_NPZ), allow_pickle=False)
+    path = resolve_artifact(path)
+    json_path = os.path.join(path, MODEL_JSON)
+    npz_path = os.path.join(path, ARRAYS_NPZ)
+    try:
+        with open(json_path) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        raise ModelLoadError(f"model artifact {json_path} is not valid "
+                             f"JSON: {e}") from e
+    try:
+        arrays = _ArrayStore(np.load(npz_path, allow_pickle=False), npz_path)
+    except (OSError, ValueError, zipfile.BadZipFile, zlib.error) as e:
+        raise ModelLoadError(
+            f"model artifact {npz_path} is not a readable npz: {e}"
+        ) from e
 
     # reapply the saved blacklist surgery to the fresh workflow so its
     # DAG matches the trained one (cascades re-derive deterministically).

@@ -5,8 +5,10 @@ scoring (reference: local/.../OpWorkflowModelLocal.scala) rebuilt
 batch-first for this engine: a micro-batching scheduler packs concurrent
 requests into fixed shape buckets so every predict rides the vectorized
 flat-heap / jitted batch paths, admission control sheds load gracefully,
-and built-in telemetry reports p50/p95/p99 latency, batch fill, queue
-depth, and rows/s as a JSON artifact.
+a circuit breaker turns persistent batch-path failure into fast loud
+shedding (with a NaN/Inf output guard) instead of a silent slow-path
+meltdown, and built-in telemetry reports p50/p95/p99 latency, batch
+fill, queue depth, rows/s, and breaker transitions as a JSON artifact.
 
     endpoint = compile_endpoint(model)           # warmed, bucketed
     with MicroBatchScheduler(endpoint) as srv:
@@ -15,6 +17,8 @@ depth, and rows/s as a JSON artifact.
 """
 from .admission import (
     AdmissionController,
+    BreakerOpenError,
+    CircuitBreaker,
     DeadlineExceededError,
     QueueFullError,
     RequestTimeoutError,
@@ -30,6 +34,8 @@ from .telemetry import ServingTelemetry
 
 __all__ = [
     "AdmissionController",
+    "BreakerOpenError",
+    "CircuitBreaker",
     "CompiledEndpoint",
     "DeadlineExceededError",
     "MicroBatchScheduler",

@@ -14,6 +14,14 @@ deadline-aware shedding at dequeue time).
                             never scored: scoring a dead request wastes
                             a batch slot someone live could use)
 * ``AdmissionController`` - the bounded FIFO both ends share
+* ``CircuitBreaker``      - batch-path health gate: K consecutive
+                            compiled-path failures open it (requests
+                            then shed fast with ``BreakerOpenError``
+                            instead of silently degrading ALL traffic
+                            to the slow row loop), a cooldown later a
+                            single half-open probe rides the batch path
+                            and its outcome closes or re-opens the
+                            breaker
 """
 from __future__ import annotations
 
@@ -34,6 +42,166 @@ class DeadlineExceededError(TimeoutError):
 
 class RequestTimeoutError(TimeoutError):
     """Caller-side wait timed out (the request may still complete)."""
+
+
+class BreakerOpenError(RuntimeError):
+    """The batch-path circuit breaker is open - request shed unscored."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for the compiled batch path.
+
+    States: ``closed`` (healthy) -> ``open`` after ``failure_threshold``
+    consecutive batch-path failures -> ``half_open`` once ``cooldown_s``
+    elapses (exactly ONE probe batch is admitted) -> ``closed`` on probe
+    success, back to ``open`` on probe failure.  Every transition lands
+    in ``ServingTelemetry`` (when attached) so a degraded endpoint is an
+    alarm, not a silent slow-down.  Thread-safe: the scheduler's batch
+    loop and direct ``score_batch`` callers may race on it.
+    """
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 5.0,
+                 clock=time.monotonic, telemetry=None,
+                 probe_timeout_s: Optional[float] = None) -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        if cooldown_s < 0:
+            raise ValueError("cooldown_s must be >= 0")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        # a probe is presumed dead (owner crashed mid-score) only after
+        # MUCH longer than the cooldown: a probe merely slower than
+        # cooldown_s must keep its ownership, or slow-but-recovered
+        # paths could never close the breaker (probe churn livelock)
+        self.probe_timeout_s = (
+            max(30.0, 10.0 * self.cooldown_s)
+            if probe_timeout_s is None else float(probe_timeout_s)
+        )
+        self.clock = clock
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        self._probe_owner: Optional[int] = None  # thread ident of the probe
+        self._probe_started_at: Optional[float] = None
+        self.opens = 0
+        self.closes = 0
+        self.probes = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _record(self, event: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.record_breaker_transition(event)
+
+    def allow(self) -> bool:
+        """True when a batch may ride the compiled path now.  In the
+        open state this flips to half-open after the cooldown and admits
+        one probe; further calls shed until the probe resolves.  The
+        admitted caller's thread OWNS the probe: only its outcome can
+        close or re-open (see record_success), and a probe whose owner
+        never resolves (died mid-score) is re-granted after
+        ``probe_timeout_s`` so the breaker cannot wedge half-open."""
+        event = None
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if (self._opened_at is not None
+                        and self.clock() - self._opened_at >= self.cooldown_s):
+                    event = self._grant_probe()
+                else:
+                    return False
+            elif self._state == "half_open":
+                stuck = (
+                    self._probe_started_at is not None
+                    and self.clock() - self._probe_started_at
+                    >= self.probe_timeout_s
+                )
+                if self._probe_in_flight and not stuck:
+                    return False
+                event = self._grant_probe()
+        self._record(event)
+        return True
+
+    def _grant_probe(self) -> str:
+        """Lock held: move to half_open with the calling thread as the
+        probe owner."""
+        self._state = "half_open"
+        self._probe_in_flight = True
+        self._probe_owner = threading.get_ident()
+        self._probe_started_at = self.clock()
+        self.probes += 1
+        return "probe"
+
+    def _is_probe_owner(self) -> bool:
+        """Lock held: is the calling thread the one the probe was
+        granted to?  Anything else finishing during open/half_open is a
+        batch admitted BEFORE the trip - stale evidence that must
+        neither close nor re-open the breaker."""
+        return (self._probe_in_flight
+                and self._probe_owner == threading.get_ident())
+
+    def record_success(self) -> None:
+        event = None
+        with self._lock:
+            if self._state == "closed":
+                self._consecutive_failures = 0
+            elif self._state == "half_open" and self._is_probe_owner():
+                self._state = "closed"
+                self._consecutive_failures = 0
+                self._probe_in_flight = False
+                self._probe_owner = None
+                self._probe_started_at = None
+                self._opened_at = None
+                self.closes += 1
+                event = "close"
+            # open, or half_open from a non-probe thread: stale success -
+            # only the probe's outcome may close, otherwise mixed-latency
+            # traffic makes the breaker flap instead of shedding fast
+        if event:
+            self._record(event)
+
+    def record_failure(self) -> None:
+        event = None
+        with self._lock:
+            if self._state == "half_open":
+                if self._is_probe_owner():
+                    self._consecutive_failures += 1
+                    self._state = "open"
+                    self._probe_in_flight = False
+                    self._probe_owner = None
+                    self._probe_started_at = None
+                    self._opened_at = self.clock()
+                    self.opens += 1
+                    event = "open"
+                # non-probe failure in half_open: stale, ignore
+            elif self._state == "closed":
+                self._consecutive_failures += 1
+                if self._consecutive_failures >= self.failure_threshold:
+                    self._state = "open"
+                    self._opened_at = self.clock()
+                    self.opens += 1
+                    event = "open"
+            else:  # open: count for observability, no transition
+                self._consecutive_failures += 1
+        if event:
+            self._record(event)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "opens": self.opens,
+                "closes": self.closes,
+                "probes": self.probes,
+            }
 
 
 @dataclass

@@ -18,16 +18,35 @@ not the design center.
   first real request never pays cold-path latency;
 * a batch that fails the compiled path degrades gracefully: rows re-score
   individually through the row fallback, bad rows surface as
-  ``RowScoringError`` results instead of poisoning their batch peers.
+  ``RowScoringError`` results instead of poisoning their batch peers;
+* a circuit breaker (admission.CircuitBreaker) watches batch-path
+  health: K consecutive compiled-path failures open it, after which
+  requests shed FAST (``BreakerOpenError``) instead of silently running
+  every row through the slow fallback loop, until a half-open probe
+  batch proves the path healthy again;
+* a NaN/Inf output guard refuses non-finite scores (a poisoned model
+  or kernel must fail loudly, not serve garbage) - guarded rows count
+  as batch-path failures toward the breaker.
+
+Fault-injection points (faults/injection.py): ``serving.batch`` (raise
+inside the compiled path), ``serving.nan_scores`` (poison outputs),
+``serving.slow_batch`` (sleep) - the drills in tests/test_faults.py
+prove the breaker, the guard, and the fallback end to end.
 """
 from __future__ import annotations
 
+import logging
+import math
 import time
 from dataclasses import dataclass
 from typing import Any, Mapping, Optional, Sequence
 
+from ..faults import injection as _faults
 from ..local.scorer import LocalScorer
+from .admission import CircuitBreaker
 from .telemetry import ServingTelemetry
+
+log = logging.getLogger("transmogrifai_tpu.serving")
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
@@ -36,9 +55,11 @@ DEFAULT_BUCKETS = (1, 8, 32, 128)
 class RowScoringError:
     """Per-row failure marker returned in a batch's result list (the
     scheduler converts it into the request's exception; direct batch
-    callers can filter)."""
+    callers can filter).  ``shed`` marks rows the breaker refused
+    unscored (scheduler accounting: shed_breaker, not failed)."""
 
     error: str
+    shed: bool = False
 
 
 class CompiledEndpoint:
@@ -51,11 +72,20 @@ class CompiledEndpoint:
         warm: bool = True,
         warm_record: Optional[Mapping[str, Any]] = None,
         telemetry: Optional[ServingTelemetry] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        breaker_threshold: int = 5,
+        breaker_cooldown_s: float = 5.0,
+        guard_nonfinite: bool = True,
     ) -> None:
         if not batch_buckets or any(int(b) < 1 for b in batch_buckets):
             raise ValueError("batch_buckets must be positive sizes")
         self.batch_buckets = tuple(sorted({int(b) for b in batch_buckets}))
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+        )
         self.telemetry = telemetry if telemetry is not None else ServingTelemetry()
+        self.guard_nonfinite = bool(guard_nonfinite)
         self._scorer = LocalScorer(model)
         # the pad row: scored to fill a bucket, sliced off before return.
         # All-None raw features ride the same missing-value handling every
@@ -72,6 +102,17 @@ class CompiledEndpoint:
         if warm:
             self.warm_up()
 
+    @property
+    def telemetry(self) -> ServingTelemetry:
+        return self._telemetry
+
+    @telemetry.setter
+    def telemetry(self, value: ServingTelemetry) -> None:
+        # breaker transitions must land wherever request telemetry lands,
+        # including after a caller swaps the accumulator (bench does)
+        self._telemetry = value
+        self.breaker.telemetry = value
+
     # -- warm-up ------------------------------------------------------------
     def warm_up(self) -> tuple[int, ...]:
         """Score one pad-batch per bucket ahead of traffic: primes the
@@ -85,6 +126,8 @@ class CompiledEndpoint:
                 warmed.append(b)
         except Exception as e:  # noqa: BLE001 - warm-up must never kill serving
             self.warm_error = f"{type(e).__name__}: {e}"
+            log.warning("endpoint warm-up failed (serving cold, exact "
+                        "batch shapes): %s", self.warm_error)
         self.warmed_buckets = tuple(warmed)
         return self.warmed_buckets
 
@@ -109,6 +152,19 @@ class CompiledEndpoint:
         n = len(records)
         if n == 0:
             return []
+        if not self.breaker.allow():
+            # open breaker: shed FAST with an explicit marker instead of
+            # burning the slow row loop on every request while the batch
+            # path is known-bad (meltdown protection + a loud signal)
+            self.telemetry.record_breaker_shed_rows(n)
+            return [
+                RowScoringError(
+                    "serving batch path unhealthy (circuit breaker open); "
+                    "request shed",
+                    shed=True,
+                )
+                for _ in range(n)
+            ]
         bucket = self.bucket_for(n)
         if self.warm_error is not None:
             # the pad record itself cannot score through this pipeline
@@ -120,8 +176,14 @@ class CompiledEndpoint:
         else:
             padded = list(records) + [self._pad_record] * (bucket - n)
         t0 = time.perf_counter()
+        # inside the timed window: injected slowness must be VISIBLE to
+        # batch telemetry, or the drill proves nothing
+        _faults.inject_sleep("serving.slow_batch")
         try:
+            _faults.inject("serving.batch")
             results = self._scorer.score_batch(padded)[:n]
+            if _faults.fires("serving.nan_scores"):
+                _faults.poison_nonfinite(results)
         except Exception:  # noqa: BLE001 - degrade to the row path
             # shape miss / malformed row: the compiled batch path assumes
             # bucket-shaped well-formed batches; anything else re-scores
@@ -133,9 +195,63 @@ class CompiledEndpoint:
             self.shape_misses += 1
             results = self._score_rows_fallback(records)
             self.telemetry.record_fallback_rows(n)
+            # breaker accounting distinguishes WHY the batch path failed:
+            # rows that ALSO fail individually are data-borne (a poison
+            # record opens no breaker - it is already surfaced to its
+            # caller), while a batch that re-scores 100% clean row-by-row
+            # indicts the batch path itself - exactly the persistent
+            # degradation the breaker exists to make loud.  Decided
+            # BEFORE the output guard runs: guard-refused NaN rows are
+            # model/kernel-borne, not caller-data-borne, and must still
+            # count toward the breaker.  In half-open the probe must
+            # resolve either way, so any failure re-opens.
+            data_borne = any(isinstance(r, RowScoringError) for r in results)
+            # guard the fallback path too: a NaN row must not slip out
+            # just because a batch peer tripped the fallback
+            if self.guard_nonfinite:
+                bad = self._nonfinite_rows(results)
+                if bad:
+                    self.telemetry.record_nonfinite_rows(len(bad))
+                    for i in bad:
+                        results[i] = RowScoringError(
+                            "non-finite score (NaN/Inf) refused by the "
+                            "serving output guard"
+                        )
+            if not data_borne or self.breaker.state == "half_open":
+                self.breaker.record_failure()
             return results
+        bad = self._nonfinite_rows(results) if self.guard_nonfinite else []
+        if bad:
+            # non-finite scores: a poisoned model/kernel must fail loudly
+            # per-row (the fallback would recompute the same NaN), and it
+            # counts as a batch-path failure toward the breaker
+            self.breaker.record_failure()
+            self.telemetry.record_nonfinite_rows(len(bad))
+            for i in bad:
+                results[i] = RowScoringError(
+                    "non-finite score (NaN/Inf) refused by the serving "
+                    "output guard"
+                )
+            return results
+        self.breaker.record_success()
         self.telemetry.record_batch(n, bucket, time.perf_counter() - t0)
         return results
+
+    @staticmethod
+    def _nonfinite_rows(results: Sequence[Any]) -> list[int]:
+        """Indices of rows whose score dicts contain any NaN/Inf float."""
+
+        def bad(v: Any) -> bool:
+            if isinstance(v, float):
+                return not math.isfinite(v)
+            if isinstance(v, dict):
+                return any(bad(x) for x in v.values())
+            if isinstance(v, (list, tuple)):
+                return any(bad(x) for x in v)
+            return False
+
+        return [i for i, row in enumerate(results)
+                if isinstance(row, dict) and bad(row)]
 
     def _score_rows_fallback(self, records: Sequence[Mapping[str, Any]]) -> list:
         out: list = []

@@ -29,6 +29,7 @@ from typing import Any, Iterable, Mapping, Optional
 
 from .admission import (
     AdmissionController,
+    BreakerOpenError,
     QueueFullError,
     RequestTimeoutError,
     _Request,
@@ -194,7 +195,16 @@ class MicroBatchScheduler:
             # request (caller's wait timed out, counted 'timeout') must
             # not ALSO count as delivered 'ok'/'failed'
             if isinstance(res, RowScoringError):
-                if req.resolve_delivered(error=RuntimeError(res.error)):
+                if res.shed:
+                    # breaker-open shed: the row was refused unscored -
+                    # a distinct outcome from a scoring failure, so the
+                    # degradation is visible in telemetry, not blended
+                    # into rows_failed
+                    if req.resolve_delivered(error=BreakerOpenError(
+                            res.error)):
+                        self.telemetry.record_request(
+                            done - req.enqueued_at, "shed_breaker")
+                elif req.resolve_delivered(error=RuntimeError(res.error)):
                     self.telemetry.record_request(done - req.enqueued_at,
                                                   "failed")
             else:

@@ -55,6 +55,14 @@ class ServingTelemetry:
         self.request_timeouts = 0
         self.batches = 0
         self.batch_wall_s = 0.0
+        # circuit-breaker health (admission.CircuitBreaker transitions +
+        # the rows it sheds + NaN/Inf rows the output guard caught)
+        self.shed_breaker = 0
+        self.rows_shed_breaker = 0
+        self.breaker_opens = 0
+        self.breaker_closes = 0
+        self.breaker_probes = 0
+        self.rows_nonfinite = 0
 
     # -- recording ----------------------------------------------------------
     def _sample(self, bucket: list, value) -> None:
@@ -64,7 +72,7 @@ class ServingTelemetry:
 
     def record_request(self, latency_s: float, outcome: str = "ok") -> None:
         """Outcomes: ok | failed | shed_deadline | shed_queue_full |
-        timeout."""
+        shed_breaker | timeout."""
         with self._lock:
             if outcome in ("ok", "failed"):
                 self._sample(self._latencies_s, float(latency_s))
@@ -76,6 +84,8 @@ class ServingTelemetry:
                 self.shed_deadline += 1
             elif outcome == "shed_queue_full":
                 self.shed_queue_full += 1
+            elif outcome == "shed_breaker":
+                self.shed_breaker += 1
             elif outcome == "timeout":
                 self.request_timeouts += 1
 
@@ -100,6 +110,36 @@ class ServingTelemetry:
     def record_queue_depth(self, depth: int) -> None:
         with self._lock:
             self._sample(self._queue_depths, int(depth))
+
+    def record_breaker_transition(self, event: str) -> None:
+        """Circuit-breaker state changes: open | close | probe.  Opens
+        log at WARNING - a breaker opening IS the degradation alarm."""
+        with self._lock:
+            if event == "open":
+                self.breaker_opens += 1
+            elif event == "close":
+                self.breaker_closes += 1
+            elif event == "probe":
+                self.breaker_probes += 1
+        if event == "open":
+            log.warning("%s circuit breaker OPEN: batch path unhealthy, "
+                        "shedding until a half-open probe succeeds",
+                        LOG_PREFIX)
+        elif event == "close":
+            log.info("%s circuit breaker closed: batch path recovered",
+                     LOG_PREFIX)
+
+    def record_breaker_shed_rows(self, n: int) -> None:
+        """Rows shed unscored because the breaker was open (request-level
+        shed_breaker accounting stays with the scheduler, mirroring the
+        rows_fallback split)."""
+        with self._lock:
+            self.rows_shed_breaker += int(n)
+
+    def record_nonfinite_rows(self, n: int) -> None:
+        """Rows whose scores failed the NaN/Inf output guard."""
+        with self._lock:
+            self.rows_nonfinite += int(n)
 
     # -- reporting ----------------------------------------------------------
     def snapshot(self) -> dict:
@@ -128,7 +168,15 @@ class ServingTelemetry:
                 "rows_fallback": self.rows_fallback,
                 "shed_deadline": self.shed_deadline,
                 "shed_queue_full": self.shed_queue_full,
+                "shed_breaker": self.shed_breaker,
                 "request_timeouts": self.request_timeouts,
+                "breaker": {
+                    "opens": self.breaker_opens,
+                    "closes": self.breaker_closes,
+                    "probes": self.breaker_probes,
+                    "rows_shed": self.rows_shed_breaker,
+                    "rows_nonfinite": self.rows_nonfinite,
+                },
                 "rows_per_s": round(rows / wall, 1),
                 "rows_batched": self.rows_batched,
                 "batch_rows_per_s": round(self.rows_batched / batch_wall, 1),
@@ -156,8 +204,10 @@ class ServingTelemetry:
             "p50_ms": lat["p50"],
             "p95_ms": lat["p95"],
             "p99_ms": lat["p99"],
-            "shed": snap["shed_deadline"] + snap["shed_queue_full"],
+            "shed": (snap["shed_deadline"] + snap["shed_queue_full"]
+                     + snap["shed_breaker"]),
             "fallback": snap["rows_fallback"],
+            "breaker_opens": snap["breaker"]["opens"],
         }
         return LOG_PREFIX + " " + " ".join(f"{k}={v}" for k, v in kv.items())
 

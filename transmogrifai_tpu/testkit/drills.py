@@ -1,0 +1,82 @@
+"""Shared fixtures for the fault/robustness drills.
+
+One tiny-but-real pipeline (FeatureBuilder -> transmogrify -> LR through
+the full stage stack) used by tests/test_faults.py,
+tests/test_model_io_corruption.py and ``bench.py --faults`` so the drill
+surface cannot drift between them, plus the crash-saver child-script
+template the kill-during-save drills run (the kill must land in a child
+process: faults.inject_kill calls ``os._exit``).
+"""
+from __future__ import annotations
+
+
+def tiny_drill_pipeline(n: int = 120, seed: int = 0):
+    """-> (workflow, data, records, prediction_name): a seconds-to-train
+    mixed-type pipeline whose numbers still come from the real stage
+    stack."""
+    import numpy as np
+
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from .. import FeatureBuilder, OpWorkflow
+    from ..models.logistic_regression import OpLogisticRegression
+    from ..ops.transmogrifier import transmogrify
+    from ..types import feature_types as ft
+
+    rng = np.random.RandomState(seed)
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": rng.randn(n).tolist(),
+        "c": [("u", "v", "w")[i % 3] for i in range(n)],
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a, c])
+    pred = OpLogisticRegression(reg_param=0.01).set_input(y, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    records = [{"a": data["a"][i], "c": data["c"][i]} for i in range(n)]
+    return wf, data, records, pred.name
+
+
+def drill_env() -> dict:
+    """Child-process env for supervision/crash drills: CPU backend, no
+    inherited fault plan (TX_FAULTS would re-arm in the child), no axon
+    pool tunnel."""
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TX_FAULTS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+#: child script for supervision drills: exits ``first_exit`` on the run
+#: that creates ``marker``, ``then_exit`` on every run after (die-once
+#: recovery when then_exit=0, differing-exit-codes when both non-zero).
+DIE_ONCE_CHILD_TEMPLATE = """
+import os, sys
+p = {marker!r}
+if not os.path.exists(p):
+    open(p, 'w').close()
+    sys.exit({first_exit})
+sys.exit({then_exit})
+"""
+
+
+#: child script for the kill-during-save drills: train the tiny pipeline,
+#: save a clean v1, arm ``fault`` (e.g. "io.save_model.crash_window:on=1"),
+#: save again and die at the injected point.  Format with repo / path /
+#: fault; exits 0 only if the kill failed to fire.
+CRASH_SAVER_TEMPLATE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+wf, _data, _records, _name = tiny_drill_pipeline()
+model = wf.train()
+model.save({path!r})                      # clean v1
+from transmogrifai_tpu.faults import injection
+injection.configure({fault!r})            # arm the crash
+model.save({path!r})                      # dies at the injected point
+os._exit(0)                               # unreachable when armed
+"""

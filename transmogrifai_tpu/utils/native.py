@@ -40,6 +40,13 @@ def _build() -> bool:
 
 def get_lib() -> Optional[ctypes.CDLL]:
     global _lib, _tried
+    from ..faults import injection as _faults
+
+    if _faults.inject_unavailable("native.load"):
+        # fault drill: the shared library "fails to load" on this call;
+        # checked BEFORE the memo so the degradation is per-call and the
+        # process recovers the real lib once the drill disarms
+        return None
     with _lock:
         if _lib is not None or _tried:
             return _lib

@@ -149,7 +149,9 @@ class OpWorkflowRunner:
         built-in telemetry (p50/p95/p99, rows/s, batch fill, queue depth)
         exports to ``<metrics_location>/serving_metrics.json``.  Knobs
         ride OpParams.custom_params: serving_buckets, serving_max_wait_us,
-        serving_max_queue, serving_deadline_ms, serving_window."""
+        serving_max_queue, serving_deadline_ms, serving_window,
+        serving_breaker_threshold, serving_breaker_cooldown_s,
+        serving_guard_nonfinite."""
         from ..serving import (
             MicroBatchScheduler,
             RowScoringError,
@@ -172,6 +174,10 @@ class OpWorkflowRunner:
         endpoint = compile_endpoint(
             model,
             batch_buckets=tuple(cp.get("serving_buckets", (1, 8, 32, 128))),
+            breaker_threshold=int(cp.get("serving_breaker_threshold", 5)),
+            breaker_cooldown_s=float(
+                cp.get("serving_breaker_cooldown_s", 5.0)),
+            guard_nonfinite=bool(cp.get("serving_guard_nonfinite", True)),
         )
         deadline = cp.get("serving_deadline_ms")
         with MicroBatchScheduler(

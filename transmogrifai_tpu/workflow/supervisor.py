@@ -13,14 +13,26 @@ halves:
   and re-dispatches.  The restarted run restores the completed CV rows
   from the checkpoint (validator._ckpt_load skip-completed semantics) and
   continues, so the final selection is identical to an uninterrupted run.
+
+Re-dispatch is budgeted, not immediate: attempts are separated by
+exponential backoff with jitter (a deterministic crash must not burn
+every restart in milliseconds, and a fleet restarting in lockstep must
+not stampede the checkpoint store), and a child that keeps exiting with
+the SAME non-zero code trips fail-fast - repeated identical exit codes
+mean a deterministic bug, where crash-looping only delays the pager.
+The ``supervisor.child_kill`` injection point (faults/injection.py)
+drills the kill -> backoff -> resume path in tests/test_faults.py.
 """
 from __future__ import annotations
 
 import os
+import random
 import subprocess
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
+
+from ..faults import injection as _faults
 
 
 def beat(heartbeat_path: str) -> None:
@@ -44,7 +56,24 @@ def staleness(heartbeat_path: str) -> Optional[float]:
 class SuperviseResult:
     returncode: int
     attempts: int
-    restarts: list = field(default_factory=list)  # (attempt, reason)
+    restarts: list = field(default_factory=list)  # (attempt, reason, backoff_s)
+
+
+def backoff_delay_s(
+    restart_index: int,
+    base_s: float,
+    max_s: float,
+    jitter_frac: float,
+    rng: random.Random,
+) -> float:
+    """Exponential backoff with jitter for restart ``restart_index``
+    (0-based): min(max_s, base_s * 2**i) stretched by up to
+    ``jitter_frac`` of itself so a preempted fleet does not re-dispatch
+    in lockstep."""
+    delay = min(max_s, base_s * (2.0 ** restart_index))
+    if jitter_frac > 0:
+        delay *= 1.0 + rng.uniform(0.0, jitter_frac)
+    return delay
 
 
 def supervise(
@@ -55,17 +84,34 @@ def supervise(
     poll_s: float = 0.5,
     grace_s: Optional[float] = None,
     env: Optional[dict] = None,
+    backoff_base_s: float = 0.5,
+    backoff_max_s: float = 30.0,
+    backoff_jitter: float = 0.1,
+    fail_fast_identical: int = 3,
+    backoff_seed: Optional[int] = None,
 ) -> SuperviseResult:
     """Run ``cmd`` under heartbeat supervision.
 
     A child that exits non-zero (crash/preemption) or whose heartbeat goes
     stale for ``stale_after_s`` (hang) is killed and re-dispatched, up to
     ``max_restarts`` times.  ``grace_s`` bounds the no-beat-yet startup
-    window (defaults to stale_after_s).  Returns the final returncode and
-    the restart log; raises RuntimeError when restarts are exhausted.
+    window (defaults to stale_after_s).  Re-dispatches are separated by
+    exponential backoff (``backoff_base_s`` doubling per restart, capped
+    at ``backoff_max_s``, stretched by up to ``backoff_jitter`` of
+    itself; ``backoff_seed`` pins the jitter for deterministic tests),
+    and each restart-log entry records the wait actually taken:
+    ``(attempt, reason, backoff_s)``.  A child that exits with the SAME
+    non-zero code ``fail_fast_identical`` times in a row fails fast -
+    that is a deterministic bug, not a preemption, and burning the
+    remaining restart budget on it only delays the alarm.  Returns the
+    final returncode and the restart log; raises RuntimeError when
+    restarts are exhausted or fail-fast trips.
     """
     grace = stale_after_s if grace_s is None else grace_s
+    rng = random.Random(backoff_seed)
     restarts: list = []
+    identical_exits = 0
+    last_exit: Optional[int] = None
     for attempt in range(max_restarts + 1):
         start = time.time()
         proc = subprocess.Popen(list(cmd), env=env)
@@ -74,17 +120,20 @@ def supervise(
             rc = proc.poll()
             if rc is not None:
                 break
+            if _faults.fires("supervisor.child_kill") is not None:
+                killed_reason = "injected child kill (fault drill)"
             s = staleness(heartbeat_path)
             age = time.time() - start
             # a beat older than this attempt's start is a leftover from a
             # previous attempt/run - it must not void the startup grace
             if s is not None and s > age:
                 s = None
-            if s is None:
-                if age > grace:
-                    killed_reason = f"no heartbeat within {grace:.0f}s"
-            elif s > stale_after_s and age > stale_after_s:
-                killed_reason = f"heartbeat stale for {s:.0f}s"
+            if killed_reason is None:
+                if s is None:
+                    if age > grace:
+                        killed_reason = f"no heartbeat within {grace:.0f}s"
+                elif s > stale_after_s and age > stale_after_s:
+                    killed_reason = f"heartbeat stale for {s:.0f}s"
             if killed_reason:
                 proc.kill()
                 proc.wait()
@@ -92,9 +141,36 @@ def supervise(
             time.sleep(poll_s)
         if proc.returncode == 0 and killed_reason is None:
             return SuperviseResult(0, attempt + 1, restarts)
-        restarts.append(
-            (attempt, killed_reason or f"exit code {proc.returncode}")
+        reason = killed_reason or f"exit code {proc.returncode}"
+        # identical-exit tracking: only clean (unkilled) non-zero exits
+        # count - a kill is the supervisor's doing, not determinism
+        if killed_reason is None:
+            identical_exits = (
+                identical_exits + 1 if proc.returncode == last_exit else 1
+            )
+            last_exit = proc.returncode
+        else:
+            identical_exits, last_exit = 0, None
+        fail_fast = (
+            fail_fast_identical > 0
+            and identical_exits >= fail_fast_identical
         )
+        wait_s = 0.0
+        if not fail_fast and attempt < max_restarts:
+            wait_s = backoff_delay_s(
+                len(restarts), backoff_base_s, backoff_max_s,
+                backoff_jitter, rng,
+            )
+        restarts.append((attempt, reason, round(wait_s, 3)))
+        if fail_fast:
+            raise RuntimeError(
+                f"command failed after {attempt + 1} attempts (fail-fast: "
+                f"exit code {proc.returncode} repeated {identical_exits} "
+                f"times - deterministic failure, not preemption); restart "
+                f"log: {restarts}"
+            )
+        if wait_s > 0:
+            time.sleep(wait_s)
     raise RuntimeError(
         f"command failed after {max_restarts + 1} attempts; restart log: "
         f"{restarts}"
