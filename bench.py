@@ -815,6 +815,261 @@ def faults_bench() -> dict:
     return out
 
 
+def mesh_faults_bench() -> dict:
+    """Degraded-mode mesh drills -> MESH_FAULTS_BENCH.json (ISSUE 3
+    acceptance): an injected ``mesh.peer_hang`` is DETECTED within the
+    configured deadline, a straggler (``collective.delay``) gets one
+    extended-deadline retry, a dead peer (``mesh.peer_die``) shrinks to
+    the survivor mesh with the recomputed result matching the
+    uninterrupted run (test_tree_predict_parity-style 1e-5 tolerance),
+    the CV fold x grid fit recovers through the validator's guarded
+    seam, and a missing coordinator fails bootstrap within
+    TX_MESH_INIT_TIMEOUT_S instead of hanging."""
+    import jax
+    import numpy as np
+
+    from transmogrifai_tpu.faults import injection
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.parallel import distributed as dist
+    from transmogrifai_tpu.parallel import resilience
+    from transmogrifai_tpu.parallel.resilience import (
+        CollectiveWatchdog,
+        DeadlinePolicy,
+        MeshTelemetry,
+    )
+
+    out: dict = {
+        "platform": jax.default_backend(),
+        "n_devices": jax.device_count(),
+    }
+    resilience.reset_mesh_telemetry()
+    tel = MeshTelemetry()
+    wd = CollectiveWatchdog(
+        telemetry=tel,
+        policy=DeadlinePolicy(floor_s=0.25, ceiling_s=120.0, factor=4.0),
+    )
+    mesh = dist.global_mesh(("data",))
+    rng = np.random.RandomState(0)
+    n = 256 * mesh.devices.size
+    X = rng.randn(n, 12).astype(np.float32)
+
+    def moments(x):
+        return x.sum(axis=0), (x * x).sum(axis=0)
+
+    def step():
+        return dist.all_reduce_stats(moments, mesh, X)
+
+    def shrink():
+        return dist.all_reduce_stats(
+            moments, resilience.survivor_mesh(("data",)), X)
+
+    def as_np(v):
+        return tuple(np.asarray(a) for a in v)
+
+    def max_diff(got, want):
+        return float(max(
+            np.abs(np.asarray(g) - np.asarray(w)).max()
+            for g, w in zip(got, want)
+        ))
+
+    # uninterrupted baseline (generous first deadline covers compile),
+    # then a warm run so the drills measure detection, not compile
+    baseline = as_np(wd.run("mesh.moments", step, shrink_fn=shrink))
+    wd.run("mesh.moments", step, shrink_fn=shrink)
+    deadline_s = 0.25
+
+    # -- drill 1: hung peer -> detect -> straggler retry stalls -> shrink
+    injection.configure("mesh.peer_hang:every=1:times=2:delay=6")
+    try:
+        t0 = time.perf_counter()
+        res = as_np(wd.run("mesh.moments", step, shrink_fn=shrink,
+                           deadline_s=deadline_s))
+        recovery_wall_s = time.perf_counter() - t0
+    finally:
+        injection.reset()
+    snap = tel.snapshot()
+    detect = [e for e in snap["events"] if e["event"] == "detect"][-1]
+    diff = max_diff(res, baseline)
+    out["peer_hang"] = {
+        "deadline_s": deadline_s,
+        "detection_latency_ms": round(detect["latency_s"] * 1e3, 2),
+        "detected_within_deadline": detect["latency_s"] <= deadline_s + 0.25,
+        "classification": detect["classification"],
+        "recovered_via": "shrink_to_survivors",
+        "recovery_wall_ms": round(recovery_wall_s * 1e3, 2),
+        "parity_max_abs_diff": diff,
+        "parity_ok": diff <= 1e-5,
+    }
+
+    # -- drill 2: straggler -> ONE extended-deadline retry recovers
+    injection.configure("collective.delay:on=1:delay=0.7")
+    retries_before = tel.snapshot()["retries_ok"]
+    try:
+        t0 = time.perf_counter()
+        res = as_np(wd.run("mesh.moments", step, shrink_fn=shrink,
+                           deadline_s=0.35))
+        retry_wall_s = time.perf_counter() - t0
+    finally:
+        injection.reset()
+    snap = tel.snapshot()
+    diff = max_diff(res, baseline)
+    out["straggler"] = {
+        "deadline_s": 0.35,
+        "retry_recovered": snap["retries_ok"] == retries_before + 1,
+        "recovery_wall_ms": round(retry_wall_s * 1e3, 2),
+        "parity_max_abs_diff": diff,
+        "parity_ok": diff <= 1e-5,
+    }
+
+    # -- drill 3: dead peer -> no retry, immediate survivor recompute
+    injection.configure("mesh.peer_die:on=1:delay=6")
+    try:
+        t0 = time.perf_counter()
+        res = as_np(wd.run("mesh.moments", step, shrink_fn=shrink,
+                           deadline_s=deadline_s))
+        die_wall_s = time.perf_counter() - t0
+    finally:
+        injection.reset()
+    snap = tel.snapshot()
+    detect = [e for e in snap["events"] if e["event"] == "detect"][-1]
+    shrink_ev = [e for e in snap["events"] if e["event"] == "shrink"][-1]
+    diff = max_diff(res, baseline)
+    out["peer_die"] = {
+        "deadline_s": deadline_s,
+        "detection_latency_ms": round(detect["latency_s"] * 1e3, 2),
+        "classification": detect["classification"],
+        "shrink_recompute_ms": round(shrink_ev["overhead_s"] * 1e3, 2),
+        "recovery_wall_ms": round(die_wall_s * 1e3, 2),
+        "parity_max_abs_diff": diff,
+        "parity_ok": diff <= 1e-5,
+    }
+
+    # -- drill 4: the validator's CV fold x grid collective, end to end
+    # (the guarded seam production training rides): dead peer mid-fit ->
+    # shrink to the single-host recompute -> identical selection
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+    from transmogrifai_tpu.selector.factories import lr_grid
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    n_cv = 1999
+    Xc = rng.randn(n_cv, 12).astype(np.float32)
+    beta = rng.randn(12)
+    yc = (rng.rand(n_cv) < 1 / (1 + np.exp(-(Xc @ beta)))).astype(
+        np.float64)
+
+    def run_cv():
+        cv = OpCrossValidation(
+            num_folds=3, evaluator=OpBinaryClassificationEvaluator(),
+            stratify=True,
+        )
+        return cv.validate([(OpLogisticRegression(), lr_grid())], Xc, yc)
+
+    prev_mesh_env = os.environ.get("TX_PRODUCT_MESH")
+    os.environ["TX_PRODUCT_MESH"] = "0"
+    try:
+        t0 = time.perf_counter()
+        cv_single = run_cv()
+        cv_single_wall_s = time.perf_counter() - t0
+    finally:
+        if prev_mesh_env is None:
+            os.environ.pop("TX_PRODUCT_MESH", None)
+        else:
+            os.environ["TX_PRODUCT_MESH"] = prev_mesh_env
+    injection.configure("mesh.peer_die:on=1:delay=0.1")
+    try:
+        t0 = time.perf_counter()
+        cv_shrunk = run_cv()
+        cv_shrunk_wall_s = time.perf_counter() - t0
+    finally:
+        injection.reset()
+    gsnap = resilience.mesh_telemetry().snapshot()
+    shrink_evs = [e for e in gsnap["events"] if e["event"] == "shrink"]
+    fold_diff = float(max(
+        np.abs(np.asarray(a["fold_metrics"])
+               - np.asarray(b["fold_metrics"])).max()
+        for a, b in zip(cv_shrunk.all_results, cv_single.all_results)
+    ))
+    out["cv_shrink"] = {
+        "shrinks_recorded": gsnap["shrinks"],
+        "same_selection": cv_shrunk.best_params == cv_single.best_params,
+        "metric_abs_diff": abs(
+            cv_shrunk.best_metric - cv_single.best_metric),
+        "fold_metrics_max_abs_diff": fold_diff,
+        "parity_ok": (
+            cv_shrunk.best_params == cv_single.best_params
+            and fold_diff <= 1e-5
+        ),
+        "uninterrupted_wall_s": round(cv_single_wall_s, 3),
+        "shrunk_wall_s": round(cv_shrunk_wall_s, 3),
+        # the survivor recompute itself, from the shrink event (the two
+        # whole-run walls are not an overhead pair: the shrunk run rides
+        # the jit cache the uninterrupted run warmed)
+        "shrink_recompute_s": (
+            shrink_evs[-1]["overhead_s"] if shrink_evs else None),
+    }
+
+    # -- drill 5: absent coordinator -> MeshBootstrapError in-deadline
+    prev_timeout = os.environ.get("TX_MESH_INIT_TIMEOUT_S")
+    os.environ["TX_MESH_INIT_TIMEOUT_S"] = "1.0"
+    injection.configure("mesh.init_no_coordinator:on=1:delay=60")
+    bootstrap_error = None
+    try:
+        t0 = time.perf_counter()
+        try:
+            dist.initialize(coordinator_address="203.0.113.1:65000",
+                            num_processes=2, process_id=0)
+        except dist.MeshBootstrapError as e:
+            bootstrap_error = type(e).__name__ + ": " + str(e)[:120]
+        bootstrap_wall_s = time.perf_counter() - t0
+    finally:
+        # env/fault hygiene even when the drill raises: a leaked armed
+        # plan or 1s bootstrap deadline must not poison later sections
+        injection.reset()
+        if prev_timeout is None:
+            os.environ.pop("TX_MESH_INIT_TIMEOUT_S", None)
+        else:
+            os.environ["TX_MESH_INIT_TIMEOUT_S"] = prev_timeout
+    out["bootstrap"] = {
+        "timeout_s": 1.0,
+        "elapsed_ms": round(bootstrap_wall_s * 1e3, 2),
+        "raised": bootstrap_error,
+        "within_deadline": (
+            bootstrap_error is not None and bootstrap_wall_s < 5.0),
+    }
+    out["telemetry"] = tel.snapshot()
+    resilience.reset_mesh_telemetry()
+    return out
+
+
+def _mesh_faults_section(result: dict) -> None:
+    """Run the mesh degradation drills: artifact side-written to
+    MESH_FAULTS_BENCH.json, headline numbers folded into the main
+    result."""
+    bench = mesh_faults_bench()
+    path = os.environ.get(
+        "TX_MESH_FAULTS_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "MESH_FAULTS_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["mesh_faults_detect_ms"] = bench["peer_hang"][
+        "detection_latency_ms"]
+    result["mesh_faults_parity_ok"] = (
+        bench["peer_hang"]["parity_ok"]
+        and bench["peer_die"]["parity_ok"]
+        and bench["cv_shrink"]["parity_ok"]
+    )
+    result["mesh_faults_bootstrap_within_deadline"] = bench["bootstrap"][
+        "within_deadline"]
+
+
 def _faults_section(result: dict) -> None:
     """Run the fault drills: artifact side-written to FAULTS_BENCH.json,
     headline recovery numbers folded into the main result."""
@@ -1006,6 +1261,11 @@ def main() -> None:
         result["faults_error"] = f"{type(e).__name__}: {e}"
     _checkpoint(result)
     try:
+        _mesh_faults_section(result)
+    except Exception as e:
+        result["mesh_faults_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
+    try:
         _ingest_section(result)
     except Exception as e:
         result["ingest_error"] = f"{type(e).__name__}: {e}"
@@ -1015,6 +1275,33 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--mesh-faults" in sys.argv:
+        # fast standalone mesh degradation drills: writes
+        # MESH_FAULTS_BENCH.json and prints it.  8 virtual CPU devices
+        # make the shrink drills exercise real multi-device collectives
+        # when the backend is the host CPU (the flag only affects the
+        # host platform - a no-op on TPU backends).
+        if "jax" not in sys.modules:
+            _flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in _flags:
+                os.environ["XLA_FLAGS"] = (
+                    _flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _mesh_faults_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
     if "--faults" in sys.argv:
         # fast standalone fault/recovery drills: writes FAULTS_BENCH.json
         # and prints it, without the multi-minute full-bench sections

@@ -2,6 +2,7 @@
 (reference: Spark's executor substrate, SURVEY §5.8; local[2]-style test
 strategy per TestSparkContext.scala:33-76)."""
 import numpy as np
+import pytest
 
 from transmogrifai_tpu.parallel import distributed as dist
 
@@ -30,3 +31,41 @@ def test_host_local_to_global_single_process():
 
 def test_initialize_noop_single_process():
     dist.initialize()  # must not raise or block on single-process setups
+    assert dist._initialized is False  # a no-op must not latch
+
+
+def test_all_reduce_rejects_mismatched_leading_axes():
+    """ISSUE 3 satellite: shape disagreement fails up front with the
+    offending array NAMED, not as an XLA error from inside jax.jit."""
+    mesh = dist.global_mesh(("data",))
+    n = mesh.devices.size * 2
+    X = np.zeros((n, 3), np.float32)
+    y = np.zeros((n + 1,), np.float32)
+    with pytest.raises(dist.MeshShapeError, match=r"array 1 has"):
+        dist.all_reduce_stats(lambda a, b: (a.sum(), b.sum()), mesh, X, y)
+
+
+def test_all_reduce_rejects_indivisible_rows():
+    mesh = dist.global_mesh(("data",))
+    X = np.zeros((mesh.devices.size * 2 + 1, 3), np.float32)
+    with pytest.raises(dist.MeshShapeError,
+                       match=r"not divisible by mesh axis 'data'"):
+        dist.all_reduce_stats(lambda a: a.sum(), mesh, X)
+
+
+def test_all_reduce_rejects_scalar_and_bad_axis():
+    mesh = dist.global_mesh(("data",))
+    with pytest.raises(dist.MeshShapeError, match="0-d"):
+        dist.all_reduce_stats(lambda a: a, mesh, np.float32(3.0))
+    X = np.zeros((mesh.devices.size, 2), np.float32)
+    with pytest.raises(dist.MeshShapeError, match="no axis 'rows'"):
+        dist.all_reduce_stats(lambda a: a.sum(), mesh, X, axis="rows")
+
+
+def test_host_local_to_global_rejects_indivisible_local_rows():
+    mesh = dist.global_mesh(("data",))
+    bad = np.zeros((mesh.devices.size + 1, 4), np.float32)
+    with pytest.raises(dist.MeshShapeError, match="local_rows"):
+        dist.host_local_to_global(bad, mesh)
+    with pytest.raises(dist.MeshShapeError, match="0-d"):
+        dist.host_local_to_global(np.float32(1.0), mesh)

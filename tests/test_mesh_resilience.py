@@ -1,0 +1,433 @@
+"""Degraded-mode distributed training drills (ISSUE 3 tentpole).
+
+The collective watchdog + peer health + shrink-to-survivors recovery in
+parallel/resilience.py, exercised end to end against the named fault
+points (SURVEY §5.3/§5.8 - the one subsystem that previously had zero
+failure handling):
+
+* ``collective.delay``  -> straggler: ONE retry with an extended deadline
+* ``mesh.peer_hang``    -> the retry stalls too: escalate to shrink
+* ``mesh.peer_die``     -> dead peer: no retry, survivor recompute,
+                           result parity with the uninterrupted run
+* ``mesh.init_no_coordinator`` / a genuinely unreachable address ->
+  ``initialize()`` raises MeshBootstrapError within
+  TX_MESH_INIT_TIMEOUT_S, never hangs (armed in-process AND via the
+  TX_FAULTS env in a child, proving the zero-code-change drill path)
+
+plus the file-based PeerHealth hang-once / die-once child drills
+(testkit/drills.py templates) and the telemetry surfacing contracts.
+Collective drills run on the in-process 8-device CPU mesh (conftest), so
+nothing here needs cross-process collectives; the child drills are
+jax-free on purpose.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.parallel import distributed as dist
+from transmogrifai_tpu.parallel import resilience
+from transmogrifai_tpu.parallel.resilience import (
+    CollectiveStallError,
+    CollectiveWatchdog,
+    DeadlinePolicy,
+    MeshTelemetry,
+    PeerHealth,
+)
+from transmogrifai_tpu.testkit.drills import (
+    MESH_BOOTSTRAP_CHILD_TEMPLATE,
+    MESH_PEER_CHILD_TEMPLATE,
+    drill_env,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm(monkeypatch):
+    """Every drill arms injection explicitly; none may leak - and the
+    process-global telemetry/watchdog must not carry events across
+    tests (summary_json would surface them elsewhere)."""
+    monkeypatch.delenv("TX_MESH_WATCHDOG", raising=False)
+    faults.reset()
+    resilience.reset_mesh_telemetry()
+    yield
+    faults.reset()
+    resilience.reset_mesh_telemetry()
+
+
+def _moments(x):
+    return x.sum(axis=0), (x * x).sum(axis=0)
+
+
+@pytest.fixture
+def mesh_setup(rng):
+    mesh = dist.global_mesh(("data",))
+    n = 16 * mesh.devices.size
+    X = rng.randn(n, 5).astype(np.float32)
+    tel = MeshTelemetry()
+    wd = CollectiveWatchdog(
+        telemetry=tel,
+        policy=DeadlinePolicy(floor_s=0.05, ceiling_s=30.0, factor=4.0),
+    )
+    step = lambda: dist.all_reduce_stats(_moments, mesh, X)  # noqa: E731
+    shrink = lambda: dist.all_reduce_stats(  # noqa: E731
+        _moments, resilience.survivor_mesh(("data",)), X)
+    baseline = tuple(np.asarray(v) for v in step())
+    return wd, tel, step, shrink, baseline
+
+
+def _parity(result, baseline):
+    for got, want in zip(result, baseline):
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+# -- deadline policy ---------------------------------------------------------
+
+def test_deadline_policy_clamps_and_tracks_p99():
+    p = DeadlinePolicy(floor_s=1.0, ceiling_s=10.0, factor=4.0)
+    # no observations yet: a cold compile must never be killed early
+    assert p.deadline_s() == 10.0
+    for _ in range(50):
+        p.observe(0.1)
+    assert p.deadline_s() == pytest.approx(1.0)  # 0.4 clamped to floor
+    for _ in range(50):
+        p.observe(1.0)
+    assert 3.9 <= p.deadline_s() <= 4.1  # p99*4
+    for _ in range(50):
+        p.observe(100.0)
+    assert p.deadline_s() == 10.0  # ceiling
+
+
+# -- the watchdog state machine ----------------------------------------------
+
+def test_healthy_step_is_transparent_and_observed(mesh_setup):
+    wd, tel, step, _shrink, baseline = mesh_setup
+    out = wd.run("drill.moments", step)
+    _parity(out, baseline)
+    snap = tel.snapshot()
+    assert snap["collectives_ok"] == 1
+    assert snap["detections"] == 0 and snap["shrinks"] == 0
+    assert snap["step_ms"]["p99"] is not None
+
+
+def test_straggler_gets_one_extended_retry(mesh_setup):
+    wd, tel, step, shrink, baseline = mesh_setup
+    wd.run("drill.moments", step)  # warm the jit cache: retry is fast
+    faults.configure("collective.delay:on=1:delay=0.5")
+    out = wd.run("drill.moments", step, shrink_fn=shrink, deadline_s=0.15)
+    _parity(out, baseline)
+    snap = tel.snapshot()
+    assert snap["detections"] == 1
+    assert snap["straggler_retries"] == 1 and snap["retries_ok"] == 1
+    assert snap["shrinks"] == 0  # the retry recovered: no shrink needed
+    detect = [e for e in snap["events"] if e["event"] == "detect"][0]
+    assert detect["classification"] == "straggler"
+    retry = [e for e in snap["events"] if e["event"] == "retry"][0]
+    assert retry["ok"] and retry["deadline_s"] == pytest.approx(0.3)
+
+
+def test_peer_hang_escalates_past_retry_to_shrink(mesh_setup):
+    wd, tel, step, shrink, baseline = mesh_setup
+    wd.run("drill.moments", step)  # warm
+    # every armed call stalls: the straggler retry stalls too
+    faults.configure("mesh.peer_hang:every=1:times=2:delay=1.5")
+    t0 = time.perf_counter()
+    out = wd.run("drill.moments", step, shrink_fn=shrink, deadline_s=0.1)
+    wall = time.perf_counter() - t0
+    _parity(out, baseline)
+    snap = tel.snapshot()
+    assert snap["detections"] == 1
+    assert snap["straggler_retries"] == 1 and snap["retries_ok"] == 0
+    assert snap["shrinks"] == 1
+    assert wall < 5.0  # bounded: deadline + retry + recompute, not 2x1.5s
+
+
+def test_peer_die_shrinks_without_retry(mesh_setup):
+    wd, tel, step, shrink, baseline = mesh_setup
+    # the dying peer marks itself then stalls briefly: detection is
+    # driven by the death, not by deadline tuning (deadline stays huge)
+    faults.configure("mesh.peer_die:on=1:delay=0.05")
+    out = wd.run("drill.moments", step, shrink_fn=shrink, deadline_s=30.0)
+    _parity(out, baseline)
+    snap = tel.snapshot()
+    assert snap["detections"] == 1
+    assert snap["straggler_retries"] == 0  # dead peer: no pointless retry
+    assert snap["shrinks"] == 1
+    detect = [e for e in snap["events"] if e["event"] == "detect"][0]
+    assert detect["classification"] == "dead_peer"
+    assert detect["dead_peers"] == ["injected"]
+    assert snap["shrink_recompute_ms"]["p99"] is not None
+
+
+def test_stall_without_shrink_path_raises_named_error(mesh_setup):
+    wd, tel, step, _shrink, _baseline = mesh_setup
+    faults.configure("mesh.peer_die:on=1:delay=0.05")
+    with pytest.raises(CollectiveStallError, match="dead_peer"):
+        wd.run("drill.moments", step, deadline_s=30.0)
+    assert tel.snapshot()["shrink_failures"] == 1
+
+
+def test_wedged_survivor_route_fails_loudly_not_hangs():
+    """'Never wedge the caller' must hold even when the survivor
+    recompute ITSELF is broken: the shrink runs in a bounded worker
+    (ceiling deadline) and a stalled one raises, never hangs."""
+    tel = MeshTelemetry()
+    wd = CollectiveWatchdog(telemetry=tel, policy=DeadlinePolicy(
+        floor_s=0.05, ceiling_s=0.3, factor=4.0))
+    faults.configure("mesh.peer_die:on=1:delay=0.05")
+
+    def wedged_shrink():
+        time.sleep(5.0)
+        return 1
+
+    t0 = time.perf_counter()
+    with pytest.raises(CollectiveStallError, match="survivor recompute"):
+        wd.run("drill.sum", lambda: 1, shrink_fn=wedged_shrink,
+               deadline_s=30.0)
+    assert time.perf_counter() - t0 < 3.0  # bounded by the 0.3s ceiling
+    assert tel.snapshot()["shrink_failures"] == 1
+
+
+def test_nested_guards_run_inline():
+    """A guarded fit inside a guarded validator step must not stack a
+    second watchdog thread/deadline (one deadline per collective)."""
+    tel = MeshTelemetry()
+    wd = CollectiveWatchdog(telemetry=tel, policy=DeadlinePolicy(
+        floor_s=0.05, ceiling_s=30.0, factor=4.0))
+
+    def outer():
+        return resilience.guarded_collective(
+            "inner", lambda: 42, watchdog=wd)
+
+    assert wd.run("outer", outer) == 42
+    assert tel.snapshot()["collectives_ok"] == 1  # outer only
+
+
+# -- peer health: hang-once / die-once child drills --------------------------
+
+def _spawn_peer(tmp_path, mode: str, beats: int = 3, interval: float = 0.1,
+                exit_code: int = 9):
+    hb_dir = str(tmp_path / "hb")
+    script = tmp_path / f"peer_{mode}.py"
+    script.write_text(MESH_PEER_CHILD_TEMPLATE.format(
+        repo=REPO, hb_dir=hb_dir, peer_id=1, beats=beats,
+        interval=interval, mode=mode, exit_code=exit_code,
+    ))
+    proc = subprocess.Popen([sys.executable, str(script)], env=drill_env())
+    return hb_dir, proc
+
+
+def _wait_for_dead(ph: PeerHealth, timeout_s: float = 30.0) -> list:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        dead = ph.dead_peers()
+        if dead:
+            return dead
+        time.sleep(0.05)
+    return []
+
+
+def test_peer_health_detects_die_once_child(tmp_path):
+    hb_dir, proc = _spawn_peer(tmp_path, "die")
+    try:
+        ph = PeerHealth(hb_dir, process_id=0, stale_after_s=0.6)
+        ph.beat()
+        proc.wait(timeout=60)
+        assert proc.returncode == 9  # really died
+        assert _wait_for_dead(ph) == [1]
+        assert ph.survivors() == [0]
+        assert 1 in ph.peers()  # the corpse's last beat is still visible
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def test_peer_health_detects_hang_once_child(tmp_path):
+    """A hung peer (alive, beatless) is as dead as a dead one: it will
+    never finish the collective."""
+    hb_dir, proc = _spawn_peer(tmp_path, "hang", beats=2)
+    try:
+        ph = PeerHealth(hb_dir, process_id=0, stale_after_s=0.6)
+        ph.beat()
+        assert _wait_for_dead(ph) == [1]
+        assert proc.poll() is None  # hung, not dead - same classification
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def test_watchdog_classifies_stale_heartbeat_as_dead_peer(tmp_path, rng):
+    """With PeerHealth attached, a stall plus a stale peer heartbeat
+    skips the straggler retry and goes straight to the survivor
+    recompute."""
+    hb_dir = str(tmp_path / "hb")
+    ph = PeerHealth(hb_dir, process_id=0, stale_after_s=5.0)
+    ph.beat()
+    # peer 1 last beat 100s ago: stale long before any drill timing
+    stale_path = ph.path_for(1)
+    with open(stale_path, "w"):
+        pass
+    past = time.time() - 100.0
+    os.utime(stale_path, (past, past))
+    tel = MeshTelemetry()
+    wd = CollectiveWatchdog(telemetry=tel, peer_health=ph)
+    faults.configure("mesh.peer_hang:on=1:delay=1.0")
+    out = wd.run("drill.sum", lambda: 7, shrink_fn=lambda: 7,
+                 deadline_s=0.1)
+    assert out == 7
+    snap = tel.snapshot()
+    assert snap["straggler_retries"] == 0
+    detect = [e for e in snap["events"] if e["event"] == "detect"][0]
+    assert detect["classification"] == "dead_peer"
+    assert detect["dead_peers"] == [1]
+    shrink = [e for e in snap["events"] if e["event"] == "shrink"][0]
+    assert shrink["survivors"] == 1  # only this process still beats
+
+
+def test_peer_health_clamps_skewed_clocks():
+    """A peer heartbeat stamped in the future must read staleness 0, not
+    negative (supervisor.staleness clamp) - never 'fresher than now'."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ph = PeerHealth(td, process_id=0)
+        ph.beat()
+        future = time.time() + 120.0
+        os.utime(ph.path_for(0), (future, future))
+        s = ph.staleness_by_peer()[0]
+        assert s == 0.0
+
+
+# -- the validator's guarded CV-fold collective ------------------------------
+
+def test_validator_mesh_fit_shrinks_to_survivor_parity(rng, monkeypatch):
+    """The CV fold x grid fit over the 8-device mesh, with the peer dying
+    mid-collective: the watchdog (auto-armed by the mesh.* fault point)
+    must shrink to the single-host recompute and reach the SAME
+    selection as an undisturbed unsharded run."""
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.selector.factories import lr_grid
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    n, d = 1999, 12
+    X = rng.randn(n, d).astype(np.float32)
+    beta = rng.randn(d)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-(X @ beta)))).astype(np.float64)
+    ev = OpBinaryClassificationEvaluator()
+
+    def run():
+        cv = OpCrossValidation(num_folds=3, evaluator=ev, stratify=True)
+        return cv.validate([(OpLogisticRegression(), lr_grid())], X, y)
+
+    monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+    res_single = run()
+    monkeypatch.setenv("TX_PRODUCT_MESH", "1")
+    # the dying peer marks itself and stalls briefly; the huge default
+    # deadline never fires early, so this is timing-insensitive
+    faults.configure("mesh.peer_die:on=1:delay=0.1")
+    res_shrunk = run()
+    snap = resilience.mesh_telemetry().snapshot()
+    assert snap["shrinks"] == 1, snap["events"]
+    assert res_shrunk.best_params == res_single.best_params
+    np.testing.assert_allclose(
+        res_shrunk.best_metric, res_single.best_metric, rtol=1e-5
+    )
+    for a, b in zip(res_shrunk.all_results, res_single.all_results):
+        np.testing.assert_allclose(
+            a["fold_metrics"], b["fold_metrics"], rtol=1e-5, atol=1e-7
+        )
+
+
+# -- bootstrap deadline ------------------------------------------------------
+
+def test_initialize_bootstrap_deadline_with_injected_absent_coordinator(
+        monkeypatch):
+    monkeypatch.setenv("TX_MESH_INIT_TIMEOUT_S", "0.4")
+    faults.configure("mesh.init_no_coordinator:on=1:delay=60")
+    t0 = time.time()
+    with pytest.raises(dist.MeshBootstrapError, match="coordinator"):
+        dist.initialize(coordinator_address="203.0.113.1:65000",
+                        num_processes=2, process_id=0)
+    assert time.time() - t0 < 10.0  # bounded, nowhere near the 60s hang
+    assert dist._initialized is False  # failure must not latch
+    # recorded as a bootstrap event in the global telemetry
+    snap = resilience.mesh_telemetry().snapshot()
+    assert snap["bootstrap_timeouts"] == 1
+
+
+def _run_bootstrap_child(tmp_path, addr: str, env_extra: dict,
+                         timeout: int = 180):
+    script = tmp_path / "bootstrap.py"
+    script.write_text(
+        MESH_BOOTSTRAP_CHILD_TEMPLATE.format(repo=REPO, addr=addr))
+    env = dict(drill_env(), **env_extra)
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, timeout=timeout,
+        capture_output=True, text=True,
+    )
+    return proc
+
+
+def test_initialize_bootstrap_deadline_env_armed_child(tmp_path):
+    """TX_FAULTS in the child env arms the drill with zero code changes
+    (the injection framework's import-time arming contract)."""
+    proc = _run_bootstrap_child(
+        tmp_path, "203.0.113.1:65000",
+        {"TX_FAULTS": "mesh.init_no_coordinator:on=1:delay=600",
+         "TX_MESH_INIT_TIMEOUT_S": "2"},
+    )
+    assert proc.returncode == 42, proc.stdout + proc.stderr
+    assert "MESH_BOOTSTRAP_ERROR" in proc.stdout
+
+
+def test_initialize_unreachable_coordinator_never_hangs(tmp_path):
+    """A genuinely bogus coordinator address (TEST-NET-3, blackholed on
+    most networks) must fail loudly within the deadline: either the
+    named MeshBootstrapError (dial hangs -> deadline) or the backend's
+    own immediate connection error (dial refused) - NEVER an indefinite
+    hang (the subprocess timeout is the hang detector)."""
+    proc = _run_bootstrap_child(
+        tmp_path, "203.0.113.1:65000", {"TX_MESH_INIT_TIMEOUT_S": "3"},
+    )
+    assert proc.returncode in (42, 43), proc.stdout + proc.stderr
+
+
+# -- telemetry surfacing -----------------------------------------------------
+
+def test_mesh_events_surface_in_stage_metrics_and_export(tmp_path):
+    from transmogrifai_tpu.utils.tracing import AppMetrics
+
+    run_metrics = AppMetrics()  # the run the degradation happens in
+    tel = resilience.mesh_telemetry()
+    wd = CollectiveWatchdog(telemetry=tel)
+    faults.configure("mesh.peer_die:on=1:delay=0.05")
+    wd.run("drill.sum", lambda: 1, shrink_fn=lambda: 1, deadline_s=30.0)
+    # AppMetrics.to_json (what model.summary_json embeds) carries the
+    # events of ITS OWN window...
+    mj = run_metrics.to_json()
+    assert [e["event"] for e in mj["mesh_resilience_events"]] == [
+        "detect", "shrink"]
+    # ...while a LATER run in the same process must not inherit another
+    # run's degradation report (per-run scoping)
+    time.sleep(0.01)
+    assert "mesh_resilience_events" not in AppMetrics().to_json()
+    # and the JSON artifact export has the ServingTelemetry-style shape
+    out = tel.export(str(tmp_path / "mesh.json"), extra={"drill": True})
+    assert out["shrinks"] == 1 and out["drill"] is True
+    import json
+
+    on_disk = json.load(open(tmp_path / "mesh.json"))
+    assert on_disk["detections"] == 1
+    assert set(on_disk["step_ms"]) == {"p50", "p95", "p99"}

@@ -158,6 +158,24 @@ def test_supervisor_exhausts_restarts(tmp_path):
         )
 
 
+def test_staleness_clamps_future_mtimes_to_zero(tmp_path):
+    """ISSUE 3 satellite regression: a heartbeat stamped in the FUTURE
+    (clock skew across hosts, coarse-mtime filesystems) must read
+    staleness 0.0, never negative - negative staleness poisons every
+    ``staleness > threshold`` comparison downstream (supervise(), mesh
+    PeerHealth), letting a hung child look alive for the whole skew
+    window."""
+    from transmogrifai_tpu.workflow.supervisor import beat, staleness
+
+    hb = str(tmp_path / "hb")
+    beat(hb)
+    future = time.time() + 120.0
+    os.utime(hb, (future, future))
+    s = staleness(hb)
+    assert s == 0.0
+    assert staleness(str(tmp_path / "never-beat")) is None
+
+
 def test_legacy_checkpoint_keys_migrate(tmp_path):
     """Pre-mode-suffix checkpoint files restore as ':exact' rows instead of
     silently retraining everything (advisor finding)."""

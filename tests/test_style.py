@@ -12,6 +12,10 @@ Enforced invariants over every module in transmogrifai_tpu/:
 - every broad ``except Exception`` under serving/ and workflow/ must
   re-raise, use the bound exception, or record telemetry/a log entry -
   silent swallowing is exactly how serving degradation hides (ISSUE 2)
+- no unbounded blocking waits under parallel/ and workflow/: every
+  ``.join()`` / ``.wait()`` / ``.get()`` / ``.recv()`` must pass a
+  timeout - a hung mesh peer or D-state child must never be able to
+  wedge supervision or the collective watchdog forever (ISSUE 3)
 """
 import ast
 import pathlib
@@ -119,6 +123,38 @@ def test_serving_and_workflow_broad_excepts_leave_a_trace():
             if isinstance(node, ast.ExceptHandler) and _is_broad(node):
                 if not _handler_is_accounted(node):
                     offenders.append(f"{p}:{node.lineno}")
+    assert not offenders, offenders
+
+
+_BLOCKING_METHODS = {"join", "wait", "get", "recv"}
+
+#: provably-bounded blocking sites, keyed (relative-path, lineno) - keep
+#: EMPTY unless a site can be argued bounded in a comment here
+_BLOCKING_ALLOWLIST: set = set()
+
+
+def test_no_unbounded_blocking_waits_under_parallel_and_workflow():
+    """Under parallel/ and workflow/ every .join()/.wait()/.get()/.recv()
+    call must pass a timeout (ISSUE 3): one wedged peer or child must
+    not be able to block supervision/recovery code forever.  The
+    zero-argument forms are the unbounded-blocking ones - dict.get(k) /
+    "sep".join(xs) / q.get(timeout=...) all carry arguments and pass."""
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        if rel[0] not in ("parallel", "workflow"):
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS
+                and not node.args
+                and not node.keywords
+                and ("/".join(rel), node.lineno) not in _BLOCKING_ALLOWLIST
+            ):
+                offenders.append(f"{p}:{node.lineno} .{node.func.attr}()")
     assert not offenders, offenders
 
 

@@ -13,7 +13,21 @@ io.save_model.crash        hard process kill mid-artifact-write (tempdir)
 io.save_model.crash_window hard kill between the artifact swap renames
 supervisor.child_kill      the supervisor kills its child (preemption)
 native.load                the native kernel library reports unavailable
+collective.delay           a mesh collective straggles ``delay`` seconds
+mesh.peer_hang             a mesh peer wedges: the collective stalls on
+                           EVERY armed call (the straggler retry stalls
+                           too, escalating to shrink-to-survivors)
+mesh.peer_die              a mesh peer dies mid-collective (classified
+                           dead immediately; no retry, straight to the
+                           survivor recompute)
+mesh.init_no_coordinator   distributed.initialize: the coordinator never
+                           answers (bootstrap-deadline drill)
 ========================== ==================================================
+
+The ``serving.*``/``io.*``/``supervisor.*``/``native.*`` points drill the
+round-7 recovery paths; the ``mesh.*``/``collective.*`` points drill the
+parallel/resilience.py watchdog (tests/test_mesh_resilience.py,
+``python bench.py --mesh-faults``).
 """
 from .injection import (
     DEFAULT_KILL_EXIT,

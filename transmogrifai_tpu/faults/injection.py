@@ -7,9 +7,11 @@ design follows the user-level checkpointing + health-checked restart
 recovery primitive (TensorFlow §4.2) and tf.data's stance that pipelines
 must degrade predictably rather than fail opaquely: every recovery path
 (crash-consistent model IO, the serving circuit breaker, supervision
-backoff, native-lib fallback) carries a NAMED injection point, and
-``tests/test_faults.py`` + ``bench.py --faults`` prove each one end to
-end.
+backoff, native-lib fallback, and the mesh collective watchdog's
+straggler-retry / shrink-to-survivors recovery in parallel/resilience.py)
+carries a NAMED injection point, and ``tests/test_faults.py`` +
+``tests/test_mesh_resilience.py`` + ``bench.py --faults`` /
+``--mesh-faults`` prove each one end to end.
 
 Faults arm via the ``TX_FAULTS`` environment variable (read once at
 import, so child processes drill crash paths with zero code changes) or

@@ -116,14 +116,25 @@ class OpLinearRegression(PredictorEstimator):
         from .packed_newton import (
             linreg_fit_batched_packed,
             packed_mesh_or_none,
+            run_packed_guarded,
             use_packed,
         )
 
         if use_packed(X, W):
-            beta, b0 = linreg_fit_batched_packed(
-                jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
-                jnp.asarray(regs), jnp.asarray(ens),
-                mesh=packed_mesh_or_none(X, W),
+            mesh = packed_mesh_or_none(X, W)
+
+            def _packed_fit(m, Xa, ya, Wa):
+                return linreg_fit_batched_packed(
+                    jnp.asarray(Xa), jnp.asarray(ya), jnp.asarray(Wa),
+                    jnp.asarray(regs), jnp.asarray(ens), mesh=m,
+                )
+
+            beta, b0 = run_packed_guarded(
+                "linreg.packed_gram",
+                lambda: _packed_fit(mesh, X, y, W),
+                lambda: _packed_fit(
+                    None, np.asarray(X), np.asarray(y), np.asarray(W)),
+                mesh,
             )
         else:
             beta, b0 = _linreg_fit_batched(

@@ -128,16 +128,28 @@ class OpLinearSVC(PredictorEstimator):
         from .logistic_regression import _hessian_bf16
         from .packed_newton import (
             packed_mesh_or_none,
+            run_packed_guarded,
             svc_fit_batched_packed,
             use_packed,
         )
 
         iters = int(self.params.get("max_iter", 20))
         if use_packed(X, W):
-            beta, b0 = svc_fit_batched_packed(
-                jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
-                jnp.asarray(regs), iters=iters, hess_bf16=_hessian_bf16(),
-                mesh=packed_mesh_or_none(X, W),
+            mesh = packed_mesh_or_none(X, W)
+
+            def _packed_fit(m, Xa, ya, Wa):
+                return svc_fit_batched_packed(
+                    jnp.asarray(Xa), jnp.asarray(ya), jnp.asarray(Wa),
+                    jnp.asarray(regs), iters=iters,
+                    hess_bf16=_hessian_bf16(), mesh=m,
+                )
+
+            beta, b0 = run_packed_guarded(
+                "svc.packed_gram",
+                lambda: _packed_fit(mesh, X, y, W),
+                lambda: _packed_fit(
+                    None, np.asarray(X), np.asarray(y), np.asarray(W)),
+                mesh,
             )
         else:
             beta, b0 = _svc_fit_batched(

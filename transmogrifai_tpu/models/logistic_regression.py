@@ -432,16 +432,27 @@ class OpLogisticRegression(PredictorEstimator):
         from .packed_newton import (
             lr_fit_batched_packed,
             packed_mesh_or_none,
+            run_packed_guarded,
             use_packed,
         )
 
         iters = int(self.params.get("max_iter", 25))
         if use_packed(X, W):
-            beta, b0 = lr_fit_batched_packed(
-                jnp.asarray(X), jnp.asarray(y), jnp.asarray(W),
-                jnp.asarray(regs), jnp.asarray(ens),
-                iters=iters, hess_bf16=_hessian_bf16(),
-                mesh=packed_mesh_or_none(X, W),
+            mesh = packed_mesh_or_none(X, W)
+
+            def _packed_fit(m, Xa, ya, Wa):
+                return lr_fit_batched_packed(
+                    jnp.asarray(Xa), jnp.asarray(ya), jnp.asarray(Wa),
+                    jnp.asarray(regs), jnp.asarray(ens),
+                    iters=iters, hess_bf16=_hessian_bf16(), mesh=m,
+                )
+
+            beta, b0 = run_packed_guarded(
+                "lr.packed_gram",
+                lambda: _packed_fit(mesh, X, y, W),
+                lambda: _packed_fit(
+                    None, np.asarray(X), np.asarray(y), np.asarray(W)),
+                mesh,
             )
         else:
             beta, b0 = _lr_fit_batched(

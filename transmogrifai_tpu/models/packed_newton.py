@@ -195,6 +195,27 @@ def use_packed(*arrays) -> bool:
     return not multi or packed_mesh_or_none(*arrays) is not None
 
 
+def run_packed_guarded(label: str, fit_fn, host_fit_fn, mesh):
+    """Run a packed MESH fit under the collective watchdog
+    (parallel/resilience): the shard_map Gram's psum is the collective a
+    hung or dead peer wedges, so it rides a deadline derived from
+    observed step times, gets one straggler retry, and shrinks to
+    ``host_fit_fn`` - the same kernel on host-resident copies with
+    mesh=None (the single-host route) - when a peer is gone.  No-mesh /
+    single-device calls bypass the guard entirely: the healthy hot path
+    pays zero threads.  Note the host fallback gathers via np.asarray,
+    which is addressable for single-host meshes; the multi-host recovery
+    seam is the validator's guarded call, which still holds the
+    process-local host inputs."""
+    if mesh is None or len(mesh.devices.flat) <= 1:
+        return fit_fn()
+    from ..parallel import resilience
+
+    return resilience.guarded_collective(
+        label, fit_fn, shrink_fn=host_fit_fn
+    )
+
+
 def _batched_diag(v):
     """[B, d] -> [B, d, d] with v on the diagonals."""
     d = v.shape[-1]

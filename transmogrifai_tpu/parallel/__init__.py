@@ -1,0 +1,42 @@
+"""Device-mesh parallel runtime: mesh construction (mesh.py), the
+multi-host communication backend (distributed.py), pallas kernels
+(pallas_kernels.py), and degraded-mode resilience (resilience.py - the
+collective watchdog, file-based peer health, and shrink-to-survivors
+mesh recovery).
+
+Imports stay lazy on purpose: mesh/distributed pull jax at import time,
+and resilience pulls the fault/supervision stack - callers that only
+need one piece must not pay for the rest (nor trigger backend init).
+"""
+from __future__ import annotations
+
+_RESILIENCE = {
+    "CollectiveStallError",
+    "CollectiveWatchdog",
+    "DeadlinePolicy",
+    "MeshTelemetry",
+    "PeerHealth",
+    "default_watchdog",
+    "guarded_all_reduce_stats",
+    "guarded_collective",
+    "mesh_telemetry",
+    "reset_mesh_telemetry",
+    "survivor_mesh",
+    "watchdog_enabled",
+}
+_DISTRIBUTED = {"MeshBootstrapError", "MeshShapeError"}
+
+
+def __getattr__(name: str):
+    if name in _RESILIENCE:
+        from . import resilience
+
+        return getattr(resilience, name)
+    if name in _DISTRIBUTED:
+        from . import distributed
+
+        return getattr(distributed, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = sorted(_RESILIENCE | _DISTRIBUTED)

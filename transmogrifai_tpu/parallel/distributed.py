@@ -8,7 +8,12 @@ psum/all-gather/reduce-scatter collectives that ride ICI within a slice and
 DCN across slices - there is no first-party NCCL/MPI to port, by design.
 
 * ``initialize``            - jax.distributed.initialize wrapper (idempotent,
-                              env-driven like Spark's executor bootstrap)
+                              env-driven like Spark's executor bootstrap),
+                              now under a bootstrap deadline
+                              (``TX_MESH_INIT_TIMEOUT_S``, default 60s): an
+                              absent/unreachable coordinator raises a named
+                              :class:`MeshBootstrapError` instead of hanging
+                              the pod forever
 * ``global_mesh``           - mesh over every device of every host
 * ``host_local_to_global``  - the reader -> partition hand-off:
                               jax.make_array_from_process_local_data turns
@@ -16,10 +21,19 @@ DCN across slices - there is no first-party NCCL/MPI to port, by design.
                               globally-sharded array (replaces Spark's
                               reader.generateDataFrame partition placement)
 * ``all_reduce_stats``      - driverless treeAggregate: psum over the mesh
+
+Shape problems fail loudly BEFORE any device placement: mismatched or
+mesh-indivisible row axes raise :class:`MeshShapeError` naming the
+offending array and axis, instead of an XLA shape error from inside
+``jax.jit``.  Degraded-mode recovery for the collectives themselves
+(stall deadlines, straggler retry, shrink-to-survivors) lives in
+``parallel/resilience.py``.
 """
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -27,7 +41,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..faults import injection as _faults
+
 _initialized = False
+
+DEFAULT_INIT_TIMEOUT_S = 60.0
 
 # env vars the pod launcher sets for env-driven bootstrap; presence of any
 # means "this is one process of a multi-host job" (jax.distributed
@@ -40,10 +58,25 @@ _BOOTSTRAP_ENV = (
 )
 
 
+class MeshBootstrapError(RuntimeError):
+    """initialize() could not bring up the cross-host runtime within the
+    bootstrap deadline: the coordinator is absent, unreachable, or a peer
+    never registered.  The pod-preemption gap SURVEY §5.3 names - a
+    missing coordinator must page, not hang forever."""
+
+
+class MeshShapeError(ValueError):
+    """An array handed to the mesh helpers cannot shard as asked
+    (mismatched leading axes, or rows indivisible by the mesh axis) -
+    raised up front with the offending array named, instead of an XLA
+    shape error from inside jax.jit."""
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    timeout_s: Optional[float] = None,
 ) -> None:
     """Bring up the cross-host runtime.  No-op on single-process setups
     (local chip, CPU test meshes); with no arguments, defers to the JAX_*
@@ -53,6 +86,14 @@ def initialize(
     jax.distributed.initialize raises once a backend exists, so this guard
     deliberately consults ONLY os.environ and the explicit arguments
     (never jax.process_count(), which would itself initialize the backend).
+
+    The connect runs in a daemon worker joined with ``timeout_s``
+    (default ``TX_MESH_INIT_TIMEOUT_S``, 60s): a coordinator that never
+    answers raises :class:`MeshBootstrapError` naming the address, and
+    ``_initialized`` latches ONLY on success - a failed bootstrap can be
+    retried.  The ``mesh.init_no_coordinator`` fault point
+    (faults/injection.py) drills the absent-coordinator hang without a
+    real network.
     """
     global _initialized
     if _initialized:
@@ -63,20 +104,63 @@ def initialize(
         # single process - nothing to bring up; do NOT latch, so a later
         # call with real coordinator arguments still initializes
         return
-    try:
-        if explicit:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
-        else:
-            jax.distributed.initialize()
-    except RuntimeError as e:
-        # idempotency: absorb "already initialized" (e.g. the launcher
-        # framework brought jax.distributed up before us)
-        if "already" not in str(e).lower():
-            raise
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("TX_MESH_INIT_TIMEOUT_S", DEFAULT_INIT_TIMEOUT_S)
+        )
+    address = coordinator_address or next(
+        (os.environ[k] for k in ("JAX_COORDINATOR_ADDRESS",
+                                 "COORDINATOR_ADDRESS") if k in os.environ),
+        "<env-driven>",
+    )
+    no_coordinator = _faults.fires("mesh.init_no_coordinator")
+    outcome: dict = {}
+
+    def _connect() -> None:
+        try:
+            if no_coordinator is not None:
+                # drill: the coordinator is absent - block like a dead
+                # grpc dial instead of touching the real backend
+                time.sleep(no_coordinator.delay)
+                return
+            if explicit:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            else:
+                jax.distributed.initialize()
+            outcome["ok"] = True
+        except RuntimeError as e:
+            # idempotency: absorb "already initialized" (e.g. the launcher
+            # framework brought jax.distributed up before us)
+            if "already" in str(e).lower():
+                outcome["ok"] = True
+            else:
+                outcome["error"] = e
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            outcome["error"] = e
+
+    worker = threading.Thread(
+        target=_connect, daemon=True, name="tx-mesh-bootstrap"
+    )
+    worker.start()
+    worker.join(timeout_s)
+    if "error" in outcome:
+        raise outcome["error"]  # _initialized stays False: retryable
+    if not outcome.get("ok"):
+        try:  # lazy: resilience imports this module
+            from .resilience import mesh_telemetry
+
+            mesh_telemetry().record_bootstrap_timeout(address, timeout_s)
+        except ImportError:
+            pass
+        raise MeshBootstrapError(
+            f"mesh bootstrap did not reach coordinator {address!r} within "
+            f"{timeout_s:.0f}s (TX_MESH_INIT_TIMEOUT_S): coordinator down, "
+            f"address wrong, or a peer never registered"
+        )
     _initialized = True
 
 
@@ -91,12 +175,54 @@ def global_mesh(axis_names: Sequence[str] = ("data",),
     return Mesh(devs.reshape(tuple(shape)), tuple(axis_names))
 
 
+def _require_axis(op: str, mesh: Mesh, axis: str) -> int:
+    if axis not in mesh.shape:
+        raise MeshShapeError(
+            f"{op}: mesh has no axis {axis!r} "
+            f"(axes: {tuple(mesh.axis_names)})"
+        )
+    return int(mesh.shape[axis])
+
+
+def _leading_rows(op: str, name: str, a, axis: str) -> int:
+    if np.ndim(a) < 1:
+        raise MeshShapeError(
+            f"{op}: {name} is 0-d (shape {np.shape(a)}) - it has no "
+            f"leading axis to shard over mesh axis {axis!r}"
+        )
+    return int(np.shape(a)[0])
+
+
+def _local_axis_shards(mesh: Mesh, axis: str) -> int:
+    """How many distinct coordinates this process's devices occupy along
+    ``axis`` - the per-process shard count a local row block must
+    divide."""
+    pidx = jax.process_index()
+    ax = list(mesh.axis_names).index(axis)
+    coords = set()
+    for idx, dev in np.ndenumerate(mesh.devices):
+        if dev.process_index == pidx:
+            coords.add(idx[ax])
+    return max(1, len(coords))
+
+
 def host_local_to_global(local_rows: np.ndarray, mesh: Mesh,
                          axis: str = "data"):
     """Each process contributes its local row block of the design matrix;
     returns one global array sharded over ``axis`` (reference hand-off:
     reader partitions -> executor memory; here host Arrow/CSV chunks ->
     HBM shards without a gather through any driver)."""
+    _require_axis("host_local_to_global", mesh, axis)
+    n_local = _leading_rows("host_local_to_global", "local_rows",
+                            local_rows, axis)
+    local_shards = _local_axis_shards(mesh, axis)
+    if n_local % local_shards:
+        raise MeshShapeError(
+            f"host_local_to_global: local_rows has {n_local} rows (shape "
+            f"{np.shape(local_rows)}), not divisible by this process's "
+            f"{local_shards} shard(s) of mesh axis {axis!r} - pad rows "
+            f"(parallel.mesh.pad_rows_to_multiple) or resize the mesh"
+        )
     spec = P(axis, *([None] * (np.ndim(local_rows) - 1)))
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
@@ -108,6 +234,26 @@ def all_reduce_stats(fn, mesh: Mesh, *arrays, axis: str = "data"):
     """Run ``fn`` under jit over row-sharded inputs; every reduction in fn
     lowers to mesh collectives (the treeAggregate/allreduce analog, with
     XLA choosing ring/tree schedules over ICI/DCN)."""
+    n_shards = _require_axis("all_reduce_stats", mesh, axis)
+    n0: Optional[int] = None
+    i0 = 0
+    for i, a in enumerate(arrays):
+        n = _leading_rows("all_reduce_stats", f"array {i}", a, axis)
+        if n0 is None:
+            n0, i0 = n, i
+        elif n != n0:
+            raise MeshShapeError(
+                f"all_reduce_stats: array {i} has {n} rows (shape "
+                f"{np.shape(a)}) but array {i0} has {n0} - row-sharded "
+                f"inputs must agree on the leading axis"
+            )
+        if n % n_shards:
+            raise MeshShapeError(
+                f"all_reduce_stats: array {i} leading axis {n} (shape "
+                f"{np.shape(a)}) is not divisible by mesh axis {axis!r} "
+                f"of size {n_shards} - pad rows "
+                f"(parallel.mesh.pad_rows_to_multiple) or resize the mesh"
+            )
     shardings = tuple(
         NamedSharding(mesh, P(axis, *([None] * (np.ndim(a) - 1))))
         for a in arrays

@@ -242,9 +242,16 @@ class OpValidator:
                 # (trainj=1 -> vmask=0), so pads touch no statistic.
                 y_fit = jnp.asarray(y, jnp.float32)
                 mesh = cv_mesh_or_none(k * g)
+                host_fit_args = None
                 if mesh is not None:
                     from jax.sharding import NamedSharding, PartitionSpec as P
 
+                    # host-route copies BEFORE padding/placement: the
+                    # shrink-to-survivors recompute (parallel/resilience)
+                    # reruns the SAME fit from these host-local inputs on
+                    # the single-host route - zero-weight padding touches
+                    # no statistic, so parity holds to f32 tolerance
+                    host_fit_args = (Xj, y_fit, Wj, regs, ens)
                     nd_data = mesh.shape["data"]
                     pad = (-Xj.shape[0]) % nd_data
                     if pad:
@@ -276,7 +283,24 @@ class OpValidator:
                         jnp.asarray(ens, jnp.float32),
                         NamedSharding(mesh, P("replica")),
                     )
-                betas, b0s = est.fit_arrays_batched(Xj, y_fit, Wj, regs, ens)
+                if mesh is not None:
+                    # the fold x grid fit is THE mesh collective of this
+                    # path: run it under the collective watchdog so a hung
+                    # or dead peer degrades (straggler retry, then a
+                    # survivor/single-host recompute) instead of wedging
+                    # the whole selection forever
+                    from ..parallel import resilience as _resilience
+
+                    betas, b0s = _resilience.guarded_collective(
+                        "validator.fit_arrays_batched",
+                        lambda: est.fit_arrays_batched(
+                            Xj, y_fit, Wj, regs, ens),
+                        shrink_fn=lambda: est.fit_arrays_batched(
+                            *(np.asarray(a) for a in host_fit_args)),
+                    )
+                else:
+                    betas, b0s = est.fit_arrays_batched(
+                        Xj, y_fit, Wj, regs, ens)
                 if mode == "approx":
                     # rank-based binary metrics computed ON DEVICE against
                     # the already-resident X: no per-fold slices ever leave

@@ -63,6 +63,55 @@ sys.exit({then_exit})
 """
 
 
+#: child for the mesh-peer drills (tests/test_mesh_resilience.py +
+#: ``bench.py --mesh-faults``): beats its PeerHealth heartbeat ``beats``
+#: times at ``interval`` seconds, then either dies (``mode='die'``, exit
+#: ``exit_code``) or wedges alive-but-beatless (``mode='hang'``) - the
+#: two stall classes a surviving mesh process must tell apart from
+#: heartbeat files alone.  Deliberately jax-free: PeerHealth is
+#: file-based exactly so liveness never rides the (possibly wedged)
+#: collective channel.
+MESH_PEER_CHILD_TEMPLATE = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from transmogrifai_tpu.parallel.resilience import PeerHealth
+ph = PeerHealth({hb_dir!r}, process_id={peer_id})
+for _ in range({beats}):
+    ph.beat()
+    time.sleep({interval})
+if {mode!r} == "die":
+    os._exit({exit_code})
+time.sleep(600)  # hang: alive but no longer beating
+"""
+
+
+#: child for the bootstrap-deadline drills: initialize() against a
+#: coordinator that never answers (armed via TX_FAULTS
+#: ``mesh.init_no_coordinator`` in the child env, or a genuinely
+#: unreachable ``addr``) must raise MeshBootstrapError within
+#: TX_MESH_INIT_TIMEOUT_S - exit 42 proves the named error, any other
+#: loud failure exits 43, and an indefinite hang fails the drill's
+#: subprocess timeout.
+MESH_BOOTSTRAP_CHILD_TEMPLATE = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+sys.path.insert(0, {repo!r})
+from transmogrifai_tpu.parallel.distributed import (
+    MeshBootstrapError, initialize)
+try:
+    initialize(coordinator_address={addr!r}, num_processes=2, process_id=0)
+except MeshBootstrapError as e:
+    print("MESH_BOOTSTRAP_ERROR:", str(e)[:160], flush=True)
+    os._exit(42)  # _exit: a half-dialed grpc runtime must not block exit
+except Exception as e:
+    print("OTHER_ERROR:", type(e).__name__, str(e)[:160], flush=True)
+    os._exit(43)
+print("NO_ERROR", flush=True)
+os._exit(0)
+"""
+
+
 #: child script for the kill-during-save drills: train the tiny pipeline,
 #: save a clean v1, arm ``fault`` (e.g. "io.save_model.crash_window:on=1"),
 #: save again and die at the injected point.  Format with repo / path /

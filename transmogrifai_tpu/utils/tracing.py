@@ -19,6 +19,31 @@ log = logging.getLogger("transmogrifai_tpu.metrics")
 
 LOG_PREFIX = "op_stage_metrics"
 
+# -- mesh resilience surfacing ----------------------------------------------
+# parallel/resilience registers its MeshTelemetry event feed here so
+# collective detection/retry/shrink events ride the same stage-metrics
+# channel (and model.summary_json()) without tracing importing any
+# jax-heavy module - this file must stay importable before jax/numpy init.
+_mesh_events_source = None
+
+
+def register_mesh_events_source(fn) -> None:
+    """``fn(since_epoch=None) -> list[dict]`` of mesh resilience events
+    (detections, straggler retries, shrink-to-survivors recomputes);
+    ``since_epoch`` scopes the feed to one run's window."""
+    global _mesh_events_source
+    _mesh_events_source = fn
+
+
+def mesh_events(since_epoch=None) -> list:
+    if _mesh_events_source is None:
+        return []
+    try:
+        return list(_mesh_events_source(since_epoch))
+    except Exception as e:  # telemetry must never break metrics reporting
+        log.debug("mesh event source failed: %s", e)
+        return []
+
 
 @dataclass
 class StageMetrics:
@@ -90,11 +115,19 @@ class AppMetrics:
         return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "total_wall_s": self.total_wall_s,
             "stages": [m.to_json() for m in self.stages],
             "by_operation": self.by_operation(),
         }
+        # degraded-mode events (collective stalls, straggler retries,
+        # shrink-to-survivors recomputes) belong next to the stage walls
+        # they inflated - scoped to THIS run's window so one model's
+        # summary never reports another run's degradation
+        ev = mesh_events(since_epoch=self.start_time)
+        if ev:
+            out["mesh_resilience_events"] = ev
+        return out
 
 
 def percentiles(

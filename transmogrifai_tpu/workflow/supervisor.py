@@ -45,9 +45,14 @@ def beat(heartbeat_path: str) -> None:
 
 
 def staleness(heartbeat_path: str) -> Optional[float]:
-    """Seconds since the last beat; None when no beat has happened yet."""
+    """Seconds since the last beat, clamped at 0; None when no beat has
+    happened yet.  The clamp matters on clock skew / coarse-mtime
+    filesystems: a beat stamped in the future would otherwise read as
+    NEGATIVE staleness, and negative values poison every downstream
+    ``staleness > threshold`` comparison (a hung child could look
+    freshly-beating for the whole skew window)."""
     try:
-        return time.time() - os.path.getmtime(heartbeat_path)
+        return max(0.0, time.time() - os.path.getmtime(heartbeat_path))
     except OSError:
         return None
 
@@ -136,7 +141,13 @@ def supervise(
                     killed_reason = f"heartbeat stale for {s:.0f}s"
             if killed_reason:
                 proc.kill()
-                proc.wait()
+                try:
+                    # bounded reap (the no-unbounded-blocking-waits gate,
+                    # tests/test_style.py): SIGKILL is not catchable, but
+                    # a D-state child could still wedge an unbounded wait
+                    proc.wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    pass  # killed_reason already records the outcome
                 break
             time.sleep(poll_s)
         if proc.returncode == 0 and killed_reason is None:

@@ -582,6 +582,24 @@ class OpWorkflowModel:
         metrics = getattr(self, "app_metrics", None)
         if metrics is not None:
             out["stageMetrics"] = metrics.to_json()
+        try:
+            from ..parallel.resilience import mesh_telemetry
+
+            # degraded-mode training happened DURING THIS RUN (collective
+            # stalls, straggler retries, shrink-to-survivors): the summary
+            # must say so, not just the logs - scoped to this model's
+            # training window so a healthy model in the same process never
+            # inherits another run's degradation report
+            if metrics is not None:  # loaded models never trained here
+                events = mesh_telemetry().events_json(
+                    since_epoch=metrics.start_time
+                )
+                if events:
+                    out["meshResilience"] = dict(
+                        mesh_telemetry().snapshot(), events=events
+                    )
+        except ImportError:
+            pass  # scoring-only installs may strip the parallel tier
         return out
 
     def summary(self) -> str:
