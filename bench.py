@@ -689,6 +689,9 @@ def serving_bench(n_requests: int = 2000) -> dict:
             "mean_batch_size": snap["mean_batch_size"],
             "batch_fill_histogram": snap["batch_fill_histogram"],
             "shape_misses": endpoint.shape_misses,
+            # schema-contract health for the served traffic: per-feature
+            # JS drift vs the training distributions + violation counts
+            "data_contract": snap["data_contract"],
         }
     return out
 
@@ -1045,6 +1048,209 @@ def mesh_faults_bench() -> dict:
     return out
 
 
+def data_faults_bench() -> dict:
+    """Data-plane robustness drills -> DATA_FAULTS_BENCH.json (ISSUE 4
+    acceptance): quarantine-mode ingest of a corrupted CSV completes
+    with EXACT bad-row counts (and its overhead vs plain ingest is
+    measured on the same code path), strict mode raises a named error
+    citing the first bad row, serve-time schema drift is detected with
+    measured latency, a distribution-shifted batch yields a nonzero JS
+    drift score, and drift_policy='shed' sheds at rate without wedging
+    the endpoint."""
+    import tempfile
+
+    import jax
+
+    from transmogrifai_tpu.faults import injection
+    from transmogrifai_tpu.readers.csv_reader import CSVReader
+    from transmogrifai_tpu.readers.fast_csv import (
+        fast_path_available,
+        read_csv_columnar,
+    )
+    from transmogrifai_tpu.schema import (
+        MalformedRowError,
+        reset_data_telemetry,
+    )
+    from transmogrifai_tpu.serving import (
+        RowScoringError,
+        SchemaDriftError,
+        compile_endpoint,
+    )
+    from transmogrifai_tpu.testkit.drills import (
+        corrupted_csv_drill,
+        tiny_drill_pipeline,
+    )
+    from transmogrifai_tpu.testkit.random_data import shift_records
+    from transmogrifai_tpu.types import feature_types as ft
+
+    out: dict = {"platform": jax.default_backend()}
+    reset_data_telemetry()
+
+    # -- drill 1: quarantine ingest of a corrupted file, exact counts +
+    # overhead vs the legacy coerce path (python reader, same code path)
+    with tempfile.TemporaryDirectory() as td:
+        n_rows = int(os.environ.get("TX_DATA_FAULTS_ROWS", "200000"))
+        path, feats, truth = corrupted_csv_drill(
+            td, n_rows=n_rows, n_type_flips=40, n_truncated=24)
+        # SAME code path for the overhead pair: use_native=False pins
+        # coerce onto the python reader the checked modes always run
+        # (the native-vs-native pair is measured separately below)
+        t0 = time.perf_counter()
+        CSVReader(path, use_native=False).generate_dataset(feats)
+        t_coerce = max(time.perf_counter() - t0, 1e-9)
+        reader = CSVReader(path, errors="quarantine")
+        t0 = time.perf_counter()
+        ds = reader.generate_dataset(feats)
+        t_quar = max(time.perf_counter() - t0, 1e-9)
+        counts_exact = (
+            len(ds) == truth["good_rows"]
+            and reader.quarantine.total == len(truth["bad_rows"])
+            and reader.quarantine.by_reason.get("type_flip", 0)
+            == len(truth["type_flip_rows"])
+            and reader.quarantine.by_reason.get("truncated_row", 0)
+            == len(truth["truncated_rows"])
+        )
+        t0 = time.perf_counter()
+        strict_error = None
+        try:
+            CSVReader(path, errors="strict").generate_dataset(feats)
+        except MalformedRowError as e:
+            strict_error = {
+                "row_index": e.row_index, "reason": e.reason,
+                "column": e.column,
+                "cites_first_bad_row": e.row_index == truth["bad_rows"][0],
+            }
+        t_strict = time.perf_counter() - t0
+        out["quarantine_ingest"] = {
+            "rows": truth["n_rows"],
+            "bad_rows": len(truth["bad_rows"]),
+            "rows_kept": len(ds),
+            "quarantined": reader.quarantine.total,
+            "by_reason": dict(reader.quarantine.by_reason),
+            "counts_exact": counts_exact,
+            "coerce_python_wall_s": round(t_coerce, 3),
+            "quarantine_wall_s": round(t_quar, 3),
+            "overhead_pct": round(100.0 * (t_quar / t_coerce - 1.0), 1),
+            "quarantine_rows_per_s": round(truth["n_rows"] / t_quar, 1),
+            "strict_first_error": strict_error,
+            "strict_detect_ms": round(t_strict * 1e3, 2),
+        }
+        # the native scanner's own quarantine path (type flips only:
+        # ragged-row detection is the python reader's job), overhead
+        # measured against the SAME native path in coerce mode
+        if fast_path_available():
+            schema = {"y": ft.Real, "a": ft.Real}
+            t0 = time.perf_counter()
+            read_csv_columnar(path, schema)
+            t_fast = max(time.perf_counter() - t0, 1e-9)
+            t0 = time.perf_counter()
+            cols = read_csv_columnar(path, schema, errors="quarantine")
+            t_fastq = max(time.perf_counter() - t0, 1e-9)
+            out["quarantine_ingest_native"] = {
+                "coerce_wall_s": round(t_fast, 3),
+                "quarantine_wall_s": round(t_fastq, 3),
+                "overhead_pct": round(100.0 * (t_fastq / t_fast - 1.0), 1),
+                "rows_kept": len(cols["a"].values),
+                # the native path owns type-flip detection; truncated
+                # rows surface as missing-value cells there (ragged-row
+                # detection is the python reader's job)
+                "type_flips_quarantined":
+                    truth["n_rows"] - len(cols["a"].values),
+                "type_flips_expected": len(truth["type_flip_rows"]),
+            }
+
+    # -- drill 2: serve-time drift detection latency + shed throughput
+    wf, _data, records, _name = tiny_drill_pipeline(n=160)
+    model = wf.train()
+    ep = compile_endpoint(model, batch_buckets=(1, 32),
+                          drift_policy="raise")
+    renamed = [{"a_renamed": r["a"], "c": r["c"]} for r in records[:32]]
+    t0 = time.perf_counter()
+    drift_raise = None
+    try:
+        ep.score_batch(renamed)
+    except SchemaDriftError as e:
+        drift_raise = str(e)[:160]
+    t_detect = time.perf_counter() - t0
+    # schema-valid but distribution-shifted traffic: nonzero JS score
+    ep.score_batch(records[:96])
+    ep.score_batch(shift_records(records[:96], "a", delta=25.0))
+    drift_js = ep.telemetry.snapshot()["data_contract"]["drift_js"]
+    # shed throughput: a drifting client must not wedge the endpoint
+    ep_shed = compile_endpoint(model, batch_buckets=(1, 32),
+                               drift_policy="shed")
+    n_shed = 0
+    t0 = time.perf_counter()
+    for _ in range(40):
+        res = ep_shed.score_batch(renamed)
+        n_shed += sum(
+            1 for r in res
+            if isinstance(r, RowScoringError) and r.shed
+        )
+    t_shed = max(time.perf_counter() - t0, 1e-9)
+    healthy_after = not any(
+        isinstance(r, RowScoringError)
+        for r in ep_shed.score_batch(records[:32])
+    )
+    out["serve_drift"] = {
+        "schema_drift_detect_ms": round(t_detect * 1e3, 2),
+        "raised": drift_raise,
+        "drift_js_after_shift": drift_js.get("a"),
+        "shed_rows": n_shed,
+        "shed_rows_per_s": round(n_shed / t_shed, 1),
+        "endpoint_healthy_after_shed": healthy_after,
+    }
+
+    # -- drill 3: the injected fault points, end to end through the
+    # quarantine machinery (reader.* corrupt LIVE rows; the serving
+    # point follows the endpoint's drift policy)
+    with tempfile.TemporaryDirectory() as td:
+        path, feats, _truth = corrupted_csv_drill(
+            td, n_rows=2000, n_type_flips=0, n_truncated=0)
+        injection.configure(
+            "reader.malformed_row:on=3 reader.type_flip:on=7")
+        try:
+            reader = CSVReader(path, errors="quarantine")
+            ds = reader.generate_dataset(feats)
+        finally:
+            injection.reset()
+        injection.configure("serving.schema_drift:on=1")
+        try:
+            shed = ep_shed.score_batch(records[:8])
+        finally:
+            injection.reset()
+        out["fault_points"] = {
+            "reader_injected_quarantined": reader.quarantine.total,
+            "reader_rows_kept": len(ds),
+            "serving_schema_drift_shed": all(
+                isinstance(r, RowScoringError) and r.shed for r in shed
+            ),
+        }
+    return out
+
+
+def _data_faults_section(result: dict) -> None:
+    """Run the data-plane drills: artifact side-written to
+    DATA_FAULTS_BENCH.json, headline numbers folded into the main
+    result."""
+    bench = data_faults_bench()
+    path = os.environ.get(
+        "TX_DATA_FAULTS_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "DATA_FAULTS_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["data_faults_counts_exact"] = bench["quarantine_ingest"][
+        "counts_exact"]
+    result["data_faults_drift_detect_ms"] = bench["serve_drift"][
+        "schema_drift_detect_ms"]
+    result["data_faults_shed_rows_per_s"] = bench["serve_drift"][
+        "shed_rows_per_s"]
+
+
 def _mesh_faults_section(result: dict) -> None:
     """Run the mesh degradation drills: artifact side-written to
     MESH_FAULTS_BENCH.json, headline numbers folded into the main
@@ -1266,6 +1472,11 @@ def main() -> None:
         result["mesh_faults_error"] = f"{type(e).__name__}: {e}"
     _checkpoint(result)
     try:
+        _data_faults_section(result)
+    except Exception as e:
+        result["data_faults_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
+    try:
         _ingest_section(result)
     except Exception as e:
         result["ingest_error"] = f"{type(e).__name__}: {e}"
@@ -1300,6 +1511,24 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _mesh_faults_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--data-faults" in sys.argv:
+        # fast standalone data-plane drills: writes DATA_FAULTS_BENCH.json
+        # and prints it, without the multi-minute full-bench sections
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _data_faults_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--faults" in sys.argv:

@@ -1,0 +1,189 @@
+"""Chaos-composition drill (ISSUE 4 satellite): ONE seeded, randomized
+schedule arming faults from four different subsystems — ``reader.*``
+(data plane), ``serving.batch`` (serving), ``io.save_model.crash``
+(serialization), ``supervisor.child_kill`` (supervision) — across a
+single end-to-end workflow run (corrupted-CSV quarantine ingest → train
+→ save/load → serve → supervise), asserting the GLOBAL invariants:
+
+* no corrupt artifact is ever loadable (checksums verify at each step);
+* no phase hangs past its deadline;
+* every injected event is accounted for in telemetry — quarantine
+  counts, fallback rows, breaker transitions, supervisor restarts.
+
+The schedule is randomized per TX_CHAOS_SEED but deterministic for a
+given seed, so a failing composition replays exactly.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.models.logistic_regression import (
+    OpLogisticRegression,
+)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.csv_reader import CSVReader
+from transmogrifai_tpu.schema import reset_data_telemetry
+from transmogrifai_tpu.serialization.model_io import (
+    load_model,
+    verify_artifact,
+)
+from transmogrifai_tpu.serving import (
+    CircuitBreaker,
+    RowScoringError,
+    ServingTelemetry,
+    compile_endpoint,
+)
+from transmogrifai_tpu.testkit.drills import (
+    CRASH_SAVER_TEMPLATE,
+    drill_env,
+    tiny_drill_pipeline,
+)
+from transmogrifai_tpu.testkit.random_data import write_corrupted_csv
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow.supervisor import supervise
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: per-phase wall-clock ceilings (generous: these catch HANGS, not
+#: slowness — a wedged collective/reader/endpoint blows way past them)
+INGEST_TRAIN_DEADLINE_S = 120.0
+CRASH_SAVE_DEADLINE_S = 300.0
+SERVE_DEADLINE_S = 60.0
+SUPERVISE_DEADLINE_S = 60.0
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.reset()
+    reset_data_telemetry()
+    yield
+    faults.reset()
+
+
+def _reader_workflow(path, reader_errors="quarantine", quarantine=None):
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a, c])
+    pred = OpLogisticRegression(reg_param=0.01).set_input(y, vec).get_output()
+    reader = CSVReader(path, errors=reader_errors, quarantine=quarantine)
+    wf = OpWorkflow().set_result_features(pred).set_reader(reader)
+    return wf, reader, pred.name
+
+
+def test_chaos_composition_end_to_end(tmp_path):
+    seed = int(os.environ.get("TX_CHAOS_SEED", "1234"))
+    rng = np.random.RandomState(seed)
+    # ---- the seeded, randomized schedule -------------------------------
+    n_rows = 400
+    n_flips = int(rng.randint(3, 9))
+    n_trunc = int(rng.randint(2, 6))
+    malformed_on = int(rng.randint(1, 50))      # rows 0..48
+    flip_on = int(rng.randint(50, 100))         # rows 49..98, disjoint
+    serving_failures = int(rng.randint(2, 5))
+    events = {"armed_points": [
+        "reader.malformed_row", "reader.type_flip", "serving.batch",
+        "io.save_model.crash", "supervisor.child_kill",
+    ]}
+
+    # ---- phase 1: quarantine ingest (real corruption + injected) → train
+    csv_path = str(tmp_path / "chaos.csv")
+    truth = write_corrupted_csv(csv_path, n_rows=n_rows,
+                                n_type_flips=n_flips,
+                                n_truncated=n_trunc, seed=seed)
+    wf, reader, pred_name = _reader_workflow(csv_path)
+    faults.configure(
+        f"reader.malformed_row:on={malformed_on} "
+        f"reader.type_flip:on={flip_on}"
+    )
+    t0 = time.monotonic()
+    model = wf.train()
+    t_train = time.monotonic() - t0
+    faults.reset()
+    assert t_train < INGEST_TRAIN_DEADLINE_S, "ingest+train hang"
+    injected_rows = {malformed_on - 1, flip_on - 1}
+    expected_quarantined = len(set(truth["bad_rows"]) | injected_rows)
+    # invariant: every injected + real bad row accounted, exactly once
+    assert reader.quarantine.total == expected_quarantined
+    events["quarantined"] = reader.quarantine.total
+    assert model.schema_contract is not None
+    # the contract saw only the CLEANED rows
+    assert model.schema_contract.n_rows == n_rows - expected_quarantined
+
+    # clean save of the chaos-trained model: artifact verifies
+    model_path = str(tmp_path / "chaos_model")
+    model.save(model_path)
+    assert verify_artifact(model_path) is None
+
+    # ---- phase 2: crash mid-save in a child → artifact invariant -------
+    crash_path = str(tmp_path / "crash_model")
+    script = tmp_path / "saver.py"
+    script.write_text(CRASH_SAVER_TEMPLATE.format(
+        repo=REPO, path=crash_path, fault="io.save_model.crash:on=1"))
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, str(script)], env=drill_env(),
+                          timeout=CRASH_SAVE_DEADLINE_S)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really crashed
+    events["crash_save_exit"] = proc.returncode
+    # invariant: the pre-crash artifact is intact and loadable, with its
+    # schema contract
+    assert verify_artifact(crash_path) is None
+    wf2, _data, records, _name = tiny_drill_pipeline()
+    recovered = load_model(crash_path, wf2)
+    assert recovered.schema_contract is not None
+
+    # ---- phase 3: serving under injected batch failures ----------------
+    telemetry = ServingTelemetry()
+    breaker = CircuitBreaker(failure_threshold=serving_failures,
+                             cooldown_s=60.0)
+    endpoint = compile_endpoint(recovered, batch_buckets=(4,),
+                                telemetry=telemetry, breaker=breaker)
+    faults.configure(
+        f"serving.batch:every=1:times={serving_failures}")
+    t0 = time.monotonic()
+    for _ in range(serving_failures):
+        out = endpoint.score_batch(records[:2])
+        # degraded, not dead: rows still score through the fallback
+        assert not any(isinstance(r, RowScoringError) for r in out)
+    assert breaker.state == "open"
+    shed = endpoint.score_batch(records[:3])
+    assert all(isinstance(r, RowScoringError) and r.shed for r in shed)
+    t_serve = time.monotonic() - t0
+    faults.reset()
+    assert t_serve < SERVE_DEADLINE_S, "serving hang"
+    snap = telemetry.snapshot()
+    # invariant: every injected batch failure accounted in telemetry
+    assert snap["rows_fallback"] == 2 * serving_failures
+    assert snap["breaker"]["opens"] == 1
+    assert snap["breaker"]["rows_shed"] == 3
+    events["serving_failures"] = serving_failures
+
+    # ---- phase 4: supervised child killed by injection -----------------
+    faults.configure("supervisor.child_kill:on=1")
+    t0 = time.monotonic()
+    res = supervise(
+        [sys.executable, "-c", "import time; time.sleep(0.4)"],
+        heartbeat_path=str(tmp_path / "hb"),
+        stale_after_s=60.0, grace_s=60.0, max_restarts=1, poll_s=0.05,
+        env=drill_env(), backoff_base_s=0.05, backoff_jitter=0.0,
+    )
+    t_sup = time.monotonic() - t0
+    faults.reset()
+    assert t_sup < SUPERVISE_DEADLINE_S, "supervision hang"
+    # invariant: the injected kill is accounted in the restart log
+    assert res.returncode == 0 and res.attempts == 2
+    assert "injected child kill" in res.restarts[0][1]
+    events["supervisor_restarts"] = len(res.restarts)
+
+    # ---- global: nothing leaked, everything accounted ------------------
+    assert not faults.active()
+    assert events["quarantined"] == expected_quarantined
+    assert verify_artifact(model_path) is None
+    assert verify_artifact(crash_path) is None

@@ -1,0 +1,620 @@
+"""Data-plane robustness drills (ISSUE 4 tentpole).
+
+Quarantine-mode ingestion across the readers (csv python path, fast_csv
+native path, avro, parquet/arrow), strict-mode named errors citing row
+indices, the schema contract's capture / artifact round-trip / serve-
+time enforcement (SchemaDriftError + drift_policy raise|warn|shed),
+distribution-drift scoring, the local-scorer/endpoint empty-batch
+parity pin, and the ``reader.*`` / ``serving.schema_drift`` fault
+points.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.readers.avro_reader import (
+    AvroReader,
+    read_avro_records,
+    write_avro_records,
+)
+from transmogrifai_tpu.readers.csv_reader import CSVReader
+from transmogrifai_tpu.readers.fast_csv import (
+    fast_path_available,
+    read_csv_columnar,
+)
+from transmogrifai_tpu.schema import (
+    DataTelemetry,
+    MalformedRowError,
+    QuarantineBuffer,
+    SchemaContract,
+    SchemaDriftError,
+    reset_data_telemetry,
+)
+from transmogrifai_tpu.serialization.model_io import (
+    LAST_GOOD_SUFFIX,
+    SCHEMA_JSON,
+    load_model,
+    save_model,
+    verify_artifact,
+)
+from transmogrifai_tpu.serving import (
+    RowScoringError,
+    ServingTelemetry,
+    compile_endpoint,
+)
+from transmogrifai_tpu.testkit.drills import (
+    corrupted_csv_drill,
+    tiny_drill_pipeline,
+)
+from transmogrifai_tpu.testkit.random_data import (
+    shift_records,
+    write_corrupted_csv,
+)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    reset_data_telemetry()
+    yield
+    faults.reset()
+
+
+def _features():
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    return [y, a, c]
+
+
+# -- CSV quarantine / strict / coerce (tier-1 regression: exact counts) -----
+
+def test_csv_quarantine_counts_are_exact_and_deterministic(tmp_path):
+    """Acceptance: quarantine-mode ingest of a corrupted file completes
+    without raising and yields exact good/quarantined row counts, twice
+    over (deterministic)."""
+    path, feats, truth = corrupted_csv_drill(str(tmp_path))
+    for _ in range(2):
+        reader = CSVReader(path, errors="quarantine")
+        ds = reader.generate_dataset(feats)
+        assert len(ds) == truth["good_rows"]
+        assert reader.quarantine.total == len(truth["bad_rows"])
+        assert sorted(q.row_index for q in reader.quarantine.rows) \
+            == truth["bad_rows"]
+        by_reason = reader.quarantine.by_reason
+        assert by_reason["type_flip"] == len(truth["type_flip_rows"])
+        assert by_reason["truncated_row"] == len(truth["truncated_rows"])
+        # every quarantined row names its reason + a payload excerpt
+        for q in reader.quarantine.rows:
+            assert q.reason in ("type_flip", "truncated_row")
+            assert q.excerpt
+
+
+def test_csv_strict_raises_naming_first_bad_row(tmp_path):
+    path, feats, truth = corrupted_csv_drill(str(tmp_path))
+    with pytest.raises(MalformedRowError) as exc:
+        CSVReader(path, errors="strict").generate_dataset(feats)
+    e = exc.value
+    assert e.row_index == truth["bad_rows"][0]
+    assert str(e.row_index) in str(e)
+    assert e.reason in ("type_flip", "truncated_row")
+
+
+def test_csv_coerce_mode_is_legacy_unchanged(tmp_path):
+    """The default mode must keep every row and silently null junk -
+    bit-compatible with the pre-quarantine reader."""
+    path, feats, truth = corrupted_csv_drill(str(tmp_path))
+    ds = CSVReader(path).generate_dataset(feats)
+    assert len(ds) == truth["n_rows"]
+    a = ds["a"].to_list()
+    for i in truth["type_flip_rows"]:
+        assert a[i] is None
+
+
+def test_csv_quarantine_telemetry_counts_and_export(tmp_path):
+    path, feats, truth = corrupted_csv_drill(str(tmp_path))
+    tel = DataTelemetry()
+    reader = CSVReader(path, errors="quarantine", telemetry=tel)
+    reader.generate_dataset(feats)
+    snap = tel.snapshot()
+    assert snap["rows_read"] == truth["n_rows"]
+    assert snap["rows_quarantined"] == len(truth["bad_rows"])
+    assert snap["quarantined_by_reason"]["type_flip"] \
+        == len(truth["type_flip_rows"])
+    out = tel.export(str(tmp_path / "data_metrics.json"))
+    assert out["rows_kept"] == truth["good_rows"]
+    with open(tmp_path / "data_metrics.json") as f:
+        assert json.load(f)["rows_read"] == truth["n_rows"]
+
+
+def test_quarantine_buffer_is_bounded_but_counts_stay_exact(tmp_path):
+    path = str(tmp_path / "many.csv")
+    truth = write_corrupted_csv(path, n_rows=300, n_type_flips=120,
+                                n_truncated=0, seed=3)
+    buf = QuarantineBuffer(max_rows=16, source=path)
+    reader = CSVReader(path, errors="quarantine", quarantine=buf)
+    ds = reader.generate_dataset(_features())
+    assert buf.total == 120          # exact count past the cap
+    assert len(buf.rows) == 16       # bounded detail
+    assert buf.truncated == 104
+    assert len(ds) == truth["good_rows"]
+
+
+@pytest.mark.skipif(not fast_path_available(),
+                    reason="native CSV kernels unavailable")
+def test_fast_csv_quarantine_and_strict(tmp_path):
+    """The native scanner's own checked path: type flips quarantined at
+    chunk speed with global row indices; strict raises named."""
+    path = str(tmp_path / "n.csv")
+    truth = write_corrupted_csv(path, n_rows=400, n_type_flips=6,
+                                n_truncated=0, seed=11)
+    schema = {"y": ft.Real, "a": ft.Real}
+    buf = QuarantineBuffer(source=path)
+    cols = read_csv_columnar(path, schema, errors="quarantine",
+                             quarantine=buf)
+    assert len(cols["a"].values) == truth["good_rows"]
+    assert buf.total == len(truth["type_flip_rows"])
+    assert sorted(q.row_index for q in buf.rows) == truth["type_flip_rows"]
+    assert all(q.reason == "type_flip" and q.column == "a"
+               for q in buf.rows)
+    with pytest.raises(MalformedRowError) as exc:
+        read_csv_columnar(path, schema, errors="strict")
+    assert exc.value.row_index == truth["type_flip_rows"][0]
+    # coerce unchanged: junk -> masked missing, all rows present
+    legacy = read_csv_columnar(path, schema)
+    assert len(legacy["a"].values) == truth["n_rows"]
+    assert not legacy["a"].mask[truth["type_flip_rows"]].any()
+
+
+@pytest.mark.skipif(not fast_path_available(),
+                    reason="native CSV kernels unavailable")
+def test_device_csv_ingest_quarantine(tmp_path):
+    from transmogrifai_tpu.readers.fast_csv import DeviceCSVIngest
+
+    path = str(tmp_path / "d.csv")
+    truth = write_corrupted_csv(path, n_rows=200, n_type_flips=4,
+                                n_truncated=0, seed=5)
+    schema = {"y": ft.Real, "a": ft.Real}
+    ing = DeviceCSVIngest(path, ["y", "a"], schema, errors="quarantine")
+    X, mask, rows = ing.to_device()
+    assert rows == truth["good_rows"]
+    assert X.shape == (truth["good_rows"], 2)
+    assert ing.quarantine.total == len(truth["type_flip_rows"])
+    tel = DataTelemetry()
+    with pytest.raises(MalformedRowError):
+        DeviceCSVIngest(path, ["y", "a"], schema, errors="strict",
+                        telemetry=tel).to_device()
+    # strict failures count in the CALLER's accumulator, like every
+    # other strict reader path
+    assert tel.snapshot()["strict_errors"] == 1
+
+
+# -- avro quarantine ---------------------------------------------------------
+
+def _avro_file(tmp_path, records):
+    schema = {
+        "type": "record", "name": "Row",
+        "fields": [
+            {"name": "y", "type": ["null", "double"]},
+            {"name": "a", "type": ["null", "string"]},
+            {"name": "c", "type": ["null", "string"]},
+        ],
+    }
+    path = str(tmp_path / "r.avro")
+    write_avro_records(path, schema, records, codec="null")
+    return path
+
+
+def test_avro_quarantine_isolates_type_flips(tmp_path):
+    recs = [{"y": float(i % 2), "a": str(i * 0.5), "c": "u"}
+            for i in range(10)]
+    recs[3]["a"] = "garbage!"
+    recs[7]["a"] = "also-bad"
+    path = _avro_file(tmp_path, recs)
+    reader = AvroReader(path, errors="quarantine")
+    ds = reader.generate_dataset(_features())
+    assert len(ds) == 8
+    assert reader.quarantine.total == 2
+    assert sorted(q.row_index for q in reader.quarantine.rows) == [3, 7]
+    assert all(q.reason == "type_flip" and q.column == "a"
+               for q in reader.quarantine.rows)
+    # strict names the first offender
+    with pytest.raises(MalformedRowError) as exc:
+        AvroReader(path, errors="strict").generate_dataset(_features())
+    assert exc.value.row_index == 3
+    # coerce keeps all rows, junk nulled (legacy)
+    ds0 = AvroReader(path).generate_dataset(_features())
+    assert len(ds0) == 10
+    assert ds0["a"].to_list()[3] is None
+
+
+def test_avro_truncated_file_quarantines_tail_strict_raises(tmp_path):
+    recs = [{"y": 1.0, "a": "1.5", "c": "u"} for _ in range(50)]
+    schema = {
+        "type": "record", "name": "Row",
+        "fields": [
+            {"name": "y", "type": ["null", "double"]},
+            {"name": "a", "type": ["null", "string"]},
+            {"name": "c", "type": ["null", "string"]},
+        ],
+    }
+    path = str(tmp_path / "blocks.avro")
+    # small blocks so a chopped tail still leaves clean whole blocks
+    write_avro_records(path, schema, recs, codec="null", block_records=16)
+    with open(path, "rb") as f:
+        data = f.read()
+    cut = str(tmp_path / "cut.avro")
+    with open(cut, "wb") as f:
+        f.write(data[: len(data) - 40])  # chop mid final block
+    # coerce (legacy): raw truncation error
+    with pytest.raises((EOFError, IndexError, ValueError)):
+        read_avro_records(cut)
+    # strict: named error
+    with pytest.raises(MalformedRowError):
+        read_avro_records(cut, errors="strict")
+    # quarantine: clean prefix + recorded damage
+    buf = QuarantineBuffer(source=cut)
+    _schema, recs2 = read_avro_records(cut, errors="quarantine",
+                                       quarantine=buf)
+    assert 0 < len(recs2) < 50
+    assert buf.total == 1
+    assert buf.rows[0].reason in ("truncated_block", "corrupt_block")
+    # telemetry stays internally consistent through the reader: the
+    # lost block counts as read-and-quarantined, and repeated
+    # generate_dataset calls must NOT double any count (memoized)
+    tel = DataTelemetry()
+    reader = AvroReader(cut, errors="quarantine", telemetry=tel)
+    ds1 = reader.generate_dataset(_features())
+    ds2 = reader.generate_dataset(_features())
+    assert len(ds1) == len(ds2) == len(recs2)
+    assert reader.quarantine.total == 1
+    snap = tel.snapshot()
+    assert snap["rows_read"] - snap["rows_kept"] == snap["rows_quarantined"]
+    assert snap["rows_quarantined"] \
+        == sum(snap["quarantined_by_reason"].values())
+    assert snap["reads"] == 1  # second call served from the memo
+
+
+def test_avro_midfile_corrupt_block_resyncs_to_later_blocks(tmp_path):
+    """A bit flip in a MIDDLE block must cost only that block: the
+    reader resyncs on the sync marker and keeps every later record —
+    not (the pre-review behavior) silently discarding 70% of the file
+    while reporting one quarantined row."""
+    recs = [{"y": float(i % 2), "a": str(i * 0.5), "c": "u"}
+            for i in range(80)]
+    schema = {
+        "type": "record", "name": "Row",
+        "fields": [
+            {"name": "y", "type": ["null", "double"]},
+            {"name": "a", "type": ["null", "string"]},
+            {"name": "c", "type": ["null", "string"]},
+        ],
+    }
+    path = str(tmp_path / "mid.avro")
+    write_avro_records(path, schema, recs, codec="deflate",
+                       block_records=16)  # 5 blocks
+    clean_schema, clean = read_avro_records(path)
+    assert len(clean) == 80
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # flip a byte in the middle of the file's payload region
+    data[len(data) // 2] ^= 0xFF
+    bad_path = str(tmp_path / "mid_bad.avro")
+    with open(bad_path, "wb") as f:
+        f.write(bytes(data))
+    buf = QuarantineBuffer(source=bad_path)
+    _s, recovered = read_avro_records(bad_path, errors="quarantine",
+                                      quarantine=buf)
+    # most of the file survives: only the damaged block's 16 records
+    # (plus possibly its neighbor at the resync point) are lost
+    assert len(recovered) >= 80 - 32, len(recovered)
+    assert buf.total >= 1
+    assert all(q.reason in ("corrupt_block", "truncated_block")
+               for q in buf.rows)
+    # ROLLBACK guarantee: no garbage record decoded off misaligned
+    # bytes survives — every recovered record is a well-formed row
+    for r in recovered:
+        assert set(r) == {"y", "a", "c"}
+        assert r["a"] is None or float(r["a"]) >= 0.0
+
+
+def test_avro_unsupported_codec_is_loud_in_every_mode(tmp_path):
+    """An unsupported codec is a configuration error, not block damage:
+    quarantine mode must refuse loudly, never resync a valid file into
+    zero records."""
+    schema = {"type": "record", "name": "R",
+              "fields": [{"name": "y", "type": ["null", "double"]}]}
+    from transmogrifai_tpu.readers.avro_reader import MAGIC, _Encoder
+
+    head = _Encoder()
+    head.write(MAGIC)
+    head.write_long(2)
+    head.write_string("avro.schema")
+    head.write_bytes(json.dumps(schema).encode())
+    head.write_string("avro.codec")
+    head.write_bytes(b"snappy")
+    head.write_long(0)
+    head.write(b"S" * 16)
+    path = str(tmp_path / "snappy.avro")
+    with open(path, "wb") as f:
+        f.write(head.getvalue() + b"\x02\x02\x00" + b"S" * 16)
+    for mode in ("coerce", "strict", "quarantine"):
+        with pytest.raises(ValueError, match="unsupported avro codec"):
+            read_avro_records(path, errors=mode,
+                              quarantine=QuarantineBuffer(source=path))
+
+
+def test_parquet_quarantine_string_typed_numeric(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    from transmogrifai_tpu.readers.avro_reader import ParquetReader
+
+    path = str(tmp_path / "p.parquet")
+    tbl = pa.table({
+        "y": [1.0, 0.0, 1.0, 0.0],
+        "a": ["1.5", "junk", "2.5", None],   # string-typed numeric
+        "c": ["u", "v", "w", "u"],
+    })
+    pq.write_table(tbl, path)
+    reader = ParquetReader(path, errors="quarantine")
+    ds = reader.generate_dataset(_features())
+    assert len(ds) == 3
+    assert reader.quarantine.total == 1
+    assert reader.quarantine.rows[0].row_index == 1
+    assert reader.quarantine.rows[0].column == "a"
+    with pytest.raises(MalformedRowError) as exc:
+        ParquetReader(path, errors="strict").generate_dataset(_features())
+    assert exc.value.row_index == 1
+
+
+def test_arrow_device_ingest_quarantine(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    from transmogrifai_tpu.readers.arrow_ingest import DeviceParquetIngest
+
+    path = str(tmp_path / "d.parquet")
+    tbl = pa.table({
+        "x0": [1.0, 2.0, 3.0, 4.0],
+        "x1": ["0.5", "nope", "1.5", "2.5"],
+    })
+    pq.write_table(tbl, path)
+    ing = DeviceParquetIngest(path, ["x0", "x1"], errors="quarantine")
+    X, mask, rows = ing.to_device()
+    assert rows == 3
+    assert ing.quarantine.total == 1
+    assert ing.quarantine.rows[0].column == "x1"
+    with pytest.raises(MalformedRowError):
+        DeviceParquetIngest(path, ["x0", "x1"],
+                            errors="strict").to_device()
+
+
+def test_bad_errors_mode_is_loud():
+    with pytest.raises(ValueError, match="errors must be one of"):
+        CSVReader("nope.csv", errors="ignore")
+
+
+# -- reader fault points -----------------------------------------------------
+
+def test_reader_fault_points_drill_quarantine_path(tmp_path):
+    path = str(tmp_path / "clean.csv")
+    write_corrupted_csv(path, n_rows=50, n_type_flips=0, n_truncated=0,
+                        seed=1)
+    faults.configure("reader.malformed_row:on=5 reader.type_flip:on=9")
+    reader = CSVReader(path, errors="quarantine")
+    ds = reader.generate_dataset(_features())
+    assert reader.quarantine.total == 2
+    assert len(ds) == 48
+    reasons = {q.reason for q in reader.quarantine.rows}
+    assert "truncated_row" in reasons  # malformed_row chops a field
+    assert "type_flip" in reasons
+    faults.reset()
+    # strict mode: injected corruption raises named
+    faults.configure("reader.type_flip:on=1")
+    with pytest.raises(MalformedRowError):
+        CSVReader(path, errors="strict").generate_dataset(_features())
+
+
+# -- schema contract: capture + artifact round-trip --------------------------
+
+@pytest.fixture(scope="module")
+def trained():
+    wf, data, records, pred_name = tiny_drill_pipeline(n=160)
+    model = wf.train()
+    return model, data, records, pred_name
+
+
+def test_contract_captured_at_fit(trained):
+    model, _data, _records, _ = trained
+    c = model.schema_contract
+    assert c is not None
+    assert set(c.names) == {"y", "a", "c"}
+    spec = c.feature("a")
+    assert spec.kind == "numeric" and not spec.is_response
+    assert c.feature("y").is_response
+    # distributions captured with pinned numeric ranges
+    assert c.distributions["a"].value_range is not None
+    assert c.distributions["a"].count == 160
+
+
+def test_contract_roundtrips_in_manifest(tmp_path, trained):
+    model, _data, _records, _ = trained
+    path = str(tmp_path / "m")
+    save_model(model, path)
+    # schema.json exists AND is checksummed by the manifest
+    assert os.path.exists(os.path.join(path, SCHEMA_JSON))
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert SCHEMA_JSON in manifest["files"]
+    assert verify_artifact(path) is None
+    wf2, _, _, _ = tiny_drill_pipeline(n=160)
+    m2 = load_model(path, wf2)
+    c2 = m2.schema_contract
+    assert c2 is not None
+    assert set(c2.names) == {"y", "a", "c"}
+    assert c2.distributions["a"].value_range \
+        == model.schema_contract.distributions["a"].value_range
+    assert np.array_equal(c2.distributions["a"].histogram,
+                          model.schema_contract.distributions["a"].histogram)
+
+
+def test_contract_corruption_fails_checksum_and_recovers(tmp_path,
+                                                         trained):
+    """Acceptance: the contract survives the last-good recovery path -
+    a bit-flipped schema.json fails verification and load falls back."""
+    model, _data, _records, _ = trained
+    path = str(tmp_path / "m")
+    save_model(model, path)
+    save_model(model, path)  # second save -> last-good exists
+    sp = os.path.join(path, SCHEMA_JSON)
+    with open(sp, "r+b") as f:
+        f.seek(10)
+        f.write(b"X")
+    damage = verify_artifact(path)
+    assert damage is not None and SCHEMA_JSON in damage
+    wf2, _, _, _ = tiny_drill_pipeline(n=160)
+    m2 = load_model(path, wf2)  # recovered from last-good
+    assert m2.schema_contract is not None
+    assert os.path.isdir(path + LAST_GOOD_SUFFIX)
+
+
+def test_contract_opt_out_and_legacy_artifact(tmp_path):
+    wf, _data, _records, _ = tiny_drill_pipeline(n=60)
+    wf.set_parameters(schema_contract=False)
+    model = wf.train()
+    assert model.schema_contract is None
+    path = str(tmp_path / "m")
+    save_model(model, path)
+    assert not os.path.exists(os.path.join(path, SCHEMA_JSON))
+    wf2, _data2, _records2, _ = tiny_drill_pipeline(n=60)
+    m2 = load_model(path, wf2)
+    assert m2.schema_contract is None
+    # contract-less models serve with guards disabled, no error
+    ep = compile_endpoint(m2, batch_buckets=(4,), drift_policy="raise")
+    out = ep.score_batch(_records2[:2])
+    assert not any(isinstance(r, RowScoringError) for r in out)
+
+
+# -- serve-time enforcement ---------------------------------------------------
+
+def test_renamed_column_raises_named_drift_error(trained):
+    model, _data, records, _ = trained
+    ep = compile_endpoint(model, batch_buckets=(4,), drift_policy="raise")
+    bad = [{"a_renamed": r["a"], "c": r["c"]} for r in records[:4]]
+    with pytest.raises(SchemaDriftError) as exc:
+        ep.score_batch(bad)
+    msg = str(exc.value)
+    assert "a" in [v["feature"] for v in exc.value.violations]
+    assert "missing_column" in msg and "a_renamed" in msg
+
+
+def test_retyped_column_raises_naming_feature(trained):
+    model, _data, records, _ = trained
+    ep = compile_endpoint(model, batch_buckets=(4,), drift_policy="raise")
+    bad = [dict(records[0], a="a-string-now")]
+    with pytest.raises(SchemaDriftError) as exc:
+        ep.score_batch(bad)
+    v = exc.value.violations[0]
+    assert v["kind"] == "type_flip" and v["feature"] == "a"
+
+
+def test_warn_policy_serves_and_counts(trained):
+    model, _data, records, _ = trained
+    tel = ServingTelemetry()
+    ep = compile_endpoint(model, batch_buckets=(4,), telemetry=tel,
+                          drift_policy="warn")
+    bad = [{"a_renamed": r["a"], "c": r["c"]} for r in records[:4]]
+    out = ep.score_batch(bad)
+    assert len(out) == 4  # served anyway ('a' scores as missing)
+    snap = tel.snapshot()["data_contract"]
+    assert snap["schema_drift_batches"] == 1
+    assert snap["violations_by_kind"]["missing_column"] == 1
+
+
+def test_shed_policy_sheds_without_wedging(trained):
+    model, _data, records, _ = trained
+    tel = ServingTelemetry()
+    ep = compile_endpoint(model, batch_buckets=(4,), telemetry=tel,
+                          drift_policy="shed")
+    bad = [{"a_renamed": r["a"], "c": r["c"]} for r in records[:4]]
+    shed = ep.score_batch(bad)
+    assert all(isinstance(r, RowScoringError) and r.shed
+               and r.shed_reason == "schema" for r in shed)
+    # endpoint is NOT wedged: conformant traffic serves immediately
+    ok = ep.score_batch(records[:4])
+    assert not any(isinstance(r, RowScoringError) for r in ok)
+    snap = tel.snapshot()["data_contract"]
+    assert snap["rows_shed_schema"] == 4
+    # the breaker is untouched: schema sheds are caller-data problems
+    assert ep.breaker.state == "closed"
+
+
+def test_scheduler_relays_schema_shed_as_drift_error(trained):
+    from transmogrifai_tpu.serving import MicroBatchScheduler
+
+    model, _data, records, _ = trained
+    tel = ServingTelemetry()
+    ep = compile_endpoint(model, batch_buckets=(4,), telemetry=tel,
+                          drift_policy="shed")
+    with MicroBatchScheduler(ep, start=False, telemetry=tel) as sched:
+        req = sched.submit({"a_renamed": 1.0, "c": "u"})
+        sched.run_once(wait_timeout_s=0.5)
+        with pytest.raises(SchemaDriftError):
+            req.wait(1.0)
+    assert tel.snapshot()["data_contract"]["shed_schema"] == 1
+
+
+def test_distribution_shift_yields_nonzero_drift_score(trained):
+    """Acceptance: a schema-valid but distribution-shifted batch
+    surfaces a nonzero per-feature drift score in the snapshot."""
+    model, _data, records, _ = trained
+    ep = compile_endpoint(model, batch_buckets=(32,))
+    ep.score_batch(records[:96])
+    base = ep.drift_scores()["a"]
+    ep.score_batch(shift_records(records[:96], "a", delta=30.0))
+    snap = ep.telemetry.snapshot()["data_contract"]
+    assert snap["drift_js"]["a"]["last"] > base
+    assert snap["drift_js"]["a"]["last"] > 0.1
+    assert snap["drift_js_max"] >= snap["drift_js"]["a"]["last"]
+
+
+def test_serving_schema_drift_fault_point(trained):
+    model, _data, records, _ = trained
+    ep = compile_endpoint(model, batch_buckets=(4,), drift_policy="raise")
+    faults.configure("serving.schema_drift:on=1")
+    with pytest.raises(SchemaDriftError, match="injected"):
+        ep.score_batch(records[:2])
+    # burned: next batch clean
+    out = ep.score_batch(records[:2])
+    assert not any(isinstance(r, RowScoringError) for r in out)
+
+
+def test_local_scorer_raise_policy_and_default_warn(trained):
+    from transmogrifai_tpu.local.scorer import LocalScorer
+
+    model, _data, records, _ = trained
+    strict = LocalScorer(model, drift_policy="raise")
+    with pytest.raises(SchemaDriftError):
+        strict.score_batch([{"a_renamed": 1.0, "c": "u"}])
+    # default (warn) still scores
+    lenient = LocalScorer(model)
+    out = lenient.score_batch([{"a": 0.5, "c": "u"}])
+    assert len(out) == 1
+
+
+def test_empty_batch_parity_endpoint_vs_scorer(trained):
+    """Satellite bugfix pin: empty (all-rows-quarantined) input returns
+    an empty result + a telemetry count from BOTH serve surfaces, never
+    an exception."""
+    model, _data, _records, _ = trained
+    scorer = model.score_function()
+    assert scorer.score_batch([]) == []
+    tel = ServingTelemetry()
+    ep = compile_endpoint(model, batch_buckets=(4,), telemetry=tel)
+    assert ep.score_batch([]) == []
+    assert tel.snapshot()["data_contract"]["empty_batches"] == 1

@@ -16,6 +16,11 @@ Enforced invariants over every module in transmogrifai_tpu/:
   ``.join()`` / ``.wait()`` / ``.get()`` / ``.recv()`` must pass a
   timeout - a hung mesh peer or D-state child must never be able to
   wedge supervision or the collective watchdog forever (ISSUE 3)
+- no silent exception swallowing under readers/ and schema/: an
+  ``except`` whose body is ONLY ``pass``/``continue`` (no re-raise, no
+  use of the exception, no telemetry/log call) is exactly how a
+  malformed row silently coerces instead of being quarantined or named
+  (ISSUE 4)
 """
 import ast
 import pathlib
@@ -155,6 +160,32 @@ def test_no_unbounded_blocking_waits_under_parallel_and_workflow():
                 and ("/".join(rel), node.lineno) not in _BLOCKING_ALLOWLIST
             ):
                 offenders.append(f"{p}:{node.lineno} .{node.func.attr}()")
+    assert not offenders, offenders
+
+
+def test_no_silent_exception_swallowing_under_readers_and_schema():
+    """Under readers/ and schema/ an ``except`` handler whose body is
+    only ``pass``/``continue`` must still leave a trace (re-raise, use
+    the exception, or call a record*/log method): the data plane's
+    whole job is to NAME bad rows, not to silently eat them (ISSUE 4).
+    Applies to every exception type, not just broad ones - a narrow
+    ``except ValueError: pass`` swallows a malformed cell just as
+    silently."""
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        if rel[0] not in ("readers", "schema"):
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            body_only_skips = all(
+                isinstance(stmt, (ast.Pass, ast.Continue))
+                for stmt in node.body
+            )
+            if body_only_skips and not _handler_is_accounted(node):
+                offenders.append(f"{p}:{node.lineno}")
     assert not offenders, offenders
 
 

@@ -22,12 +22,21 @@ mesh.peer_die              a mesh peer dies mid-collective (classified
                            survivor recompute)
 mesh.init_no_coordinator   distributed.initialize: the coordinator never
                            answers (bootstrap-deadline drill)
+reader.malformed_row       a reader row turns malformed/truncated mid-
+                           ingest (quarantine/strict drill)
+reader.type_flip           a numeric reader cell turns to junk text
+                           (type-flip quarantine drill)
+serving.schema_drift       the endpoint sees a synthetic schema-contract
+                           violation (drift_policy drill)
 ========================== ==================================================
 
 The ``serving.*``/``io.*``/``supervisor.*``/``native.*`` points drill the
 round-7 recovery paths; the ``mesh.*``/``collective.*`` points drill the
 parallel/resilience.py watchdog (tests/test_mesh_resilience.py,
-``python bench.py --mesh-faults``).
+``python bench.py --mesh-faults``); the ``reader.*`` +
+``serving.schema_drift`` points drill the data-plane quarantine and
+drift guards (schema/, tests/test_data_plane.py,
+``python bench.py --data-faults``).
 """
 from .injection import (
     DEFAULT_KILL_EXIT,

@@ -43,6 +43,10 @@ class FeatureDistribution:
 
     def merge(self, other: "FeatureDistribution") -> "FeatureDistribution":
         assert (self.name, self.key) == (other.name, other.key)
+        # bin ranges must agree for histograms to be addable; mismatched
+        # ranges (a score-side dist built without the train Summary) keep
+        # None so js_divergence consumers can see the ranges diverged
+        vr = self.value_range if self.value_range == other.value_range else None
         return FeatureDistribution(
             name=self.name,
             key=self.key,
@@ -53,6 +57,7 @@ class FeatureDistribution:
                 self.moments[0] + other.moments[0],
                 self.moments[1] + other.moments[1],
             ),
+            value_range=vr,
         )
 
     def js_divergence(self, other: "FeatureDistribution") -> float:
@@ -76,7 +81,29 @@ class FeatureDistribution:
             "nulls": self.nulls,
             "fill_rate": self.fill_rate,
             "histogram": self.histogram.tolist(),
+            "moments": list(self.moments),
+            "value_range": (
+                None if self.value_range is None else list(self.value_range)
+            ),
         }
+
+    @staticmethod
+    def from_json(doc: dict) -> "FeatureDistribution":
+        """Inverse of to_json (the schema-contract persistence path,
+        schema/contract.py); pre-contract docs without moments/value_range
+        still load."""
+        return FeatureDistribution(
+            name=doc["name"],
+            key=doc.get("key"),
+            count=int(doc["count"]),
+            nulls=int(doc["nulls"]),
+            histogram=np.asarray(doc["histogram"], dtype=np.float64),
+            moments=tuple(doc.get("moments", (0.0, 0.0))),
+            value_range=(
+                None if doc.get("value_range") is None
+                else tuple(doc["value_range"])
+            ),
+        )
 
 
 TEXT_BUCKETS = 100

@@ -20,6 +20,7 @@ transforms host-side numpy columns, and every predictor exposes a pure-numpy
 from __future__ import annotations
 
 import copy
+import logging
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
 from ..models.base import PredictorModel
@@ -27,16 +28,41 @@ from ..types.columns import column_from_list
 from ..types.dataset import Dataset
 from ..workflow.workflow import OpWorkflowModel
 
+log = logging.getLogger("transmogrifai_tpu.local")
+
 
 class LocalScorer:
-    """Compiled dict->dict scorer over a fitted OpWorkflowModel."""
+    """Compiled dict->dict scorer over a fitted OpWorkflowModel.
 
-    def __init__(self, model: OpWorkflowModel) -> None:
+    ``drift_policy`` wires the model's schema contract (schema/
+    contract.py) into the row path: ``"warn"`` (default) logs each
+    distinct violation once, ``"raise"`` raises SchemaDriftError naming
+    the offending feature, ``None``/``"off"`` disables validation (the
+    serving endpoint sets this - it owns validation itself, validating
+    twice per batch would be pure overhead).
+    """
+
+    def __init__(self, model: OpWorkflowModel,
+                 contract=None,
+                 drift_policy: Optional[str] = "warn") -> None:
         self.raw_features = tuple(
             f for f in model.raw_features
             if not any(f.name == b.name for b in model.blacklisted_features)
         )
         self.result_features = tuple(model.result_features)
+        self.contract = (
+            contract if contract is not None
+            else getattr(model, "schema_contract", None)
+        )
+        self.drift_policy = (
+            None if drift_policy in (None, "off") else drift_policy
+        )
+        if self.drift_policy not in (None, "warn", "raise"):
+            raise ValueError(
+                "LocalScorer drift_policy must be 'warn', 'raise', or "
+                f"'off', got {drift_policy!r}"
+            )
+        self._warned_violations: set = set()
         # shallow-copy the DAG so flipping prefer_numpy never mutates the
         # model object the caller still holds
         dag = model._dag()
@@ -67,11 +93,33 @@ class LocalScorer:
                      stage.output_name)
                 )
 
+    # -- contract validation -------------------------------------------------
+    def _validate(self, records: Sequence[Mapping[str, Any]]) -> None:
+        if self.drift_policy is None or self.contract is None:
+            return
+        violations = self.contract.validate_records(records)
+        if not violations:
+            return
+        if self.drift_policy == "raise":
+            from ..schema.contract import SchemaDriftError
+
+            raise SchemaDriftError(violations)
+        from ..schema.contract import log_violations_once
+
+        log_violations_once(violations, self._warned_violations, log,
+                            "local scorer serving anyway")
+
     # -- scoring ------------------------------------------------------------
     def score_batch(
         self, records: Sequence[Mapping[str, Any]]
     ) -> list[dict[str, Any]]:
-        """Score a micro-batch of record dicts -> list of result dicts."""
+        """Score a micro-batch of record dicts -> list of result dicts.
+        An empty batch (e.g. every row quarantined upstream) returns an
+        empty list - pinned to the serving endpoint's behavior, never an
+        exception from a zero-row stage."""
+        if not records:
+            return []
+        self._validate(records)
         cols = {
             f.name: column_from_list(
                 [r.get(f.name) for r in records], f.ftype

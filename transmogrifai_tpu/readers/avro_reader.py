@@ -7,17 +7,36 @@ decodes the standard OCF layout - header magic ``Obj\\x01``, file metadata
 zigzag-varint-encoded records - into python dicts / a columnar Dataset.
 Supports null, boolean, int, long, float, double, bytes, string, enum,
 fixed, array, map, union, and nested record schemas.
+
+Error policy (``errors=``, schema/quarantine.py): ``"coerce"`` keeps
+legacy behavior (type-mismatched values silently become missing,
+truncation raises raw EOFError), ``"strict"`` raises MalformedRowError
+naming the record index, ``"quarantine"`` isolates type-flipped records
+and a truncated/corrupt trailing block into a bounded QuarantineBuffer
+instead of aborting the whole ingest.
 """
 from __future__ import annotations
 
 import json
+import logging
 import struct
 import zlib
 from typing import Any, Optional, Sequence
 
+from ..faults import injection as _faults
 from ..features.feature import Feature
+from ..schema.quarantine import (
+    MalformedRowError,
+    QuarantineBuffer,
+    check_errors_mode,
+    coerce_numeric,
+    data_telemetry,
+    excerpt_of,
+)
 from ..types.columns import column_from_list
 from ..types.dataset import Dataset
+
+log = logging.getLogger("transmogrifai_tpu.readers")
 
 MAGIC = b"Obj\x01"
 
@@ -130,8 +149,19 @@ def _decode_value(dec: _Decoder, schema: Any) -> Any:
     raise ValueError(f"unsupported avro type: {schema!r}")
 
 
-def read_avro_records(path: str) -> tuple[dict, list[dict]]:
-    """Read all records + the parsed schema from an OCF file."""
+def read_avro_records(
+    path: str,
+    errors: str = "coerce",
+    quarantine: Optional[QuarantineBuffer] = None,
+) -> tuple[dict, list[dict]]:
+    """Read all records + the parsed schema from an OCF file.
+
+    A truncated or corrupt trailing block: raw EOFError/ValueError under
+    ``"coerce"`` (legacy), :class:`MalformedRowError` naming the record
+    index under ``"strict"``, or — under ``"quarantine"`` — the cleanly
+    decoded prefix is returned and the damage recorded in the buffer.
+    """
+    check_errors_mode(errors)
     with open(path, "rb") as f:
         data = f.read()
     dec = _Decoder(data)
@@ -151,47 +181,188 @@ def read_avro_records(path: str) -> tuple[dict, list[dict]]:
     sync = dec.read(16)
     schema = json.loads(meta["avro.schema"].decode("utf-8"))
     codec = meta.get("avro.codec", b"null").decode("utf-8")
+    if codec not in ("null", "deflate"):
+        # configuration error, NOT block damage: checked once up front
+        # so quarantine mode can never misread a whole valid file in an
+        # unsupported codec as wall-to-wall corrupt blocks
+        raise ValueError(f"unsupported avro codec {codec!r}")
     records: list[dict] = []
     while not dec.at_end():
-        count = dec.read_long()
-        size = dec.read_long()
-        block = dec.read(size)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec != "null":
-            raise ValueError(f"unsupported avro codec {codec!r}")
-        bdec = _Decoder(block)
-        for _ in range(count):
-            records.append(_decode_value(bdec, schema))
-        if dec.read(16) != sync:
-            raise ValueError("bad sync marker (corrupt avro file)")
+        block_start = dec.pos
+        n_before = len(records)
+        try:
+            count = dec.read_long()
+            size = dec.read_long()
+            block = dec.read(size)
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            bdec = _Decoder(block)
+            for _ in range(count):
+                records.append(_decode_value(bdec, schema))
+            if dec.read(16) != sync:
+                raise ValueError("bad sync marker (corrupt avro file)")
+        except (EOFError, IndexError, ValueError, KeyError, zlib.error,
+                struct.error, UnicodeDecodeError) as e:
+            if errors == "coerce":
+                raise
+            truncated = isinstance(e, (EOFError, IndexError, struct.error))
+            reason = "truncated_block" if truncated else "corrupt_block"
+            if errors == "strict":
+                data_telemetry().record_strict_error(path)
+                raise MalformedRowError(
+                    path, len(records), reason, None, excerpt_of(str(e))
+                ) from e
+            # quarantine: the whole damaged block is suspect - records
+            # it already appended may be garbage decoded off misaligned
+            # bytes, so roll back to the block boundary before
+            # resyncing.  The sync marker exists precisely so one
+            # corrupt block does not cost every block after it; only
+            # with no further marker (a truncated tail) does the clean
+            # prefix stand alone.
+            del records[n_before:]
+            # search from the block HEAD, not the failure point: when
+            # damage hits early payload (or just the trailing marker)
+            # this finds THIS block's own boundary, so the next healthy
+            # block is never skipped.  A false match inside payload
+            # just fails the next decode and resyncs again - strictly
+            # forward progress either way.
+            nxt = data.find(sync, block_start)
+            if nxt < 0:
+                if quarantine is not None:
+                    quarantine.add(
+                        len(records), reason, None,
+                        excerpt_of(f"{e}; no later sync marker - "
+                                   f"{len(data) - block_start} trailing "
+                                   "bytes undecodable"),
+                    )
+                log.warning(
+                    "avro %s: %s at record %d; no sync marker after "
+                    "byte %d - keeping the %d-record clean prefix",
+                    path, reason, len(records), block_start,
+                    len(records),
+                )
+                break
+            if quarantine is not None:
+                quarantine.add(
+                    len(records), reason, None,
+                    excerpt_of(f"{e}; block dropped, resynced past "
+                               f"{nxt + 16 - block_start} bytes"),
+                )
+            log.warning(
+                "avro %s: %s at record %d; dropping the damaged block "
+                "(%d bytes) and resyncing",
+                path, reason, len(records), nxt + 16 - block_start,
+            )
+            dec.pos = nxt + 16  # just past the marker: next block head
     return schema, records
 
 
 class AvroReader:
     """Batch reader over an avro file (reference: DataReaders.Simple.avro)."""
 
-    def __init__(self, path: str, key_field: Optional[str] = None) -> None:
+    def __init__(self, path: str, key_field: Optional[str] = None,
+                 errors: str = "coerce",
+                 quarantine: Optional[QuarantineBuffer] = None,
+                 telemetry=None) -> None:
         self.path = path
         self.key_field = key_field
+        self.errors = check_errors_mode(errors)
+        self.quarantine = quarantine
+        self.telemetry = telemetry
         self._schema: Optional[dict] = None
         self._records: Optional[list[dict]] = None
+        self._checked_cache: dict[tuple, list] = {}
+
+    def _buffer(self) -> QuarantineBuffer:
+        if self.quarantine is None:
+            self.quarantine = QuarantineBuffer(source=self.path)
+        return self.quarantine
 
     @property
     def records(self) -> list[dict]:
         if self._records is None:
-            self._schema, self._records = read_avro_records(self.path)
+            self._schema, self._records = read_avro_records(
+                self.path, errors=self.errors,
+                quarantine=(
+                    self._buffer() if self.errors == "quarantine" else None
+                ),
+            )
         return self._records
 
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
     ) -> Dataset:
         recs = self.records
+        if self.errors != "coerce":
+            # memoized PER FEATURE SET: a repeat call with the same
+            # features (train + compute_data_up_to on one reader) must
+            # not re-validate and double every quarantine/telemetry
+            # count, while a different feature list (new numeric
+            # columns = new type-flip surface) validates afresh
+            key = tuple(
+                (f.name, f.ftype.kind) for f in raw_features
+            )
+            if key not in self._checked_cache:
+                self._checked_cache[key] = self._checked_records(
+                    recs, raw_features
+                )
+            recs = self._checked_cache[key]
         cols = {}
         for f in raw_features:
             vals = [_coerce(r.get(f.name), f) for r in recs]
             cols[f.name] = column_from_list(vals, f.ftype)
         return Dataset(cols)
+
+    def _checked_records(
+        self, recs: list, raw_features: Sequence[Feature]
+    ) -> list:
+        """Per-record validation: a non-null value in a numeric feature
+        that fails the coerce path's float() is a type flip (the coerce
+        mode would silently null it); a non-record entry is malformed.
+        Strict raises at the first offense naming the record index;
+        quarantine drops the record and keeps exact counts."""
+        buf = self._buffer()
+        # entries already in the buffer are file-level damage from
+        # read_avro_records (a truncated/corrupt tail block): count each
+        # as a read-and-quarantined row so rows_read - rows_kept always
+        # agrees with the buffer's by_reason totals
+        file_level = buf.total
+        numeric = [f.name for f in raw_features
+                   if f.ftype.kind == "numeric"]
+        kept = []
+        for i, r in enumerate(recs):
+            reason = col = cell = None
+            if _faults.fires("reader.malformed_row") is not None:
+                reason, cell = "malformed_record", "<injected>"
+            elif (_faults.fires("reader.type_flip") is not None
+                    and numeric):
+                reason, col, cell = "type_flip", numeric[0], "<injected>"
+            elif not isinstance(r, dict):
+                reason, cell = "malformed_record", excerpt_of(r)
+            else:
+                for name in numeric:
+                    v = r.get(name)
+                    if v is None or isinstance(v, (bool, int, float)):
+                        continue
+                    if coerce_numeric(v) is None:
+                        reason, col, cell = (
+                            "type_flip", name, excerpt_of(v)
+                        )
+                        break
+            if reason is not None:
+                if self.errors == "strict":
+                    (self.telemetry or data_telemetry()
+                     ).record_strict_error(self.path)
+                    raise MalformedRowError(
+                        self.path, i, reason, col, cell
+                    )
+                buf.add(i, reason, col, cell)
+                continue
+            kept.append(r)
+        (self.telemetry or data_telemetry()).record_read(
+            self.path, len(recs) + file_level, len(kept), buf
+        )
+        return kept
 
 
 def _coerce(v: Any, f: Feature) -> Any:
@@ -202,10 +373,7 @@ def _coerce(v: Any, f: Feature) -> Any:
             return float(v)
         if isinstance(v, (int, float)):
             return float(v)
-        try:
-            return float(v)
-        except (TypeError, ValueError):
-            return None
+        return coerce_numeric(v)
     if f.ftype.kind == "text":
         return str(v)
     return v
@@ -215,8 +383,60 @@ class ParquetReader:
     """Batch reader over parquet (reference: ParquetProductReader) - via
     pyarrow when available."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, errors: str = "coerce",
+                 quarantine: Optional[QuarantineBuffer] = None,
+                 telemetry=None) -> None:
         self.path = path
+        self.errors = check_errors_mode(errors)
+        self.quarantine = quarantine
+        self.telemetry = telemetry
+
+    def _checked_take(self, table, raw_features: Sequence[Feature]):
+        """Row-validated parquet ingest: parquet's own types make most
+        flips impossible, but a string-typed column serving a numeric
+        feature can still carry junk the coerce path would silently
+        null.  Drops (quarantine) or names (strict) those rows."""
+        import pyarrow.types as pat
+
+        buf = self.quarantine
+        if buf is None:
+            buf = self.quarantine = QuarantineBuffer(source=self.path)
+        n = table.num_rows
+        bad: dict[int, tuple[str, Optional[str], str]] = {}
+        for f in raw_features:
+            if f.ftype.kind != "numeric":
+                continue
+            col = table.column(f.name)
+            if (pat.is_integer(col.type) or pat.is_floating(col.type)
+                    or pat.is_boolean(col.type) or pat.is_decimal(col.type)):
+                continue
+            for i, v in enumerate(col.to_pylist()):
+                if v is None or isinstance(v, (bool, int, float)):
+                    continue
+                if coerce_numeric(v) is None and i not in bad:
+                    bad[i] = ("type_flip", f.name, excerpt_of(v))
+        if _faults.fires("reader.type_flip") is not None and n:
+            bad.setdefault(0, ("type_flip", raw_features[0].name,
+                               "<injected>"))
+        if _faults.fires("reader.malformed_row") is not None and n:
+            bad.setdefault(0, ("malformed_record", None, "<injected>"))
+        if bad and self.errors == "strict":
+            i0 = min(bad)
+            reason, col_name, cell = bad[i0]
+            (self.telemetry or data_telemetry()).record_strict_error(
+                self.path
+            )
+            raise MalformedRowError(self.path, i0, reason, col_name, cell)
+        for i in sorted(bad):
+            reason, col_name, cell = bad[i]
+            buf.add(i, reason, col_name, cell)
+        (self.telemetry or data_telemetry()).record_read(
+            self.path, n, n - len(bad), buf
+        )
+        if not bad:
+            return table
+        keep = [i for i in range(n) if i not in bad]
+        return table.take(keep)
 
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
@@ -228,6 +448,8 @@ class ParquetReader:
         table = pq.read_table(
             self.path, columns=[f.name for f in raw_features]
         )
+        if self.errors != "coerce":
+            table = self._checked_take(table, raw_features)
         cols = {}
         for f in raw_features:
             col = table.column(f.name)

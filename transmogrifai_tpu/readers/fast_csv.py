@@ -124,13 +124,14 @@ def _retry_masked_unicode_cells(
     float() - both native ingest routes must agree with it on every
     cell.  Mutates vals/mask in place; ASCII junk stays masked.  Callers
     gate on chunk.isascii() so pure-ASCII chunks never reach here."""
+    from ..schema.quarantine import coerce_numeric
+
     for r in np.nonzero(~mask)[0]:
         cell = chunk[cb[r]:ce[r]]
         if not cell or cell.isascii():
             continue
-        try:
-            v = float(cell.decode("utf-8").strip())
-        except (ValueError, UnicodeDecodeError):
+        v = coerce_numeric(cell)
+        if v is None:
             continue
         vals[r] = v
         mask[r] = True
@@ -143,6 +144,9 @@ def read_csv_columnar(
     has_header: bool = True,
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     wanted: Optional[Sequence[str]] = None,
+    errors: str = "coerce",
+    quarantine=None,
+    telemetry=None,
 ) -> dict[str, Column]:
     """Stream a CSV into columnar form via the native scanner.
 
@@ -150,7 +154,29 @@ def read_csv_columnar(
     which columns are materialized (all schema'd columns by default).
     Raises RuntimeError when the native path is unavailable - callers
     (CSVReader) fall back to the python reader.
+
+    ``errors`` (schema/quarantine.py): ``"coerce"`` keeps junk numeric
+    cells as missing values (legacy); ``"strict"`` raises
+    MalformedRowError at the first non-empty numeric cell that fails to
+    parse; ``"quarantine"`` drops such rows across ALL materialized
+    columns, recording (global row index, cell excerpt, reason).  The
+    scanner has no per-row field counts, so ragged/truncated-row
+    detection is the python reader's job (CSVReader routes checked
+    modes there); this path owns type-flip detection at native speed.
     """
+    from ..schema.quarantine import (
+        MalformedRowError,
+        QuarantineBuffer,
+        check_errors_mode,
+        data_telemetry,
+        excerpt_of,
+    )
+    from ..faults import injection as _faults
+
+    check_errors_mode(errors)
+    checked = errors != "coerce"
+    if checked and quarantine is None:
+        quarantine = QuarantineBuffer(source=path)
     if not fast_path_available():
         raise RuntimeError("native CSV kernels unavailable")
     header = list(headers) if headers else (
@@ -163,6 +189,8 @@ def read_csv_columnar(
     col_idx: dict[str, int] = {}
     modes: Optional[np.ndarray] = None
     names: list[str] = []
+    rows_seen = 0
+    rows_kept = 0
     for chunk in _aligned_chunks(path, chunk_bytes):
         if first and chunk.startswith(b"\xef\xbb\xbf"):
             # strip the BOM on the data path too: headerless files never
@@ -201,6 +229,8 @@ def read_csv_columnar(
         # pure-ASCII chunks (the hot path) skip the unicode retry check
         # entirely; isascii() short-circuits at the first high byte
         retry = not chunk.isascii()
+        chunk_num: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        chunk_text: dict[str, np.ndarray] = {}
         for n in names:
             c = col_idx[n]
             if modes[c] == 1:
@@ -210,12 +240,68 @@ def read_csv_columnar(
                     _retry_masked_unicode_cells(
                         chunk, cb[c], ce[c], vals_c, mask_c
                     )
+                chunk_num[n] = (vals_c, mask_c)
+            else:
+                chunk_text[n] = _decode_text_column(chunk, cb[c], ce[c])
+        keep = None
+        if checked:
+            # a masked-but-NON-EMPTY cell is junk the parser refused: a
+            # type flip.  Empty cells (ce <= cb) and literal-nan cells
+            # (parsed, mask flows from the NaN handling below) are
+            # legitimate missing values in every mode.
+            bad = np.zeros(nrows, dtype=bool)
+            bad_detail: dict[int, tuple[str, str, str]] = {}
+            for n, (vals_c, mask_c) in chunk_num.items():
+                c = col_idx[n]
+                junk = ~mask_c & (ce[c] > cb[c])
+                for r in np.nonzero(junk)[0]:
+                    bad_detail.setdefault(int(r), (
+                        "type_flip", n,
+                        excerpt_of(chunk[cb[c][r]:ce[c][r]]),
+                    ))
+                bad |= junk
+            # drill points: corrupt the chunk's first row so the drills
+            # flow through the same quarantine/strict machinery
+            if _faults.fires("reader.type_flip") is not None and nrows:
+                bad_detail.setdefault(
+                    0, ("type_flip", names[0], "<injected>"))
+                bad[0] = True
+            if _faults.fires("reader.malformed_row") is not None and nrows:
+                bad_detail.setdefault(
+                    0, ("malformed_row", None, "<injected>"))
+                bad[0] = True
+            if bad.any():
+                if errors == "strict":
+                    (telemetry or data_telemetry()).record_strict_error(
+                        path
+                    )
+                    r0 = int(np.nonzero(bad)[0][0])
+                    reason, col, cell = bad_detail[r0]
+                    raise MalformedRowError(
+                        path, rows_seen + r0, reason, col, cell
+                    )
+                for r in sorted(bad_detail):
+                    reason, col, cell = bad_detail[r]
+                    quarantine.add(rows_seen + r, reason, col, cell)
+                keep = ~bad
+        rows_seen += nrows
+        rows_kept += nrows if keep is None else int(keep.sum())
+        for n in names:
+            if n in chunk_num:
+                vals_c, mask_c = chunk_num[n]
+                if keep is not None:
+                    vals_c, mask_c = vals_c[keep], mask_c[keep]
                 num_parts.setdefault(n, []).append(vals_c)
                 mask_parts.setdefault(n, []).append(mask_c)
             else:
-                text_parts.setdefault(n, []).append(
-                    _decode_text_column(chunk, cb[c], ce[c])
-                )
+                txt = chunk_text[n]
+                if keep is not None:
+                    txt = txt[keep]
+                text_parts.setdefault(n, []).append(txt)
+    if checked:
+        (telemetry or data_telemetry()).record_read(
+            path, rows_seen, rows_kept, quarantine
+        )
     if first:
         # zero-byte file: the chunk loop never ran - surface the same
         # missing-column error the python path gives
@@ -294,14 +380,32 @@ class DeviceCSVIngest:
     def __init__(self, path: str, columns: Sequence[str],
                  schema: Mapping[str, Type[FeatureType]],
                  has_header: bool = True,
-                 chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> None:
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 errors: str = "coerce",
+                 quarantine=None,
+                 telemetry=None) -> None:
+        from ..schema.quarantine import QuarantineBuffer, check_errors_mode
+
         self.path = path
         self.columns = list(columns)
         self.schema = dict(schema)
         self.has_header = has_header
         self.chunk_bytes = chunk_bytes
+        self.errors = check_errors_mode(errors)
+        if self.errors != "coerce" and quarantine is None:
+            quarantine = QuarantineBuffer(source=path)
+        self.quarantine = quarantine
+        self.telemetry = telemetry
 
     def _parse_worker(self, q: queue.Queue) -> None:
+        from ..schema.quarantine import (
+            MalformedRowError,
+            data_telemetry,
+            excerpt_of,
+        )
+
+        checked = self.errors != "coerce"
+        rows_seen = rows_kept = 0
         try:
             header: Optional[list[str]] = None
             idx: Optional[list[int]] = None
@@ -339,15 +443,58 @@ class DeviceCSVIngest:
                         _retry_masked_unicode_cells(
                             chunk, cb[c], ce[c], num_vals[c], num_mask[c]
                         )
+                keep = None
+                if checked:
+                    # same junk rule as read_csv_columnar: a non-empty
+                    # cell the parser (plus unicode retry) refused is a
+                    # type flip, not a missing value
+                    bad = np.zeros(nrows, dtype=bool)
+                    for c in idx:
+                        bad |= ~num_mask[c] & (ce[c] > cb[c])
+                    if bad.any():
+                        if self.errors == "strict":
+                            r0 = int(np.nonzero(bad)[0][0])
+                            c0 = next(
+                                c for c in idx
+                                if not num_mask[c][r0]
+                                and ce[c][r0] > cb[c][r0]
+                            )
+                            (self.telemetry or data_telemetry()
+                             ).record_strict_error(self.path)
+                            raise MalformedRowError(
+                                self.path, rows_seen + r0, "type_flip",
+                                self.columns[idx.index(c0)],
+                                excerpt_of(chunk[cb[c0][r0]:ce[c0][r0]]),
+                            )
+                        for r in np.nonzero(bad)[0]:
+                            c_bad = next(
+                                c for c in idx
+                                if not num_mask[c][r] and ce[c][r] > cb[c][r]
+                            )
+                            self.quarantine.add(
+                                rows_seen + int(r), "type_flip",
+                                self.columns[idx.index(c_bad)],
+                                excerpt_of(chunk[cb[c_bad][r]:ce[c_bad][r]]),
+                            )
+                        keep = ~bad
                 block = np.ascontiguousarray(
                     num_vals[idx].T, dtype=np.float32
                 )  # [rows, d]
                 mask = num_mask[idx].T  # [rows, d]
+                if keep is not None:
+                    block = block[keep]
+                    mask = mask[keep]
+                rows_seen += nrows
+                rows_kept += block.shape[0]
                 nan = np.isnan(block)  # literal "nan" cells -> missing
                 if nan.any():
                     block = np.where(nan, np.float32(0.0), block)
                     mask = mask & ~nan
                 q.put((block, mask))
+            if checked:
+                (self.telemetry or data_telemetry()).record_read(
+                    self.path, rows_seen, rows_kept, self.quarantine
+                )
             q.put(None)
         except BaseException as e:  # surface parse errors to the consumer
             q.put(e)

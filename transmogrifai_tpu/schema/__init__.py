@@ -1,0 +1,51 @@
+"""Data-plane robustness: schema contracts, quarantine, drift guards.
+
+The train/serve-skew layer the reference builds into RawFeatureFilter
+(reference: core/.../filters/RawFeatureFilter.scala — score-vs-train
+distribution comparison gating features before they reach a model) and
+that tf.data treats as a first-class production concern (PAPERS.md:
+input pipelines own their error policies and telemetry).  Three pieces:
+
+* :class:`SchemaContract` — raw-feature names, dtypes, nullability and
+  per-feature :class:`~..filters.feature_distribution.FeatureDistribution`
+  summaries captured at fit time, persisted inside the crash-consistent
+  model artifact (serialization/model_io.py ``schema.json``, checksummed
+  by the manifest), and enforced against serve-time batches
+  (``SchemaDriftError`` / ``drift_policy`` on the serving endpoint).
+* Quarantine-mode ingestion — readers accept ``errors="quarantine"``:
+  malformed / type-flipped / truncated rows land in a bounded
+  :class:`QuarantineBuffer` (row index, payload excerpt, reason) with
+  exact counts in :class:`DataTelemetry` instead of aborting the ingest
+  (``errors="strict"``) or silently coercing (``errors="coerce"``, the
+  legacy default).
+* :class:`DriftMonitor` — serve-side running FeatureDistributions merged
+  batch-by-batch (the monoid the reference reduces over partitions),
+  scored against the training contract by JS divergence.
+"""
+from .contract import FeatureSpec, SchemaContract, SchemaDriftError
+from .drift import DriftMonitor
+from .quarantine import (
+    ERROR_MODES,
+    DataTelemetry,
+    MalformedRowError,
+    QuarantineBuffer,
+    QuarantinedRow,
+    check_errors_mode,
+    data_telemetry,
+    reset_data_telemetry,
+)
+
+__all__ = [
+    "ERROR_MODES",
+    "DataTelemetry",
+    "DriftMonitor",
+    "FeatureSpec",
+    "MalformedRowError",
+    "QuarantineBuffer",
+    "QuarantinedRow",
+    "SchemaContract",
+    "SchemaDriftError",
+    "check_errors_mode",
+    "data_telemetry",
+    "reset_data_telemetry",
+]

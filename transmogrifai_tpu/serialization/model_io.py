@@ -43,6 +43,7 @@ log = logging.getLogger("transmogrifai_tpu.serialization")
 MODEL_JSON = "model.json"
 ARRAYS_NPZ = "arrays.npz"
 MANIFEST_JSON = "manifest.json"
+SCHEMA_JSON = "schema.json"
 LAST_GOOD_SUFFIX = ".last-good"
 
 
@@ -262,6 +263,16 @@ def save_model(model, path: str) -> None:
         "stages": stages_doc,
     }
     json_bytes = json.dumps(doc, indent=1, default=str).encode("utf-8")
+    # the schema contract (schema/contract.py) rides INSIDE the same
+    # crash-consistent artifact: serve-time drift enforcement must load
+    # the exact data shape this model trained on, checksummed and
+    # last-good-recoverable like every other artifact file
+    contract = getattr(model, "schema_contract", None)
+    schema_bytes = None
+    if contract is not None:
+        schema_bytes = json.dumps(
+            contract.to_json(), indent=1, default=str
+        ).encode("utf-8")
 
     parent = os.path.dirname(path) or "."
     os.makedirs(parent, exist_ok=True)
@@ -295,6 +306,11 @@ def save_model(model, path: str) -> None:
             ARRAYS_NPZ: {"sha256": npz_sha, "bytes": npz_size},
         },
     }
+    if schema_bytes is not None:
+        _write_fsync(os.path.join(tmp, SCHEMA_JSON), schema_bytes)
+        manifest["files"][SCHEMA_JSON] = {
+            "sha256": _sha256(schema_bytes), "bytes": len(schema_bytes),
+        }
     _write_fsync(
         os.path.join(tmp, MANIFEST_JSON),
         json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8"),
@@ -327,7 +343,9 @@ def save_model(model, path: str) -> None:
     _fsync_dir(parent)
 
 
-_ARTIFACT_FILES = frozenset((MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON))
+_ARTIFACT_FILES = frozenset(
+    (MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON, SCHEMA_JSON)
+)
 
 
 def _carry_extras(old_dir: str, new_dir: str) -> None:
@@ -370,8 +388,15 @@ def _publish_by_copy(tmp: str, path: str, last_good: str,
     os.makedirs(path, exist_ok=True)
     # payload before manifest: until the manifest flips, verification
     # sees old-manifest-vs-new-payload and rejects the half-published dir
-    for name in (MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON):
+    for name in (MODEL_JSON, ARRAYS_NPZ, SCHEMA_JSON, MANIFEST_JSON):
         src = os.path.join(tmp, name)
+        if name == SCHEMA_JSON and not os.path.exists(src):
+            # contract-less model: a STALE schema.json from the replaced
+            # artifact must not survive to masquerade as this model's
+            stale = os.path.join(path, name)
+            if os.path.exists(stale):
+                os.remove(stale)
+            continue
         part = os.path.join(path, name + ".part")
         with open(src, "rb") as fsrc, open(part, "wb") as fdst:
             shutil.copyfileobj(fsrc, fdst, _HASH_CHUNK)
@@ -565,4 +590,21 @@ def load_model(path: str, workflow):
         train_time_s=doc.get("train_time_s", 0.0),
         blacklisted_features=workflow.blacklisted_features,
     )
+    # schema contract (optional: pre-contract artifacts have none) - the
+    # serve tier's drift guards need the trained data shape; checksummed
+    # via the manifest, so corruption was already caught above
+    schema_path = os.path.join(path, SCHEMA_JSON)
+    if os.path.exists(schema_path):
+        from ..schema.contract import SchemaContract
+
+        try:
+            with open(schema_path) as f:
+                model.schema_contract = SchemaContract.from_json(
+                    json.load(f)
+                )
+        except (ValueError, KeyError, TypeError) as e:
+            raise ModelLoadError(
+                f"model artifact {schema_path} is not a valid schema "
+                f"contract: {e}"
+            ) from e
     return model

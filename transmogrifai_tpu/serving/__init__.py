@@ -7,14 +7,18 @@ requests into fixed shape buckets so every predict rides the vectorized
 flat-heap / jitted batch paths, admission control sheds load gracefully,
 a circuit breaker turns persistent batch-path failure into fast loud
 shedding (with a NaN/Inf output guard) instead of a silent slow-path
-meltdown, and built-in telemetry reports p50/p95/p99 latency, batch
-fill, queue depth, rows/s, and breaker transitions as a JSON artifact.
+meltdown, schema/distribution drift guards validate every batch against
+the contract the model trained under (schema/: ``SchemaDriftError``,
+``drift_policy="raise"|"warn"|"shed"``, per-feature JS drift scores),
+and built-in telemetry reports p50/p95/p99 latency, batch fill, queue
+depth, rows/s, breaker transitions, and drift as a JSON artifact.
 
     endpoint = compile_endpoint(model)           # warmed, bucketed
     with MicroBatchScheduler(endpoint) as srv:
         result = srv.score(record, timeout_s=1.0)
     endpoint.telemetry.export("serving_metrics.json")
 """
+from ..schema.contract import SchemaDriftError
 from .admission import (
     AdmissionController,
     BreakerOpenError,
@@ -42,6 +46,7 @@ __all__ = [
     "QueueFullError",
     "RequestTimeoutError",
     "RowScoringError",
+    "SchemaDriftError",
     "ServingTelemetry",
     "compile_endpoint",
     "records_from_dataset",

@@ -37,12 +37,15 @@ from __future__ import annotations
 
 import logging
 import math
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Mapping, Optional, Sequence
 
 from ..faults import injection as _faults
 from ..local.scorer import LocalScorer
+from ..schema.contract import SchemaDriftError, log_violations_once
+from ..schema.drift import DriftMonitor
 from .admission import CircuitBreaker
 from .telemetry import ServingTelemetry
 
@@ -50,16 +53,21 @@ log = logging.getLogger("transmogrifai_tpu.serving")
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
+DRIFT_POLICIES = ("raise", "warn", "shed")
+
 
 @dataclass
 class RowScoringError:
     """Per-row failure marker returned in a batch's result list (the
     scheduler converts it into the request's exception; direct batch
-    callers can filter).  ``shed`` marks rows the breaker refused
-    unscored (scheduler accounting: shed_breaker, not failed)."""
+    callers can filter).  ``shed`` marks rows refused unscored, with
+    ``shed_reason`` naming why: ``"breaker"`` (circuit open — scheduler
+    accounting shed_breaker) or ``"schema"`` (contract violation under
+    drift_policy='shed' — accounting shed_schema)."""
 
     error: str
     shed: bool = False
+    shed_reason: str = "breaker"
 
 
 class CompiledEndpoint:
@@ -76,9 +84,17 @@ class CompiledEndpoint:
         breaker_threshold: int = 5,
         breaker_cooldown_s: float = 5.0,
         guard_nonfinite: bool = True,
+        contract=None,
+        drift_policy: str = "warn",
+        drift_scores: bool = True,
     ) -> None:
         if not batch_buckets or any(int(b) < 1 for b in batch_buckets):
             raise ValueError("batch_buckets must be positive sizes")
+        if drift_policy not in DRIFT_POLICIES:
+            raise ValueError(
+                f"drift_policy must be one of {DRIFT_POLICIES}, got "
+                f"{drift_policy!r}"
+            )
         self.batch_buckets = tuple(sorted({int(b) for b in batch_buckets}))
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             failure_threshold=breaker_threshold,
@@ -86,7 +102,23 @@ class CompiledEndpoint:
         )
         self.telemetry = telemetry if telemetry is not None else ServingTelemetry()
         self.guard_nonfinite = bool(guard_nonfinite)
-        self._scorer = LocalScorer(model)
+        # schema/distribution drift guards: the contract the model
+        # trained under (loaded from the artifact's schema.json) is
+        # enforced per batch; the inner scorer's own validation is OFF -
+        # the endpoint owns it, validating twice would be pure overhead
+        self.contract = (
+            contract if contract is not None
+            else getattr(model, "schema_contract", None)
+        )
+        self.drift_policy = drift_policy
+        self._warned_violations: set = set()
+        self._drift_monitor: Optional[DriftMonitor] = None
+        self._drift_pending: list = []
+        self._drift_lock = threading.Lock()
+        if (drift_scores and self.contract is not None
+                and self.contract.distributions):
+            self._drift_monitor = DriftMonitor(self.contract)
+        self._scorer = LocalScorer(model, drift_policy=None)
         # the pad row: scored to fill a bucket, sliced off before return.
         # All-None raw features ride the same missing-value handling every
         # stage already implements; a caller-provided warm_record is used
@@ -141,12 +173,113 @@ class CompiledEndpoint:
 
     def score_batch(self, records: Sequence[Mapping[str, Any]]) -> list:
         """Score a batch through the bucketed compiled path; element i of
-        the result aligns with records[i] (RowScoringError on failure)."""
+        the result aligns with records[i] (RowScoringError on failure).
+        An empty batch (all rows quarantined upstream) is a counted
+        no-op, never an exception - pinned to LocalScorer's behavior."""
+        if not records:
+            self.telemetry.record_empty_batch()
+            return []
+        shed = self._enforce_contract(records)
+        if shed is not None:
+            return shed
         out: list = []
         step = self.batch_buckets[-1]
         for lo in range(0, len(records), step):
             out.extend(self._score_bucketed(records[lo:lo + step]))
+        self._observe_drift(records)
         return out
+
+    # -- schema/distribution drift guards -----------------------------------
+    def _enforce_contract(
+        self, records: Sequence[Mapping[str, Any]]
+    ) -> Optional[list]:
+        """Validate a batch against the training contract and apply
+        ``drift_policy``.  Returns None to proceed with scoring, or the
+        full shed-marker result list (policy='shed').
+
+        Enforcement is BATCH-granular by design (missing-column
+        detection is a property of the batch's key union, and one
+        validation per batch keeps the hot path O(1)-ish): under
+        raise/shed, conformant requests micro-batched together with a
+        violating one share its outcome for that batch.  Deployments
+        mixing untrusted clients behind one scheduler should prefer
+        ``drift_policy="warn"`` (violations counted + logged, rows
+        still served) or segregate clients per endpoint."""
+        violations: list[dict] = []
+        if _faults.fires("serving.schema_drift") is not None:
+            violations.append({
+                "kind": "injected",
+                "feature": "<injected>",
+                "detail": "serving.schema_drift fault armed",
+            })
+        if self.contract is not None:
+            violations.extend(self.contract.validate_records(records))
+        if not violations:
+            return None
+        self.telemetry.record_schema_violations(
+            violations, self.drift_policy
+        )
+        if self.drift_policy == "raise":
+            raise SchemaDriftError(violations)
+        if self.drift_policy == "warn":
+            log_violations_once(violations, self._warned_violations, log,
+                                "endpoint serving anyway")
+            return None
+        # shed: refuse the batch unscored, loudly and cheaply - the
+        # endpoint stays healthy for conformant traffic
+        self.telemetry.record_schema_shed_rows(len(records))
+        err = SchemaDriftError(violations)
+        return [
+            RowScoringError(str(err), shed=True, shed_reason="schema")
+            for _ in records
+        ]
+
+    #: drift observation amortization: scored records buffer until this
+    #: many rows, then fold into the running distributions in ONE
+    #: vectorized pass - per-histogram python overhead on the batch-of-1
+    #: hot path would otherwise cost ~2/3 of single-row throughput
+    DRIFT_OBSERVE_MIN_ROWS = 64
+
+    def _observe_drift(
+        self, records: Sequence[Mapping[str, Any]]
+    ) -> None:
+        """Buffer the batch toward the running serve-side distributions;
+        per-feature JS divergence lands in telemetry once per observe
+        window.  Monitoring must never take scoring down."""
+        if self._drift_monitor is None:
+            return
+        with self._drift_lock:
+            self._drift_pending.extend(records)
+            if len(self._drift_pending) < self.DRIFT_OBSERVE_MIN_ROWS:
+                return
+            pending, self._drift_pending = self._drift_pending, []
+        try:
+            self._drift_monitor.observe(pending)
+            self.telemetry.record_drift_scores(
+                self._drift_monitor.scores()
+            )
+        except Exception:  # noqa: BLE001 - monitoring only
+            log.warning("drift monitoring failed for a batch",
+                        exc_info=True)
+
+    def drift_scores(self) -> dict[str, float]:
+        """Current per-feature JS divergence vs the training
+        distributions (empty when the model has no contract).  Flushes
+        the observation buffer so the scores reflect every row scored
+        so far."""
+        if self._drift_monitor is None:
+            return {}
+        with self._drift_lock:
+            pending, self._drift_pending = self._drift_pending, []
+        if pending:
+            try:
+                self._drift_monitor.observe(pending)
+            except Exception:  # noqa: BLE001 - monitoring only
+                log.warning("drift flush failed", exc_info=True)
+        scores = self._drift_monitor.scores()
+        if scores:
+            self.telemetry.record_drift_scores(scores)
+        return scores
 
     def _score_bucketed(self, records: Sequence[Mapping[str, Any]]) -> list:
         n = len(records)

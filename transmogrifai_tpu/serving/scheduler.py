@@ -196,14 +196,22 @@ class MicroBatchScheduler:
             # not ALSO count as delivered 'ok'/'failed'
             if isinstance(res, RowScoringError):
                 if res.shed:
-                    # breaker-open shed: the row was refused unscored -
-                    # a distinct outcome from a scoring failure, so the
-                    # degradation is visible in telemetry, not blended
-                    # into rows_failed
-                    if req.resolve_delivered(error=BreakerOpenError(
-                            res.error)):
+                    # shed rows were refused unscored - a distinct
+                    # outcome from a scoring failure, so the degradation
+                    # is visible in telemetry, not blended into
+                    # rows_failed; shed_reason picks the error class
+                    # (breaker open vs schema-contract violation)
+                    if getattr(res, "shed_reason", "breaker") == "schema":
+                        from ..schema.contract import SchemaDriftError
+
+                        err: Exception = SchemaDriftError(res.error)
+                        outcome = "shed_schema"
+                    else:
+                        err = BreakerOpenError(res.error)
+                        outcome = "shed_breaker"
+                    if req.resolve_delivered(error=err):
                         self.telemetry.record_request(
-                            done - req.enqueued_at, "shed_breaker")
+                            done - req.enqueued_at, outcome)
                 elif req.resolve_delivered(error=RuntimeError(res.error)):
                     self.telemetry.record_request(done - req.enqueued_at,
                                                   "failed")

@@ -63,6 +63,16 @@ class ServingTelemetry:
         self.breaker_closes = 0
         self.breaker_probes = 0
         self.rows_nonfinite = 0
+        # data-contract guards (schema/: contract validation + the
+        # serve-vs-train distribution drift monitor)
+        self.empty_batches = 0
+        self.shed_schema = 0
+        self.rows_shed_schema = 0
+        self.schema_drift_batches = 0
+        self.schema_violations_by_kind: dict = {}
+        self.schema_drift_actions: dict = {}
+        self._drift_last: dict = {}
+        self._drift_max: dict = {}
 
     # -- recording ----------------------------------------------------------
     def _sample(self, bucket: list, value) -> None:
@@ -72,7 +82,7 @@ class ServingTelemetry:
 
     def record_request(self, latency_s: float, outcome: str = "ok") -> None:
         """Outcomes: ok | failed | shed_deadline | shed_queue_full |
-        shed_breaker | timeout."""
+        shed_breaker | shed_schema | timeout."""
         with self._lock:
             if outcome in ("ok", "failed"):
                 self._sample(self._latencies_s, float(latency_s))
@@ -86,6 +96,8 @@ class ServingTelemetry:
                 self.shed_queue_full += 1
             elif outcome == "shed_breaker":
                 self.shed_breaker += 1
+            elif outcome == "shed_schema":
+                self.shed_schema += 1
             elif outcome == "timeout":
                 self.request_timeouts += 1
 
@@ -141,6 +153,42 @@ class ServingTelemetry:
         with self._lock:
             self.rows_nonfinite += int(n)
 
+    def record_empty_batch(self) -> None:
+        """A zero-row batch reached the endpoint (e.g. every row was
+        quarantined upstream): a counted no-op, not an error."""
+        with self._lock:
+            self.empty_batches += 1
+
+    def record_schema_violations(self, violations, action: str) -> None:
+        """One batch violated the schema contract; ``action`` is the
+        drift_policy applied (raise | warn | shed), counted per policy
+        so the snapshot shows HOW violating batches were handled."""
+        with self._lock:
+            self.schema_drift_batches += 1
+            self.schema_drift_actions[action] = (
+                self.schema_drift_actions.get(action, 0) + 1
+            )
+            for v in violations:
+                kind = v.get("kind", "unknown")
+                self.schema_violations_by_kind[kind] = (
+                    self.schema_violations_by_kind.get(kind, 0) + 1
+                )
+
+    def record_schema_shed_rows(self, n: int) -> None:
+        """Rows refused unscored under drift_policy='shed' (request-
+        level shed_schema accounting stays with the scheduler)."""
+        with self._lock:
+            self.rows_shed_schema += int(n)
+
+    def record_drift_scores(self, scores: dict) -> None:
+        """Latest per-feature JS divergence vs the training
+        distributions; running max kept per feature."""
+        with self._lock:
+            for name, s in scores.items():
+                self._drift_last[name] = float(s)
+                if s > self._drift_max.get(name, 0.0):
+                    self._drift_max[name] = float(s)
+
     # -- reporting ----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -176,6 +224,25 @@ class ServingTelemetry:
                     "probes": self.breaker_probes,
                     "rows_shed": self.rows_shed_breaker,
                     "rows_nonfinite": self.rows_nonfinite,
+                },
+                "data_contract": {
+                    "empty_batches": self.empty_batches,
+                    "shed_schema": self.shed_schema,
+                    "rows_shed_schema": self.rows_shed_schema,
+                    "schema_drift_batches": self.schema_drift_batches,
+                    "violations_by_kind": dict(
+                        self.schema_violations_by_kind),
+                    "batches_by_action": dict(self.schema_drift_actions),
+                    "drift_js": {
+                        name: {
+                            "last": round(self._drift_last[name], 6),
+                            "max": round(
+                                self._drift_max.get(name, 0.0), 6),
+                        }
+                        for name in sorted(self._drift_last)
+                    },
+                    "drift_js_max": round(
+                        max(self._drift_max.values(), default=0.0), 6),
                 },
                 "rows_per_s": round(rows / wall, 1),
                 "rows_batched": self.rows_batched,

@@ -38,6 +38,33 @@ def tiny_drill_pipeline(n: int = 120, seed: int = 0):
     return wf, data, records, pred.name
 
 
+def corrupted_csv_drill(dirpath: str, n_rows: int = 500,
+                        n_type_flips: int = 5, n_truncated: int = 3,
+                        seed: int = 7):
+    """-> (csv_path, raw_features, truth): a corrupted CSV matching the
+    tiny drill pipeline's schema (y response, a numeric, c picklist)
+    plus the exact corruption ground truth (random_data.
+    write_corrupted_csv) - ONE fixture shared by the quarantine tests,
+    the chaos-composition drill, and ``bench.py --data-faults`` so
+    their expected counts can never drift apart."""
+    import os
+
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from .. import FeatureBuilder
+    from ..types import feature_types as ft
+    from .random_data import write_corrupted_csv
+
+    path = os.path.join(dirpath, "corrupted.csv")
+    truth = write_corrupted_csv(
+        path, n_rows=n_rows, n_type_flips=n_type_flips,
+        n_truncated=n_truncated, seed=seed,
+    )
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    return path, [y, a, c], truth
+
+
 def drill_env() -> dict:
     """Child-process env for supervision/crash drills: CPU backend, no
     inherited fault plan (TX_FAULTS would re-arm in the child), no axon

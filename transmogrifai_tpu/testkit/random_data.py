@@ -298,6 +298,71 @@ def random_dataset(
     )
 
 
+def write_corrupted_csv(
+    path: str,
+    n_rows: int = 500,
+    n_type_flips: int = 5,
+    n_truncated: int = 3,
+    seed: int = 7,
+) -> dict:
+    """Deterministic corrupted-CSV generator for the data-plane drills
+    (shared by tests/test_data_plane.py and ``bench.py --data-faults``).
+
+    Writes a mixed numeric/text file (columns ``y``, ``a``, ``c``) with
+    ``n_type_flips`` rows whose numeric cell ``a`` holds junk text and
+    ``n_truncated`` rows missing their trailing fields.  Returns the
+    ground truth a quarantine ingest must reproduce EXACTLY::
+
+        {"n_rows", "columns", "type_flip_rows", "truncated_rows",
+         "bad_rows", "good_rows"}
+    """
+    rng = np.random.RandomState(seed)
+    n_bad = n_type_flips + n_truncated
+    if n_bad > n_rows:
+        raise ValueError("more corrupted rows than rows")
+    bad = rng.choice(n_rows, size=n_bad, replace=False)
+    flip_rows = sorted(int(i) for i in bad[:n_type_flips])
+    trunc_rows = sorted(int(i) for i in bad[n_type_flips:])
+    flips, truncs = set(flip_rows), set(trunc_rows)
+    cats = ("u", "v", "w")
+    with open(path, "w", newline="") as f:
+        f.write("y,a,c\n")
+        for i in range(n_rows):
+            y = i % 2
+            a = rng.randn()
+            c = cats[i % 3]
+            if i in flips:
+                f.write(f"{y},not-a-number-{i},{c}\n")
+            elif i in truncs:
+                f.write(f"{y}\n")
+            else:
+                f.write(f"{y},{a:.6f},{c}\n")
+    return {
+        "n_rows": n_rows,
+        "columns": ["y", "a", "c"],
+        "type_flip_rows": flip_rows,
+        "truncated_rows": trunc_rows,
+        "bad_rows": sorted(flips | truncs),
+        "good_rows": n_rows - n_bad,
+    }
+
+
+def shift_records(records, feature: str, delta: float = 0.0,
+                  scale: float = 1.0) -> list[dict]:
+    """Distribution-shifted copies of serve records (drift-guard
+    drills): numeric ``feature`` becomes ``value * scale + delta``,
+    missing values stay missing, everything else is untouched - the
+    batch stays schema-VALID, only its distribution moves."""
+    out = []
+    for r in records:
+        r = dict(r)
+        v = r.get(feature)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            r[feature] = v * scale + delta
+        out.append(r)
+    return out
+
+
 class InfiniteStream:
     """Endless Dataset batches from named generators (reference:
     testkit InfiniteStream): drives streaming-score paths and soak tests.

@@ -151,7 +151,8 @@ class OpWorkflowRunner:
         ride OpParams.custom_params: serving_buckets, serving_max_wait_us,
         serving_max_queue, serving_deadline_ms, serving_window,
         serving_breaker_threshold, serving_breaker_cooldown_s,
-        serving_guard_nonfinite."""
+        serving_guard_nonfinite, serving_drift_policy (raise|warn|shed,
+        enforced against the artifact's schema contract)."""
         from ..serving import (
             MicroBatchScheduler,
             RowScoringError,
@@ -178,6 +179,7 @@ class OpWorkflowRunner:
             breaker_cooldown_s=float(
                 cp.get("serving_breaker_cooldown_s", 5.0)),
             guard_nonfinite=bool(cp.get("serving_guard_nonfinite", True)),
+            drift_policy=str(cp.get("serving_drift_policy", "warn")),
         )
         deadline = cp.get("serving_deadline_ms")
         with MicroBatchScheduler(

@@ -17,10 +17,13 @@ computations on the device mesh.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from typing import Any, Mapping, Optional, Sequence
 
 import numpy as np
+
+log = logging.getLogger("transmogrifai_tpu.workflow")
 
 from ..features.feature import Feature
 from ..stages.base import Estimator, PipelineStage, Transformer
@@ -381,6 +384,20 @@ class OpWorkflow:
             dag, train_data, holdout, metrics=app_metrics,
             cv_during=cv_during,
         )
+        # capture the schema contract from the post-RawFeatureFilter raw
+        # data: the serve tier enforces this exact shape (names, dtypes,
+        # nullability, per-feature distributions) against every batch.
+        # Opt out with parameters(schema_contract=False); capture failure
+        # must never fail a completed train.
+        contract = None
+        if self.parameters.get("schema_contract", True):
+            try:
+                from ..schema.contract import SchemaContract
+
+                contract = SchemaContract.capture(self.raw_features, raw)
+            except Exception as e:  # noqa: BLE001 - capture is best-effort
+                log.warning("schema contract capture failed (model will "
+                            "serve uncontracted): %s", e)
         model = OpWorkflowModel(
             result_features=self.result_features,
             raw_features=self.raw_features,
@@ -389,6 +406,7 @@ class OpWorkflow:
             train_time_s=time.time() - t0,
             blacklisted_features=list(self.blacklisted_features),
             rff_results=self.rff_results,
+            schema_contract=contract,
         )
         model._train_data_cache = train_out
         model._holdout_data_cache = holdout_out
@@ -427,6 +445,7 @@ class OpWorkflowModel:
         train_time_s: float = 0.0,
         blacklisted_features: Sequence[Feature] = (),
         rff_results: Optional[dict] = None,
+        schema_contract=None,
     ) -> None:
         self.result_features = tuple(result_features)
         self.raw_features = tuple(raw_features)
@@ -435,6 +454,9 @@ class OpWorkflowModel:
         self.train_time_s = train_time_s
         self.blacklisted_features = list(blacklisted_features)
         self.rff_results = rff_results
+        # fit-time data shape (schema/contract.py), persisted in the
+        # artifact as schema.json and enforced by the serving tier
+        self.schema_contract = schema_contract
         self._train_data_cache: Optional[Dataset] = None
         self._holdout_data_cache: Optional[Dataset] = None
         self._scoring_dag: Optional[list[Layer]] = None
