@@ -1,14 +1,18 @@
-"""Chaos-composition drill (ISSUE 4 satellite): ONE seeded, randomized
-schedule arming faults from four different subsystems — ``reader.*``
-(data plane), ``serving.batch`` (serving), ``io.save_model.crash``
-(serialization), ``supervisor.child_kill`` (supervision) — across a
-single end-to-end workflow run (corrupted-CSV quarantine ingest → train
-→ save/load → serve → supervise), asserting the GLOBAL invariants:
+"""Chaos-composition drill (ISSUE 4 satellite, extended by ISSUE 5):
+ONE seeded, randomized schedule arming faults from five different
+subsystems — ``reader.*`` (data plane), ``serving.batch`` (serving),
+``io.save_model.crash`` (serialization), ``supervisor.child_kill``
+(supervision), ``registry.publish_crash`` + ``canary.regression``
+(model lifecycle) — across a single end-to-end workflow run
+(corrupted-CSV quarantine ingest → train → save/load → serve →
+supervise → registry publish/canary), asserting the GLOBAL invariants:
 
-* no corrupt artifact is ever loadable (checksums verify at each step);
+* no corrupt artifact is ever loadable (checksums verify at each step,
+  including the registry index after a crashed publish);
 * no phase hangs past its deadline;
 * every injected event is accounted for in telemetry — quarantine
-  counts, fallback rows, breaker transitions, supervisor restarts.
+  counts, fallback rows, breaker transitions, supervisor restarts,
+  canary NaN-guard refusals and the rollback decision they trigger.
 
 The schedule is randomized per TX_CHAOS_SEED but deterministic for a
 given seed, so a failing composition replays exactly.
@@ -88,9 +92,11 @@ def test_chaos_composition_end_to_end(tmp_path):
     malformed_on = int(rng.randint(1, 50))      # rows 0..48
     flip_on = int(rng.randint(50, 100))         # rows 49..98, disjoint
     serving_failures = int(rng.randint(2, 5))
+    canary_regression_on = int(rng.randint(1, 4))  # Nth canary batch
     events = {"armed_points": [
         "reader.malformed_row", "reader.type_flip", "serving.batch",
         "io.save_model.crash", "supervisor.child_kill",
+        "registry.publish_crash", "canary.regression",
     ]}
 
     # ---- phase 1: quarantine ingest (real corruption + injected) → train
@@ -182,8 +188,75 @@ def test_chaos_composition_end_to_end(tmp_path):
     assert "injected child kill" in res.restarts[0][1]
     events["supervisor_restarts"] = len(res.restarts)
 
+    # ---- phase 5: model lifecycle under injected faults ----------------
+    # (ISSUE 5 satellite) a crashed registry publish in a child leaves
+    # the registry loadable at the prior version, and a poisoned canary
+    # auto-rolls-back with the injection accounted in telemetry
+    from transmogrifai_tpu.registry import (
+        DeploymentController,
+        ModelRegistry,
+        RollbackPolicy,
+    )
+    from transmogrifai_tpu.testkit.drills import (
+        REGISTRY_CRASH_PUBLISHER_TEMPLATE,
+    )
+
+    reg_root = str(tmp_path / "registry")
+    reg_script = tmp_path / "publisher.py"
+    reg_script.write_text(REGISTRY_CRASH_PUBLISHER_TEMPLATE.format(
+        repo=REPO, root=reg_root, fault="registry.publish_crash:on=1"))
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, str(reg_script)],
+                          env=drill_env(), timeout=CRASH_SAVE_DEADLINE_S)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really crashed
+    events["registry_crash_exit"] = proc.returncode
+    registry = ModelRegistry(reg_root, create=False)
+    # invariant: the index never saw the crashed publish — prior version
+    # intact, the half-published artifact reported as an orphan
+    report = registry.verify()
+    assert report["ok"] and report["versions"]["v1"] is None
+    assert report["orphans"], "crashed publish left no orphan to report"
+    wf5 = tiny_drill_pipeline()[0]
+    stable_model = registry.load_stable(wf5)
+    controller = DeploymentController(
+        registry=registry, canary_fraction=0.5,
+        policy=RollbackPolicy(min_canary_rows=4),
+        check_every_batches=1, batch_buckets=(4,),
+    )
+    controller.deploy(stable_model, version="v1")
+    # publish the canary candidate THROUGH the registry (v2, parent v1)
+    v2 = registry.publish(stable_model, metrics={"drill": True})
+    wf6 = tiny_drill_pipeline()[0]
+    canary_gen = controller.start_canary_version(v2.version, wf6)
+    assert registry.canary == v2.version
+    faults.configure(f"canary.regression:on={canary_regression_on}")
+    t0 = time.monotonic()
+    rolled_back_after = None
+    for i in range(canary_regression_on + 3):
+        controller.score_batch([dict(r) for r in records[:8]])
+        if controller.canary_generation is None:
+            rolled_back_after = i + 1
+            break
+    t_canary = time.monotonic() - t0
+    faults.reset()
+    assert t_canary < SERVE_DEADLINE_S, "canary control loop hang"
+    # invariant: the injected regression is accounted — NaN-guard hits
+    # in the canary's telemetry, a rollback event with evidence on the
+    # controller, and the demotion in the registry lineage
+    assert rolled_back_after is not None
+    c_snap = canary_gen.endpoint.telemetry.snapshot()
+    assert c_snap["breaker"]["rows_nonfinite"] > 0
+    rollbacks = [e for e in controller.events()
+                 if e["event"] == "rollback"]
+    assert len(rollbacks) == 1
+    assert any(r["signal"] == "nonfinite_rows"
+               for r in rollbacks[0]["reasons"])
+    assert any(e["event"] == "rollback" for e in registry.lineage())
+    events["canary_rolled_back_after_batches"] = rolled_back_after
+
     # ---- global: nothing leaked, everything accounted ------------------
     assert not faults.active()
     assert events["quarantined"] == expected_quarantined
     assert verify_artifact(model_path) is None
     assert verify_artifact(crash_path) is None
+    assert registry.verify()["ok"]

@@ -1,0 +1,791 @@
+"""Registry drills (ISSUE 5): versioned store, hot-swap, canary, rollback.
+
+Covers the model-lifecycle control loop end to end: content-addressed
+publish over crash-consistent artifacts, the checksummed registry index
+surviving crash/corruption via ``.last-good``, the stage machine
+(candidate → canary → stable → rolled_back), zero-downtime hot-swap
+under concurrent scoring threads (no dropped / duplicated /
+mixed-generation batch), deterministic hash canary splits, shadow
+scoring, and signal-driven automatic rollback with recorded evidence —
+plus the ``registry.publish_crash`` / ``registry.swap_crash`` /
+``canary.regression`` / ``canary.latency`` fault points that drill each
+window.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu import cli
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.faults.injection import InjectedFault
+from transmogrifai_tpu.registry import (
+    DeploymentController,
+    ModelRegistry,
+    RegistryError,
+    RegistryIntegrityError,
+    RollbackPolicy,
+)
+from transmogrifai_tpu.serving import RowScoringError, ServingTelemetry
+from transmogrifai_tpu.testkit.drills import (
+    REGISTRY_CRASH_PUBLISHER_TEMPLATE,
+    drill_env,
+    tiny_drill_pipeline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _trained():
+    wf, data, records, name = tiny_drill_pipeline()
+    return wf.train(), records, name
+
+
+def _trained_variant(seed: int = 1):
+    """A second model whose FEATURE NAMES match the first pipeline's
+    (uids reset, so the result feature carries the same suffix — the
+    registry serves versions of ONE workflow definition, not arbitrary
+    foreign models)."""
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    return tiny_drill_pipeline(seed=seed)[0].train()
+
+
+def _fresh_workflow():
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    return tiny_drill_pipeline()[0]
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry: publish / index / verify
+# ---------------------------------------------------------------------------
+def test_publish_records_content_address_and_lineage(tmp_path):
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model, metrics={"auroc": 0.91})
+    assert v1.version == "v1" and v1.stage == "candidate"
+    assert len(v1.manifest_sha256) == 64
+    assert v1.schema_sha256 is not None  # the tiny pipeline has a contract
+    assert v1.metrics == {"auroc": 0.91}
+    assert v1.parent is None
+    reg.promote("v1", to="stable")
+    # the second publish records the current stable as its parent
+    v2 = reg.publish(model)
+    assert v2.version == "v2" and v2.parent == "v1"
+    events = [e["event"] for e in reg.lineage()]
+    assert events == ["publish", "promote", "publish"]
+    listed = reg.versions()
+    assert [v.version for v in listed] == ["v1", "v2"]
+    with pytest.raises(RegistryError, match="v9"):
+        reg.get("v9")
+
+
+def test_registry_index_recovers_from_last_good(tmp_path):
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    reg.promote("v1", to="stable")  # second commit: last-good now exists
+    index = os.path.join(root, "registry.json")
+    with open(index, "r+b") as f:
+        f.seek(10)
+        f.write(b"XXXX")  # bit-flip the primary
+    reg2 = ModelRegistry(root, create=False)
+    report = reg2.verify()
+    assert report["recovered_from_last_good"]
+    # a registry serving from last-good is one commit stale: verify must
+    # FAIL loudly even though it stays operable
+    assert not report["index_ok"] and not report["ok"]
+    # last-good predates the promote; the version itself must be there
+    assert "v1" in {v.version for v in reg2.versions()}
+    # the next commit must NOT snapshot the corrupt primary over the
+    # only good last-good copy (that would brick the registry if the
+    # commit then crashed); after it, both copies verify again
+    reg2.publish(model)
+    report = reg2.verify()
+    assert report["index_ok"] and report["ok"]
+    assert "v2" in {v.version for v in reg2.versions()}
+
+
+def test_registry_index_both_damaged_is_loud(tmp_path):
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    reg.promote("v1", to="stable")
+    for name in ("registry.json", "registry.json.last-good"):
+        with open(os.path.join(root, name), "w") as f:
+            f.write("{not json")
+    with pytest.raises(RegistryIntegrityError, match="last-good"):
+        ModelRegistry(root, create=False).versions()
+
+
+def test_verify_reports_tamper_and_orphans(tmp_path):
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    # orphan: an artifact directory the index never committed (the
+    # publish crash window)
+    os.makedirs(os.path.join(root, "versions", "v99", "junk"))
+    npz = os.path.join(root, "versions", "v1", "arrays.npz")
+    with open(npz, "r+b") as f:
+        f.seek(30)
+        f.write(b"\x00\x00\x00\x00")
+    report = reg.verify()
+    assert not report["ok"]
+    assert "checksum" in report["versions"]["v1"]
+    assert os.path.join("versions", "v99") in report["orphans"]
+
+
+def test_load_verifies_the_registered_content_address(tmp_path):
+    model, records, name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    wf2 = _fresh_workflow()
+    loaded = reg.load("v1", wf2)
+    assert loaded.schema_contract is not None
+    scored = loaded.score_function()(dict(records[0]))
+    assert loaded.result_features[0].name in scored
+    # replace the artifact OUTSIDE the registry: content address breaks
+    # even though the artifact itself is internally consistent
+    from transmogrifai_tpu.serialization.model_io import save_model
+
+    model2 = _trained_variant()
+    save_model(model2, os.path.join(root, "versions", "v1"))
+    with pytest.raises(RegistryIntegrityError, match="manifest"):
+        reg.load("v1", _fresh_workflow())
+
+
+# ---------------------------------------------------------------------------
+# stage machine
+# ---------------------------------------------------------------------------
+def test_stage_machine_and_invalid_transitions(tmp_path):
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model)
+    reg.publish(model)
+    reg.promote("v1", to="stable")
+    reg.promote("v2", to="canary")
+    assert reg.stable == "v1" and reg.canary == "v2"
+    # a second canary cannot evict the live one silently
+    reg.publish(model)
+    with pytest.raises(RegistryError, match="canary slot"):
+        reg.promote("v3", to="canary")
+    # canary graduates: stable advances, old stable retires
+    reg.promote("v2", to="stable")
+    assert reg.stable == "v2" and reg.canary is None
+    assert reg.get("v1").stage == "retired"
+    # a retired version cannot be re-promoted without re-publishing
+    with pytest.raises(RegistryError, match="retired"):
+        reg.promote("v1", to="stable")
+
+
+def test_rollback_stable_reverts_to_parent(tmp_path):
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model)
+    reg.promote("v1", to="stable")
+    reg.publish(model)  # parent = v1
+    reg.promote("v2", to="stable")
+    event = reg.rollback(reason="bad release")
+    assert event["version"] == "v2"
+    assert event["stable_reverted_to"] == "v1"
+    assert reg.stable == "v1"
+    assert reg.get("v2").stage == "rolled_back"
+    assert reg.get("v1").stage == "stable"
+    # nothing left to revert to: v1 has no parent
+    with pytest.raises(RegistryError, match="no parent"):
+        reg.rollback()
+
+
+def test_publish_directly_into_a_stage(tmp_path):
+    """publish(stage=...) promotes after the index commit — it must not
+    deadlock on the cross-process registry lock it already holds (the
+    flock is per-fd, not per-process)."""
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model, stage="stable")
+    assert v1.stage == "stable" and reg.stable == "v1"
+    v2 = reg.publish(model, stage="canary")
+    assert v2.stage == "canary" and reg.canary == "v2"
+    with pytest.raises(RegistryError, match="retired"):
+        reg.publish(model, stage="retired")
+
+
+def test_orphaned_version_ids_are_never_reissued(tmp_path):
+    """A version directory without an index entry (mid-publish crash, or
+    a concurrent publisher's reservation) consumes its id: the next
+    publish must skip it, not overwrite it."""
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    os.makedirs(os.path.join(root, "versions", "v2"))  # crash orphan
+    v3 = reg.publish(model)
+    assert v3.version == "v3"
+    assert os.path.join("versions", "v2") in reg.verify()["orphans"]
+
+
+def test_rollback_never_reinstates_a_rolled_back_parent(tmp_path):
+    """A parent the operator explicitly demoted must not silently
+    become the serving stable again when its child rolls back."""
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model)
+    reg.promote("v1", to="stable")
+    reg.publish(model)
+    reg.promote("v2", to="stable")  # v1 -> retired
+    reg.rollback(version="v1", reason="v1 is bad too")  # -> rolled_back
+    with pytest.raises(RegistryError, match="rolled_back"):
+        reg.rollback(reason="v2 regressed")
+    assert reg.stable == "v2"  # nothing silently reverted
+
+
+def test_versions_listing_tolerates_non_canonical_ids(tmp_path):
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    # hand-migrated id the next-version logic already warns about:
+    # the listing (and so `tx registry list`) must not crash on it
+    with reg._exclusive():
+        doc = reg._read()
+        entry = dict(doc["versions"]["v1"], version="legacy-2024")
+        doc["versions"]["legacy-2024"] = entry
+        reg._commit(doc)
+    listed = reg.versions()
+    assert [v.version for v in listed] == ["v1", "legacy-2024"]
+    v2 = reg.publish(model)  # canonical numbering continues from v1
+    assert v2.version == "v2"
+
+
+def test_publish_attributes_process_telemetry(tmp_path):
+    from transmogrifai_tpu.parallel.resilience import mesh_telemetry
+    from transmogrifai_tpu.schema import data_telemetry
+
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model)
+    # the process-wide training-side accumulators now name the version
+    # their metrics produced
+    assert data_telemetry().snapshot()["model_version"] == v1.version
+    assert mesh_telemetry().snapshot()["model_version"] == v1.version
+
+
+def test_rollback_empty_registry_is_loud(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RegistryError, match="nothing to roll back"):
+        reg.rollback()
+
+
+def test_release_canary_frees_the_slot_without_judgement(tmp_path):
+    """Ending an observation window undecided returns the version to
+    candidate (re-promotable), unlike a rollback."""
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model, stage="stable")
+    reg.publish(model, stage="canary")
+    event = reg.release_canary(reason="run ended")
+    assert event["version"] == "v2"
+    assert reg.canary is None
+    assert reg.get("v2").stage == "candidate"
+    assert reg.lineage()[-1]["event"] == "canary_release"
+    # undecided, not condemned: the same version can canary again
+    reg.promote("v2", to="canary")
+    assert reg.canary == "v2"
+    # nothing to release is a no-op, not an error
+    reg.release_canary()
+    assert reg.release_canary() is None
+
+
+def test_describe_is_one_consistent_view(tmp_path):
+    model, _records, _name = _trained()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model, stage="stable")
+    doc = reg.describe(lineage=True)
+    assert doc["stable"] == "v1" and doc["canary"] is None
+    assert [v["version"] for v in doc["versions"]] == ["v1"]
+    assert [e["event"] for e in doc["lineage"]] == ["publish", "promote"]
+
+
+# ---------------------------------------------------------------------------
+# publish crash window (registry.publish_crash)
+# ---------------------------------------------------------------------------
+def test_publish_crash_leaves_registry_at_prior_version(tmp_path):
+    root = str(tmp_path / "reg")
+    script = tmp_path / "publisher.py"
+    script.write_text(REGISTRY_CRASH_PUBLISHER_TEMPLATE.format(
+        repo=REPO, root=root, fault="registry.publish_crash:on=1"))
+    proc = subprocess.run([sys.executable, str(script)], env=drill_env(),
+                          timeout=300)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really crashed
+    reg = ModelRegistry(root, create=False)
+    # the index never saw v2: the registry is loadable at v1
+    assert [v.version for v in reg.versions()] == ["v1"]
+    assert reg.stable == "v1"
+    report = reg.verify()
+    assert report["versions"]["v1"] is None  # prior version intact
+    # the half-published v2 artifact is an orphan, reported not trusted
+    assert any("v2" in o for o in report["orphans"])
+    loaded = reg.load_stable(_fresh_workflow())
+    assert loaded.schema_contract is not None
+
+
+def test_publish_crash_cli_verify_reports_prior_intact(tmp_path, capsys):
+    root = str(tmp_path / "reg")
+    script = tmp_path / "publisher.py"
+    script.write_text(REGISTRY_CRASH_PUBLISHER_TEMPLATE.format(
+        repo=REPO, root=root, fault="registry.publish_crash:on=1"))
+    proc = subprocess.run([sys.executable, str(script)], env=drill_env(),
+                          timeout=300)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT
+    rc = cli.main(["registry", "verify", "--root", root])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["ok"]
+    assert report["versions"]["v1"] is None
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_list_promote_rollback_roundtrip(tmp_path, capsys):
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    reg.publish(model)
+    assert cli.main(["registry", "promote", "--root", root,
+                     "--version", "v1"]) == 0
+    capsys.readouterr()
+    assert cli.main(["registry", "promote", "--root", root,
+                     "--version", "v2", "--to", "canary"]) == 0
+    capsys.readouterr()
+    assert cli.main(["registry", "list", "--root", root,
+                     "--lineage"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["stable"] == "v1" and doc["canary"] == "v2"
+    assert [e["event"] for e in doc["lineage"]][:2] == [
+        "publish", "publish"]
+    assert cli.main(["registry", "rollback", "--root", root,
+                     "--reason", "drill"]) == 0
+    event = json.loads(capsys.readouterr().out)
+    assert event["version"] == "v2" and event["reason"] == "drill"
+    # invalid transitions surface as JSON errors + exit 2, not tracebacks
+    assert cli.main(["registry", "promote", "--root", root,
+                     "--version", "v2"]) == 2
+    assert "error" in json.loads(capsys.readouterr().out)
+
+
+def test_cli_verify_exits_nonzero_on_damage(tmp_path, capsys):
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    npz = os.path.join(root, "versions", "v1", "arrays.npz")
+    with open(npz, "r+b") as f:
+        f.seek(40)
+        f.write(b"\xff\xff")
+    assert cli.main(["registry", "verify", "--root", root]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["ok"]
+    # a missing registry is exit 2 (operational error, not damage)
+    assert cli.main(["registry", "list", "--root",
+                     str(tmp_path / "nope")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# DeploymentController: hot-swap
+# ---------------------------------------------------------------------------
+def test_hot_swap_serves_without_interruption(tmp_path):
+    model, records, name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8))
+    ctl.deploy(model, version="v1")
+    out1 = ctl.score_batch(records[:8])
+    assert len(out1) == 8 and all(name in r for r in out1)
+    model2 = _trained_variant()
+    gen2 = ctl.deploy(model2, version="v2")
+    assert gen2.generation == 2
+    out2 = ctl.score_batch(records[:8])
+    assert len(out2) == 8 and all(name in r for r in out2)
+    # the swap is in the lifecycle log with its latency evidence
+    swaps = [e for e in ctl.events() if e["event"] == "swap"]
+    assert len(swaps) == 2
+    assert swaps[1]["from_version"] == "v1"
+    assert swaps[1]["flip_us"] < 1e6  # the flip is a pointer write
+    # per-generation telemetry attribution (satellite: shared field)
+    snap = gen2.endpoint.telemetry.snapshot()
+    assert snap["model_version"] == "v2" and snap["generation"] == 2
+
+
+def test_swap_crash_leaves_old_generation_serving(tmp_path):
+    model, records, name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8))
+    ctl.deploy(model, version="v1")
+    model2 = _trained_variant()
+    faults.configure("registry.swap_crash:on=1")
+    with pytest.raises(InjectedFault):
+        ctl.deploy(model2, version="v2")
+    faults.reset()
+    gen = ctl.stable_generation
+    assert gen.version == "v1" and gen.generation == 1
+    out = ctl.score_batch(records[:4])
+    assert all(name in r for r in out)
+    # the failed deploy left no half-registered generation behind: the
+    # next deploy gets a clean consecutive id
+    gen2 = ctl.deploy(model2, version="v2")
+    assert gen2.generation == 2
+
+
+def test_concurrent_scoring_through_hot_swaps_drops_nothing(tmp_path):
+    """Threads score continuously while the main thread hot-swaps twice:
+    every submitted batch returns exactly its own results (no drop, no
+    duplicate, no error), and each call observes exactly ONE stable
+    generation (never a half-swapped mix)."""
+    model, records, name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8))
+    generations = [ctl.deploy(model, version="v1")]
+    stop = threading.Event()
+    failures: list[str] = []
+    counts = {"batches": 0, "rows": 0}
+    lock = threading.Lock()
+
+    def pump(tid: int):
+        i = 0
+        while not stop.is_set():
+            batch = [dict(records[(i + j + tid) % len(records)])
+                     for j in range(4)]
+            try:
+                out, info = ctl.score_batch_with_info(batch)
+            except Exception as e:  # noqa: BLE001 - the invariant under test
+                failures.append(f"t{tid}: {type(e).__name__}: {e}")
+                return
+            if len(out) != len(batch):
+                failures.append(f"t{tid}: {len(out)} results for "
+                                f"{len(batch)} rows")
+                return
+            bad = [r for r in out
+                   if isinstance(r, RowScoringError) or name not in r]
+            if bad:
+                failures.append(f"t{tid}: bad rows during swap: {bad[:2]}")
+                return
+            if info["stable_generation"] not in (1, 2, 3):
+                failures.append(f"t{tid}: unknown generation {info}")
+                return
+            with lock:
+                counts["batches"] += 1
+                counts["rows"] += len(out)
+            i += 4
+
+    threads = [threading.Thread(target=pump, args=(t,)) for t in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for seed, version in ((1, "v2"), (2, "v3")):
+            time.sleep(0.15)
+            m = _trained_variant(seed=seed)
+            generations.append(ctl.deploy(m, version=version))
+    finally:
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(30)
+    assert not failures, failures[:3]
+    assert counts["batches"] > 0
+    assert len([e for e in ctl.events() if e["event"] == "swap"]) == 3
+    # conservation: every submitted row landed in exactly one
+    # generation's request accounting (none dropped, none double-counted)
+    telem_rows = sum(
+        g.endpoint.telemetry.snapshot()["rows_scored"]
+        for g in generations
+    )
+    assert telem_rows == counts["rows"]
+
+
+def test_canary_arm_failure_never_fails_stable_rows(tmp_path):
+    """A canary defect that raises out of its endpoint (e.g. a stricter
+    contract under drift_policy='raise') must not take down the
+    stable-routed share of the batch: its rows re-score on stable and
+    the failure lands in the CANARY's telemetry for the policy."""
+    model, records, name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8), canary_fraction=0.5,
+                               check_every_batches=1000)
+    ctl.deploy(model, version="v1")
+    canary_gen = ctl.start_canary(_trained_variant(), version="v2")
+
+    def boom(records):
+        raise RuntimeError("canary endpoint defect")
+
+    canary_gen.endpoint.score_batch = boom
+    out, info = ctl.score_batch_with_info(records[:16])
+    assert len(out) == 16
+    assert not any(isinstance(r, RowScoringError) for r in out)
+    assert info["canary_rows"] > 0
+    snap = canary_gen.endpoint.telemetry.snapshot()
+    assert snap["rows_failed"] == info["canary_rows"]
+
+
+def test_deploy_version_rejects_ineligible_stage_without_swapping(tmp_path):
+    model, records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    reg.promote("v1", to="stable")
+    reg.publish(model)
+    reg.promote("v2", to="stable")  # v1 is now retired
+    ctl = DeploymentController(registry=reg, batch_buckets=(1, 8))
+    ctl.deploy_version("v2", _fresh_workflow())
+    # redeploying the retired v1 must fail FAST: live pointer and
+    # registry both untouched (revert goes through registry.rollback)
+    with pytest.raises(RegistryError, match="retired"):
+        ctl.deploy_version("v1", _fresh_workflow())
+    assert ctl.stable_generation.version == "v2"
+    assert reg.stable == "v2"
+
+
+def test_start_canary_validates_before_building(tmp_path):
+    model, _records, _name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8))
+    ctl.deploy(model, version="v1")
+    with pytest.raises(ValueError, match="fraction"):
+        ctl.start_canary(model, version="v2", fraction=1.5)
+    # the bad input cost no generation id and left no canary behind
+    assert ctl.canary_generation is None
+    gen = ctl.start_canary(model, version="v2", fraction=0.5)
+    assert gen.generation == 2
+
+
+# ---------------------------------------------------------------------------
+# canary routing + shadow + rollback
+# ---------------------------------------------------------------------------
+def test_canary_split_is_deterministic_and_proportional(tmp_path):
+    model, records, _name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8), canary_fraction=0.3)
+    ctl.deploy(model, version="v1")
+    routes = [ctl.routes_to_canary(r) for r in records]
+    # deterministic: the same records route identically on every call
+    assert routes == [ctl.routes_to_canary(r) for r in records]
+    frac = sum(routes) / len(routes)
+    assert 0.05 < frac < 0.6  # 120 hashed records around 0.3
+    # fraction 0 and 1 are exact
+    assert not any(ctl.routes_to_canary(r, fraction=0.0) for r in records)
+    assert all(ctl.routes_to_canary(r, fraction=1.0) for r in records)
+
+
+def test_canary_scores_its_share_and_promotes(tmp_path):
+    model, records, name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8), canary_fraction=0.5,
+                               check_every_batches=1000)
+    ctl.deploy(model, version="v1")
+    model2 = _trained_variant()
+    ctl.start_canary(model2, version="v2")
+    out, info = ctl.score_batch_with_info(records[:32])
+    assert len(out) == 32 and all(name in r for r in out)
+    assert 0 < info["canary_rows"] < 32
+    c_snap = ctl.canary_generation.endpoint.telemetry.snapshot()
+    assert c_snap["rows_scored"] == info["canary_rows"]
+    assert c_snap["model_version"] == "v2"
+    promoted = ctl.promote_canary()
+    assert ctl.stable_generation is promoted
+    assert ctl.canary_generation is None
+
+
+def test_shadow_scoring_never_touches_responses(tmp_path):
+    model, records, name = _trained()
+    ctl = DeploymentController(batch_buckets=(1, 8),
+                               check_every_batches=1000)
+    ctl.deploy(model, version="v1")
+    baseline = ctl.score_batch(records[:16])
+    model2 = _trained_variant()
+    ctl.start_canary(model2, version="v2", shadow=True)
+    shadowed, info = ctl.score_batch_with_info(records[:16])
+    # responses are stable's, bit-identical to the pre-canary scores
+    assert shadowed == baseline
+    assert info["shadow_rows"] == 16
+    stats = ctl.shadow_stats()
+    assert stats["rows"] == 16
+    # two differently-seeded models disagree somewhere
+    assert stats["rows_differed"] > 0
+    assert stats["max_abs_delta"] > 0
+
+
+def test_canary_regression_fault_triggers_auto_rollback(tmp_path):
+    model, records, _name = _trained()
+    ctl = DeploymentController(
+        batch_buckets=(1, 8), canary_fraction=0.5,
+        policy=RollbackPolicy(min_canary_rows=8), check_every_batches=1,
+    )
+    ctl.deploy(model, version="v1")
+    model2 = _trained_variant()
+    canary_gen = ctl.start_canary(model2, version="v2")
+    faults.configure("canary.regression:every=1")
+    try:
+        for _ in range(8):
+            ctl.score_batch(records[:16])
+            if ctl.canary_generation is None:
+                break
+    finally:
+        faults.reset()
+    assert ctl.canary_generation is None  # demoted automatically
+    rollbacks = [e for e in ctl.events() if e["event"] == "rollback"]
+    assert len(rollbacks) == 1
+    reasons = {r["signal"] for r in rollbacks[0]["reasons"]}
+    assert "nonfinite_rows" in reasons  # the guard saw the poison
+    # evidence names both arms with their live numbers
+    assert rollbacks[0]["evidence"]["canary"]["breaker"][
+        "rows_nonfinite"] > 0
+    # the decision also landed in the demoted generation's telemetry
+    snap = canary_gen.endpoint.telemetry.snapshot()
+    assert any(e["event"] == "rollback" for e in snap["lifecycle"])
+    # stable keeps serving untouched
+    out = ctl.score_batch(records[:8])
+    assert not any(isinstance(r, RowScoringError) for r in out)
+
+
+def test_canary_latency_fault_trips_the_latency_slo(tmp_path):
+    model, records, _name = _trained()
+    ctl = DeploymentController(
+        batch_buckets=(1, 8), canary_fraction=0.5,
+        policy=RollbackPolicy(min_canary_rows=8, max_latency_ratio=3.0,
+                              max_breaker_opens=None,
+                              max_nonfinite_rows=None,
+                              max_failed_ratio=None),
+        check_every_batches=1,
+    )
+    ctl.deploy(model, version="v1")
+    model2 = _trained_variant()
+    ctl.start_canary(model2, version="v2")
+    # warm both arms' latency samples before arming the slowdown
+    for _ in range(4):
+        ctl.score_batch(records[:16])
+    assert ctl.canary_generation is not None  # healthy so far
+    faults.configure("canary.latency:every=1:delay=0.25")
+    try:
+        for _ in range(10):
+            ctl.score_batch(records[:16])
+            if ctl.canary_generation is None:
+                break
+    finally:
+        faults.reset()
+    assert ctl.canary_generation is None
+    rollbacks = [e for e in ctl.events() if e["event"] == "rollback"]
+    assert {r["signal"] for r in rollbacks[0]["reasons"]} == {
+        "p99_latency_ratio"}
+    assert rollbacks[0]["reasons"][0]["value"] > 3.0
+
+
+def test_manual_rollback_and_registry_lineage(tmp_path):
+    model, records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model)
+    reg.promote("v1", to="stable")
+    model2 = _trained_variant()
+    reg.publish(model2)
+    ctl = DeploymentController(registry=reg, batch_buckets=(1, 8))
+    wf_a, wf_b = _fresh_workflow(), _fresh_workflow()
+    ctl.deploy_version("v1", wf_a)
+    ctl.start_canary_version("v2", wf_b, fraction=0.5)
+    assert reg.get("v2").stage == "canary"
+    ctl.score_batch(records[:16])
+    event = ctl.rollback_canary(reason="operator said so")
+    assert event["reason"] == "operator said so"
+    assert reg.get("v2").stage == "rolled_back"
+    assert reg.canary is None
+    tail = reg.lineage()[-1]
+    assert tail["event"] == "rollback" and tail["version"] == "v2"
+
+
+# ---------------------------------------------------------------------------
+# shared telemetry attribution field (satellite)
+# ---------------------------------------------------------------------------
+def test_all_three_telemetry_tiers_carry_model_version():
+    from transmogrifai_tpu.parallel.resilience import MeshTelemetry
+    from transmogrifai_tpu.schema import DataTelemetry
+
+    for cls in (ServingTelemetry, DataTelemetry, MeshTelemetry):
+        t = cls()
+        snap = t.snapshot()
+        assert snap["model_version"] is None and snap["generation"] is None
+        t.set_model_version("v7", generation=3)
+        snap = t.snapshot()
+        assert snap["model_version"] == "v7", cls.__name__
+        assert snap["generation"] == 3, cls.__name__
+
+
+# ---------------------------------------------------------------------------
+# runner deploy run type
+# ---------------------------------------------------------------------------
+def test_runner_deploy_run_publishes_and_serves(tmp_path):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    wf, _data, _records, _name = tiny_drill_pipeline()
+    model = wf.train()
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    root = str(tmp_path / "reg")
+    runner = OpWorkflowRunner(tiny_drill_pipeline()[0])
+    params = OpParams(
+        model_location=model_dir,
+        metrics_location=str(tmp_path / "metrics"),
+        custom_params={"registry_root": root, "deploy_batch_rows": 32},
+    )
+    res = runner.run("deploy", params)
+    m = res.metrics
+    assert m["rows_submitted"] == 120 and m["rows_failed"] == 0
+    assert m["published_version"] == "v1"
+    assert m["deployed_version"] == "v1"
+    assert m["stable"]["telemetry"]["model_version"] == "v1"
+    exported = json.load(
+        open(os.path.join(str(tmp_path / "metrics"),
+                          "deploy_metrics.json")))
+    assert exported["deployed_version"] == "v1"
+    # the registry now records v1 as stable
+    assert ModelRegistry(root, create=False).stable == "v1"
+
+
+def test_runner_deploy_releases_an_undecided_canary(tmp_path):
+    """A deploy run ending with its canary neither promoted nor rolled
+    back must free the registry's canary slot (back to candidate), so a
+    later run's canary never serves while the registry points at a
+    stale one.  Each registry load gets a FRESH workflow via the
+    factory — two versions with different blacklists cannot share one
+    workflow object."""
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    model, _records, _name = _trained()
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish(model, stage="stable")
+    reg.publish(_trained_variant())
+    runner = OpWorkflowRunner(_fresh_workflow(),
+                              workflow_factory=_fresh_workflow)
+    params = OpParams(custom_params={
+        "registry_root": root, "canary_version": "v2",
+        "canary_fraction": 0.3, "deploy_batch_rows": 32,
+    })
+    res = runner.run("deploy", params)
+    m = res.metrics
+    assert m["rows_failed"] == 0
+    assert m["canary_released"] is not None
+    reg2 = ModelRegistry(root, create=False)
+    assert reg2.canary is None
+    assert reg2.get("v2").stage == "candidate"  # undecided, not condemned
+    assert reg2.lineage()[-1]["event"] == "canary_release"

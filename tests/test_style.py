@@ -21,6 +21,12 @@ Enforced invariants over every module in transmogrifai_tpu/:
   use of the exception, no telemetry/log call) is exactly how a
   malformed row silently coerces instead of being quarantined or named
   (ISSUE 4)
+- model artifacts are written only via serialization/ and registry/:
+  no ``np.save``/``np.savez*`` calls and no write-mode ``open()`` of an
+  artifact file (model.json, arrays.npz, manifest.json, schema.json,
+  registry.json) anywhere else - every published version must ride the
+  crash-consistent fsync+manifest+rename path, or a registry entry
+  could reference an artifact that a crash can corrupt (ISSUE 5)
 """
 import ast
 import pathlib
@@ -186,6 +192,66 @@ def test_no_silent_exception_swallowing_under_readers_and_schema():
             )
             if body_only_skips and not _handler_is_accounted(node):
                 offenders.append(f"{p}:{node.lineno}")
+    assert not offenders, offenders
+
+
+#: files that make up a crash-consistent model artifact (plus the
+#: registry index); writing any of these outside the exempt dirs
+#: bypasses the fsync + manifest + atomic-rename discipline
+_ARTIFACT_FILES = (
+    "model.json", "arrays.npz", "manifest.json", "schema.json",
+    "registry.json",
+)
+_ARTIFACT_WRITE_EXEMPT_DIRS = ("serialization", "registry")
+_NP_SAVERS = {"save", "savez", "savez_compressed"}
+
+
+def _call_writes_artifact(node: ast.Call) -> bool:
+    """A write-mode ``open()`` whose argument expressions mention an
+    artifact filename literal."""
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return False
+    mode = ""
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = str(node.args[1].value)
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = str(kw.value.value)
+    if not any(c in mode for c in "wax+"):
+        return False
+    for arg in node.args[:1]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                if any(sub.value.endswith(n) for n in _ARTIFACT_FILES):
+                    return True
+    return False
+
+
+def test_model_artifacts_written_only_via_serialization_and_registry():
+    """Every model artifact write must go through serialization/ (or the
+    registry/ index commit): a raw ``open()``/``np.savez`` elsewhere
+    produces an artifact with no manifest, no fsync, and no atomic swap
+    - exactly the un-verifiable state the registry exists to prevent
+    (ISSUE 5)."""
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        if rel[0] in _ARTIFACT_WRITE_EXEMPT_DIRS:
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _NP_SAVERS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy")
+            ):
+                offenders.append(f"{p}:{node.lineno} np.{f.attr}")
+            elif _call_writes_artifact(node):
+                offenders.append(f"{p}:{node.lineno} open(<artifact>, 'w')")
     assert not offenders, offenders
 
 

@@ -1,4 +1,4 @@
-"""Project generator CLI.
+"""Project generator + registry CLI.
 
 Counterpart of the reference cli module (reference: cli/src/main/scala/com/
 salesforce/op/cli/ - CliExec.scala `op gen`, SchemaSource.scala auto-infer,
@@ -15,6 +15,18 @@ Generated project: main.py (train + summary), score.py (load + batch
 score), serve.py (micro-batched serving endpoint + telemetry),
 params.json (OpParams), test_smoke.py (pytest e2e on a sample),
 README.md.
+
+Model-lifecycle commands over a versioned registry (registry/; alias
+``tx`` for ``python -m transmogrifai_tpu.cli``):
+
+    tx registry list     --root ./registry            # versions + stages
+    tx registry verify   --root ./registry [--version vN]
+    tx registry promote  --root ./registry --version vN [--to stable|canary]
+    tx registry rollback --root ./registry [--version vN] [--reason ...]
+
+Each prints one JSON document; ``verify`` exits non-zero when any
+checksum fails (the prior version must still verify after a crashed
+publish - drilled by ``bench.py --registry``).
 """
 from __future__ import annotations
 
@@ -592,9 +604,75 @@ def _parse_override(s: str) -> tuple[str, type]:
     return col, t
 
 
+# ---------------------------------------------------------------------------
+# registry commands (registry/: versioned store + lifecycle)
+# ---------------------------------------------------------------------------
+def _registry_main(args) -> int:
+    from .registry import ModelRegistry, RegistryError
+
+    try:
+        reg = ModelRegistry(args.root, create=False)
+    except RegistryError as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+    try:
+        if args.registry_cmd == "list":
+            doc = reg.describe(lineage=args.lineage)
+            print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+            return 0
+        if args.registry_cmd == "verify":
+            report = reg.verify(args.version)
+            print(json.dumps(report, indent=1, sort_keys=True))
+            return 0 if report["ok"] else 1
+        if args.registry_cmd == "promote":
+            entry = reg.promote(args.version, to=args.to)
+            print(json.dumps(entry.to_json(), indent=1, sort_keys=True,
+                             default=str))
+            return 0
+        if args.registry_cmd == "rollback":
+            event = reg.rollback(version=args.version,
+                                 reason=args.reason or "cli")
+            print(json.dumps(event, indent=1, sort_keys=True, default=str))
+            return 0
+    except RegistryError as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+    raise AssertionError(f"unhandled registry command {args.registry_cmd}")
+
+
+def _add_registry_parser(sub) -> None:
+    r = sub.add_parser("registry",
+                       help="versioned model registry lifecycle")
+    rsub = r.add_subparsers(dest="registry_cmd", required=True)
+    for name, helptext in (
+        ("list", "versions, stages, stable/canary pointers"),
+        ("verify", "checksum-verify the index and artifacts"),
+        ("promote", "candidate->canary or candidate/canary->stable"),
+        ("rollback", "demote the canary (or revert stable to parent)"),
+    ):
+        c = rsub.add_parser(name, help=helptext)
+        c.add_argument("--root", required=True,
+                       help="registry root directory")
+        if name == "list":
+            c.add_argument("--lineage", action="store_true",
+                           help="include the lineage event log")
+        if name == "verify":
+            c.add_argument("--version", default=None,
+                           help="verify one version (default: all)")
+        if name == "promote":
+            c.add_argument("--version", required=True)
+            c.add_argument("--to", choices=("stable", "canary"),
+                           default="stable")
+        if name == "rollback":
+            c.add_argument("--version", default=None,
+                           help="default: the live canary, else stable")
+            c.add_argument("--reason", default=None)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="transmogrifai_tpu.cli")
     sub = p.add_subparsers(dest="cmd", required=True)
+    _add_registry_parser(sub)
     g = sub.add_parser("gen", help="generate a project from data")
     g.add_argument("--input", required=True, help="CSV or .avsc path")
     g.add_argument("--response", required=True)
@@ -615,6 +693,8 @@ def main(argv=None) -> int:
                         "interactive questions (reference: op gen "
                         "--answers)")
     args = p.parse_args(argv)
+    if args.cmd == "registry":
+        return _registry_main(args)
     answers = load_answers(args.answers) if args.answers else None
     path = generate(
         args.input, args.response, args.name, args.output, args.kind,

@@ -28,6 +28,17 @@ reader.type_flip           a numeric reader cell turns to junk text
                            (type-flip quarantine drill)
 serving.schema_drift       the endpoint sees a synthetic schema-contract
                            violation (drift_policy drill)
+registry.publish_crash     hard kill between the artifact publish and
+                           the registry-index commit (the registry must
+                           stay loadable at the prior version)
+registry.swap_crash        InjectedFault in the deploy swap window (new
+                           endpoint built, pointer not yet flipped - the
+                           old generation must keep serving)
+canary.regression          live canary outputs poisoned to NaN through
+                           the guard + breaker accounting (auto-rollback
+                           drill)
+canary.latency             the canary arm sleeps ``delay`` seconds
+                           inside its timed window (latency-SLO drill)
 ========================== ==================================================
 
 The ``serving.*``/``io.*``/``supervisor.*``/``native.*`` points drill the
@@ -36,7 +47,9 @@ parallel/resilience.py watchdog (tests/test_mesh_resilience.py,
 ``python bench.py --mesh-faults``); the ``reader.*`` +
 ``serving.schema_drift`` points drill the data-plane quarantine and
 drift guards (schema/, tests/test_data_plane.py,
-``python bench.py --data-faults``).
+``python bench.py --data-faults``); the ``registry.*`` + ``canary.*``
+points drill the model-lifecycle control loop (registry/,
+tests/test_registry.py, ``python bench.py --registry``).
 """
 from .injection import (
     DEFAULT_KILL_EXIT,

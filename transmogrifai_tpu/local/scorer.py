@@ -97,17 +97,15 @@ class LocalScorer:
     def _validate(self, records: Sequence[Mapping[str, Any]]) -> None:
         if self.drift_policy is None or self.contract is None:
             return
-        violations = self.contract.validate_records(records)
-        if not violations:
-            return
-        if self.drift_policy == "raise":
-            from ..schema.contract import SchemaDriftError
+        # the validate + policy dispatch shared with the serving endpoint
+        # (schema/contract.py): one implementation, so a registry-driven
+        # swap cannot behave differently across the two serve surfaces
+        from ..schema.contract import apply_drift_policy, collect_violations
 
-            raise SchemaDriftError(violations)
-        from ..schema.contract import log_violations_once
-
-        log_violations_once(violations, self._warned_violations, log,
-                            "local scorer serving anyway")
+        violations = collect_violations(self.contract, records)
+        apply_drift_policy(violations, self.drift_policy,
+                           self._warned_violations, log,
+                           "local scorer serving anyway")
 
     # -- scoring ------------------------------------------------------------
     def score_batch(

@@ -207,6 +207,11 @@ class MeshTelemetry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.started_at = time.time()
+        # model-version attribution (registry/): the ServingTelemetry-
+        # shared pair, so degraded-training events in bench JSON and
+        # summary_json() name the model version they trained
+        self.model_version: Optional[str] = None
+        self.generation: Optional[int] = None
         self.collectives_ok = 0
         self.detections = 0
         self.straggler_retries = 0
@@ -288,6 +293,14 @@ class MeshTelemetry:
                 LOG_PREFIX, label, overhead_s,
             )
 
+    def set_model_version(self, version: Optional[str],
+                          generation: Optional[int] = None) -> None:
+        """Attribute subsequent mesh events to one model version /
+        deployment generation (the ServingTelemetry contract)."""
+        with self._lock:
+            self.model_version = version
+            self.generation = generation
+
     def record_bootstrap_timeout(self, address: str,
                                  timeout_s: float) -> None:
         with self._lock:
@@ -321,6 +334,8 @@ class MeshTelemetry:
         with self._lock:
             return {
                 "wall_s": round(max(time.time() - self.started_at, 1e-9), 3),
+                "model_version": self.model_version,
+                "generation": self.generation,
                 "collectives_ok": self.collectives_ok,
                 "detections": self.detections,
                 "straggler_retries": self.straggler_retries,

@@ -1,0 +1,143 @@
+"""Signal-driven canary rollback policy.
+
+The closed-loop half of the registry (reference frame: TF-Serving
+advances servable versions only while health checks hold; this engine
+already EMITS every needed health signal — breaker transitions and
+NaN-guard hits from serving/admission.py + endpoint.py, per-feature JS
+drift from schema/drift.py, latency percentiles from
+serving/telemetry.py — and the policy here is what finally reads them):
+a :class:`RollbackPolicy` compares the canary generation's live
+``ServingTelemetry`` snapshot against the stable generation's and
+returns a :class:`RollbackDecision` naming every breached signal with
+its value, threshold, and the evidence snapshots.
+
+Signal classes:
+
+* **hard** — breaker opens and NaN/Inf-guard refusals on the canary.
+  These indicate a broken model/kernel, not statistical noise, so they
+  trip IMMEDIATELY regardless of sample size.
+* **soft** — p99 latency ratio vs stable, per-feature JS drift, and the
+  failed-row ratio.  These are distributions, so they only trip after
+  ``min_canary_rows`` rows have scored on the canary (a 4-row sample
+  "drifts" from pure noise; a latched false rollback is worse than a
+  slightly later true one — the DriftMonitor warn gate's reasoning).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class RollbackDecision:
+    """One policy evaluation: breached signals + the evidence behind
+    them (recorded verbatim in the registry lineage and the controller's
+    ``summary_json()`` when the rollback fires)."""
+
+    rollback: bool
+    reasons: list = field(default_factory=list)
+    evidence: dict = field(default_factory=dict)
+    checked_at: float = field(default_factory=time.time)
+
+    def to_json(self) -> dict:
+        return {
+            "rollback": self.rollback,
+            "reasons": [dict(r) for r in self.reasons],
+            "evidence": dict(self.evidence),
+            "checked_at": self.checked_at,
+        }
+
+
+def _evidence_subset(snap: dict) -> dict:
+    """The slice of a ServingTelemetry snapshot a rollback decision
+    cites (full snapshots are big; evidence must stay readable in the
+    lineage log)."""
+    return {
+        "rows_scored": snap.get("rows_scored"),
+        "rows_failed": snap.get("rows_failed"),
+        "latency_ms": snap.get("latency_ms"),
+        "breaker": snap.get("breaker"),
+        "drift_js_max": snap.get("data_contract", {}).get("drift_js_max"),
+        "model_version": snap.get("model_version"),
+        "generation": snap.get("generation"),
+    }
+
+
+@dataclass
+class RollbackPolicy:
+    """SLO thresholds for automatic canary demotion.
+
+    ``max_breaker_opens`` / ``max_nonfinite_rows`` are hard limits (a
+    single excess trips regardless of traffic volume); the latency
+    ratio, drift, and failure-ratio limits wait for ``min_canary_rows``
+    canary rows.  Any limit set to ``None`` disables that signal.
+    """
+
+    min_canary_rows: int = 64
+    max_breaker_opens: Optional[int] = 0
+    max_nonfinite_rows: Optional[int] = 0
+    max_latency_ratio: Optional[float] = 3.0
+    max_drift_js: Optional[float] = 0.25
+    max_failed_ratio: Optional[float] = 0.2
+
+    def evaluate(self, stable_snap: dict,
+                 canary_snap: dict) -> RollbackDecision:
+        """Compare live canary signals against stable; breaches become
+        ``reasons`` entries of ``{signal, value, threshold}``."""
+        reasons: list[dict] = []
+        c_breaker = canary_snap.get("breaker", {})
+        if (self.max_breaker_opens is not None
+                and c_breaker.get("opens", 0) > self.max_breaker_opens):
+            reasons.append({
+                "signal": "breaker_opens",
+                "value": c_breaker.get("opens", 0),
+                "threshold": self.max_breaker_opens,
+            })
+        if (self.max_nonfinite_rows is not None
+                and c_breaker.get("rows_nonfinite", 0)
+                > self.max_nonfinite_rows):
+            reasons.append({
+                "signal": "nonfinite_rows",
+                "value": c_breaker.get("rows_nonfinite", 0),
+                "threshold": self.max_nonfinite_rows,
+            })
+        c_rows = (canary_snap.get("rows_scored", 0)
+                  + canary_snap.get("rows_failed", 0))
+        if c_rows >= self.min_canary_rows:
+            s_p99 = (stable_snap.get("latency_ms") or {}).get("p99")
+            c_p99 = (canary_snap.get("latency_ms") or {}).get("p99")
+            if (self.max_latency_ratio is not None
+                    and s_p99 and c_p99 and s_p99 > 0
+                    and c_p99 / s_p99 > self.max_latency_ratio):
+                reasons.append({
+                    "signal": "p99_latency_ratio",
+                    "value": round(c_p99 / s_p99, 3),
+                    "threshold": self.max_latency_ratio,
+                })
+            drift = canary_snap.get(
+                "data_contract", {}).get("drift_js_max", 0.0)
+            if (self.max_drift_js is not None and drift is not None
+                    and drift > self.max_drift_js):
+                reasons.append({
+                    "signal": "drift_js_max",
+                    "value": drift,
+                    "threshold": self.max_drift_js,
+                })
+            if (self.max_failed_ratio is not None
+                    and canary_snap.get("rows_failed", 0) / c_rows
+                    > self.max_failed_ratio):
+                reasons.append({
+                    "signal": "failed_ratio",
+                    "value": round(
+                        canary_snap.get("rows_failed", 0) / c_rows, 4),
+                    "threshold": self.max_failed_ratio,
+                })
+        return RollbackDecision(
+            rollback=bool(reasons),
+            reasons=reasons,
+            evidence={
+                "stable": _evidence_subset(stable_snap),
+                "canary": _evidence_subset(canary_snap),
+            },
+        )

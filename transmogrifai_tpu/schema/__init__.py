@@ -22,7 +22,13 @@ input pipelines own their error policies and telemetry).  Three pieces:
   batch-by-batch (the monoid the reference reduces over partitions),
   scored against the training contract by JS divergence.
 """
-from .contract import FeatureSpec, SchemaContract, SchemaDriftError
+from .contract import (
+    FeatureSpec,
+    SchemaContract,
+    SchemaDriftError,
+    apply_drift_policy,
+    collect_violations,
+)
 from .drift import DriftMonitor
 from .quarantine import (
     ERROR_MODES,
@@ -45,7 +51,9 @@ __all__ = [
     "QuarantinedRow",
     "SchemaContract",
     "SchemaDriftError",
+    "apply_drift_policy",
     "check_errors_mode",
+    "collect_violations",
     "data_telemetry",
     "reset_data_telemetry",
 ]

@@ -86,6 +86,38 @@ def log_violations_once(violations: Sequence[dict], warned: set,
         )
 
 
+def collect_violations(contract, records: Sequence[Mapping[str, Any]],
+                       extra_violations: Sequence[dict] = ()) -> list[dict]:
+    """THE batch-vs-contract check every serve surface shares (serving
+    endpoint, local scorer, registry deployment controller): one
+    implementation so registry-driven swaps can never diverge between
+    surfaces.  ``extra_violations`` carries caller-injected entries
+    (e.g. the ``serving.schema_drift`` fault point); a None contract or
+    empty batch validates vacuously."""
+    violations = list(extra_violations)
+    if contract is not None and records:
+        violations.extend(contract.validate_records(records))
+    return violations
+
+
+def apply_drift_policy(violations: Sequence[dict], policy: str,
+                       warned: set, logger, context: str) -> bool:
+    """The policy dispatch shared by the same surfaces: raises
+    :class:`SchemaDriftError` under ``policy='raise'``, warns once per
+    distinct violation under ``'warn'``, and returns True exactly when
+    the caller must SHED the batch (``policy='shed'`` with violations).
+    Telemetry accounting stays with the caller — it happens BEFORE this
+    call so a raised error is still counted."""
+    if not violations:
+        return False
+    if policy == "raise":
+        raise SchemaDriftError(violations)
+    if policy == "warn":
+        log_violations_once(violations, warned, logger, context)
+        return False
+    return policy == "shed"
+
+
 @dataclass
 class FeatureSpec:
     """One raw feature's contracted shape."""

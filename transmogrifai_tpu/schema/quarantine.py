@@ -180,6 +180,11 @@ class DataTelemetry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.started_at = time.time()
+        # model-version attribution (registry/): the ServingTelemetry-
+        # shared pair, so data-plane metrics in bench JSON and
+        # summary_json() name the model version they fed
+        self.model_version: Optional[str] = None
+        self.generation: Optional[int] = None
         self.rows_read = 0
         self.rows_kept = 0
         self.rows_quarantined = 0
@@ -222,12 +227,22 @@ class DataTelemetry:
         with self._lock:
             self.strict_errors += 1
 
+    def set_model_version(self, version: Optional[str],
+                          generation: Optional[int] = None) -> None:
+        """Attribute subsequent ingest metrics to one model version /
+        deployment generation (the ServingTelemetry contract)."""
+        with self._lock:
+            self.model_version = version
+            self.generation = generation
+
     # -- reporting ----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
             wall = max(time.time() - self.started_at, 1e-9)
             return {
                 "wall_s": round(wall, 3),
+                "model_version": self.model_version,
+                "generation": self.generation,
                 "reads": self.reads,
                 "rows_read": self.rows_read,
                 "rows_kept": self.rows_kept,

@@ -44,7 +44,11 @@ from typing import Any, Mapping, Optional, Sequence
 
 from ..faults import injection as _faults
 from ..local.scorer import LocalScorer
-from ..schema.contract import SchemaDriftError, log_violations_once
+from ..schema.contract import (
+    SchemaDriftError,
+    apply_drift_policy,
+    collect_violations,
+)
 from ..schema.drift import DriftMonitor
 from .admission import CircuitBreaker
 from .telemetry import ServingTelemetry
@@ -205,25 +209,27 @@ class CompiledEndpoint:
         mixing untrusted clients behind one scheduler should prefer
         ``drift_policy="warn"`` (violations counted + logged, rows
         still served) or segregate clients per endpoint."""
-        violations: list[dict] = []
+        extra = ()
         if _faults.fires("serving.schema_drift") is not None:
-            violations.append({
+            extra = ({
                 "kind": "injected",
                 "feature": "<injected>",
                 "detail": "serving.schema_drift fault armed",
-            })
-        if self.contract is not None:
-            violations.extend(self.contract.validate_records(records))
+            },)
+        # the validate + policy dispatch is the ONE shared implementation
+        # in schema/contract.py (the local scorer runs the same code, so
+        # the two serve surfaces cannot diverge); only the telemetry +
+        # shed-marker mechanics are endpoint-specific
+        violations = collect_violations(self.contract, records, extra)
         if not violations:
             return None
         self.telemetry.record_schema_violations(
             violations, self.drift_policy
         )
-        if self.drift_policy == "raise":
-            raise SchemaDriftError(violations)
-        if self.drift_policy == "warn":
-            log_violations_once(violations, self._warned_violations, log,
-                                "endpoint serving anyway")
+        shed = apply_drift_policy(violations, self.drift_policy,
+                                  self._warned_violations, log,
+                                  "endpoint serving anyway")
+        if not shed:
             return None
         # shed: refuse the batch unscored, loudly and cheaply - the
         # endpoint stays healthy for conformant traffic

@@ -42,6 +42,13 @@ class ServingTelemetry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.started_at = time.time()
+        # model-version attribution (registry/): every snapshot names
+        # the model version + deployment generation that produced it, so
+        # bench JSON and summary_json() metrics are attributable after a
+        # hot-swap (the Mesh/Data telemetry classes carry the same pair)
+        self.model_version: Optional[str] = None
+        self.generation: Optional[int] = None
+        self._lifecycle: list[dict] = []
         self._latencies_s: list[float] = []
         self._batch_sizes: list[int] = []
         self._batch_fills: list[float] = []
@@ -180,6 +187,28 @@ class ServingTelemetry:
         with self._lock:
             self.rows_shed_schema += int(n)
 
+    def set_model_version(self, version: Optional[str],
+                          generation: Optional[int] = None) -> None:
+        """Attribute everything this accumulator records to one model
+        version / deployment generation (set by the registry's
+        DeploymentController at deploy time)."""
+        with self._lock:
+            self.model_version = version
+            self.generation = generation
+
+    #: lifecycle events kept per accumulator (bounded like samples)
+    _MAX_LIFECYCLE = 256
+
+    def record_lifecycle(self, event: dict) -> None:
+        """A deployment lifecycle event (swap / canary start / rollback
+        decision with evidence) attributed to this generation; surfaced
+        in the snapshot so the serving JSON artifact carries the WHY
+        behind any metric discontinuity."""
+        with self._lock:
+            self._lifecycle.append(dict(event))
+            if len(self._lifecycle) > self._MAX_LIFECYCLE:
+                del self._lifecycle[0]
+
     def record_drift_scores(self, scores: dict) -> None:
         """Latest per-feature JS divergence vs the training
         distributions; running max kept per feature."""
@@ -211,6 +240,9 @@ class ServingTelemetry:
                     fill_hist["75-100%"] += 1
             return {
                 "wall_s": round(wall, 3),
+                "model_version": self.model_version,
+                "generation": self.generation,
+                "lifecycle": [dict(e) for e in self._lifecycle],
                 "rows_scored": self.rows_ok,
                 "rows_failed": self.rows_failed,
                 "rows_fallback": self.rows_fallback,

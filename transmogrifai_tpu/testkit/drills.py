@@ -139,6 +139,31 @@ os._exit(0)
 """
 
 
+#: child for the registry publish-crash drills (tests/test_registry.py,
+#: tests/test_chaos_composition.py, ``bench.py --registry``): train the
+#: tiny pipeline, publish + promote a clean v1 into the registry at
+#: ``root``, arm ``fault`` (e.g. "registry.publish_crash:on=1"), publish
+#: again and die in the window between the artifact save and the index
+#: commit.  Exits 0 only if the kill failed to fire; the parent asserts
+#: the registry is still loadable at v1.
+REGISTRY_CRASH_PUBLISHER_TEMPLATE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+from transmogrifai_tpu.registry import ModelRegistry
+wf, _data, _records, _name = tiny_drill_pipeline()
+model = wf.train()
+reg = ModelRegistry({root!r})
+v1 = reg.publish(model, metrics={{"auroc": 0.9}})
+reg.promote(v1.version, to="stable")
+from transmogrifai_tpu.faults import injection
+injection.configure({fault!r})            # arm the crash
+reg.publish(model)                        # dies at the injected point
+os._exit(0)                               # unreachable when armed
+"""
+
+
 #: child script for the kill-during-save drills: train the tiny pipeline,
 #: save a clean v1, arm ``fault`` (e.g. "io.save_model.crash_window:on=1"),
 #: save again and die at the injected point.  Format with repo / path /

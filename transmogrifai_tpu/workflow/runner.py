@@ -14,6 +14,14 @@ OpWorkflowRunner.scala:296-365, OpApp.scala:49-209): run types
                    (serving/), pump the reader's rows through the
                    micro-batching scheduler as requests, export the
                    latency/throughput telemetry JSON
+* deploy         - registry-driven serving (registry/): publish the
+                   model_location artifact into a versioned registry
+                   when asked, hot-swap the stable version live through
+                   a DeploymentController, optionally canary a second
+                   version on a deterministic traffic split with
+                   signal-driven automatic rollback, and export the
+                   deployment summary (generations, lifecycle events,
+                   rollback evidence) as JSON
 
 plus a CLI (``python -m transmogrifai_tpu.workflow.runner --run-type ...``)
 standing in for OpApp.main's scopt parsing.
@@ -49,11 +57,23 @@ class OpWorkflowRunner:
         evaluator=None,
         train_reader=None,
         score_reader=None,
+        workflow_factory=None,
     ) -> None:
         self.workflow = workflow
         self.evaluator = evaluator
         self.train_reader = train_reader
         self.score_reader = score_reader
+        # zero-arg builder returning a FRESH workflow (or the main()
+        # factory's tuple): model loads apply blacklist surgery to their
+        # target, so loading TWO versions (deploy run: stable + canary)
+        # needs a fresh build per load whenever their blacklists differ
+        self.workflow_factory = workflow_factory
+
+    def _fresh_workflow(self) -> OpWorkflow:
+        if self.workflow_factory is None:
+            return self.workflow
+        built = self.workflow_factory()
+        return built[0] if isinstance(built, tuple) else built
 
     def run(self, run_type: str, params: Optional[OpParams] = None) -> OpWorkflowRunnerResult:
         params = params or OpParams()
@@ -73,6 +93,8 @@ class OpWorkflowRunner:
             result = self._evaluate(params)
         elif run_type == "serve":
             result = self._serve(params)
+        elif run_type == "deploy":
+            result = self._deploy(params)
         else:
             raise ValueError(f"unknown run type {run_type!r}")
         result.wall_s = time.time() - t0
@@ -218,6 +240,138 @@ class OpWorkflowRunner:
             run_type="serve", model=model, metrics=metrics
         )
 
+    def _deploy(self, params: OpParams) -> OpWorkflowRunnerResult:
+        """Registry-driven deployment run.  Knobs ride
+        OpParams.custom_params: ``registry_root`` (required),
+        ``registry_publish`` (publish the model_location artifact as a
+        new version; default: only when the registry has no stable yet),
+        ``deploy_version`` (default: the registry's stable),
+        ``canary_version`` + ``canary_fraction`` + ``canary_shadow``,
+        ``canary_check_every_batches``, ``rollback_*`` (RollbackPolicy
+        fields, e.g. ``rollback_max_latency_ratio``), plus the serve
+        knobs ``serving_buckets`` / ``serving_drift_policy``.  The
+        deployment summary (generations + telemetry + lifecycle events
+        with rollback evidence) exports to
+        ``<metrics_location>/deploy_metrics.json``.  A canary still
+        live when the run ends is RELEASED in the registry (back to
+        candidate, undecided) so the slot never points at a version no
+        process is serving.  Each registry load gets a fresh workflow
+        from ``workflow_factory`` when the runner has one — required
+        whenever the stable and canary versions carry different
+        blacklists."""
+        from ..registry import (
+            DeploymentController,
+            ModelRegistry,
+            RollbackPolicy,
+        )
+        from ..serving import RowScoringError, records_from_dataset
+
+        cp = params.custom_params
+        root = cp.get("registry_root")
+        if not root:
+            raise ValueError(
+                "deploy run requires custom_params['registry_root']"
+            )
+        registry = ModelRegistry(root)
+        published = None
+        if params.model_location and cp.get(
+                "registry_publish", registry.stable is None):
+            model = self._load_model(params)
+            published = registry.publish(
+                model, metrics=dict(cp.get("registry_metrics", {}))
+            )
+            if registry.stable is None:
+                registry.promote(published.version, to="stable")
+        stable_version = cp.get("deploy_version") or registry.stable
+        if stable_version is None:
+            raise ValueError(
+                "deploy run: the registry has no stable version to "
+                "deploy (publish one via model_location + "
+                "registry_publish, or promote one first)"
+            )
+        policy_kw = {
+            k[len("rollback_"):]: v
+            for k, v in cp.items() if k.startswith("rollback_")
+        }
+        controller = DeploymentController(
+            registry=registry,
+            policy=RollbackPolicy(**policy_kw) if policy_kw else None,
+            canary_fraction=float(cp.get("canary_fraction", 0.05)),
+            shadow=bool(cp.get("canary_shadow", False)),
+            check_every_batches=int(
+                cp.get("canary_check_every_batches", 8)),
+            batch_buckets=tuple(cp.get("serving_buckets", (1, 8, 32, 128))),
+            drift_policy=str(cp.get("serving_drift_policy", "warn")),
+        )
+        controller.deploy_version(stable_version, self._fresh_workflow())
+        if cp.get("canary_version"):
+            controller.start_canary_version(
+                str(cp["canary_version"]), self._fresh_workflow()
+            )
+        stable_gen = controller.stable_generation
+        # serve-side ingest attribution: rows read for this deploy count
+        # against the model version they feed (the shared telemetry
+        # model_version/generation pair)
+        from ..schema.quarantine import data_telemetry
+
+        data_telemetry().set_model_version(stable_version,
+                                           generation=stable_gen.generation)
+        raw_features = stable_gen.endpoint.raw_features
+        reader = self._reader("score")
+        if reader is not None:
+            raw = reader.generate_dataset(raw_features,
+                                          params.reader_params)
+        else:
+            raw = self.workflow.generate_raw_data()
+        records = records_from_dataset(raw, raw_features)
+        step = max(int(cp.get("deploy_batch_rows", 128)), 1)
+        results: list = []
+        for lo in range(0, len(records), step):
+            results.extend(controller.score_batch(records[lo:lo + step]))
+        final_check = controller.check_canary()
+        # an undecided canary must not keep the registry slot after this
+        # serving process exits: a later run's canary would otherwise
+        # serve untracked while operator rollback targeted the stale one
+        canary_released = None
+        if controller.canary_generation is not None:
+            canary_released = registry.release_canary(
+                reason="deploy run ended with the canary undecided"
+            )
+        extra = {
+            "run_type": "deploy",
+            "registry_root": registry.root,
+            "rows_submitted": len(records),
+            "rows_failed": sum(
+                isinstance(r, RowScoringError) for r in results),
+            "published_version":
+                published.version if published else None,
+            "deployed_version": stable_version,
+            "canary_version": cp.get("canary_version"),
+            "final_decision":
+                final_check.to_json() if final_check else None,
+            "canary_released": canary_released,
+        }
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            metrics = controller.export(
+                os.path.join(params.metrics_location,
+                             "deploy_metrics.json"),
+                extra=extra,
+            )
+        else:
+            metrics = dict(controller.summary_json(), **extra)
+        if params.write_location:
+            os.makedirs(params.write_location, exist_ok=True)
+            rows = [
+                {"error": r.error} if isinstance(r, RowScoringError) else r
+                for r in results
+            ]
+            with open(
+                os.path.join(params.write_location, "scores.json"), "w"
+            ) as f:
+                json.dump(rows, f, default=str)
+        return OpWorkflowRunnerResult(run_type="deploy", metrics=metrics)
+
     # ------------------------------------------------------------------
     def streaming_score(
         self,
@@ -275,7 +429,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="transmogrifai_tpu workflow runner")
     p.add_argument("--run-type", required=True,
                    choices=["train", "score", "features", "evaluate",
-                            "serve"])
+                            "serve", "deploy"])
     p.add_argument("--params", help="path to OpParams JSON")
     p.add_argument("--workflow", required=True,
                    help="module:function returning (workflow, evaluator, readers...)")
@@ -287,7 +441,8 @@ def main(argv=None) -> int:
     built = factory()
     wf = built[0] if isinstance(built, tuple) else built
     evaluator = built[1] if isinstance(built, tuple) and len(built) > 1 else None
-    runner = OpWorkflowRunner(wf, evaluator=evaluator)
+    runner = OpWorkflowRunner(wf, evaluator=evaluator,
+                              workflow_factory=factory)
     params = OpParams.from_file(args.params) if args.params else OpParams()
     result = runner.run(args.run_type, params)
     print(json.dumps({"run_type": result.run_type, "wall_s": result.wall_s}))
