@@ -270,3 +270,33 @@ def test_library_modules_do_not_print():
             ):
                 offenders.append(f"{p}:{node.lineno}")
     assert not offenders, offenders
+
+
+def test_fused_module_stays_columnar():
+    """The fused serving program (local/fused.py) must stay columnar end
+    to end (ISSUE 6): no ``for``/``while`` statement loops anywhere in
+    the module (single-pass boundary comprehensions at decode/assembly
+    are the ONLY per-record python allowed), and no Column round trips -
+    ``to_list()`` / ``column_from_list`` / ``with_column`` would rebuild
+    exactly the per-stage boxing the compiler exists to remove."""
+    fused = ROOT / "local" / "fused.py"
+    src = fused.read_text(encoding="utf-8")
+    tree = ast.parse(src)
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            offenders.append(
+                f"{fused}:{node.lineno} statement loop "
+                f"({type(node).__name__})"
+            )
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr in ("to_list", "with_column")
+        ):
+            offenders.append(f"{fused}:{node.lineno} .{node.attr}")
+        elif (
+            isinstance(node, ast.Name)
+            and node.id == "column_from_list"
+        ):
+            offenders.append(f"{fused}:{node.lineno} column_from_list")
+    assert not offenders, offenders

@@ -1,4 +1,19 @@
 """Engine-free local scoring (reference ``local`` module analog)."""
+from .fused import (
+    FusedPipeline,
+    FusionError,
+    PipelineCompiler,
+    RecordDecoder,
+    compile_pipeline,
+)
 from .scorer import LocalScorer, score_function
 
-__all__ = ["LocalScorer", "score_function"]
+__all__ = [
+    "FusedPipeline",
+    "FusionError",
+    "LocalScorer",
+    "PipelineCompiler",
+    "RecordDecoder",
+    "compile_pipeline",
+    "score_function",
+]

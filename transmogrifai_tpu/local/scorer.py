@@ -24,9 +24,14 @@ import logging
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
 from ..models.base import PredictorModel
+# module-level: the row path validates EVERY scored batch - importing
+# inside _validate put one import-machinery hit on every call
+from ..schema.contract import apply_drift_policy, collect_violations
 from ..types.columns import column_from_list
 from ..types.dataset import Dataset
 from ..workflow.workflow import OpWorkflowModel
+from .fused import DECODABLE_KINDS, FusionError, RecordDecoder, \
+    compile_pipeline
 
 log = logging.getLogger("transmogrifai_tpu.local")
 
@@ -44,7 +49,8 @@ class LocalScorer:
 
     def __init__(self, model: OpWorkflowModel,
                  contract=None,
-                 drift_policy: Optional[str] = "warn") -> None:
+                 drift_policy: Optional[str] = "warn",
+                 fused: bool = True) -> None:
         self.raw_features = tuple(
             f for f in model.raw_features
             if not any(f.name == b.name for b in model.blacklisted_features)
@@ -92,6 +98,45 @@ class LocalScorer:
                     (stage, [f.name for f in stage.input_features],
                      stage.output_name)
                 )
+        # ONE decoder for both serve paths: raw record dicts -> dense
+        # arrays (fused) or Columns (interpreted), no per-element
+        # column_from_list loop on the hot path.  Features the decoder
+        # cannot handle fall back to column_from_list per batch.
+        self._decoder = RecordDecoder(
+            [f for f in self.raw_features
+             if f.ftype.kind in DECODABLE_KINDS]
+        )
+        self._slow_features = tuple(
+            f for f in self.raw_features
+            if f.ftype.kind not in DECODABLE_KINDS
+        )
+        # whole-pipeline fused compilation (ROADMAP item 1, local/
+        # fused.py): when every fitted stage lowers, batches score
+        # through ONE array program; otherwise the pipeline serves
+        # interpreted for its whole life (per-pipeline choice, recorded
+        # in fused_reason and surfaced by serving telemetry)
+        self.fused = None
+        self.fused_reason: Optional[str] = (
+            None if fused else "disabled by caller"
+        )
+        if fused:
+            try:
+                self.fused = compile_pipeline(
+                    self._steps, self.raw_features, self.result_features
+                )
+            except FusionError as e:
+                self.fused_reason = str(e)
+                log.info("pipeline not fusable, serving interpreted: %s", e)
+            except Exception as e:  # noqa: BLE001 - degrade, don't die
+                # lower() is an open extension seam: a buggy third-party
+                # lowering must cost the fused path, not the endpoint
+                self.fused_reason = (
+                    f"lowering raised {type(e).__name__}: {e}"
+                )
+                log.warning(
+                    "pipeline fusion failed, serving interpreted: %s",
+                    self.fused_reason,
+                )
 
     # -- contract validation -------------------------------------------------
     def _validate(self, records: Sequence[Mapping[str, Any]]) -> None:
@@ -100,8 +145,6 @@ class LocalScorer:
         # the validate + policy dispatch shared with the serving endpoint
         # (schema/contract.py): one implementation, so a registry-driven
         # swap cannot behave differently across the two serve surfaces
-        from ..schema.contract import apply_drift_policy, collect_violations
-
         violations = collect_violations(self.contract, records)
         apply_drift_policy(violations, self.drift_policy,
                            self._warned_violations, log,
@@ -118,12 +161,17 @@ class LocalScorer:
         if not records:
             return []
         self._validate(records)
-        cols = {
+        if self.fused is not None:
+            # the whole-pipeline compiled path: decode -> one fused
+            # array program per shape bucket -> result dicts
+            return self.fused.score_batch(records)
+        cols = self._decoder.decode_columns(records)
+        cols.update({
             f.name: column_from_list(
                 [r.get(f.name) for r in records], f.ftype
             )
-            for f in self.raw_features
-        }
+            for f in self._slow_features
+        })
         # mutate the scorer-owned Dataset in place: the functional
         # with_column path re-validates and copies the whole column dict
         # per stage (~16 Dataset builds per scored row), half the serving
@@ -137,7 +185,7 @@ class LocalScorer:
             )
         names = [f.name for f in self.result_features if f.name in out]
         n = len(records)
-        lists = {}
+        lists = []
         for name in names:
             vals = out[name].to_list()
             if len(vals) != n:  # the validate=False escape hatch's guard
@@ -145,10 +193,12 @@ class LocalScorer:
                     f"result column {name!r} has {len(vals)} rows for "
                     f"{n} scored records"
                 )
-            lists[name] = vals
-        return [
-            {name: lists[name][i] for name in names} for i in range(n)
-        ]
+            lists.append(vals)
+        if not names:
+            return [{} for _ in records]
+        # one columnar pass: zip the result columns into row dicts
+        # instead of the per-row x per-name double comprehension
+        return [dict(zip(names, row)) for row in zip(*lists)]
 
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
         return self.score_batch([record])[0]

@@ -22,7 +22,13 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
-from ..stages.base import Estimator, Transformer
+from ..stages.base import (
+    PROB_SUFFIX,
+    RAW_SUFFIX,
+    Estimator,
+    Lowering,
+    Transformer,
+)
 from ..types.columns import Column, NumericColumn, PredictionColumn, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import OPVector, Prediction, RealNN
@@ -76,6 +82,59 @@ class PredictorModel(Transformer):
     def feature_contributions(self) -> Optional[np.ndarray]:
         return self.estimator_ref.contributions(self.model_params)
 
+    def lower(self) -> Optional[Lowering]:
+        """Compile the fitted head to one closed-over array call through
+        the estimator family's pure-numpy predict path.  Gated on the
+        family's ``lowerable`` opt-in: a head whose predict dispatches
+        to device state or is otherwise impure must stay interpreted."""
+        est = self.estimator_ref
+        if not getattr(est, "lowerable", False):
+            return None
+        vec_name = self.input_features[-1].name
+        out = self.output_name
+        params = self.model_params
+        # the interpreted path feeds predict float64; families whose
+        # kernel is float32-exact (trees: the first predict step is a
+        # float32 binning, and f32->f64->f32 is the identity) skip the
+        # float64 round trip without changing a single output bit
+        in_dtype = (
+            np.float32 if getattr(est, "predict_f32_exact", False)
+            else np.float64
+        )
+
+        def fn(env: dict) -> dict:
+            pred, raw, prob = est.predict_arrays_np(
+                params, np.asarray(env[vec_name], dtype=in_dtype)
+            )
+            # PredictionColumn's canonical shapes: pred flat float64,
+            # raw/prob [n, k] float64
+            res = {out: np.asarray(pred, dtype=np.float64).reshape(-1)}
+            if raw is not None:
+                raw = np.asarray(raw, dtype=np.float64)
+                res[out + RAW_SUFFIX] = (
+                    raw[:, None] if raw.ndim == 1 else raw
+                )
+            if prob is not None:
+                prob = np.asarray(prob, dtype=np.float64)
+                res[out + PROB_SUFFIX] = (
+                    prob[:, None] if prob.ndim == 1 else prob
+                )
+            return res
+
+        # raw/prob presence is fixed by the fitted family, not the batch,
+        # but it is not knowable here without running predict - so only
+        # the guaranteed key is DECLARED.  A future stage consuming
+        # out@raw/out@prob therefore fails with a compile-time
+        # FusionError (interpreted fallback, correct results) instead of
+        # compiling cleanly and KeyError-ing on every serve-time batch;
+        # the result assembler reads the suffixed keys tolerantly via
+        # env.get, so emitting undeclared keys is fine.
+        return Lowering(
+            fn=fn, inputs=(vec_name,),
+            outputs=(out,),
+            signature={out: "float64[n]"},
+        )
+
 
 class PredictorEstimator(Estimator):
     """Base estimator over (label, features)."""
@@ -83,6 +142,10 @@ class PredictorEstimator(Estimator):
     input_types = [RealNN, OPVector]
     output_type = Prediction
     model_type: str = "Predictor"
+    #: opt-in to whole-pipeline fused compilation (local/fused.py): True
+    #: promises ``predict_arrays_np`` is a pure host-side function of
+    #: (params, X) safe to close over in a per-shape-bucket program
+    lowerable: bool = False
     # Whether fit_arrays_batched's kernel assumes y in {0,1} (sigmoid/hinge
     # losses).  Classifiers keep the conservative True so multiclass labels
     # fall back to the per-candidate OVR route; regressors override to False

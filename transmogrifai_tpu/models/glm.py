@@ -177,6 +177,8 @@ def _glm_fit_folds_kernel(X, y, W, reg, family: str, iters: int,
 
 
 class OpGeneralizedLinearRegression(PredictorEstimator):
+    #: fused serving seam: predict_arrays (numpy link fn) is pure host-side
+    lowerable = True
     model_type = "OpGeneralizedLinearRegression"
 
     def __init__(

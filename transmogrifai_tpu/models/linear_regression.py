@@ -83,6 +83,9 @@ class OpLinearRegression(PredictorEstimator):
     """(reference: OpLinearRegression.scala; grid: regParam
     {0.001,0.01,0.1,0.2}, elasticNet {0.1,0.5})"""
 
+    #: fused serving seam: predict_arrays_np is pure numpy over host betas
+    lowerable = True
+
     model_type = "OpLinearRegression"
     batched_needs_binary_y = False  # squared loss: any real y batches fine
 

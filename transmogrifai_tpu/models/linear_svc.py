@@ -98,6 +98,8 @@ def _svc_fit_batched(X, y, W, regs, iters: int):
 
 
 class OpLinearSVC(PredictorEstimator):
+    #: fused serving seam: predict_arrays (numpy margin) is pure host-side
+    lowerable = True
     model_type = "OpLinearSVC"
 
     def __init__(self, reg_param: float = 0.0, max_iter: int = 20, **kw) -> None:

@@ -330,6 +330,9 @@ class OpLogisticRegression(PredictorEstimator):
     DefaultSelectorParams.scala:36-61 - regParam {0.001,0.01,0.1,0.2},
     elasticNet {0.1,0.5})"""
 
+    #: fused serving seam: predict_arrays_np is pure numpy over host betas
+    lowerable = True
+
     model_type = "OpLogisticRegression"
 
     def __init__(

@@ -103,6 +103,9 @@ class OpMultilayerPerceptronClassifier(PredictorEstimator):
     """(reference defaults: layers from input->hidden(s)->classes, maxIter
     100; our hidden default mirrors the reference grids' [10,10])"""
 
+    #: fused serving seam: predict_arrays_np is a pure numpy forward pass
+    lowerable = True
+
     model_type = "OpMultilayerPerceptronClassifier"
 
     def __init__(

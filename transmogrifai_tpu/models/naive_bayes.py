@@ -50,6 +50,8 @@ def _nb_predict_kernel(X, theta, prior):
 
 
 class OpNaiveBayes(PredictorEstimator):
+    #: fused serving seam: predict_arrays_np is pure numpy over host params
+    lowerable = True
     model_type = "OpNaiveBayes"
 
     def __init__(self, smoothing: float = 1.0, **kw) -> None:

@@ -205,6 +205,11 @@ def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:
 
 class _TreeEnsembleBase(PredictorEstimator):
     is_classification = True
+    # fused serving (local/fused.py): predict_arrays_np is ONE flat-heap
+    # native/numpy batch call over host params - pure and closable; the
+    # f32 binning front end makes a float32 feed bit-identical
+    lowerable = True
+    predict_f32_exact = True
 
     def __init__(
         self,

@@ -11,11 +11,12 @@ from __future__ import annotations
 
 from collections import Counter
 from functools import lru_cache
+from itertools import repeat
 from typing import Optional, Sequence
 
 import numpy as np
 
-from ..stages.base import Estimator, Transformer
+from ..stages.base import MASK_SUFFIX, Estimator, Lowering, Transformer
 from ..types.columns import Column, ListColumn, NumericColumn, TextColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import (
@@ -95,65 +96,99 @@ class OneHotModel(SequenceVectorizerModel):
         mask = np.array([v is not None for v in vals], dtype=bool)
         return vals, mask
 
+    def _text_codes(self, i: int, values) -> np.ndarray:
+        """Raw text value -> column code (label index, OTHER, or -1 for
+        missing) with the per-feature memo.  The single-value pivot hot
+        path (batch-scoring profile top line) shared verbatim between the
+        interpreted blocks_for and the lowered (fused) block, so both
+        serve from ONE memo."""
+        labels = self.labels_per_feature[i]
+        other_j = len(labels)
+        memos = getattr(self, "_code_memos", None)
+        if memos is None:
+            memos = self._code_memos = {}
+        key = (tuple(labels), self.clean_text)
+        hit = memos.get(i)
+        if hit is None or hit[0] != key:
+            # label->index built once per memo generation, not per batch:
+            # only code_slow's first sightings need it
+            memos[i] = hit = (
+                key, {}, {v: j for j, v in enumerate(labels)},
+            )
+        memo, idx = hit[1], hit[2]
+        if len(memo) > 65536:
+            # same bound as _clean_cached: a high-cardinality text
+            # feature must not grow the memo without limit in a
+            # long-lived scoring process
+            memo.clear()
+        # missing IS a code: seeding the memo with None -> -1 lets the
+        # whole batch encode through one C-level two-arg map
+        memo.setdefault(None, -1)
+
+        def code_slow(x):
+            """First sighting of a value (or an unhashable oddity):
+            clean + label lookup, memoized when possible."""
+            if x is None:
+                return -1
+            try:
+                hashable = True
+                hash(x)
+            except TypeError:
+                hashable = False
+            j = idx.get(_clean_value(x, self.clean_text))
+            c = other_j if j is None else j
+            if hashable:
+                memo[x] = c
+            return c
+
+        _MISS = -2
+        try:
+            # steady state: ONE map(dict.get) call over the batch (the
+            # C fast path); only first sightings take code_slow
+            codes = np.array(
+                list(map(memo.get, values, repeat(_MISS))),
+                dtype=np.int64,
+            )
+        except TypeError:
+            # an unhashable oddity in the batch: per-value tolerant pass
+            return np.array(
+                [code_slow(x) for x in values], dtype=np.int64,
+            )
+        miss = np.flatnonzero(codes == _MISS)
+        if miss.size:
+            codes[miss] = [code_slow(values[i]) for i in miss]
+        return codes
+
+    def _scatter_sets(self, vals, arr: np.ndarray, labels) -> None:
+        """Indicator scatter for per-row value-sets (multi-value pivot)."""
+        idx = {v: j for j, v in enumerate(labels)}
+        other_j = len(labels)
+        for r, vset in enumerate(vals):
+            if vset is None:
+                continue
+            hit_other = False
+            for v in vset:
+                j = idx.get(v)
+                if j is not None:
+                    arr[r, j] = 1.0
+                else:
+                    hit_other = True
+            if hit_other:
+                arr[r, other_j] = 1.0
+
     def blocks_for(self, col: Column, i: int):
         feat = self.input_features[i]
         labels = self.labels_per_feature[i]
         n = len(col)
         width = len(labels) + 1 + (1 if self.track_nulls else 0)
         arr = np.zeros((n, width), dtype=np.float64)
-        other_j = len(labels)
         if isinstance(col, TextColumn):
-            # single-value pivot hot path (batch-scoring profile top
-            # line): memoize raw value -> column code per feature, so
-            # repeat values skip cleaning AND the label lookup; the
-            # scatter is one fancy-indexed write
-            memos = getattr(self, "_code_memos", None)
-            if memos is None:
-                memos = self._code_memos = {}
-            key = (tuple(labels), self.clean_text)
-            hit = memos.get(i)
-            if hit is None or hit[0] != key:
-                memos[i] = hit = (key, {})
-            memo = hit[1]
-            if len(memo) > 65536:
-                # same bound as _clean_cached: a high-cardinality text
-                # feature must not grow the memo without limit in a
-                # long-lived scoring process
-                memo.clear()
-            idx = {v: j for j, v in enumerate(labels)}
-            codes = np.empty(n, dtype=np.int64)
-            for r, x in enumerate(col.values):
-                if x is None:
-                    codes[r] = -1
-                    continue
-                try:
-                    c = memo.get(x)
-                    hashable = True
-                except TypeError:  # non-str oddity: clean uncached
-                    c, hashable = None, False
-                if c is None:
-                    j = idx.get(_clean_value(x, self.clean_text))
-                    c = other_j if j is None else j
-                    if hashable:
-                        memo[x] = c
-                codes[r] = c
+            codes = self._text_codes(i, col.values)
             present = codes >= 0
             arr[np.nonzero(present)[0], codes[present]] = 1.0
         else:
             vals, present = self._values_of(col)
-            idx = {v: j for j, v in enumerate(labels)}
-            for r, vset in enumerate(vals):
-                if vset is None:
-                    continue
-                hit_other = False
-                for v in vset:
-                    j = idx.get(v)
-                    if j is not None:
-                        arr[r, j] = 1.0
-                    else:
-                        hit_other = True
-                if hit_other:
-                    arr[r, other_j] = 1.0
+            self._scatter_sets(vals, arr, labels)
         def build():
             tname = feat.ftype.type_name()
             ms = [
@@ -193,6 +228,52 @@ class OneHotModel(SequenceVectorizerModel):
         if self.track_nulls:
             arr[:, -1] = (~present).astype(np.float64)
         return arr, metas
+
+    def lower_block(self, i: int):
+        feat = self.input_features[i]
+        kind = feat.ftype.kind
+        if kind not in ("text", "textlist", "multipicklist", "numeric"):
+            return None
+        name = feat.name
+        labels = self.labels_per_feature[i]
+        track_nulls, clean = self.track_nulls, self.clean_text
+        width = len(labels) + 1 + (1 if track_nulls else 0)
+
+        def block(env: dict) -> np.ndarray:
+            values = env[name]
+            n = len(values)
+            arr = np.zeros((n, width), dtype=np.float64)
+            if kind == "text":
+                codes = self._text_codes(i, values)
+                present = codes >= 0
+                arr[np.nonzero(present)[0], codes[present]] = 1.0
+            else:
+                # the multi-value / numeric pivot branches of _values_of
+                # over the lowered env representation (tuples/frozensets
+                # for lists, values+mask arrays for numerics)
+                if kind == "numeric":
+                    mask = env[name + MASK_SUFFIX]
+                    vals = [
+                        (str(int(v)) if float(v).is_integer()
+                         else str(float(v)),) if m else None
+                        for v, m in zip(values, mask)
+                    ]
+                    present = np.asarray(mask, dtype=bool)
+                else:
+                    vals = [
+                        tuple(_clean_value(x, clean) for x in v) if v
+                        else None
+                        for v in values
+                    ]
+                    present = np.array(
+                        [v is not None for v in vals], dtype=bool
+                    )
+                self._scatter_sets(vals, arr, labels)
+            if track_nulls:
+                arr[:, -1] = (~present).astype(np.float64)
+            return arr
+
+        return block
 
 
 class OneHotVectorizer(SequenceVectorizer):
@@ -243,20 +324,47 @@ class StringIndexerModel(Transformer):
         super().__init__(**kw)
         self.labels = list(labels)
 
+    def _encode(self, values) -> tuple:
+        """str-or-None values -> (vals float64 [n], mask bool [n]): the
+        ONE implementation of the NoFilter index semantics, shared by
+        the interpreted and lowered paths so they can never diverge.
+        UNSEEN strings get the reserved tail index; a MISSING value
+        stays missing (masked, canonical 0.0) - it must not silently
+        become a phantom class when the indexed feature is a training
+        label (the predictor fit gate rejects masked labels)."""
+        idx = getattr(self, "_idx_memo", None)
+        if idx is None:
+            idx = self._idx_memo = {
+                v: float(j) for j, v in enumerate(self.labels)
+            }
+        unseen = float(len(self.labels))
+        vals = np.array(
+            [0.0 if v is None else idx.get(v, unseen) for v in values]
+        )
+        mask = np.array([v is not None for v in values], dtype=bool)
+        return vals, mask
+
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         (col,) = cols
         assert isinstance(col, TextColumn)
-        idx = {v: float(j) for j, v in enumerate(self.labels)}
-        unseen = float(len(self.labels))
-        # UNSEEN strings get the reserved tail index (NoFilter scoring
-        # semantics); a MISSING value stays missing (masked) - it must not
-        # silently become a phantom class when the indexed feature is a
-        # training label (the predictor fit gate rejects masked labels)
-        vals = np.array(
-            [0.0 if v is None else idx.get(v, unseen) for v in col.values]
-        )
-        mask = np.array([v is not None for v in col.values], dtype=bool)
+        vals, mask = self._encode(col.values)
         return NumericColumn(vals, mask, RealNN)
+
+    def lower(self):
+        (feat,) = self.input_features
+        if feat.ftype.kind != "text":
+            return None
+        name, out = feat.name, self.output_name
+        encode = self._encode
+
+        def fn(env: dict) -> dict:
+            vals, mask = encode(env[name])
+            return {out: vals, out + MASK_SUFFIX: mask}
+
+        return Lowering(
+            fn=fn, inputs=(name,), outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
+        )
 
 
 class StringIndexer(Estimator):

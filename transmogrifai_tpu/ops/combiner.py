@@ -11,7 +11,7 @@ from typing import Callable, Sequence
 
 import numpy as np
 
-from ..stages.base import Transformer
+from ..stages.base import MASK_SUFFIX, Lowering, Transformer
 from ..types.columns import Column, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import OPVector
@@ -51,6 +51,20 @@ class VectorsCombiner(Transformer):
             self._combine_cache = (key, meta, metas)
         return VectorColumn(values, meta)
 
+    def lower(self):
+        if not self.input_features:
+            return None
+        names = tuple(f.name for f in self.input_features)
+        out = self.output_name
+
+        def fn(env: dict) -> dict:
+            return {out: np.concatenate([env[k] for k in names], axis=1)}
+
+        return Lowering(
+            fn=fn, inputs=names, outputs=(out,),
+            signature={out: "float32[n,d]"},
+        )
+
 
 class DropIndicesByTransformer(Transformer):
     """Drop vector dimensions whose metadata matches a predicate (reference:
@@ -87,6 +101,25 @@ class AliasTransformer(Transformer):
     def transform_columns(self, cols: Sequence[Column], ds: Dataset) -> Column:
         (c,) = cols
         return c
+
+    def lower(self):
+        (feat,) = self.input_features
+        kind = feat.ftype.kind
+        if kind not in ("numeric", "text", "vector"):
+            return None
+        name, out = feat.name, self.output_name
+        aux = (MASK_SUFFIX,) if kind == "numeric" else ()
+
+        def fn(env: dict) -> dict:
+            res = {out: env[name]}
+            res.update({out + s: env[name + s] for s in aux})
+            return res
+
+        return Lowering(
+            fn=fn, inputs=(name,) + tuple(name + s for s in aux),
+            outputs=(out,) + tuple(out + s for s in aux),
+            signature={out: "passthrough"},
+        )
 
     def set_input(self, *features):
         super().set_input(*features)

@@ -12,6 +12,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..stages.base import MASK_SUFFIX
 from ..types.columns import Column, NumericColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import Binary, Integral, OPNumeric, Real, RealNN
@@ -57,6 +58,21 @@ class NumericVectorizerModel(SequenceVectorizerModel):
             i, (feat.name, feat.ftype.type_name(), self.track_nulls), build
         )
         return np.stack(blocks, axis=1), metas
+
+    def lower_block(self, i: int):
+        name = self.input_features[i].name
+        fill = self.fill_values[i]
+        track_nulls = self.track_nulls
+
+        def block(env: dict) -> np.ndarray:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            filled = np.where(mask, vals, fill)
+            blocks = [filled]
+            if track_nulls:
+                blocks.append((~mask).astype(np.float64))
+            return np.stack(blocks, axis=1)
+
+        return block
 
 
 class RealVectorizer(SequenceVectorizer):

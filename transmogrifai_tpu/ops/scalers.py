@@ -11,7 +11,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..stages.base import Estimator, Transformer
+from ..stages.base import MASK_SUFFIX, Estimator, Lowering, Transformer
 from ..types.columns import Column, NumericColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import Real, RealNN
@@ -32,6 +32,23 @@ class _ScaleModel(Transformer):
         assert isinstance(c, NumericColumn)
         vals = (c.values - self.mean) / (self.std if self.std > 0 else 1.0)
         return NumericColumn(np.where(c.mask, vals, 0.0), c.mask, RealNN)
+
+    def lower(self):
+        (feat,) = self.input_features
+        name, out = feat.name, self.output_name
+        mean = self.mean
+        std = self.std if self.std > 0 else 1.0
+
+        def fn(env: dict) -> dict:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            return {out: np.where(mask, (vals - mean) / std, 0.0),
+                    out + MASK_SUFFIX: mask}
+
+        return Lowering(
+            fn=fn, inputs=(name, name + MASK_SUFFIX),
+            outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
+        )
 
 
 class OpScalarStandardScaler(Estimator):
@@ -68,6 +85,22 @@ class _FillMeanModel(Transformer):
         vals = np.where(c.mask, c.values, self.fill)
         return NumericColumn(vals, np.ones(len(c), dtype=bool), RealNN)
 
+    def lower(self):
+        (feat,) = self.input_features
+        name, out = feat.name, self.output_name
+        fill = self.fill
+
+        def fn(env: dict) -> dict:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            return {out: np.where(mask, vals, fill),
+                    out + MASK_SUFFIX: np.ones(len(vals), dtype=bool)}
+
+        return Lowering(
+            fn=fn, inputs=(name, name + MASK_SUFFIX),
+            outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
+        )
+
 
 class FillMissingWithMean(Estimator):
     """Real -> RealNN mean imputation (reference: FillMissingWithMean.scala)."""
@@ -101,6 +134,25 @@ class _PercentileModel(Transformer):
         scaled = ranks.astype(np.float64) * (99.0 / max(len(self.splits), 1))
         return NumericColumn(
             np.where(c.mask, np.clip(scaled, 0, 99), 0.0), c.mask, RealNN
+        )
+
+    def lower(self):
+        (feat,) = self.input_features
+        name, out = feat.name, self.output_name
+        splits = self.splits
+        scale = 99.0 / max(len(self.splits), 1)
+
+        def fn(env: dict) -> dict:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            ranks = np.searchsorted(splits, vals, side="right")
+            scaled = ranks.astype(np.float64) * scale
+            return {out: np.where(mask, np.clip(scaled, 0, 99), 0.0),
+                    out + MASK_SUFFIX: mask}
+
+        return Lowering(
+            fn=fn, inputs=(name, name + MASK_SUFFIX),
+            outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
         )
 
 

@@ -8,11 +8,11 @@ whose per-dimension provenance is recorded in VectorMetadata.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Type
+from typing import Callable, Optional, Sequence, Type
 
 import numpy as np
 
-from ..stages.base import Estimator, Transformer
+from ..stages.base import MASK_SUFFIX, Estimator, Lowering, Transformer
 from ..types.columns import Column, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import FeatureType, OPVector
@@ -80,6 +80,44 @@ class SequenceVectorizerModel(Transformer):
             meta = VectorMetadata(self.output_name, metas_t).reindexed()
             self._meta_cache = (self.output_name, meta, metas_t)
         return VectorColumn(values, meta)
+
+    # -- compile-to-kernel seam (stages/base.Lowering) ----------------------
+    def lower_block(self, i: int) -> Optional[Callable[[dict], np.ndarray]]:
+        """Array-level analog of ``blocks_for`` for input ``i``: a pure
+        closure over the fitted state mapping the lowered env to the
+        [n, k] float block.  None (the default) marks the block - and
+        therefore the whole stage - as not lowerable."""
+        return None
+
+    def lower(self) -> Optional[Lowering]:
+        blocks = []
+        inputs: list[str] = []
+        for i, feat in enumerate(self.input_features):
+            fn_i = self.lower_block(i)
+            if fn_i is None:
+                return None
+            blocks.append(fn_i)
+            inputs.append(feat.name)
+            if feat.ftype.kind == "numeric":
+                # numeric blocks read the @mask companion too; declared
+                # so the compiler can validate it is actually produced
+                inputs.append(feat.name + MASK_SUFFIX)
+        if not blocks:
+            return None
+        out = self.output_name
+
+        def fn(env: dict) -> dict:
+            arrays = [
+                np.asarray(b(env), dtype=np.float32) for b in blocks
+            ]
+            return {out: np.concatenate(arrays, axis=1)}
+
+        return Lowering(
+            fn=fn,
+            inputs=tuple(inputs),
+            outputs=(out,),
+            signature={out: "float32[n,d]"},
+        )
 
 
 class SequenceVectorizer(Estimator):

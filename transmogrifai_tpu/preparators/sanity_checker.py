@@ -28,7 +28,7 @@ import numpy as np
 
 from ..parallel.mesh import data_mesh_or_none
 from ..parallel.pallas_kernels import fused_moments, fused_moments_sharded
-from ..stages.base import Estimator, Transformer
+from ..stages.base import Estimator, Lowering, Transformer
 from ..types.columns import Column, NumericColumn, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import OPVector, RealNN
@@ -71,6 +71,22 @@ class SanityCheckerModel(Transformer):
             meta = vec.metadata.select(self.indices_to_keep)
             self._select_cache = (id(vec.metadata), meta, vec.metadata)
         return VectorColumn(vec.values[:, self.indices_to_keep], meta)
+
+    def lower(self):
+        # input 0 is the label, consumed only at fit time: the lowered
+        # transform reads the feature vector alone, so a fused program
+        # never needs the label decoded at serve time
+        vec_name = self.input_features[1].name
+        out = self.output_name
+        keep = np.asarray(self.indices_to_keep, dtype=np.intp)
+
+        def fn(env: dict) -> dict:
+            return {out: env[vec_name][:, keep]}
+
+        return Lowering(
+            fn=fn, inputs=(vec_name,), outputs=(out,),
+            signature={out: f"float32[n,{len(keep)}]"},
+        )
 
 
 class SanityChecker(Estimator):

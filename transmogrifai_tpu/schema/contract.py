@@ -255,9 +255,7 @@ class SchemaContract:
         # the key scan is deliberately O(batch): a key present in ANY
         # record counts as present (only the per-VALUE type check below
         # is sample-bounded)
-        seen_keys: set = set()
-        for r in records:
-            seen_keys.update(r.keys())
+        seen_keys: set = set().union(*(r.keys() for r in records))
         for spec in self.features:
             if spec.is_response:
                 continue

@@ -91,6 +91,7 @@ class CompiledEndpoint:
         contract=None,
         drift_policy: str = "warn",
         drift_scores: bool = True,
+        fused: bool = True,
     ) -> None:
         if not batch_buckets or any(int(b) < 1 for b in batch_buckets):
             raise ValueError("batch_buckets must be positive sizes")
@@ -122,7 +123,7 @@ class CompiledEndpoint:
         if (drift_scores and self.contract is not None
                 and self.contract.distributions):
             self._drift_monitor = DriftMonitor(self.contract)
-        self._scorer = LocalScorer(model, drift_policy=None)
+        self._scorer = LocalScorer(model, drift_policy=None, fused=fused)
         # the pad row: scored to fill a bucket, sliced off before return.
         # All-None raw features ride the same missing-value handling every
         # stage already implements; a caller-provided warm_record is used
@@ -137,6 +138,7 @@ class CompiledEndpoint:
         self.warm_error: Optional[str] = None
         if warm:
             self.warm_up()
+        self._push_fused_status()
 
     @property
     def telemetry(self) -> ServingTelemetry:
@@ -148,6 +150,35 @@ class CompiledEndpoint:
         # including after a caller swaps the accumulator (bench does)
         self._telemetry = value
         self.breaker.telemetry = value
+        self._push_fused_status()
+
+    # -- fused-path status --------------------------------------------------
+    @property
+    def fused(self) -> bool:
+        """True when batches score through the whole-pipeline fused
+        program (local/fused.py) rather than the interpreted DAG walk."""
+        return self._scorer.fused is not None
+
+    @property
+    def fused_reason(self) -> Optional[str]:
+        return self._scorer.fused_reason
+
+    def _push_fused_status(self) -> None:
+        """Mirror the scorer's fused status + per-bucket compile times
+        into whatever telemetry accumulator is currently attached (the
+        choice and its cost must ride every serving artifact)."""
+        scorer = getattr(self, "_scorer", None)
+        if scorer is None:  # telemetry attached before construction done
+            return
+        fp = scorer.fused
+        self._fused_buckets_pushed = (
+            len(fp.compile_ms) if fp is not None else 0
+        )
+        self._telemetry.set_fused_status(
+            fp is not None,
+            scorer.fused_reason,
+            dict(fp.compile_ms) if fp is not None else None,
+        )
 
     # -- warm-up ------------------------------------------------------------
     def warm_up(self) -> tuple[int, ...]:
@@ -318,10 +349,12 @@ class CompiledEndpoint:
         # inside the timed window: injected slowness must be VISIBLE to
         # batch telemetry, or the drill proves nothing
         _faults.inject_sleep("serving.slow_batch")
+        poisoned = False
         try:
             _faults.inject("serving.batch")
             results = self._scorer.score_batch(padded)[:n]
             if _faults.fires("serving.nan_scores"):
+                poisoned = True
                 _faults.poison_nonfinite(results)
         except Exception:  # noqa: BLE001 - degrade to the row path
             # shape miss / malformed row: the compiled batch path assumes
@@ -359,7 +392,7 @@ class CompiledEndpoint:
             if not data_borne or self.breaker.state == "half_open":
                 self.breaker.record_failure()
             return results
-        bad = self._nonfinite_rows(results) if self.guard_nonfinite else []
+        bad = self._guard_rows(results, n, poisoned)
         if bad:
             # non-finite scores: a poisoned model/kernel must fail loudly
             # per-row (the fallback would recompute the same NaN), and it
@@ -373,8 +406,28 @@ class CompiledEndpoint:
                 )
             return results
         self.breaker.record_success()
-        self.telemetry.record_batch(n, bucket, time.perf_counter() - t0)
+        fp = self._scorer.fused
+        self.telemetry.record_batch(n, bucket, time.perf_counter() - t0,
+                                    fused=fp is not None)
+        if fp is not None and len(fp.compile_ms) != getattr(
+                self, "_fused_buckets_pushed", 0):
+            # a new shape bucket compiled mid-traffic: surface its cost
+            self._push_fused_status()
         return results
+
+    def _guard_rows(self, results: Sequence[Any], n: int,
+                    poisoned: bool) -> list[int]:
+        """NaN/Inf guard dispatch: the fused program already computed a
+        columnar non-finite mask over its result arrays, so the guard is
+        a slice instead of a python walk over every result dict.  A
+        fault-injected poisoning mutates the dicts AFTER scoring, so that
+        (test-only) path - and the interpreted path - re-walk the dicts."""
+        if not self.guard_nonfinite:
+            return []
+        fp = self._scorer.fused
+        if fp is not None and not poisoned:
+            return [i for i in fp.last_nonfinite_rows if i < n]
+        return self._nonfinite_rows(results)
 
     @staticmethod
     def _nonfinite_rows(results: Sequence[Any]) -> list[int]:

@@ -57,6 +57,15 @@ class ServingTelemetry:
         self.rows_fallback = 0
         self.rows_failed = 0
         self.rows_batched = 0
+        # whole-pipeline fused compilation status (local/fused.py): set
+        # by the endpoint, exported so every serving artifact names
+        # whether the hot path was the fused program or the interpreted
+        # DAG walk, why, and what each shape bucket's compile cost
+        self.fused_enabled: Optional[bool] = None
+        self.fused_reason: Optional[str] = None
+        self.fused_compile_ms: dict = {}
+        self.batches_fused = 0
+        self.rows_fused = 0
         self.shed_deadline = 0
         self.shed_queue_full = 0
         self.request_timeouts = 0
@@ -109,15 +118,32 @@ class ServingTelemetry:
                 self.request_timeouts += 1
 
     def record_batch(self, n_rows: int, bucket_size: int,
-                     wall_s: float) -> None:
+                     wall_s: float, fused: bool = False) -> None:
         with self._lock:
             self.batches += 1
             self.batch_wall_s += float(wall_s)
             self.rows_batched += int(n_rows)
+            if fused:
+                self.batches_fused += 1
+                self.rows_fused += int(n_rows)
             self._sample(self._batch_sizes, int(n_rows))
             self._sample(
                 self._batch_fills, n_rows / bucket_size if bucket_size else 0.0
             )
+
+    def set_fused_status(self, enabled: bool, reason: Optional[str],
+                         compile_ms_by_bucket: Optional[dict] = None) -> None:
+        """Record whether this endpoint serves through the fused
+        program, why not (when interpreted), and the per-shape-bucket
+        compile/warm wall times (keyed by batch length, ms)."""
+        with self._lock:
+            self.fused_enabled = bool(enabled)
+            self.fused_reason = reason
+            if compile_ms_by_bucket:
+                self.fused_compile_ms.update(
+                    {int(k): round(float(v), 3)
+                     for k, v in compile_ms_by_bucket.items()}
+                )
 
     def record_fallback_rows(self, n: int) -> None:
         """Rows that missed the compiled bucketed path and scored through
@@ -279,6 +305,16 @@ class ServingTelemetry:
                 "rows_per_s": round(rows / wall, 1),
                 "rows_batched": self.rows_batched,
                 "batch_rows_per_s": round(self.rows_batched / batch_wall, 1),
+                "fused": {
+                    "enabled": self.fused_enabled,
+                    "reason": self.fused_reason,
+                    "compile_ms_by_bucket": {
+                        str(k): v
+                        for k, v in sorted(self.fused_compile_ms.items())
+                    },
+                    "batches_fused": self.batches_fused,
+                    "rows_fused": self.rows_fused,
+                },
                 "latency_ms": {
                     k: _finite(v, 3)
                     for k, v in percentiles(lat_ms, (50.0, 95.0, 99.0)).items()

@@ -18,13 +18,59 @@ features/.../stages/base/*).  Differences by design:
 from __future__ import annotations
 
 import copy as _copy
-from typing import Any, Optional, Sequence, Type
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence, Type
 
 from ..features.feature import Feature
 from ..types.columns import Column
 from ..types.dataset import Dataset
 from ..types.feature_types import FeatureType
 from ..utils.uid import make_uid
+
+#: env-key suffix for a numeric feature's validity mask in the lowered
+#: (fused) array representation - see :class:`Lowering`
+MASK_SUFFIX = "@mask"
+#: env-key suffixes for a Prediction output's auxiliary arrays
+RAW_SUFFIX = "@raw"
+PROB_SUFFIX = "@prob"
+
+
+@dataclass(frozen=True)
+class Lowering:
+    """A fitted stage compiled down to a pure array function.
+
+    The compile-to-kernel seam (ROADMAP item 1, the Flare-style
+    whole-pipeline fusion of arXiv 1703.08219): a fitted Transformer
+    that can express its transform as a closed-over function over
+    named numpy arrays returns one of these from :meth:`Transformer.
+    lower`, and the PipelineCompiler (local/fused.py) fuses every
+    lowered stage of a fitted plan into ONE program per shape bucket -
+    no Column/Dataset boxing between stages.
+
+    The environment is a flat ``dict[str, np.ndarray]`` keyed by
+    feature name, with auxiliary arrays under suffixed keys:
+
+    * numeric feature ``f``  -> ``f``: float64 [n] (masked slots hold
+      0.0, matching NumericColumn's canonical form), ``f@mask``:
+      bool [n]
+    * text feature ``f``     -> ``f``: object [n] host list or array
+      (None = missing; consumers iterate element-wise either way)
+    * list-ish feature ``f`` -> ``f``: object [n] of tuples/frozensets
+    * vector feature ``f``   -> ``f``: float32 [n, d]
+    * prediction output ``f``-> ``f``: float64 [n], plus optional
+      ``f@raw`` / ``f@prob``: float64 [n, k]
+
+    ``fn`` receives the env and returns the new entries to merge into
+    it; it must be pure (no mutation of its inputs) so a fused program
+    can be replayed per shape bucket and cached.  ``signature``
+    documents the dtype/shape contract per produced key for telemetry
+    and debugging.
+    """
+
+    fn: Callable[[dict], dict]
+    inputs: tuple  # env keys read
+    outputs: tuple  # env keys written
+    signature: dict = field(default_factory=dict)  # key -> "dtype[shape]"
 
 
 class PipelineStage:
@@ -130,6 +176,14 @@ class Transformer(PipelineStage):
     def transform(self, ds: Dataset) -> Dataset:
         col = self.transform_columns(self.input_columns(ds), ds)
         return ds.with_column(self.output_name, col)
+
+    def lower(self) -> Optional[Lowering]:
+        """Compile this FITTED stage to a pure array function, or None
+        when it cannot be lowered (the pipeline then serves through the
+        interpreted stage-by-stage path).  Implementations must produce
+        bit-identical arrays to ``transform_columns`` - parity is pinned
+        by tests/test_fused_pipeline.py."""
+        return None
 
 
 class Estimator(PipelineStage):

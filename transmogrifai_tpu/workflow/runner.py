@@ -174,7 +174,8 @@ class OpWorkflowRunner:
         serving_max_queue, serving_deadline_ms, serving_window,
         serving_breaker_threshold, serving_breaker_cooldown_s,
         serving_guard_nonfinite, serving_drift_policy (raise|warn|shed,
-        enforced against the artifact's schema contract)."""
+        enforced against the artifact's schema contract), serving_fused
+        (off-switch for the whole-pipeline fused program)."""
         from ..serving import (
             MicroBatchScheduler,
             RowScoringError,
@@ -202,6 +203,7 @@ class OpWorkflowRunner:
                 cp.get("serving_breaker_cooldown_s", 5.0)),
             guard_nonfinite=bool(cp.get("serving_guard_nonfinite", True)),
             drift_policy=str(cp.get("serving_drift_policy", "warn")),
+            fused=bool(cp.get("serving_fused", True)),
         )
         deadline = cp.get("serving_deadline_ms")
         with MicroBatchScheduler(
