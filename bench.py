@@ -1548,6 +1548,180 @@ def _faults_section(result: dict) -> None:
     result["faults_supervisor_attempts"] = bench["supervisor"]["attempts"]
 
 
+def obs_bench() -> dict:
+    """Observability-plane overhead proof -> OBS_BENCH.json (ISSUE 7
+    acceptance: the always-on claim must be MEASURED, not asserted).
+
+    Four sections:
+    * span_record   - raw cost of one span (enabled + disabled), ns/span
+    * serving       - fused-endpoint batch throughput with the obs plane
+                      ON vs OFF (best-of-5 wall + CPU time; the <=3%%
+                      acceptance bar), same model, same records
+    * exposition    - Prometheus text render latency at 10k native
+                      series plus the full-view scrape of the serving
+                      run's registered telemetry
+    * tail_sampler  - retention accounting over a synthetic heavy-tail
+                      span population (how many roots considered, how
+                      many p99 exemplars retained/evicted)
+    """
+    import jax
+
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.obs import (
+        MetricsRegistry,
+        SpanProfiler,
+        metrics_registry,
+        reset_metrics_registry,
+        reset_tracer,
+        set_enabled,
+    )
+    from transmogrifai_tpu.serving import compile_endpoint, \
+        records_from_dataset
+
+    out: dict = {"platform": jax.default_backend()}
+    reset_metrics_registry()
+    tracer = reset_tracer()
+
+    # -- span record cost ---------------------------------------------------
+    n_spans = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_spans):
+        with tracer.span("bench.span"):
+            pass
+    enabled_ns = (time.perf_counter() - t0) / n_spans * 1e9
+    set_enabled(False)
+    t0 = time.perf_counter()
+    for _ in range(n_spans):
+        with tracer.span("bench.span"):
+            pass
+    disabled_ns = (time.perf_counter() - t0) / n_spans * 1e9
+    set_enabled(True)
+    out["span_record"] = {
+        "n_spans": n_spans,
+        "enabled_ns_per_span": round(enabled_ns, 1),
+        "disabled_ns_per_span": round(disabled_ns, 1),
+    }
+
+    # -- fused serving on/off ----------------------------------------------
+    n_requests = 2000
+    wf, dataset_name = _serving_pipeline(OpLogisticRegression(reg_param=0.01))
+    model = wf.train()
+    base = records_from_dataset(wf.generate_raw_data(), model.raw_features)
+    records = (base * (n_requests // len(base) + 1))[:n_requests]
+    endpoint = compile_endpoint(model, batch_buckets=(1, 8, 32, 128, 512))
+    endpoint.score_batch(records)  # steady state for BOTH arms
+
+    # calibrate the timed window: process_time quantizes at ~10ms on
+    # this host, so each pass must accumulate >=~1.5s of CPU for one
+    # tick to stay well under the 3% acceptance bar (8 reps put only
+    # ~0.1s in the window and the ratio swung -8%..+20% run to run)
+    w0 = time.perf_counter()
+    endpoint.score_batch(records)
+    one_rep_s = max(time.perf_counter() - w0, 1e-4)
+    reps = max(8, min(512, int(1.5 / one_rep_s) + 1))
+
+    def _timed_pass() -> tuple[float, float]:
+        w0, c0 = time.perf_counter(), time.process_time()
+        for _ in range(reps):
+            scored = endpoint.score_batch(records)
+        w, c = time.perf_counter() - w0, time.process_time() - c0
+        assert len(scored) == n_requests
+        return max(w / reps, 1e-9), max(c / reps, 1e-9)
+
+    on_w = on_c = off_w = off_c = float("inf")
+    for _ in range(5):  # interleaved best-of-5: shared-host noise hits
+        # both arms alike instead of whichever ran second
+        set_enabled(True)
+        w, c = _timed_pass()
+        on_w, on_c = min(on_w, w), min(on_c, c)
+        set_enabled(False)
+        w, c = _timed_pass()
+        off_w, off_c = min(off_w, w), min(off_c, c)
+    set_enabled(True)
+    out["serving"] = {
+        "dataset": dataset_name,
+        "config": "OpLogisticRegression(reg_param=0.01), fused endpoint, "
+                  "buckets (1,8,32,128,512)",
+        "n_requests": n_requests,
+        "fused": endpoint.fused,
+        "obs_on_rows_per_s": round(n_requests / on_w, 1),
+        "obs_off_rows_per_s": round(n_requests / off_w, 1),
+        "overhead_wall_pct": round((on_w / off_w - 1.0) * 100.0, 2),
+        "obs_on_cpu_s": round(on_c, 5),
+        "obs_off_cpu_s": round(off_c, 5),
+        "overhead_cpu_pct": round((on_c / off_c - 1.0) * 100.0, 2),
+    }
+
+    # -- exposition latency at 10k series -----------------------------------
+    big = MetricsRegistry()
+    n_series = 10_000
+    for i in range(n_series):
+        big.counter(f"bench.series_{i:05d}").inc(i)
+    t0 = time.perf_counter()
+    text = big.prometheus_text()
+    render_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    live_text = metrics_registry().prometheus_text()
+    live_ms = (time.perf_counter() - t0) * 1e3
+    out["exposition"] = {
+        "native_series": n_series,
+        "native_render_ms": round(render_ms, 2),
+        "native_lines": len(text.splitlines()),
+        "live_scrape_ms": round(live_ms, 2),
+        "live_lines": len(live_text.splitlines()),
+    }
+
+    # -- tail-sampler retention accounting ----------------------------------
+    prof = SpanProfiler(exemplar_capacity=16, min_samples=64)
+    rng_state = [0x9E3779B9]
+
+    def _lcg() -> float:  # deterministic heavy-tail walls, no RNG deps
+        rng_state[0] = (rng_state[0] * 1103515245 + 12345) % (1 << 31)
+        return rng_state[0] / float(1 << 31)
+
+    n_roots = 10_000
+    for i in range(n_roots):
+        u = _lcg()
+        wall = 1.0 + u  # 1-2ms bulk ...
+        if u > 0.99:
+            wall = 50.0 + 100.0 * u  # ... with a 1% slow tail
+        prof.observe("bench.root", wall, tree={"trace": f"t{i}",
+                                               "wall_ms": wall})
+    snap = prof.snapshot()
+    out["tail_sampler"] = dict(
+        snap["tail"],
+        p99_ms=snap["spans"]["bench.root"]["p99_ms"],
+        retained_pct=round(
+            100.0 * snap["tail"]["exemplars_retained"] / n_roots, 3
+        ),
+    )
+    return out
+
+
+def _obs_section(result: dict) -> None:
+    """Observability overhead proof inside the full bench: fields prefix
+    obs_*, artifact side-written to OBS_BENCH.json."""
+    bench = obs_bench()
+    path = os.environ.get(
+        "TX_OBS_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "OBS_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["obs_span_ns"] = bench["span_record"]["enabled_ns_per_span"]
+    result["obs_serving_overhead_wall_pct"] = bench["serving"][
+        "overhead_wall_pct"]
+    result["obs_serving_overhead_cpu_pct"] = bench["serving"][
+        "overhead_cpu_pct"]
+    result["obs_exposition_10k_ms"] = bench["exposition"][
+        "native_render_ms"]
+
+
 def _serving_section(result: dict) -> None:
     """Run the serving microbench inside the full bench: fields prefix
     serving_*, artifact side-written to SERVING_BENCH.json."""
@@ -1739,6 +1913,11 @@ def main() -> None:
         result["registry_error"] = f"{type(e).__name__}: {e}"
     _checkpoint(result)
     try:
+        _obs_section(result)
+    except Exception as e:
+        result["obs_error"] = f"{type(e).__name__}: {e}"
+    _checkpoint(result)
+    try:
         _ingest_section(result)
     except Exception as e:
         result["ingest_error"] = f"{type(e).__name__}: {e}"
@@ -1828,6 +2007,25 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _faults_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--obs" in sys.argv:
+        # fast standalone observability overhead proof: writes
+        # OBS_BENCH.json and prints it, without the multi-minute
+        # full-bench sections
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _obs_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--serving" in sys.argv:

@@ -1,0 +1,502 @@
+"""Unified observability plane drills (ISSUE 7, obs/).
+
+Pins the acceptance criteria:
+* one trace id demonstrably spans a full ingest -> train -> save ->
+  publish -> swap -> serve run, walked from the EXPORTED span tree;
+* Prometheus exposition parses and round-trips every numeric series the
+  four legacy telemetry snapshots report;
+* the shared percentile helper is THE implementation (utils.tracing
+  aliases it, quantiles pinned);
+* telemetry survives >=4-thread hammering with a hot-swap mid-run -
+  no lost updates, torn snapshots, or exceptions;
+* a broken mesh-event feed counts obs.events_dropped and surfaces it;
+* the tail sampler retains full span trees only for slow outliers;
+* observability-on serving costs within the CPU-time floor of
+  observability-off (the 3%% wall target is proven by bench.py --obs;
+  the tier-1 floor is the loose, non-flaky version of the same claim).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.obs import (
+    MetricsRegistry,
+    SpanProfiler,
+    build_trees,
+    export_obs,
+    metrics_registry,
+    prometheus_text_from_json,
+    reset_metrics_registry,
+    reset_tracer,
+    set_enabled,
+    tracer,
+)
+from transmogrifai_tpu.obs.metrics import _numeric_leaves, percentiles
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.csv_reader import CSVReader
+from transmogrifai_tpu.serving import compile_endpoint, records_from_dataset
+from transmogrifai_tpu.serving.telemetry import ServingTelemetry
+from transmogrifai_tpu.types import feature_types as ft
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Every test gets its own registry + tracer (and leaves a fresh
+    pair behind so later test modules scrape their own state)."""
+    reset_metrics_registry()
+    reset_tracer()
+    yield
+    reset_metrics_registry()
+    reset_tracer()
+
+
+def _small_csv(tmp_path, n=120) -> str:
+    rng = np.random.RandomState(0)
+    path = os.path.join(str(tmp_path), "data.csv")
+    with open(path, "w") as f:
+        f.write("label,a,b,kind\n")
+        for _ in range(n):
+            a, b = rng.rand(), rng.rand()
+            kind = ("x", "y", "z")[int(rng.randint(3))]
+            f.write(f"{int(a + b > 1.0)},{a:.4f},{b:.4f},{kind}\n")
+    return path
+
+
+def _small_workflow(csv_path):
+    label = FeatureBuilder(ft.RealNN, "label").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    kind = FeatureBuilder(ft.PickList, "kind").as_predictor()
+    vec = transmogrify([a, b, kind])
+    checked = label.sanity_check(vec, remove_bad_features=True)
+    pred = OpLogisticRegression().set_input(label, checked).get_output()
+    return (
+        OpWorkflow()
+        .set_result_features(pred)
+        .set_reader(CSVReader(csv_path))
+    )
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one trace id across the full lifecycle
+# ---------------------------------------------------------------------------
+def test_one_trace_id_spans_full_lifecycle(tmp_path):
+    """ingest -> fit -> save -> publish -> swap -> serve under ONE trace
+    id, pinned by walking the EXPORTED span tree (JSONL round trip, not
+    the in-memory buffer)."""
+    from transmogrifai_tpu.registry import (
+        DeploymentController,
+        ModelRegistry,
+    )
+
+    tr = tracer()
+    wf = _small_workflow(_small_csv(tmp_path))
+    with tr.span("e2e_run") as root:
+        model = wf.train()
+        model.save(os.path.join(str(tmp_path), "model"))
+        registry = ModelRegistry(os.path.join(str(tmp_path), "registry"))
+        version = registry.publish(model)
+        registry.promote(version.version, to="stable")
+        controller = DeploymentController(registry=registry)
+        controller.deploy(model, version=version.version)
+        records = records_from_dataset(
+            wf.generate_raw_data(), model.raw_features
+        )
+        results = controller.score_batch(records[:32])
+    assert len(results) == 32
+
+    jsonl = os.path.join(str(tmp_path), "spans.jsonl")
+    n = tr.export_jsonl(jsonl, trace_id=root.trace_id)
+    assert n > 0
+    with open(jsonl) as f:
+        records_out = [json.loads(line) for line in f if line.strip()]
+    assert {r["trace"] for r in records_out} == {root.trace_id}
+
+    trees = build_trees(records_out)
+    assert len(trees) == 1 and trees[0]["name"] == "e2e_run"
+
+    def walk(node):
+        yield node
+        for c in node.get("children", ()):
+            yield from walk(c)
+
+    names = {nd["name"] for nd in walk(trees[0])}
+    required = {
+        "workflow.train", "workflow.ingest", "ingest.read", "stage.fit",
+        "stage.transform", "model.save", "registry.publish",
+        "deploy.swap", "serve.batch", "score.batch",
+    }
+    assert required <= names, f"missing spans: {required - names}"
+    # the serve batch names its bucket + fused status (ISSUE 7 tagging)
+    serve = next(nd for nd in walk(trees[0])
+                 if nd["name"] == "serve.batch")
+    assert "bucket" in serve["attrs"] and "fused" in serve["attrs"]
+    # and every span wall is perf_counter-derived and non-negative
+    assert all(nd["wall_ms"] >= 0.0 for nd in walk(trees[0]))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: Prometheus exposition round-trips the legacy snapshots
+# ---------------------------------------------------------------------------
+_PROM_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$"
+)
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Strict parse of the text exposition: every non-comment line must
+    be ``name{labels} value``; returns {(name, labels): float}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m is not None, f"unparseable exposition line: {line!r}"
+        labels = tuple(sorted(_PROM_LABEL.findall(m.group(2) or "")))
+        out[(m.group(1), labels)] = float(m.group(3))
+    return out
+
+
+def test_prometheus_round_trips_all_four_legacy_snapshots():
+    """Every finite numeric series the four legacy telemetry snapshots
+    report appears in the Prometheus text with the same value."""
+    from transmogrifai_tpu.parallel.resilience import MeshTelemetry
+    from transmogrifai_tpu.schema.quarantine import DataTelemetry
+    from transmogrifai_tpu.utils.tracing import AppMetrics, StageMetrics
+
+    reg = metrics_registry()
+    serving = ServingTelemetry()
+    serving.record_request(0.002, "ok")
+    serving.record_request(0.004, "failed")
+    serving.record_batch(32, 32, 0.01, fused=True)
+    serving.record_breaker_transition("open")
+    serving.set_model_version("v001", generation=3)
+    mesh = MeshTelemetry()
+    mesh.record_step("fit", 0.5)
+    mesh.record_detection("fit", 1.0, "straggler", 1.2, [])
+    data = DataTelemetry()
+    data.record_read("a.csv", 100, 97)
+    app = AppMetrics()
+    app.record(StageMetrics("uid1", "OpX", "fit", 0.25, 100))
+
+    # ONE document: live views tick (wall_s, rows_per_s), so the parse
+    # target must be the exposition of the SAME snapshot it checks
+    doc = reg.to_json()
+    kinds = {k.split("/")[0] for k in doc["views"]}
+    assert {"serving", "mesh", "data", "stage"} <= kinds
+
+    samples = _parse_prometheus(prometheus_text_from_json(doc))
+    missing, wrong = [], []
+    for key, snap in doc["views"].items():
+        kind, _, idx = key.partition("/")
+        for path, value in _numeric_leaves(snap):
+            from transmogrifai_tpu.obs import sanitize_metric_name
+
+            name = sanitize_metric_name(kind + "_" + "_".join(path))
+            got = samples.get((name, (("instance", idx),)))
+            if got is None:
+                missing.append(name)
+            elif abs(got - float(value)) > 1e-9:
+                wrong.append((name, got, value))
+    assert not missing, f"series missing from exposition: {missing[:10]}"
+    assert not wrong, f"series value mismatch: {wrong[:10]}"
+    # spot-pin a few load-bearing ones end to end
+    assert samples[("tx_serving_rows_scored", (("instance", "0"),))] == 1.0
+    assert samples[("tx_serving_generation", (("instance", "0"),))] == 3.0
+    assert samples[("tx_mesh_detections", (("instance", "0"),))] == 1.0
+    assert samples[("tx_data_rows_quarantined", (("instance", "0"),))] == 3.0
+
+
+def test_prometheus_renderer_shared_with_saved_json(tmp_path, capsys):
+    """tx obs metrics renders a SAVED metrics.json through the SAME
+    renderer a live scrape uses: the CLI output is byte-identical to
+    prometheus_text_from_json of the saved document."""
+    reg = metrics_registry()
+    reg.counter("obs.events_dropped", help="drops").inc(4)
+    serving = ServingTelemetry()
+    serving.record_request(0.001, "ok")
+    out = export_obs(str(tmp_path / "obs"))
+    assert out["series"]["obs.events_dropped"]["value"] == 4
+    with open(tmp_path / "obs" / "metrics.json") as f:
+        saved = json.load(f)
+    # the .prom file written next to it came from the same document
+    with open(tmp_path / "obs" / "metrics.prom") as f:
+        assert f.read() == prometheus_text_from_json(saved)
+
+    from transmogrifai_tpu import cli
+
+    rc = cli.main(["obs", "metrics", "--path", str(tmp_path / "obs"),
+                   "--format", "prometheus"])
+    assert rc == 0
+    assert capsys.readouterr().out == prometheus_text_from_json(saved)
+
+
+# ---------------------------------------------------------------------------
+# satellite: one percentile implementation
+# ---------------------------------------------------------------------------
+def test_percentiles_single_implementation_and_pinned():
+    from transmogrifai_tpu.utils import tracing
+
+    # the alias IS the function, not a fork
+    assert tracing.percentiles is percentiles
+    vals = [5.0, 1.0, 3.0, 2.0, 4.0]
+    got = percentiles(vals, (50.0, 95.0, 99.0))
+    assert got["p50"] == 3.0
+    assert got["p95"] == pytest.approx(4.8)
+    assert got["p99"] == pytest.approx(4.96)
+    # numpy's linear-interpolation quantile is the independent oracle
+    for q in (50.0, 95.0, 99.0):
+        assert percentiles(vals, (q,))[f"p{q:g}"] == pytest.approx(
+            float(np.percentile(vals, q))
+        )
+    # empty input: NaN, never an exception (snapshot paths rely on it)
+    empty = percentiles([], (50.0,))
+    assert empty["p50"] != empty["p50"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: events_dropped self-metric
+# ---------------------------------------------------------------------------
+def test_broken_mesh_event_feed_is_counted_and_surfaced():
+    from transmogrifai_tpu.utils import tracing
+
+    old = tracing._mesh_events_source
+
+    def _broken(since_epoch=None):
+        raise RuntimeError("event feed wedged")
+
+    try:
+        tracing.register_mesh_events_source(_broken)
+        assert tracing.mesh_events() == []  # still never raises
+        assert tracing.mesh_events_dropped() == 1
+        app = tracing.AppMetrics()
+        doc = app.to_json()  # calls mesh_events again -> second drop
+        assert doc["obs_events_dropped"] >= 2
+        # and the scrape sees the self-metric
+        samples = _parse_prometheus(metrics_registry().prometheus_text())
+        assert samples[("tx_obs_events_dropped", ())] >= 2
+    finally:
+        tracing.register_mesh_events_source(old)
+
+
+# ---------------------------------------------------------------------------
+# satellite: telemetry under concurrency (>=4 threads + hot-swap)
+# ---------------------------------------------------------------------------
+def test_serving_telemetry_concurrent_no_lost_updates():
+    tel = ServingTelemetry()
+    tel.set_model_version("v001", generation=1)
+    n_threads, per_thread = 6, 2000
+    errors: list = []
+    start = threading.Barrier(n_threads + 2)
+
+    def hammer(tid: int) -> None:
+        try:
+            start.wait(timeout=10)
+            for i in range(per_thread):
+                tel.record_request(0.001 * (i % 7), "ok")
+                tel.record_batch(4, 8, 0.0001, fused=bool(i % 2))
+                if i % 5 == 0:
+                    tel.record_request(0.002, "failed")
+        except Exception as e:  # noqa: BLE001 - the assertion itself
+            errors.append(e)
+
+    def swap() -> None:
+        # hot-swap mid-run: generation tagging must never tear a
+        # snapshot or lose counts
+        try:
+            start.wait(timeout=10)
+            for g in range(2, 40):
+                tel.set_model_version(f"v{g:03d}", generation=g)
+                tel.record_lifecycle({"event": "swap", "generation": g})
+                time.sleep(0.001)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+    ] + [threading.Thread(target=swap)]
+    for t in threads:
+        t.start()
+    start.wait(timeout=10)
+    seen_rows = 0
+    deadline = time.monotonic() + 120
+    while any(t.is_alive() for t in threads):
+        assert time.monotonic() < deadline, "concurrency drill wedged"
+        snap = tel.snapshot()  # concurrent snapshots must not tear
+        assert snap["rows_scored"] >= seen_rows  # monotonic, no lost inc
+        seen_rows = snap["rows_scored"]
+        assert snap["rows_scored"] <= n_threads * per_thread
+        time.sleep(0.02)  # snapshot copies bounded reservoirs under
+        # the lock; an unthrottled loop starves the writers it drills
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    final = tel.snapshot()
+    assert final["rows_scored"] == n_threads * per_thread
+    assert final["rows_failed"] == n_threads * ((per_thread + 4) // 5)
+    assert final["batches"] == n_threads * per_thread
+    assert final["rows_batched"] == n_threads * per_thread * 4
+    assert final["generation"] == 39
+    assert final["model_version"] == "v039"
+
+
+def test_metrics_registry_concurrent_no_lost_updates():
+    reg = MetricsRegistry()
+    c = reg.counter("hammer.count")
+    h = reg.histogram("hammer.ms")
+    n_threads, per_thread = 5, 8000
+    errors: list = []
+
+    def hammer(tid: int) -> None:
+        try:
+            for i in range(per_thread):
+                c.inc()
+                h.observe(float(i % 100))
+                if i % 1000 == 0:
+                    reg.prometheus_text()  # concurrent scrape
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,))
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# profiler: tail sampler
+# ---------------------------------------------------------------------------
+def test_tail_sampler_retains_only_slow_outlier_trees():
+    prof = SpanProfiler(exemplar_capacity=8, min_samples=50,
+                        threshold_refresh=10)
+    for i in range(500):
+        prof.observe("serve.batch", 1.0,
+                     tree={"trace": f"t{i}", "wall_ms": 1.0})
+    snap = prof.snapshot()
+    assert snap["tail"]["exemplars_retained"] == 0  # no tail, no hoard
+    prof.observe("serve.batch", 250.0, tree={
+        "trace": "slow", "wall_ms": 250.0,
+        "children": [{"name": "score.batch", "wall_ms": 249.0}],
+    })
+    snap = prof.snapshot()
+    assert snap["tail"]["exemplars_retained"] == 1
+    ex = prof.exemplars()[0]
+    assert ex["trace"] == "slow" and ex["wall_ms"] == 250.0
+    # the FULL tree rode along: the stage-level breakdown is right there
+    assert ex["tree"]["children"][0]["name"] == "score.batch"
+    # stats: ewma tracks recency, histogram quantiles are finite
+    st = snap["spans"]["serve.batch"]
+    assert st["count"] == 501
+    assert st["p99_ms"] is not None and st["max_ms"] == 250.0
+
+
+def test_span_ring_buffer_bounded_and_eviction_counted():
+    tr = reset_tracer(capacity=64)
+    for _ in range(200):
+        with tr.span("tick"):
+            pass
+    snap = tr.snapshot()
+    assert snap["spans_retained"] == 64
+    assert snap["spans_recorded"] == 200
+    assert snap["spans_evicted"] == 136
+
+
+def test_disabled_tracer_records_nothing():
+    tr = reset_tracer(enabled=False)
+    with tr.span("off") as sp:
+        sp.set_attr("ignored", 1)  # the null span accepts the calls
+    assert tr.spans() == []
+    set_enabled(True)
+    with tr.span("on"):
+        pass
+    assert [r["name"] for r in tr.spans()] == ["on"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: CPU-time overhead floor (the bench proves the 3% wall bar)
+# ---------------------------------------------------------------------------
+def test_observability_on_within_cpu_floor_of_off(tmp_path):
+    """Obs-on fused serving must stay within 1.25x the CPU time of
+    obs-off (min-of-3, interleaved arms).  The bench (OBS_BENCH.json)
+    pins the tight 3%% wall-clock claim; this floor is the loose,
+    CI-stable tier-1 version - a per-row or per-value span regression
+    blows straight past it."""
+    wf = _small_workflow(_small_csv(tmp_path, n=240))
+    model = wf.train()
+    records = records_from_dataset(
+        wf.generate_raw_data(), model.raw_features
+    )
+    endpoint = compile_endpoint(model, batch_buckets=(1, 8, 32, 128))
+    endpoint.score_batch(records)  # warm both arms' caches
+
+    def cpu_pass() -> float:
+        t0 = time.process_time()
+        for _ in range(4):
+            out = endpoint.score_batch(records)
+        assert len(out) == len(records)
+        return max(time.process_time() - t0, 1e-9)
+
+    on_c = off_c = float("inf")
+    for _ in range(3):
+        set_enabled(True)
+        on_c = min(on_c, cpu_pass())
+        set_enabled(False)
+        off_c = min(off_c, cpu_pass())
+    set_enabled(True)
+    assert on_c <= off_c * 1.25 + 0.01, (
+        f"observability overhead too high: on={on_c:.4f}s "
+        f"off={off_c:.4f}s cpu"
+    )
+
+
+# ---------------------------------------------------------------------------
+# runner knob + CLI trace view
+# ---------------------------------------------------------------------------
+def test_runner_metrics_path_knob_exports_plane(tmp_path):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    wf = _small_workflow(_small_csv(tmp_path))
+    runner = OpWorkflowRunner(wf)
+    out_dir = str(tmp_path / "obs_out")
+    result = runner.run("train", OpParams(
+        model_location=str(tmp_path / "model"),
+        custom_params={"metrics_path": out_dir},
+    ))
+    assert result.model is not None
+    for name in ("metrics.json", "metrics.prom", "spans.jsonl"):
+        assert os.path.exists(os.path.join(out_dir, name)), name
+    with open(os.path.join(out_dir, "metrics.json")) as f:
+        doc = json.load(f)
+    assert "views" in doc and any(
+        k.startswith("stage/") for k in doc["views"]
+    )
+    # exposition file parses
+    with open(os.path.join(out_dir, "metrics.prom")) as f:
+        _parse_prometheus(f.read())
+    # the spans JSONL reconstructs to a tree containing the train run
+    from transmogrifai_tpu import cli
+
+    rc = cli.main(["obs", "trace", "--path", out_dir, "--slowest", "3"])
+    assert rc == 0
+    with open(os.path.join(out_dir, "spans.jsonl")) as f:
+        names = {json.loads(line)["name"] for line in f if line.strip()}
+    assert {"run.train", "workflow.train", "ingest.read"} <= names

@@ -27,6 +27,16 @@ Enforced invariants over every module in transmogrifai_tpu/:
   registry.json) anywhere else - every published version must ride the
   crash-consistent fsync+manifest+rename path, or a registry entry
   could reference an artifact that a crash can corrupt (ISSUE 5)
+- durations are never measured on the epoch clock: no ``time.time()``
+  call inside a subtraction anywhere in the package (ISSUE 7) - the
+  epoch clock steps under NTP, so span/metric timing must ride
+  ``time.perf_counter``/``perf_counter_ns``/``monotonic``; the one
+  allowlisted site compares against a file MTIME, which only exists on
+  the epoch timeline
+- the observability plane (obs/ and utils/tracing.py) stays importable
+  before jax/numpy init: module-level imports are stdlib or intra-obs
+  relative only (ISSUE 7) - the measurement plane must not depend on
+  the accelerator stack it measures
 """
 import ast
 import pathlib
@@ -269,6 +279,90 @@ def test_library_modules_do_not_print():
                 and node.func.id == "print"
             ):
                 offenders.append(f"{p}:{node.lineno}")
+    assert not offenders, offenders
+
+
+#: epoch-clock subtraction sites that are provably NOT durations, keyed
+#: (relative-path, lineno) - each needs a justification here:
+#: supervisor.staleness compares time.time() against a heartbeat file's
+#: os.path.getmtime(), and mtimes only exist on the epoch timeline
+_EPOCH_SUB_ALLOWLIST = {("workflow/supervisor.py", 55)}
+
+
+def _is_time_time_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "time"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+def test_no_epoch_clock_durations():
+    """No ``time.time()`` call may appear inside a subtraction anywhere
+    in the package (ISSUE 7): ``time.time() - t0`` is a duration
+    measured on a clock that steps under NTP.  Span/metric timing code
+    must use ``time.perf_counter`` / ``perf_counter_ns`` /
+    ``time.monotonic``; epoch stamps are fine as plain timestamps."""
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            if not any(_is_time_time_call(sub) for sub in ast.walk(node)):
+                continue
+            if ("/".join(rel), node.lineno) in _EPOCH_SUB_ALLOWLIST:
+                continue
+            offenders.append(f"{p}:{node.lineno} time.time() in a "
+                             "subtraction")
+    assert not offenders, offenders
+
+
+def test_obs_plane_importable_before_jax_numpy():
+    """obs/ (and utils/tracing.py, which it absorbed the quantile
+    helper from) must stay importable before jax/numpy init (ISSUE 7):
+    every module-level import is either stdlib or a relative import
+    within obs/ - so a metrics scrape or span export can never be the
+    thing that initializes a device backend."""
+    import sys
+
+    stdlib = set(sys.stdlib_module_names)
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        if not (rel[0] == "obs" or rel == ("utils", "tracing.py")):
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in tree.body:  # module level only: lazy imports are
+            # exactly the escape hatch (profile_to imports jax inside)
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    if root not in stdlib:
+                        offenders.append(f"{p}:{node.lineno} import "
+                                         f"{a.name}")
+            elif isinstance(node, ast.ImportFrom):
+                if node.level > 0:
+                    mod = node.module or ""
+                    if mod.split(".")[0] != "obs" and rel[0] != "obs":
+                        offenders.append(
+                            f"{p}:{node.lineno} relative import "
+                            f"{mod!r} outside obs/"
+                        )
+                    elif rel[0] == "obs" and node.level > 1:
+                        offenders.append(
+                            f"{p}:{node.lineno} relative import above "
+                            "obs/"
+                        )
+                else:
+                    root = (node.module or "").split(".")[0]
+                    if root not in stdlib:
+                        offenders.append(f"{p}:{node.lineno} from "
+                                         f"{node.module} import ...")
     assert not offenders, offenders
 
 

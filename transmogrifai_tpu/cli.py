@@ -27,6 +27,17 @@ Model-lifecycle commands over a versioned registry (registry/; alias
 Each prints one JSON document; ``verify`` exits non-zero when any
 checksum fails (the prior version must still verify after a crashed
 publish - drilled by ``bench.py --registry``).
+
+Observability commands over the unified plane (obs/; artifacts written
+by the runner's ``metrics_path`` knob or ``obs.export_obs``):
+
+    tx obs metrics --path <dir-or-metrics.json> [--format prometheus|json]
+    tx obs trace   --path <dir-or-spans.jsonl> [--trace-id ID] [--slowest N]
+
+``metrics`` renders a saved registry document as Prometheus text
+exposition (the SAME renderer a live scrape uses) or JSON; ``trace``
+reconstructs span trees from a JSONL export, optionally only the
+slowest N roots (the profiler's p99-exemplar view, offline).
 """
 from __future__ import annotations
 
@@ -605,6 +616,88 @@ def _parse_override(s: str) -> tuple[str, type]:
 
 
 # ---------------------------------------------------------------------------
+# observability commands (obs/: metrics exposition + span trees)
+# ---------------------------------------------------------------------------
+def _obs_resolve(path: str, default_name: str) -> str:
+    """Accept either the export directory (the ``metrics_path`` knob's
+    output) or the file itself."""
+    if os.path.isdir(path):
+        return os.path.join(path, default_name)
+    return path
+
+
+def _obs_main(args) -> int:
+    from .obs import build_trees, prometheus_text_from_json
+
+    if args.obs_cmd == "metrics":
+        path = _obs_resolve(args.path, "metrics.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        if args.format == "prometheus":
+            print(prometheus_text_from_json(doc), end="")
+        else:
+            print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        return 0
+    if args.obs_cmd == "trace":
+        path = _obs_resolve(args.path, "spans.jsonl")
+        records = []
+        try:
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except ValueError as e:
+                        print(json.dumps({
+                            "error": f"{path}:{lineno}: not JSON: {e}"
+                        }))
+                        return 2
+        except OSError as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        if args.trace_id:
+            records = [r for r in records if r.get("trace") == args.trace_id]
+        trees = build_trees(records)
+        if args.slowest:
+            trees = sorted(
+                trees, key=lambda t: -float(t.get("wall_ms", 0.0))
+            )[: args.slowest]
+        print(json.dumps({
+            "spans": len(records),
+            "roots": len(trees),
+            "trees": trees,
+        }, indent=1, sort_keys=True, default=str))
+        return 0
+    raise AssertionError(f"unhandled obs command {args.obs_cmd}")
+
+
+def _add_obs_parser(sub) -> None:
+    o = sub.add_parser("obs", help="unified observability plane "
+                                   "(metrics exposition, span trees)")
+    osub = o.add_subparsers(dest="obs_cmd", required=True)
+    m = osub.add_parser("metrics",
+                        help="render an exported metrics document")
+    m.add_argument("--path", required=True,
+                   help="export dir (metrics_path knob) or metrics.json")
+    m.add_argument("--format", choices=("prometheus", "json"),
+                   default="prometheus")
+    t = osub.add_parser("trace", help="reconstruct span trees from a "
+                                      "spans.jsonl export")
+    t.add_argument("--path", required=True,
+                   help="export dir (metrics_path knob) or spans.jsonl")
+    t.add_argument("--trace-id", default=None,
+                   help="only this trace id")
+    t.add_argument("--slowest", type=int, default=None, metavar="N",
+                   help="only the N slowest root spans")
+
+
+# ---------------------------------------------------------------------------
 # registry commands (registry/: versioned store + lifecycle)
 # ---------------------------------------------------------------------------
 def _registry_main(args) -> int:
@@ -673,6 +766,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="transmogrifai_tpu.cli")
     sub = p.add_subparsers(dest="cmd", required=True)
     _add_registry_parser(sub)
+    _add_obs_parser(sub)
     g = sub.add_parser("gen", help="generate a project from data")
     g.add_argument("--input", required=True, help="CSV or .avsc path")
     g.add_argument("--response", required=True)
@@ -695,6 +789,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.cmd == "registry":
         return _registry_main(args)
+    if args.cmd == "obs":
+        return _obs_main(args)
     answers = load_answers(args.answers) if args.answers else None
     path = generate(
         args.input, args.response, args.name, args.output, args.kind,

@@ -24,6 +24,7 @@ import logging
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
 from ..models.base import PredictorModel
+from ..obs import trace as _obs_trace
 # module-level: the row path validates EVERY scored batch - importing
 # inside _validate put one import-machinery hit on every call
 from ..schema.contract import apply_drift_policy, collect_violations
@@ -163,42 +164,49 @@ class LocalScorer:
         self._validate(records)
         if self.fused is not None:
             # the whole-pipeline compiled path: decode -> one fused
-            # array program per shape bucket -> result dicts
-            return self.fused.score_batch(records)
-        cols = self._decoder.decode_columns(records)
-        cols.update({
-            f.name: column_from_list(
-                [r.get(f.name) for r in records], f.ftype
-            )
-            for f in self._slow_features
-        })
-        # mutate the scorer-owned Dataset in place: the functional
-        # with_column path re-validates and copies the whole column dict
-        # per stage (~16 Dataset builds per scored row), half the serving
-        # latency at profile
-        out = Dataset(cols)
-        for stage, in_names, out_name in self._steps:
-            out.set_column(
-                out_name,
-                stage.transform_columns([out[n] for n in in_names], out),
-                validate=False,
-            )
-        names = [f.name for f in self.result_features if f.name in out]
-        n = len(records)
-        lists = []
-        for name in names:
-            vals = out[name].to_list()
-            if len(vals) != n:  # the validate=False escape hatch's guard
-                raise ValueError(
-                    f"result column {name!r} has {len(vals)} rows for "
-                    f"{n} scored records"
+            # array program per shape bucket -> result dicts (one span
+            # per batch, fused-tagged, riding the ambient trace)
+            with _obs_trace.span("score.batch", n=len(records),
+                                 fused=True):
+                return self.fused.score_batch(records)
+        with _obs_trace.span("score.batch", n=len(records), fused=False):
+            cols = self._decoder.decode_columns(records)
+            cols.update({
+                f.name: column_from_list(
+                    [r.get(f.name) for r in records], f.ftype
                 )
-            lists.append(vals)
-        if not names:
-            return [{} for _ in records]
-        # one columnar pass: zip the result columns into row dicts
-        # instead of the per-row x per-name double comprehension
-        return [dict(zip(names, row)) for row in zip(*lists)]
+                for f in self._slow_features
+            })
+            # mutate the scorer-owned Dataset in place: the functional
+            # with_column path re-validates and copies the whole column
+            # dict per stage (~16 Dataset builds per scored row), half
+            # the serving latency at profile
+            out = Dataset(cols)
+            for stage, in_names, out_name in self._steps:
+                out.set_column(
+                    out_name,
+                    stage.transform_columns(
+                        [out[n] for n in in_names], out),
+                    validate=False,
+                )
+            names = [
+                f.name for f in self.result_features if f.name in out
+            ]
+            n = len(records)
+            lists = []
+            for name in names:
+                vals = out[name].to_list()
+                if len(vals) != n:  # validate=False escape hatch guard
+                    raise ValueError(
+                        f"result column {name!r} has {len(vals)} rows "
+                        f"for {n} scored records"
+                    )
+                lists.append(vals)
+            if not names:
+                return [{} for _ in records]
+            # one columnar pass: zip the result columns into row dicts
+            # instead of the per-row x per-name double comprehension
+            return [dict(zip(names, row)) for row in zip(*lists)]
 
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
         return self.score_batch([record])[0]

@@ -1,0 +1,89 @@
+"""transmogrifai_tpu.obs: the unified observability plane.
+
+One package, three coupled pieces (ISSUE 7):
+
+* :mod:`~transmogrifai_tpu.obs.trace` - run-scoped trace spans
+  (contextvar-propagated, ``perf_counter_ns``-timed, bounded ring
+  buffer, JSONL export): one trace id follows
+  ingest -> fit -> save -> publish -> swap -> serve.
+* :mod:`~transmogrifai_tpu.obs.metrics` - ONE metrics registry
+  (counters / gauges / fixed-bucket histograms, the shared percentile
+  implementation) into which the four legacy telemetry classes register
+  their snapshots as views; exported as JSON and Prometheus text via
+  ``tx obs`` and the runner's ``metrics_path`` knob.
+* :mod:`~transmogrifai_tpu.obs.profiler` - always-on per-span EWMA +
+  histogram with a p99 tail sampler retaining full span trees for slow
+  outliers.
+
+The whole package is stdlib-only and importable before jax/numpy init
+(gated by tests/test_style.py), exactly like ``utils/tracing.py`` - the
+measurement plane must not depend on the stack it measures.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_registry,
+    percentiles,
+    prometheus_text_from_json,
+    reset_metrics_registry,
+    sanitize_metric_name,
+    write_json_artifact,
+)
+from .profiler import SpanProfiler
+from .trace import (
+    Span,
+    Tracer,
+    build_trees,
+    reset_tracer,
+    set_enabled,
+    span,
+    tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "SpanProfiler",
+    "Tracer",
+    "build_trees",
+    "export_obs",
+    "metrics_registry",
+    "percentiles",
+    "prometheus_text_from_json",
+    "reset_metrics_registry",
+    "reset_tracer",
+    "sanitize_metric_name",
+    "set_enabled",
+    "span",
+    "tracer",
+    "write_json_artifact",
+]
+
+
+def export_obs(path: str, extra: Optional[dict] = None) -> dict:
+    """Export the whole observability plane into directory ``path``:
+    ``metrics.json`` (the registry document - native series + every
+    registered telemetry view), ``metrics.prom`` (the same document as
+    Prometheus text exposition), and ``spans.jsonl`` (the tracer's
+    retained spans).  The runner's ``metrics_path`` knob and callers
+    who want a one-call dump share this.  Returns the JSON document."""
+    os.makedirs(path, exist_ok=True)
+    reg = metrics_registry()
+    doc = reg.to_json()
+    if extra:
+        doc = dict(doc, **extra)
+    write_json_artifact(os.path.join(path, "metrics.json"), doc)
+    with open(os.path.join(path, "metrics.prom"), "w") as f:
+        f.write(prometheus_text_from_json(doc))
+    tracer().export_jsonl(os.path.join(path, "spans.jsonl"))
+    return doc

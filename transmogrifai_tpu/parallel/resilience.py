@@ -46,7 +46,6 @@ recompute overhead, and survivor-result parity (MESH_FAULTS_BENCH.json).
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
 import re
@@ -56,6 +55,8 @@ import time
 from typing import Callable, Optional, Sequence
 
 from ..faults import injection as _faults
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from ..utils import tracing as _tracing
 from ..workflow.supervisor import beat as _beat, staleness as _staleness
 
@@ -206,7 +207,11 @@ class MeshTelemetry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.started_at = time.time()
+        self.started_at = time.time()  # epoch stamp (correlation only)
+        self._pc_start = time.perf_counter()  # durations never use the
+        # epoch clock (the tests/test_style.py timing gate)
+        # unified metrics plane (obs/): snapshot registered as a view
+        _obs_metrics.metrics_registry().register_view("mesh", self)
         # model-version attribution (registry/): the ServingTelemetry-
         # shared pair, so degraded-training events in bench JSON and
         # summary_json() name the model version they trained
@@ -223,6 +228,12 @@ class MeshTelemetry:
         self._detection_s: list[float] = []
         self._shrink_s: list[float] = []
         self._events: list[dict] = []
+        # epoch stamp per event, parallel to _events and kept OUT of the
+        # exported dicts: the since_epoch window filter must compare
+        # epoch against epoch (a perf_counter-elapsed `t` vs an
+        # epoch-difference cutoff diverges when NTP steps the wall
+        # clock mid-process)
+        self._event_epochs: list[float] = []
 
     # -- recording ----------------------------------------------------------
     def _sample(self, bucket: list, value: float) -> None:
@@ -231,10 +242,12 @@ class MeshTelemetry:
             del bucket[::2]
 
     def _event(self, **kw) -> None:
-        kw["t"] = round(time.time() - self.started_at, 3)
+        kw["t"] = round(time.perf_counter() - self._pc_start, 3)
         self._events.append(kw)
+        self._event_epochs.append(time.time())
         if len(self._events) > _MAX_EVENTS:
             del self._events[0]
+            del self._event_epochs[0]
 
     def record_step(self, label: str, wall_s: float) -> None:
         with self._lock:
@@ -319,8 +332,12 @@ class MeshTelemetry:
         with self._lock:
             if since_epoch is None:
                 return [dict(e) for e in self._events]
-            cutoff = since_epoch - self.started_at - 1e-3  # t rounding
-            return [dict(e) for e in self._events if e["t"] >= cutoff]
+            cutoff = since_epoch - 1e-3  # caller-stamp ordering slack
+            return [
+                dict(e)
+                for e, te in zip(self._events, self._event_epochs)
+                if te >= cutoff
+            ]
 
     def snapshot(self) -> dict:
         def _ms(vals):
@@ -333,7 +350,8 @@ class MeshTelemetry:
 
         with self._lock:
             return {
-                "wall_s": round(max(time.time() - self.started_at, 1e-9), 3),
+                "wall_s": round(
+                    max(time.perf_counter() - self._pc_start, 1e-9), 3),
                 "model_version": self.model_version,
                 "generation": self.generation,
                 "collectives_ok": self.collectives_ok,
@@ -365,9 +383,7 @@ class MeshTelemetry:
         snap = self.snapshot()
         if extra:
             snap.update(extra)
-        with open(path, "w") as f:
-            json.dump(snap, f, indent=1, sort_keys=True, default=str)
-            f.write("\n")
+        _obs_metrics.write_json_artifact(path, snap)
         log.info(self.log_line())
         return snap
 
@@ -498,60 +514,76 @@ class CollectiveWatchdog:
         )
         if self.peer_health is not None:
             self.peer_health.beat()
-        ok, value, wall, info = self._attempt(label, step_fn, deadline)
-        if ok:
-            self.policy.observe(wall)
-            self.telemetry.record_step(label, wall)
-            if self.peer_health is not None:
-                self.peer_health.beat()  # liveness == collective progress
-            return value
-        classification, dead = self._classify(info)
-        self.telemetry.record_detection(
-            label, deadline, classification, wall, dead
-        )
-        if classification == "straggler":
-            extended = deadline * self.retry_factor
-            ok2, value2, wall2, info2 = self._attempt(
-                label, step_fn, extended
-            )
-            self.telemetry.record_retry(label, ok2, extended)
-            if ok2:
-                self.policy.observe(wall2)
+        # one trace span per guarded collective: a stalled step's
+        # detection/retry/shrink story rides the SAME run trace as the
+        # stage fit that issued it (ISSUE 7), outcome tagged on exit
+        with _obs_trace.span(
+            "mesh.collective", label=label,
+            deadline_s=round(deadline, 3),
+        ) as sp:
+            ok, value, wall, info = self._attempt(label, step_fn, deadline)
+            if ok:
+                sp.set_attr("outcome", "ok")
+                self.policy.observe(wall)
+                self.telemetry.record_step(label, wall)
                 if self.peer_health is not None:
-                    self.peer_health.beat()
-                return value2
-            # the retry stalled too: a straggler that never finishes is a
-            # dead peer for recovery purposes
-            _, dead2 = self._classify(info2)
-            dead = dead or dead2 or ["unresponsive"]
-        if shrink_fn is None:
-            self.telemetry.record_shrink(label, False, 0.0, None)
-            raise CollectiveStallError(
-                f"collective {label!r} stalled past its {deadline:.3f}s "
-                f"deadline (classified {classification}; dead peers: "
-                f"{dead}) and no survivor recompute path was provided"
+                    self.peer_health.beat()  # liveness == progress
+                return value
+            classification, dead = self._classify(info)
+            sp.set_attr("classification", classification)
+            self.telemetry.record_detection(
+                label, deadline, classification, wall, dead
             )
-        # the shrink runs in its own bounded worker too (the ceiling - a
-        # fresh mesh means recompile - and no fault consultation: the
-        # armed faults simulate the DEGRADED mesh, not the survivor
-        # route).  'Never wedge the caller' must hold even when the
-        # survivor recompute itself is broken.
-        ok3, value, wall3, _info3 = self._attempt(
-            label, shrink_fn, self.policy.ceiling_s, consult_faults=False
-        )
-        if not ok3:
+            if classification == "straggler":
+                extended = deadline * self.retry_factor
+                ok2, value2, wall2, info2 = self._attempt(
+                    label, step_fn, extended
+                )
+                self.telemetry.record_retry(label, ok2, extended)
+                if ok2:
+                    sp.set_attr("outcome", "retry_ok")
+                    self.policy.observe(wall2)
+                    if self.peer_health is not None:
+                        self.peer_health.beat()
+                    return value2
+                # the retry stalled too: a straggler that never finishes
+                # is a dead peer for recovery purposes
+                _, dead2 = self._classify(info2)
+                dead = dead or dead2 or ["unresponsive"]
+            if shrink_fn is None:
+                sp.set_attr("outcome", "stalled")
+                self.telemetry.record_shrink(label, False, 0.0, None)
+                raise CollectiveStallError(
+                    f"collective {label!r} stalled past its "
+                    f"{deadline:.3f}s deadline (classified "
+                    f"{classification}; dead peers: {dead}) and no "
+                    "survivor recompute path was provided"
+                )
+            # the shrink runs in its own bounded worker too (the ceiling
+            # - a fresh mesh means recompile - and no fault consultation:
+            # the armed faults simulate the DEGRADED mesh, not the
+            # survivor route).  'Never wedge the caller' must hold even
+            # when the survivor recompute itself is broken.
+            ok3, value, wall3, _info3 = self._attempt(
+                label, shrink_fn, self.policy.ceiling_s,
+                consult_faults=False
+            )
+            if not ok3:
+                sp.set_attr("outcome", "shrink_stalled")
+                self.telemetry.record_shrink(
+                    label, False, wall3, self._survivor_count()
+                )
+                raise CollectiveStallError(
+                    f"survivor recompute for collective {label!r} "
+                    f"stalled past the {self.policy.ceiling_s:.1f}s "
+                    "ceiling - the degraded mesh AND the survivor route "
+                    "are both wedged"
+                )
+            sp.set_attr("outcome", "shrink_ok")
             self.telemetry.record_shrink(
-                label, False, wall3, self._survivor_count()
+                label, True, wall3, self._survivor_count()
             )
-            raise CollectiveStallError(
-                f"survivor recompute for collective {label!r} stalled "
-                f"past the {self.policy.ceiling_s:.1f}s ceiling - the "
-                f"degraded mesh AND the survivor route are both wedged"
-            )
-        self.telemetry.record_shrink(
-            label, True, wall3, self._survivor_count()
-        )
-        return value
+            return value
 
 
 # -- module-level plumbing ---------------------------------------------------

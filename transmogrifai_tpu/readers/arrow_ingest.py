@@ -24,6 +24,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..faults import injection as _faults
+from ..obs import trace as _obs_trace
 from ..schema.quarantine import (
     MalformedRowError,
     QuarantineBuffer,
@@ -166,4 +167,9 @@ class DeviceParquetIngest:
     def to_device(self):
         """Returns (X_device [n, d] float32, valid_mask [n, d] bool,
         rows)."""
-        return double_buffered_to_device(self._producer, len(self.columns))
+        with _obs_trace.span(
+            "ingest.device", source=self.path, format="parquet",
+        ):
+            return double_buffered_to_device(
+                self._producer, len(self.columns)
+            )

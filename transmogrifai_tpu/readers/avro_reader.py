@@ -25,6 +25,7 @@ from typing import Any, Optional, Sequence
 
 from ..faults import injection as _faults
 from ..features.feature import Feature
+from ..obs import trace as _obs_trace
 from ..schema.quarantine import (
     MalformedRowError,
     QuarantineBuffer,
@@ -292,6 +293,17 @@ class AvroReader:
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
     ) -> Dataset:
+        with _obs_trace.span(
+            "ingest.read", source=self.path, format="avro",
+            errors=self.errors,
+        ) as sp:
+            ds = self._generate_dataset(raw_features)
+            sp.set_attr("rows", len(ds))
+            return ds
+
+    def _generate_dataset(
+        self, raw_features: Sequence[Feature]
+    ) -> Dataset:
         recs = self.records
         if self.errors != "coerce":
             # memoized PER FEATURE SET: a repeat call with the same
@@ -440,6 +452,17 @@ class ParquetReader:
 
     def generate_dataset(
         self, raw_features: Sequence[Feature], params: Optional[dict] = None
+    ) -> Dataset:
+        with _obs_trace.span(
+            "ingest.read", source=self.path, format="parquet",
+            errors=self.errors,
+        ) as sp:
+            ds = self._generate_dataset(raw_features)
+            sp.set_attr("rows", len(ds))
+            return ds
+
+    def _generate_dataset(
+        self, raw_features: Sequence[Feature]
     ) -> Dataset:
         import numpy as np
         import pyarrow.parquet as pq

@@ -25,6 +25,7 @@ import numpy as np
 from ..faults import injection as _faults
 from ..features.feature import Feature
 from ..features.feature_builder import infer_feature_type
+from ..obs import trace as _obs_trace
 from ..schema.quarantine import (
     MalformedRowError,
     QuarantineBuffer,
@@ -117,7 +118,20 @@ class CSVReader:
         (readers/fast_csv.py) - no per-value python work for numeric
         columns; anything else (or no native lib) takes the python path.
         Strict/quarantine error modes run the checked python path (row
-        structure is required for ragged-row detection)."""
+        structure is required for ragged-row detection).  Each read is
+        one ``ingest.read`` trace span on the ambient run trace
+        (obs/)."""
+        with _obs_trace.span(
+            "ingest.read", source=self.path, format="csv",
+            errors=self.errors,
+        ) as sp:
+            ds = self._generate_dataset(raw_features, params)
+            sp.set_attr("rows", len(ds))
+            return ds
+
+    def _generate_dataset(
+        self, raw_features: Sequence[Feature], params: Optional[dict] = None
+    ) -> Dataset:
         if self.errors != "coerce":
             return self._generate_checked(raw_features)
         if self.use_native and all(

@@ -27,6 +27,7 @@ from typing import Mapping, Optional, Sequence, Type
 
 import numpy as np
 
+from ..obs import trace as _obs_trace
 from ..types.columns import Column, NumericColumn, TextColumn
 from ..types.feature_types import FeatureType, OPNumeric, Text
 from ..utils import native
@@ -138,6 +139,29 @@ def _retry_masked_unicode_cells(
 
 
 def read_csv_columnar(
+    path: str,
+    schema: Mapping[str, Type[FeatureType]],
+    headers: Optional[Sequence[str]] = None,
+    has_header: bool = True,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    wanted: Optional[Sequence[str]] = None,
+    errors: str = "coerce",
+    quarantine=None,
+    telemetry=None,
+) -> dict[str, Column]:
+    """One ``ingest.read`` trace span per native scan (obs/), wrapping
+    :func:`_read_csv_columnar`."""
+    with _obs_trace.span(
+        "ingest.read", source=path, format="csv_native", errors=errors,
+    ):
+        return _read_csv_columnar(
+            path, schema, headers=headers, has_header=has_header,
+            chunk_bytes=chunk_bytes, wanted=wanted, errors=errors,
+            quarantine=quarantine, telemetry=telemetry,
+        )
+
+
+def _read_csv_columnar(
     path: str,
     schema: Mapping[str, Type[FeatureType]],
     headers: Optional[Sequence[str]] = None,
@@ -503,6 +527,9 @@ class DeviceCSVIngest:
         """Returns (X_device [n, d] float32, valid_mask_device [n, d]
         bool, rows).  Missing numeric cells are 0 with mask False (the
         NumericColumn contract, device-side)."""
-        return double_buffered_to_device(
-            self._parse_worker, len(self.columns)
-        )
+        with _obs_trace.span(
+            "ingest.device", source=self.path, format="csv_native",
+        ):
+            return double_buffered_to_device(
+                self._parse_worker, len(self.columns)
+            )

@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Any, Mapping, Optional, Sequence
 
 from ..faults import injection as _faults
+from ..obs import trace as _obs_trace
 from ..serving.endpoint import (
     CompiledEndpoint,
     RowScoringError,
@@ -148,6 +149,10 @@ class DeploymentController:
             self._events.append(entry)
             if len(self._events) > _MAX_EVENTS:
                 del self._events[0]
+        # every lifecycle event is also a zero-duration span on the
+        # ambient run trace (obs/): a swap/canary/rollback lines up
+        # causally with the serving batches around it
+        _obs_trace.tracer().event("deploy." + event, **kw)
         return entry
 
     def _build_generation(self, model, version: str,

@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ..faults import injection as _faults
+from ..obs import trace as _obs_trace
 from ..serialization.model_io import (
     MANIFEST_JSON,
     SCHEMA_JSON,
@@ -354,6 +355,18 @@ class ModelRegistry:
     def publish(self, model, metrics: Optional[dict] = None,
                 parent: Optional[str] = None,
                 stage: str = "candidate") -> RegistryVersion:
+        """One ``registry.publish`` trace span per publish (obs/): the
+        artifact save + index commit ride the ambient run trace, the
+        published version tagged on exit."""
+        with _obs_trace.span("registry.publish", stage=stage) as sp:
+            entry = self._publish(model, metrics=metrics, parent=parent,
+                                  stage=stage)
+            sp.set_attr("version", entry.version)
+            return entry
+
+    def _publish(self, model, metrics: Optional[dict] = None,
+                 parent: Optional[str] = None,
+                 stage: str = "candidate") -> RegistryVersion:
         """Save ``model`` as the next version and record it in the index.
 
         The exclusive lock is held only to RESERVE the version id (a

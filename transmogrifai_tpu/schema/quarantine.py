@@ -23,12 +23,13 @@ when the caller does not pass one.
 """
 from __future__ import annotations
 
-import json
 import logging
 import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
+
+from ..obs.metrics import metrics_registry, write_json_artifact
 
 log = logging.getLogger("transmogrifai_tpu.schema")
 
@@ -179,7 +180,11 @@ class DataTelemetry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.started_at = time.time()
+        self.started_at = time.time()  # epoch stamp (correlation only)
+        self._pc_start = time.perf_counter()  # durations never use the
+        # epoch clock (the tests/test_style.py timing gate)
+        # unified metrics plane (obs/): snapshot registered as a view
+        metrics_registry().register_view("data", self)
         # model-version attribution (registry/): the ServingTelemetry-
         # shared pair, so data-plane metrics in bench JSON and
         # summary_json() name the model version they fed
@@ -238,7 +243,7 @@ class DataTelemetry:
     # -- reporting ----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
-            wall = max(time.time() - self.started_at, 1e-9)
+            wall = max(time.perf_counter() - self._pc_start, 1e-9)
             return {
                 "wall_s": round(wall, 3),
                 "model_version": self.model_version,
@@ -267,9 +272,7 @@ class DataTelemetry:
         snap = self.snapshot()
         if extra:
             snap.update(extra)
-        with open(path, "w") as f:
-            json.dump(snap, f, indent=1, sort_keys=True)
-            f.write("\n")
+        write_json_artifact(path, snap)
         log.info(self.log_line())
         return snap
 

@@ -44,6 +44,7 @@ from typing import Any, Mapping, Optional, Sequence
 
 from ..faults import injection as _faults
 from ..local.scorer import LocalScorer
+from ..obs import trace as _obs_trace
 from ..schema.contract import (
     SchemaDriftError,
     apply_drift_policy,
@@ -220,7 +221,16 @@ class CompiledEndpoint:
         out: list = []
         step = self.batch_buckets[-1]
         for lo in range(0, len(records), step):
-            out.extend(self._score_bucketed(records[lo:lo + step]))
+            chunk = records[lo:lo + step]
+            # one span per bucketed chunk (obs/): bucket + fused status
+            # tagged so a slow batch in the trace names its shape and
+            # whether it rode the fused program or the interpreted walk
+            with _obs_trace.span(
+                "serve.batch", n=len(chunk),
+                bucket=self.bucket_for(len(chunk)), fused=self.fused,
+                fused_reason=self.fused_reason,
+            ):
+                out.extend(self._score_bucketed(chunk))
         self._observe_drift(records)
         return out
 

@@ -11,13 +11,16 @@ analog of the bench's one-JSON-line evidence contract).
 """
 from __future__ import annotations
 
-import json
 import logging
 import threading
 import time
 from typing import Optional
 
-from ..utils.tracing import percentiles
+from ..obs.metrics import (
+    metrics_registry,
+    percentiles,
+    write_json_artifact,
+)
 
 log = logging.getLogger("transmogrifai_tpu.serving")
 
@@ -41,7 +44,13 @@ class ServingTelemetry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.started_at = time.time()
+        self.started_at = time.time()  # epoch stamp (correlation only)
+        self._pc_start = time.perf_counter()  # durations NEVER use the
+        # epoch clock (the tests/test_style.py timing gate)
+        # unified metrics plane (obs/): this accumulator's snapshot is a
+        # registered VIEW - same shape, scrapeable via `tx obs` and the
+        # Prometheus exposition next to mesh/data/stage metrics
+        metrics_registry().register_view("serving", self)
         # model-version attribution (registry/): every snapshot names
         # the model version + deployment generation that produced it, so
         # bench JSON and summary_json() metrics are attributable after a
@@ -251,7 +260,7 @@ class ServingTelemetry:
             fills = list(self._batch_fills)
             sizes = list(self._batch_sizes)
             depths = list(self._queue_depths)
-            wall = max(time.time() - self.started_at, 1e-9)
+            wall = max(time.perf_counter() - self._pc_start, 1e-9)
             batch_wall = max(self.batch_wall_s, 1e-9)
             rows = self.rows_ok + self.rows_failed
             fill_hist = {"0-25%": 0, "25-50%": 0, "50-75%": 0, "75-100%": 0}
@@ -352,8 +361,6 @@ class ServingTelemetry:
         snap = self.snapshot()
         if extra:
             snap.update(extra)
-        with open(path, "w") as f:
-            json.dump(snap, f, indent=1, sort_keys=True)
-            f.write("\n")
+        write_json_artifact(path, snap)
         log.info(self.log_line())
         return snap

@@ -6,6 +6,15 @@ wall-clock + row-count records accumulated during fit/transform, with the
 same structured-log-line style, retrievable at the end of a run.  The JAX
 profiler (jax.profiler.trace) fills the deep-tracing role the Spark UI
 played; ``profile_to`` wraps a block with an xplane dump.
+
+Since ISSUE 7 this module is a THIN layer over the unified observability
+plane (``transmogrifai_tpu/obs/``): quantiles come from the one shared
+implementation (:func:`transmogrifai_tpu.obs.metrics.percentiles` -
+``percentiles`` here is an alias kept for the many existing callers),
+``AppMetrics.timed`` additionally records a trace span per stage
+fit/transform so per-stage walls ride the run's span tree, and each
+``AppMetrics`` registers itself as a metrics-registry view.  Both this
+module and ``obs/`` stay importable before jax/numpy init.
 """
 from __future__ import annotations
 
@@ -15,9 +24,17 @@ import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
+
 log = logging.getLogger("transmogrifai_tpu.metrics")
 
 LOG_PREFIX = "op_stage_metrics"
+
+#: THE quantile implementation lives in obs/metrics.py now; this alias
+#: keeps every existing ``utils.tracing.percentiles`` caller working
+#: (tests pin the two names identical)
+percentiles = _obs_metrics.percentiles
 
 # -- mesh resilience surfacing ----------------------------------------------
 # parallel/resilience registers its MeshTelemetry event feed here so
@@ -35,13 +52,31 @@ def register_mesh_events_source(fn) -> None:
     _mesh_events_source = fn
 
 
+def mesh_events_dropped() -> int:
+    """How many times the mesh event feed failed to deliver (the
+    ``obs.events_dropped`` self-metric): a broken feed must be VISIBLE
+    in snapshots, not an invisible hole in the degradation report."""
+    return int(
+        _obs_metrics.metrics_registry().counter("obs.events_dropped").value
+    )
+
+
 def mesh_events(since_epoch=None) -> list:
     if _mesh_events_source is None:
         return []
     try:
         return list(_mesh_events_source(since_epoch))
-    except Exception as e:  # telemetry must never break metrics reporting
-        log.debug("mesh event source failed: %s", e)
+    except Exception as e:  # telemetry must never break metrics
+        # reporting - but a silently-broken event feed is exactly the
+        # invisible degradation ISSUE 7 forbids: count the drop in the
+        # obs self-metric (surfaced by AppMetrics.to_json) and log loud
+        _obs_metrics.metrics_registry().counter(
+            "obs.events_dropped",
+            help="telemetry event-feed failures (a broken feed, not an "
+                 "empty one)",
+        ).inc()
+        log.warning("mesh event source failed (drop counted in "
+                    "obs.events_dropped): %s", e)
         return []
 
 
@@ -79,10 +114,20 @@ class StageMetrics:
 @dataclass
 class AppMetrics:
     """Whole-run accumulation (reference: AppMetrics, OpSparkListener.scala:
-    133-161)."""
+    133-161).  ``start_time`` stays a wall-clock epoch (it anchors the
+    mesh-event window across accumulators); DURATIONS are measured on
+    ``time.perf_counter`` - the epoch clock can step under NTP and must
+    never time a stage (the tests/test_style.py timing gate)."""
 
     stages: list[StageMetrics] = field(default_factory=list)
     start_time: float = field(default_factory=time.time)
+    _pc_start: float = field(default_factory=time.perf_counter, repr=False)
+
+    def __post_init__(self) -> None:
+        # a metrics-registry view: every finite numeric leaf of
+        # to_json() becomes a scrapeable series (weakref - a finished
+        # run's metrics leave the scrape when the object does)
+        _obs_metrics.metrics_registry().register_view("stage", self)
 
     def record(self, m: StageMetrics) -> None:
         self.stages.append(m)
@@ -90,23 +135,27 @@ class AppMetrics:
 
     @contextlib.contextmanager
     def timed(self, stage, phase: str, n_rows: int) -> Iterator[None]:
-        t0 = time.time()
-        try:
-            yield
-        finally:
-            self.record(
-                StageMetrics(
-                    stage_uid=stage.uid,
-                    operation=stage.operation_name,
-                    phase=phase,
-                    wall_s=time.time() - t0,
-                    n_rows=n_rows,
+        t0 = time.perf_counter()
+        with _obs_trace.span(
+            "stage." + phase, uid=stage.uid,
+            op=stage.operation_name, rows=int(n_rows),
+        ):
+            try:
+                yield
+            finally:
+                self.record(
+                    StageMetrics(
+                        stage_uid=stage.uid,
+                        operation=stage.operation_name,
+                        phase=phase,
+                        wall_s=time.perf_counter() - t0,
+                        n_rows=n_rows,
+                    )
                 )
-            )
 
     @property
     def total_wall_s(self) -> float:
-        return time.time() - self.start_time
+        return time.perf_counter() - self._pc_start
 
     def by_operation(self) -> dict[str, float]:
         out: dict[str, float] = {}
@@ -127,28 +176,18 @@ class AppMetrics:
         ev = mesh_events(since_epoch=self.start_time)
         if ev:
             out["mesh_resilience_events"] = ev
+        dropped = mesh_events_dropped()
+        if dropped:
+            # the feed failed at least once this process: say so next to
+            # the (possibly empty) event list instead of letting a broken
+            # feed read as a healthy mesh
+            out["obs_events_dropped"] = dropped
         return out
 
-
-def percentiles(
-    values, qs: tuple = (50.0, 95.0, 99.0)
-) -> dict[str, float]:
-    """Empirical percentiles keyed 'p50'/'p95'/'p99' (linear interpolation
-    between order statistics).  The shared latency-summary helper behind
-    the serving telemetry (serving/telemetry.py) - dependency-light on
-    purpose so tracing stays importable before jax/numpy init."""
-    out: dict[str, float] = {}
-    vals = sorted(float(v) for v in values)
-    for q in qs:
-        key = f"p{q:g}"
-        if not vals:
-            out[key] = float("nan")
-            continue
-        pos = (len(vals) - 1) * (q / 100.0)
-        lo = int(pos)
-        hi = min(lo + 1, len(vals) - 1)
-        out[key] = vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
-    return out
+    def snapshot(self) -> dict:
+        """The metrics-registry view contract (the other telemetry
+        classes call theirs ``snapshot`` too)."""
+        return self.to_json()
 
 
 @contextlib.contextmanager

@@ -77,27 +77,40 @@ class OpWorkflowRunner:
 
     def run(self, run_type: str, params: Optional[OpParams] = None) -> OpWorkflowRunnerResult:
         params = params or OpParams()
-        t0 = time.time()
+        t0 = time.perf_counter()
+        from ..obs import trace as _obs_trace
         from .dag import compute_dag
 
         dag = compute_dag(self.workflow.result_features)
         params.apply_to_dag(dag)
         run_type = run_type.lower().replace("-", "_")
-        if run_type == "train":
-            result = self._train(params)
-        elif run_type == "score":
-            result = self._score(params)
-        elif run_type == "features":
-            result = self._features(params)
-        elif run_type == "evaluate":
-            result = self._evaluate(params)
-        elif run_type == "serve":
-            result = self._serve(params)
-        elif run_type == "deploy":
-            result = self._deploy(params)
-        else:
-            raise ValueError(f"unknown run type {run_type!r}")
-        result.wall_s = time.time() - t0
+        # one root span per run: every subsystem span underneath
+        # (ingest, stage fits, save, publish, swap, serve batches)
+        # inherits this trace id - the ISSUE 7 causal spine
+        with _obs_trace.span("run." + run_type, run_type=run_type):
+            if run_type == "train":
+                result = self._train(params)
+            elif run_type == "score":
+                result = self._score(params)
+            elif run_type == "features":
+                result = self._features(params)
+            elif run_type == "evaluate":
+                result = self._evaluate(params)
+            elif run_type == "serve":
+                result = self._serve(params)
+            elif run_type == "deploy":
+                result = self._deploy(params)
+            else:
+                raise ValueError(f"unknown run type {run_type!r}")
+        result.wall_s = time.perf_counter() - t0
+        # the observability-plane export knob: custom_params
+        # {"metrics_path": DIR} dumps metrics.json + metrics.prom
+        # (Prometheus text) + spans.jsonl after any run type
+        mp = params.custom_params.get("metrics_path")
+        if mp:
+            from ..obs import export_obs
+
+            export_obs(str(mp), extra={"run_type": run_type})
         return result
 
     # ------------------------------------------------------------------

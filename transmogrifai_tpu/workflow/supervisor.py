@@ -118,7 +118,8 @@ def supervise(
     identical_exits = 0
     last_exit: Optional[int] = None
     for attempt in range(max_restarts + 1):
-        start = time.time()
+        start = time.monotonic()  # durations never ride the epoch
+        # clock (the tests/test_style.py timing gate)
         proc = subprocess.Popen(list(cmd), env=env)
         killed_reason = None
         while True:
@@ -128,7 +129,7 @@ def supervise(
             if _faults.fires("supervisor.child_kill") is not None:
                 killed_reason = "injected child kill (fault drill)"
             s = staleness(heartbeat_path)
-            age = time.time() - start
+            age = time.monotonic() - start
             # a beat older than this attempt's start is a leftover from a
             # previous attempt/run - it must not void the startup grace
             if s is not None and s > age:

@@ -307,8 +307,8 @@ class OpWorkflow:
 
     def train(self) -> "OpWorkflowModel":
         """(reference: OpWorkflow.train:332-357)"""
+        from ..obs import trace as _obs_trace
         from ..parallel.distributed import initialize
-        from ..utils.tracing import AppMetrics
 
         # env-driven multi-host bootstrap (no-op single-process): on a pod,
         # every host must join the jax.distributed runtime before any stage
@@ -316,9 +316,22 @@ class OpWorkflow:
         # executor-bootstrap analog, SURVEY §5.8)
         initialize()
 
+        # run-scoped trace (obs/): the train span roots (or joins) the
+        # run's trace so reader ingest, per-stage fit/transform, and the
+        # eventual save/publish/serve all share one trace id
+        with _obs_trace.span("workflow.train") as _train_span:
+            model = self._train_traced(_train_span)
+        return model
+
+    def _train_traced(self, train_span) -> "OpWorkflowModel":
+        from ..obs import trace as _obs_trace
+        from ..utils.tracing import AppMetrics
+
         app_metrics = AppMetrics()
-        t0 = time.time()
-        raw = self.generate_raw_data()
+        t0 = time.perf_counter()
+        with _obs_trace.span("workflow.ingest"):
+            raw = self.generate_raw_data()
+        train_span.set_attr("rows", len(raw))
         dag = compute_dag(self.result_features)
         validate_dag(dag)
 
@@ -403,7 +416,7 @@ class OpWorkflow:
             raw_features=self.raw_features,
             stages=fitted,
             parameters=dict(self.parameters),
-            train_time_s=time.time() - t0,
+            train_time_s=time.perf_counter() - t0,
             blacklisted_features=list(self.blacklisted_features),
             rff_results=self.rff_results,
             schema_contract=contract,
@@ -633,12 +646,16 @@ class OpWorkflowModel:
         return ModelInsights.from_model(self).pretty()
 
     def save(self, path: str) -> None:
+        from ..obs import trace as _obs_trace
         from ..serialization.model_io import save_model
 
-        save_model(self, path)
+        with _obs_trace.span("model.save", path=path):
+            save_model(self, path)
 
     @staticmethod
     def load(path: str, workflow: "OpWorkflow") -> "OpWorkflowModel":
+        from ..obs import trace as _obs_trace
         from ..serialization.model_io import load_model
 
-        return load_model(path, workflow)
+        with _obs_trace.span("model.load", path=path):
+            return load_model(path, workflow)
