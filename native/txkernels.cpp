@@ -11,6 +11,7 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC txkernels.cpp -o libtxkernels.so
 
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -290,6 +291,31 @@ inline void csv_row_cells(const uint8_t* buf, int64_t row_begin,
 
 }  // namespace
 
+// dynamic CSV-scan thread cap (see tx_csv_cells); 0 = uninstalled
+std::atomic<int64_t> g_csv_thread_cap{0};
+
+void tx_set_csv_threads(int64_t n) {
+  g_csv_thread_cap.store(n < 0 ? 0 : n, std::memory_order_relaxed);
+}
+
+// GIL-free byte counting (ctypes releases the GIL around the call): the
+// chunk aligner's quote-parity scan and the scanner's newline-capacity
+// count were the largest GIL-held blocks in the sharded input pipeline's
+// workers - bytes.count() holds the GIL, this does not.
+int64_t tx_count_byte(const uint8_t* buf, int64_t len, int32_t byte) {
+  int64_t n = 0;
+  const uint8_t b = (uint8_t)byte;
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  while (p < end) {
+    p = (const uint8_t*)memchr(p, b, end - p);
+    if (p == nullptr) break;
+    n++;
+    p++;
+  }
+  return n;
+}
+
 // Cell extraction + numeric parse, threaded over row ranges.  Outputs are
 // COLUMN-major ([ncols, nrows]) so each parsed column is a contiguous
 // slice on the python side.  `row_starts` comes from tx_csv_index;
@@ -300,8 +326,27 @@ void tx_csv_cells(const uint8_t* buf, int64_t len, const int64_t* row_starts,
                   double* num_out, uint8_t* num_mask, int64_t* cell_begin,
                   int64_t* cell_end) {
   const unsigned hw = std::thread::hardware_concurrency();
-  const int64_t nthreads =
+  int64_t nthreads =
       nrows < 4096 ? 1 : (hw > 8 ? 8 : (hw ? hw : 1));
+  // per-call fan-out cap: the sharded input pipeline (readers/
+  // pipeline.py) runs several scans concurrently, and N workers each
+  // spawning the full default would oversubscribe the host.  The
+  // dynamic cap is an ATOMIC set via tx_set_csv_threads - mutating the
+  // environment from python while another thread's scan calls getenv
+  // is use-after-free UB (glibc setenv reallocs environ).  The
+  // TX_CSV_THREADS env var remains as a STATIC operator knob, read
+  // only when no dynamic cap is installed (a never-mutated environ is
+  // safe to getenv concurrently).
+  const int64_t dyn = g_csv_thread_cap.load(std::memory_order_relaxed);
+  if (dyn >= 1) {
+    if (dyn < nthreads) nthreads = dyn;
+  } else {
+    const char* cap = std::getenv("TX_CSV_THREADS");
+    if (cap != nullptr && cap[0] != '\0') {
+      const int64_t c = std::atol(cap);
+      if (c >= 1 && c < nthreads) nthreads = c;
+    }
+  }
   auto work = [&](int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; r++) {
       const int64_t rb = row_starts[r];

@@ -1,0 +1,633 @@
+"""Async sharded input pipeline drills (readers/pipeline.py, ISSUE 10).
+
+Covers the determinism seam (serial-vs-pipelined identical datasets,
+selection, and planted coefficients), exact quarantine accounting under
+worker concurrency (including armed fault points), clean shutdown on
+producer crash with the shard + file named, the workflow streaming
+ingest mode's partial-fit parity, and the tier-1 4-worker throughput
+floor (mechanism asserted before the ratio, mirroring the fused-serving
+floor pattern).
+"""
+import io
+import os
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.readers import fast_csv
+from transmogrifai_tpu.readers.csv_reader import CSVReader
+from transmogrifai_tpu.readers.pipeline import (
+    InputPipeline,
+    PipelinedCSVReader,
+    ShardIngestError,
+    pipelined_columns,
+    pipelined_design_matrix,
+    shard,
+    stack_chunk_columns,
+)
+from transmogrifai_tpu.schema.quarantine import (
+    MalformedRowError,
+    QuarantineBuffer,
+)
+from transmogrifai_tpu.selector.validator import (
+    OpCrossValidation,
+    StreamingFoldBuilder,
+    stratified_kfold_masks,
+)
+from transmogrifai_tpu.testkit.random_data import write_corrupted_csv
+from transmogrifai_tpu.types import feature_types as ft
+
+pytestmark = pytest.mark.skipif(
+    not fast_csv.fast_path_available(),
+    reason="native CSV kernels unavailable",
+)
+
+rng = np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _write_shards(tmp_path, nshards=4, rows=2_000, d=3, seed=0,
+                  prefix="s"):
+    r = np.random.RandomState(seed)
+    paths = []
+    for s in range(nshards):
+        M = r.randn(rows, d)
+        buf = io.StringIO()
+        np.savetxt(buf, M, delimiter=",", fmt="%.6f")
+        p = str(tmp_path / f"{prefix}{s}.csv")
+        with open(p, "w") as f:
+            f.write(",".join(f"x{i}" for i in range(d)) + "\n")
+            f.write(buf.getvalue())
+        paths.append(p)
+    return paths
+
+
+def _schema(d=3):
+    return {f"x{i}": ft.Real for i in range(d)}
+
+
+# -- determinism seam --------------------------------------------------------
+
+def test_pipelined_columns_identical_to_serial(tmp_path):
+    paths = _write_shards(tmp_path)
+    schema = _schema()
+    serial = [fast_csv.read_csv_columnar(p, schema) for p in paths]
+    pipe = InputPipeline(shard(paths), schema, workers=4,
+                         chunk_bytes=1 << 15)
+    cols = pipelined_columns(pipe)
+    for name in schema:
+        want = np.concatenate([c[name].values for c in serial])
+        assert np.array_equal(cols[name].values, want)
+        wmask = np.concatenate([c[name].mask for c in serial])
+        assert np.array_equal(cols[name].mask, wmask)
+
+
+def test_chunks_carry_shard_and_chunk_ids_and_ordered_mode(tmp_path):
+    paths = _write_shards(tmp_path, nshards=5)
+    # an empty shard (header only) must not wedge ordered reassembly
+    empty = str(tmp_path / "empty.csv")
+    with open(empty, "w") as f:
+        f.write("x0,x1,x2\n")
+    paths.insert(2, empty)
+    pipe = InputPipeline(shard(paths), _schema(), workers=3,
+                         chunk_bytes=1 << 14, ordered=True)
+    keys = [pc.order_key for pc in pipe.chunks()]
+    assert keys == sorted(keys)
+    assert len({k[0] for k in keys}) == 5  # every non-empty shard
+    assert all(k[0] != 2 for k in keys)  # the empty shard has no chunks
+
+
+def test_design_matrix_deterministic_any_arrival_order(tmp_path):
+    paths = _write_shards(tmp_path, nshards=6, rows=1_500)
+    schema = _schema()
+    cols = list(schema)
+    ref = None
+    for workers in (1, 4):
+        pipe = InputPipeline(shard(paths), schema, workers=workers,
+                             chunk_bytes=1 << 14)
+        X, M, n = pipelined_design_matrix(pipe, cols)
+        assert n == 9_000
+        if ref is None:
+            ref = X
+        else:
+            assert np.array_equal(ref, X)
+
+
+def test_serial_vs_pipelined_model_parity_planted(tmp_path):
+    """The ISSUE determinism pin: the same model fit from serial and
+    pipelined ingest — identical selection and planted-coefficient
+    parity."""
+    d, rows, nshards = 4, 3_000, 4
+    r = np.random.RandomState(3)
+    beta = np.array([1.0, -0.5, 0.25, 0.0])
+    paths = []
+    for s in range(nshards):
+        M = r.randn(rows, d)
+        y = (M @ beta + 0.5 * r.randn(rows) > 0).astype(float)
+        buf = io.StringIO()
+        np.savetxt(buf, np.column_stack([y, M]), delimiter=",",
+                   fmt="%.6f")
+        p = str(tmp_path / f"pl{s}.csv")
+        with open(p, "w") as f:
+            f.write("y," + ",".join(f"x{i}" for i in range(d)) + "\n")
+            f.write(buf.getvalue())
+        paths.append(p)
+    schema = {"y": ft.Real, **{f"x{i}": ft.Real for i in range(d)}}
+    cols = ["y"] + [f"x{i}" for i in range(d)]
+    # serial arm
+    serial = [fast_csv.read_csv_columnar(p, schema) for p in paths]
+    Xs = np.column_stack([
+        np.concatenate([c[x].values for c in serial]) for x in cols[1:]
+    ]).astype(np.float32)
+    ys = np.concatenate([c["y"].values for c in serial])
+    # pipelined arm
+    pipe = InputPipeline(shard(paths), schema, workers=4,
+                         chunk_bytes=1 << 14)
+    Xp_full, _, _ = pipelined_design_matrix(pipe, cols)
+    Xp, yp = Xp_full[:, 1:], Xp_full[:, 0].astype(np.float64)
+    assert np.array_equal(Xs, Xp) and np.array_equal(ys, yp)
+    # identical CV selection (streamed fold construction vs batch)
+    grid = [{"reg_param": 1e-3}, {"reg_param": 1e-1}]
+    cv = OpCrossValidation(num_folds=3, stratify=True)
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+
+    cv.evaluator = OpBinaryClassificationEvaluator()
+    lr = OpLogisticRegression(max_iter=15)
+    res_serial = cv.validate([(lr, grid)], Xs, ys)
+
+    def _chunks():
+        step = 2_000
+        for i, at in enumerate(range(0, len(yp), step)):
+            yield (0, i), Xp[at:at + step], yp[at:at + step]
+
+    res_stream = cv.validate_stream([(lr, grid)], _chunks())
+    assert res_serial.best_params == res_stream.best_params
+    assert res_serial.best_metric == pytest.approx(
+        res_stream.best_metric, abs=1e-12)
+    # planted parity: both ingest routes recover the same coefficients
+    p_s = lr.fit_arrays(Xs, ys)
+    p_p = lr.fit_arrays(Xp, yp)
+    assert np.array_equal(p_s["beta"], p_p["beta"])
+    assert np.sign(p_s["beta"][0]) > 0 and np.sign(p_s["beta"][1]) < 0
+
+
+def test_streamed_fold_masks_bit_identical_shuffled_arrival():
+    y = (np.random.RandomState(5).rand(10_000) > 0.6).astype(float)
+    X = np.random.RandomState(6).randn(10_000, 3).astype(np.float32)
+    want = stratified_kfold_masks(y, 4, seed=11, stratify=True)
+    fb = StreamingFoldBuilder(4, seed=11, stratify=True)
+    step = 1_000
+    order = list(range(0, 10_000, step))
+    np.random.RandomState(7).shuffle(order)  # arrival != source order
+    for at in order:
+        fb.observe((0, at // step), X[at:at + step], y[at:at + step])
+    Xf, yf, masks = fb.finalize()
+    assert np.array_equal(masks, want)
+    assert np.array_equal(yf, y) and np.array_equal(Xf, X)
+
+
+# -- quarantine under concurrency --------------------------------------------
+
+def test_quarantine_counts_exact_multi_shard(tmp_path):
+    nshards, rows, flips = 5, 400, 17
+    paths, truths = [], []
+    for s in range(nshards):
+        p = str(tmp_path / f"bad{s}.csv")
+        truths.append(write_corrupted_csv(
+            p, n_rows=rows, n_type_flips=flips, n_truncated=0,
+            seed=50 + s))
+        paths.append(p)
+    schema = {"y": ft.Real, "a": ft.Real, "c": ft.Text}
+    pipe = InputPipeline(shard(paths), schema, workers=4,
+                         errors="quarantine", chunk_bytes=1 << 13,
+                         quarantine_max_rows=1 << 16)
+    kept = sum(pc.n_rows for pc in pipe.chunks())
+    merged = pipe.merged_quarantine()
+    expected_rows = sorted(
+        s * rows + r
+        for s, t in enumerate(truths) for r in t["type_flip_rows"]
+    )
+    assert merged.total == nshards * flips
+    assert kept == nshards * (rows - flips)
+    assert sorted(r.row_index for r in merged.rows) == expected_rows
+    assert merged.by_reason == {"type_flip": nshards * flips}
+    # deterministic regardless of completion order: merge again equal
+    merged2 = pipe.merged_quarantine()
+    assert ([r.to_json() for r in merged2.rows]
+            == [r.to_json() for r in merged.rows])
+
+
+def test_quarantine_python_path_ragged_rows(tmp_path):
+    """The python fallback shard reader owns ragged-row detection the
+    native scanner cannot do — counts stay exact through the pipeline."""
+    nshards, rows = 3, 300
+    paths, truths = [], []
+    for s in range(nshards):
+        p = str(tmp_path / f"rag{s}.csv")
+        truths.append(write_corrupted_csv(
+            p, n_rows=rows, n_type_flips=4, n_truncated=6,
+            seed=70 + s))
+        paths.append(p)
+    schema = {"y": ft.Real, "a": ft.Real, "c": ft.Text}
+    pipe = InputPipeline(shard(paths), schema, workers=3,
+                         errors="quarantine", chunk_rows=64,
+                         use_native=False, quarantine_max_rows=1 << 16)
+    kept = sum(pc.n_rows for pc in pipe.chunks())
+    merged = pipe.merged_quarantine()
+    assert merged.total == nshards * 10
+    assert kept == nshards * (rows - 10)
+    assert merged.by_reason["type_flip"] == nshards * 4
+    assert merged.by_reason["truncated_row"] == nshards * 6
+
+
+def test_fault_points_fire_inside_worker_shards(tmp_path):
+    """reader.malformed_row / reader.type_flip armed while 4 workers
+    parse concurrently: exact fire accounting (times=K bounds total
+    fires across ALL workers), no hang, clean drain."""
+    paths = _write_shards(tmp_path, nshards=4, rows=500)
+    schema = _schema()
+    faults.configure(
+        "reader.type_flip:every=1:times=3 "
+        "reader.malformed_row:every=1:times=2"
+    )
+    pipe = InputPipeline(shard(paths), schema, workers=4,
+                         errors="quarantine", chunk_bytes=1 << 13,
+                         quarantine_max_rows=1 << 16)
+    t0 = time.perf_counter()
+    kept = sum(pc.n_rows for pc in pipe.chunks())
+    assert time.perf_counter() - t0 < 60
+    merged = pipe.merged_quarantine()
+    # the two points can co-fire on the same chunk's row 0 (one row,
+    # one reason recorded) - total injected rows is between max and sum
+    assert 3 <= merged.total <= 5
+    assert kept == 2_000 - merged.total
+    assert set(merged.by_reason) <= {"type_flip", "malformed_row"}
+
+
+def test_strict_mode_error_names_shard_and_file(tmp_path):
+    paths = _write_shards(tmp_path, nshards=3, rows=200)
+    bad = str(tmp_path / "s1.csv")  # corrupt the middle shard
+    with open(bad, "a") as f:
+        f.write("junk_cell,1.0,2.0\n")
+    pipe = InputPipeline(shard(paths), _schema(), workers=3,
+                         errors="strict", chunk_bytes=1 << 13)
+    with pytest.raises(ShardIngestError) as exc:
+        for _ in pipe.chunks():
+            pass
+    assert exc.value.shard_id == 1
+    assert exc.value.path == bad
+    assert isinstance(exc.value.cause, MalformedRowError)
+    # workers all joined: no leaked live threads
+    assert all(not t.is_alive() for t in pipe._threads)
+
+
+def test_producer_crash_drains_cleanly_no_hang(tmp_path):
+    """A worker crash (unreadable shard) surfaces as ShardIngestError
+    naming the shard + file; the bounded queue drains and every worker
+    joins - the pipeline can never wedge the trainer."""
+    paths = _write_shards(tmp_path, nshards=4, rows=800)
+    paths[2] = str(tmp_path / "missing.csv")  # ENOENT mid-fleet
+    pipe = InputPipeline(shard(paths), _schema(), workers=2,
+                         buffer_chunks=1, chunk_bytes=1 << 12)
+    t0 = time.perf_counter()
+    with pytest.raises(ShardIngestError) as exc:
+        for _ in pipe.chunks():
+            pass
+    assert time.perf_counter() - t0 < 60
+    assert exc.value.shard_id == 2
+    assert "missing.csv" in str(exc.value)
+    assert all(not t.is_alive() for t in pipe._threads)
+    assert pipe._queue.qsize() == 0  # drained
+
+
+def test_consumer_abandonment_stops_workers(tmp_path):
+    paths = _write_shards(tmp_path, nshards=4, rows=2_000)
+    pipe = InputPipeline(shard(paths), _schema(), workers=4,
+                         buffer_chunks=1, chunk_bytes=1 << 12)
+    it = pipe.chunks()
+    next(it)
+    it.close()  # GeneratorExit mid-stream
+    assert all(not t.is_alive() for t in pipe._threads)
+
+
+def test_parquet_and_avro_shards_interleave(tmp_path):
+    """The interleave stage speaks all three formats: a mixed
+    CSV + Parquet + Avro shard list lands one consistent column set."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    from transmogrifai_tpu.readers.avro_reader import save_dataset_avro
+    from transmogrifai_tpu.types.columns import column_from_list
+    from transmogrifai_tpu.types.dataset import Dataset
+
+    r = np.random.RandomState(21)
+    vals = {s: r.randn(500, 2) for s in range(3)}
+    csv_p = str(tmp_path / "m0.csv")
+    with open(csv_p, "w") as f:
+        f.write("x0,x1\n")
+        np.savetxt(f, vals[0], delimiter=",", fmt="%.6f")
+    pq_p = str(tmp_path / "m1.parquet")
+    pq.write_table(
+        pa.table({"x0": vals[1][:, 0], "x1": vals[1][:, 1]}), pq_p)
+    av_p = str(tmp_path / "m2.avro")
+    save_dataset_avro(Dataset({
+        "x0": column_from_list(vals[2][:, 0], ft.Real),
+        "x1": column_from_list(vals[2][:, 1], ft.Real),
+    }), av_p)
+    schema = {"x0": ft.Real, "x1": ft.Real}
+    pipe = InputPipeline(shard([csv_p, pq_p, av_p]), schema, workers=3,
+                         chunk_rows=200)
+    cols = pipelined_columns(pipe)
+    want = np.concatenate([vals[s][:, 0] for s in range(3)])
+    assert len(cols["x0"].values) == 1_500
+    assert np.allclose(cols["x0"].values, want, atol=1e-5)
+
+
+def test_avro_shard_checked_modes_match_serial_reader(tmp_path):
+    """Avro shards through the pipeline must count type flips exactly
+    like the serial avro route (strict raises, quarantine drops)."""
+    from transmogrifai_tpu.readers.avro_reader import save_dataset_avro
+    from transmogrifai_tpu.types.columns import column_from_list
+    from transmogrifai_tpu.types.dataset import Dataset
+
+    av = str(tmp_path / "flip.avro")
+    save_dataset_avro(Dataset({
+        "x0": column_from_list(
+            ["1.5", "junk", "2.5", "alsojunk", "3.5"], ft.Text),
+    }), av)
+    schema = {"x0": ft.Real}
+    pipe = InputPipeline(shard([av]), schema, workers=1,
+                         errors="quarantine")
+    kept = sum(pc.n_rows for pc in pipe.chunks())
+    merged = pipe.merged_quarantine()
+    assert kept == 3 and merged.total == 2
+    assert merged.by_reason == {"type_flip": 2}
+    assert sorted(r.row_index for r in merged.rows) == [1, 3]
+    pipe2 = InputPipeline(shard([av]), schema, workers=1,
+                          errors="strict")
+    with pytest.raises(ShardIngestError) as exc:
+        for _ in pipe2.chunks():
+            pass
+    assert isinstance(exc.value.cause, MalformedRowError)
+    assert exc.value.cause.row_index == 1
+
+
+# -- workflow streaming ingest ----------------------------------------------
+
+def _csv_workflow_shards(tmp_path, nshards=3, rows=400):
+    import csv as _csv
+
+    r = np.random.RandomState(9)
+    paths = []
+    for s in range(nshards):
+        p = str(tmp_path / f"wf{s}.csv")
+        with open(p, "w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(["y", "a", "cat"])
+            for i in range(rows):
+                a = r.randn()
+                y = float(a + 0.3 * r.randn() > 0)
+                w.writerow([
+                    y, "" if i % 13 == 0 else f"{a:.6f}",
+                    ("u", "v", "w")[int(r.randint(3))],
+                ])
+        paths.append(p)
+    return paths
+
+
+def _wf(reader):
+    from transmogrifai_tpu.ops.categorical import StringIndexer
+    from transmogrifai_tpu.ops.scalers import (
+        FillMissingWithMean,
+        OpScalarStandardScaler,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    cat = FeatureBuilder(ft.Text, "cat").as_predictor()
+    am = FillMissingWithMean().set_input(a).get_output()
+    asc = OpScalarStandardScaler().set_input(am).get_output()
+    ci = StringIndexer().set_input(cat).get_output()
+    vec = transmogrify([asc, ci])
+    pred = OpLogisticRegression(reg_param=0.1).set_input(
+        y, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_reader(reader)
+    return wf, pred
+
+
+def test_workflow_streaming_ingest_partial_fit_parity(tmp_path):
+    """Streaming train (vectorizer stats accumulated while shards
+    parse) must produce the same fitted stages and scores as the serial
+    reader over the concatenated data."""
+    paths = _csv_workflow_shards(tmp_path)
+    concat = str(tmp_path / "all.csv")
+    with open(concat, "w") as out:
+        out.write("y,a,cat\n")
+        for p in paths:
+            with open(p) as f:
+                next(f)
+                out.write(f.read())
+    schema = {"y": ft.RealNN, "a": ft.Real, "cat": ft.Text}
+    wf_s, pred_s = _wf(CSVReader(concat, schema=schema))
+    m_s = wf_s.train()
+    wf_p, pred_p = _wf(PipelinedCSVReader(paths, workers=3,
+                                          chunk_rows=128,
+                                          chunk_bytes=1 << 12))
+    m_p = wf_p.train()
+    by_type_s = {type(s).__name__: s for s in m_s.stages}
+    by_type_p = {type(s).__name__: s for s in m_p.stages}
+    assert by_type_s["_FillMeanModel"].fill == pytest.approx(
+        by_type_p["_FillMeanModel"].fill, rel=1e-12)
+    assert by_type_s["_ScaleModel"].mean == pytest.approx(
+        by_type_p["_ScaleModel"].mean, rel=1e-12)
+    assert by_type_s["_ScaleModel"].std == pytest.approx(
+        by_type_p["_ScaleModel"].std, rel=1e-9)
+    assert (by_type_s["StringIndexerModel"].labels
+            == by_type_p["StringIndexerModel"].labels)
+    probe = {"y": [0.0, 1.0], "a": [0.5, -1.2], "cat": ["u", "w"]}
+    s_s = m_s.score(data=probe)[pred_s.name]
+    s_p = m_p.score(data=probe)[pred_p.name]
+    assert np.allclose(s_s.probability, s_p.probability, atol=1e-9)
+
+
+def test_partial_fit_stats_are_one_shot():
+    """A fold refit after a streamed fit must re-observe its own data,
+    never silently reuse full-data statistics (leakage guard)."""
+    from transmogrifai_tpu.ops.scalers import FillMissingWithMean
+    from transmogrifai_tpu.types.columns import NumericColumn
+    from transmogrifai_tpu.types.dataset import Dataset
+
+    est = FillMissingWithMean()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    est.set_input(a)
+    est.accept_partial_fits([(2, 10.0), (2, 30.0)])
+    ds = Dataset({"a": NumericColumn(np.array([1.0, 2.0]),
+                                     np.array([True, True]), ft.Real)})
+    m1 = est.fit(ds)
+    assert m1.fill == pytest.approx(10.0)  # streamed (10+30)/4
+    m2 = est.fit(ds)  # refit: stats consumed, falls back to the data
+    assert m2.fill == pytest.approx(1.5)
+
+
+def test_runner_pipelined_ingest_knob(tmp_path):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    paths = _csv_workflow_shards(tmp_path, nshards=2, rows=200)
+    wf, pred = _wf(None)
+    runner = OpWorkflowRunner(wf)
+    params = OpParams(custom_params={
+        "ingest_shards": paths, "ingest_workers": 2,
+    })
+    res = runner.run("train", params)
+    assert res.model is not None
+    assert len(res.model._train_data_cache) == 400
+
+
+# -- streamed sufficient-statistics fit --------------------------------------
+
+def test_linreg_fit_from_stats_matches_batch_kernel():
+    r = np.random.RandomState(13)
+    n, d = 20_000, 6
+    X = r.randn(n, d).astype(np.float32)
+    beta = r.randn(d)
+    y = X @ beta + 0.05 * r.randn(n)
+    est = OpLinearRegression(reg_param=1e-3)
+    batch = est.fit_arrays(X, y)
+    stats = [
+        OpLinearRegression.streaming_fit_stats(X[at:at + 2_500],
+                                               y[at:at + 2_500])
+        for at in range(0, n, 2_500)
+    ]
+    streamed = est.fit_from_stats(stats)
+    assert np.allclose(batch["beta"], streamed["beta"], atol=1e-4)
+    assert batch["intercept"] == pytest.approx(
+        streamed["intercept"], abs=1e-4)
+    assert np.abs(streamed["beta"] - beta).max() < 0.05
+
+
+def test_stack_chunk_columns_matches_block(tmp_path):
+    paths = _write_shards(tmp_path, nshards=1, rows=500)
+    pipe = InputPipeline(shard(paths), _schema(), workers=1)
+    cols = list(_schema())
+    for pc in pipe.chunks():
+        A = stack_chunk_columns(pc.payload, cols)
+        block, _mask = fast_csv.chunk_to_block(pc.payload, cols)
+        assert np.allclose(A.T, block, atol=1e-6)
+
+
+# -- observability -----------------------------------------------------------
+
+def test_ingest_shard_spans_join_ambient_trace(tmp_path):
+    from transmogrifai_tpu.obs import trace as obs_trace
+
+    paths = _write_shards(tmp_path, nshards=3, rows=300)
+    tracer = obs_trace.reset_tracer()
+    with obs_trace.span("test.root") as root:
+        pipe = InputPipeline(shard(paths), _schema(), workers=3)
+        for _ in pipe.chunks():
+            pass
+        trace_id = root.trace_id
+    spans = tracer.spans(trace_id)
+    shard_spans = [s for s in spans if s["name"] == "ingest.shard"]
+    assert len(shard_spans) == 3
+    assert {s["attrs"]["shard"] for s in shard_spans} == {0, 1, 2}
+    for s in shard_spans:
+        assert s["attrs"]["rows"] == 300
+        assert s["attrs"]["quarantined"] == 0
+    obs_trace.reset_tracer()
+
+
+def test_pipeline_gauges_registered(tmp_path):
+    from transmogrifai_tpu.obs.metrics import metrics_registry
+
+    paths = _write_shards(tmp_path, nshards=2, rows=300)
+    pipe = InputPipeline(shard(paths), _schema(), workers=2)
+    for _ in pipe.chunks():
+        pass
+    doc = metrics_registry().to_json()["series"]
+    assert "pipeline.buffer_depth" in doc
+    assert doc["pipeline.chunks"]["value"] >= 2
+    assert "pipeline.producer_stall_ms" in doc
+    assert "pipeline.consumer_stall_ms" in doc
+
+
+# -- tier-1 throughput floor -------------------------------------------------
+
+def test_pipeline_4worker_throughput_floor(tmp_path):
+    """Pipelined 4-worker ingest of a multi-shard CSV must sustain
+    >= 1.5x the serial per-shard throughput on this host.  The test
+    first asserts the pipeline actually ran workers CONCURRENTLY
+    (producer busy time exceeding the wall = provable overlap) before
+    reading the ratio, mirroring the fused-serving floor pattern; a
+    failing ratio is re-measured before it fails the gate - a true
+    regression to serial ingest fails every attempt."""
+    d, rows_per_shard, nshards = 8, 150_000, 8
+    r = np.random.RandomState(1)
+    buf = io.StringIO()
+    np.savetxt(buf, r.randn(50_000, d), delimiter=",", fmt="%.5f")
+    blk = buf.getvalue().encode() * (rows_per_shard // 50_000)
+    hdr = (",".join(f"x{i}" for i in range(d)) + "\n").encode()
+    paths = []
+    for s in range(nshards):
+        p = str(tmp_path / f"floor{s}.csv")
+        with open(p, "wb") as f:
+            f.write(hdr)
+            f.write(blk)
+        paths.append(p)
+    for p in paths:  # page-cache warm so both arms measure parsing
+        with open(p, "rb") as f:
+            f.read()
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    n_total = rows_per_shard * nshards
+
+    def serial_wall():
+        t0 = time.perf_counter()
+        for p in paths:
+            fast_csv.read_csv_columnar(p, schema)
+        return time.perf_counter() - t0
+
+    def pipelined_wall():
+        pipe = InputPipeline(shard(paths), schema, workers=4)
+        t0 = time.perf_counter()
+        rows = sum(pc.n_rows for pc in pipe.chunks())
+        wall = time.perf_counter() - t0
+        assert rows == n_total
+        return wall, pipe.stats.snapshot()
+
+    ratio = None
+    for _attempt in range(3):
+        best_s = min(serial_wall(), serial_wall())
+        wall_1, st_1 = pipelined_wall()
+        wall_2, st_2 = pipelined_wall()
+        best_p, st = ((wall_1, st_1) if wall_1 <= wall_2
+                      else (wall_2, st_2))
+        # mechanism first: workers provably ran concurrently (total
+        # producer busy time well beyond one serial lane's wall)
+        assert st["producer_busy_s"] > st["wall_s"] * 1.3, st
+        assert st["overlap_fraction"] > 0.2, st
+        ratio = best_s / best_p
+        if ratio >= 1.5:
+            break
+    assert ratio >= 1.5, (
+        f"pipelined 4-worker ingest only {ratio:.2f}x serial "
+        f"({n_total / best_p:.0f} vs {n_total / best_s:.0f} rows/s) - "
+        "the interleave stopped paying for itself"
+    )

@@ -179,6 +179,34 @@ def test_no_unbounded_blocking_waits_under_parallel_and_workflow():
     assert not offenders, offenders
 
 
+def test_pipeline_queue_waits_are_bounded():
+    """Under readers/pipeline.py every queue ``.put()`` must carry an
+    explicit ``timeout=`` and every zero-argument ``.get()``/``.join()``
+    is forbidden (ISSUE 10, same rule family as the parallel/ gate): a
+    full prefetch buffer with a dead consumer - or a wedged worker at
+    join time - must never block ingest forever.  ``"sep".join(xs)`` /
+    ``d.get(k)`` carry arguments and pass; ``q.put(item)`` does NOT
+    pass (it has an argument but still blocks unboundedly)."""
+    p = ROOT / "readers" / "pipeline.py"
+    tree = ast.parse(p.read_text(encoding="utf-8"))
+    offenders = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr not in ("put", "get", "join"):
+            continue
+        has_timeout_kw = any(kw.arg == "timeout" for kw in node.keywords)
+        if attr == "put":
+            ok = has_timeout_kw
+        else:
+            ok = has_timeout_kw or bool(node.args)
+        if not ok:
+            offenders.append(f"{p}:{node.lineno} .{attr}()")
+    assert not offenders, offenders
+
+
 def test_no_silent_exception_swallowing_under_readers_and_schema():
     """Under readers/ and schema/ an ``except`` handler whose body is
     only ``pass``/``continue`` must still leave a trace (re-raise, use

@@ -146,6 +146,65 @@ class OpLinearRegression(PredictorEstimator):
             )
         return np.asarray(beta), np.asarray(b0)
 
+    # -- streamed sufficient-statistics fit (readers/pipeline.py) ----------
+    @staticmethod
+    def streaming_fit_stats(X_block, y_block) -> tuple:
+        """Per-chunk sufficient statistics for the closed-form ridge fit:
+        (n, Σx [d], XᵀX [d, d], Σy, Xᵀy [d]).  Mergeable by addition, so
+        the sharded input pipeline can accumulate them in its workers
+        WHILE later shards parse — fit_from_stats then completes the
+        ingest→fit overlap in O(d²) after the last chunk lands."""
+        Xb = np.asarray(X_block, dtype=np.float64)
+        yb = np.asarray(y_block, dtype=np.float64)
+        return (
+            len(yb), Xb.sum(axis=0), Xb.T @ Xb, float(yb.sum()),
+            Xb.T @ yb,
+        )
+
+    def fit_from_stats(self, stats) -> dict:
+        """Fit from accumulated :meth:`streaming_fit_stats` chunks —
+        the same centered/standardized ridge + reweighted-L1 math as
+        ``_linreg_fit_kernel``, reconstructed from the merged moments
+        (the [n, d] matrix never needs to exist).  Chunks are summed in
+        the given (deterministic source) order.  Parity with the batch
+        kernel is f32-level, pinned in tests."""
+        from .packed_newton import pd_jitter
+
+        stats = list(stats)
+        if not stats:
+            raise ValueError("fit_from_stats needs at least one chunk")
+        n = sum(s[0] for s in stats)
+        S1 = np.sum([s[1] for s in stats], axis=0)
+        S2 = np.sum([s[2] for s in stats], axis=0)
+        Sy = float(sum(s[3] for s in stats))
+        Sxy = np.sum([s[4] for s in stats], axis=0)
+        d = len(S1)
+        m0 = S1 / n
+        # centered second moments: Xcᵀ Xc = XᵀX - n·m0 m0ᵀ (Xc sums to 0,
+        # so the kernel's `mu`/`a` terms vanish exactly here)
+        XtX_c = S2 - n * np.outer(m0, m0)
+        var = np.maximum(np.diag(XtX_c) / n, 0.0)
+        msq = var  # mu == 0
+        active = var > 1e-6 * msq + 1e-30
+        sd = np.where(active, np.sqrt(np.maximum(var, 1e-12)), 1.0)
+        ybar = Sy / n
+        G = (XtX_c / np.outer(sd, sd) / n) * np.outer(active, active)
+        c = ((Sxy - m0 * Sy) / sd / n) * active
+        reg = float(self.params["reg_param"])
+        en = float(self.params["elastic_net_param"])
+        lam_l2 = reg * (1.0 - en)
+        lam_l1 = reg * en
+        ridge = float(pd_jitter(np.trace(G) / d, d, hess_bf16=False))
+        beta_s = np.zeros(d)
+        for _ in range(8):  # same reweighted-L1 schedule as the kernel
+            l1_diag = lam_l1 / (np.abs(beta_s) + 1e-3)
+            H = G + np.diag(lam_l2 + l1_diag + ridge + (1.0 - active))
+            new = np.linalg.solve(H, c)
+            beta_s = np.where(np.isfinite(new), new, beta_s)
+        beta = beta_s / sd
+        intercept = ybar - float(m0 @ beta)
+        return {"beta": beta, "intercept": float(intercept)}
+
     def predict_arrays(self, params: Any, X: np.ndarray):
         pred = np.asarray(
             _linreg_predict_kernel(

@@ -373,10 +373,26 @@ class StringIndexer(Estimator):
 
     input_types = [Text]
     output_type = RealNN
+    streaming_fittable = True
+
+    def partial_fit_chunk(self, cols: Sequence[Column], ds: Dataset):
+        """Mergeable per-chunk label counts — the streaming-ingest
+        overlap seam (stages/base.py); Counter addition is exact, so
+        streamed and batch fits index identically."""
+        (col,) = cols
+        return Counter(v for v in col.values if v is not None)
+
+    def _merge_partial_fits(self, stats: list):
+        total: Counter = Counter()
+        for c in stats:
+            total.update(c)
+        return total
 
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
-        (col,) = cols
-        counts = Counter(v for v in col.values if v is not None)
+        counts = self._take_streamed()
+        if counts is None:
+            (col,) = cols
+            counts = Counter(v for v in col.values if v is not None)
         labels = [v for v, _ in sorted(counts.items(), key=lambda vc: (-vc[1], vc[0]))]
         return StringIndexerModel(labels)
 

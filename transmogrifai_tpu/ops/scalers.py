@@ -57,12 +57,35 @@ class OpScalarStandardScaler(Estimator):
     input_types = [Real]
     output_type = RealNN
 
+    streaming_fittable = True
+
     def __init__(self, with_mean: bool = True, with_std: bool = True, **kw) -> None:
         super().__init__(**kw)
         self.with_mean = with_mean
         self.with_std = with_std
 
+    def partial_fit_chunk(self, cols: Sequence[Column], ds: Dataset):
+        """Mergeable moments (n, Σx, Σx²) of the present values — the
+        streaming-ingest overlap seam (stages/base.py)."""
+        (c,) = cols
+        present = c.values[c.mask]
+        return (int(present.size), float(present.sum()),
+                float(np.square(present).sum()))
+
+    def _merge_partial_fits(self, stats: list):
+        n = sum(s[0] for s in stats)
+        sx = sum(s[1] for s in stats)
+        sxx = sum(s[2] for s in stats)
+        return (n, sx, sxx)
+
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        streamed = self._take_streamed()
+        if streamed is not None:
+            n, sx, sxx = streamed
+            mean = sx / n if self.with_mean and n else 0.0
+            var = max(sxx / n - (sx / n) ** 2, 0.0) if n else 0.0
+            std = float(np.sqrt(var)) if self.with_std and n else 1.0
+            return _ScaleModel(float(mean), std)
         (c,) = cols
         assert isinstance(c, NumericColumn)
         present = c.values[c.mask]
@@ -107,12 +130,27 @@ class FillMissingWithMean(Estimator):
 
     input_types = [Real]
     output_type = RealNN
+    streaming_fittable = True
 
     def __init__(self, default: float = 0.0, **kw) -> None:
         super().__init__(**kw)
         self.default = default
 
+    def partial_fit_chunk(self, cols: Sequence[Column], ds: Dataset):
+        """Mergeable (n_present, Σx) — the streaming-ingest overlap
+        seam (stages/base.py)."""
+        (c,) = cols
+        present = c.values[c.mask]
+        return (int(present.size), float(present.sum()))
+
+    def _merge_partial_fits(self, stats: list):
+        return (sum(s[0] for s in stats), sum(s[1] for s in stats))
+
     def fit_model(self, cols: Sequence[Column], ds: Dataset):
+        streamed = self._take_streamed()
+        if streamed is not None:
+            n, sx = streamed
+            return _FillMeanModel(sx / n if n else self.default)
         (c,) = cols
         assert isinstance(c, NumericColumn)
         return _FillMeanModel(masked_mean(c.values, c.mask, self.default))

@@ -1,0 +1,3 @@
+"""Reader tier: per-file readers (csv_reader/avro_reader/arrow_ingest),
+the native chunked CSV scanner (fast_csv), and the async sharded input
+pipeline (pipeline: shard → interleave → map → prefetch)."""

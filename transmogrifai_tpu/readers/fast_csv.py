@@ -35,6 +35,14 @@ from ..utils import native
 DEFAULT_CHUNK_BYTES = 64 << 20
 
 
+def _count_quotes(buf: bytes) -> int:
+    """Quote count for the chunk aligner: the native GIL-free counter
+    when available (pipeline workers scan concurrently), bytes.count
+    otherwise."""
+    n = native.count_byte(buf, 0x22)
+    return buf.count(b'"') if n is None else n
+
+
 def _aligned_chunks(path: str, chunk_bytes: int):
     """Yield byte chunks ending on a record boundary: the cut point is a
     newline with an even number of quote bytes before it (cumulative from
@@ -53,7 +61,7 @@ def _aligned_chunks(path: str, chunk_bytes: int):
             # iteration - pathological all-quoted tails degrade to carry)
             cut = -1
             search_end = len(buf)
-            total_quotes = buf.count(b'"')
+            total_quotes = _count_quotes(buf)
             while search_end > 0:
                 nl = buf.rfind(b"\n", 0, search_end)
                 if nl < 0:
@@ -138,6 +146,25 @@ def _retry_masked_unicode_cells(
         mask[r] = True
 
 
+class CsvChunk:
+    """One decoded, keep-filtered chunk of a native CSV scan: the unit
+    the sharded input pipeline (readers/pipeline.py) moves through its
+    bounded queues.  ``numeric`` maps column name -> (values f64 [n],
+    present-mask bool [n]); ``text`` maps name -> object array of
+    optional strings.  ``row_offset`` is the chunk's first data-row
+    index within its source file (header excluded)."""
+
+    __slots__ = ("n_rows", "numeric", "text", "row_offset")
+
+    def __init__(self, n_rows: int,
+                 numeric: dict[str, tuple[np.ndarray, np.ndarray]],
+                 text: dict[str, np.ndarray], row_offset: int) -> None:
+        self.n_rows = n_rows
+        self.numeric = numeric
+        self.text = text
+        self.row_offset = row_offset
+
+
 def read_csv_columnar(
     path: str,
     schema: Mapping[str, Type[FeatureType]],
@@ -150,18 +177,72 @@ def read_csv_columnar(
     telemetry=None,
 ) -> dict[str, Column]:
     """One ``ingest.read`` trace span per native scan (obs/), wrapping
-    :func:`_read_csv_columnar`."""
+    the chunk iterator + columnar assembly."""
     with _obs_trace.span(
         "ingest.read", source=path, format="csv_native", errors=errors,
     ):
-        return _read_csv_columnar(
+        names = [n for n in (wanted or list(schema)) if n in schema]
+        chunks = iter_csv_chunks(
             path, schema, headers=headers, has_header=has_header,
             chunk_bytes=chunk_bytes, wanted=wanted, errors=errors,
             quarantine=quarantine, telemetry=telemetry,
         )
+        return assemble_columns(names, schema, chunks)
 
 
-def _read_csv_columnar(
+def _concat_parts(parts: list, empty) -> np.ndarray:
+    """Join chunk parts without the redundant single-part copy: chunk
+    arrays are freshly allocated per scan (never reused buffers), so a
+    lone part IS the column."""
+    if not parts:
+        return empty
+    if len(parts) == 1:
+        return parts[0]
+    return np.concatenate(parts)
+
+
+def assemble_columns(
+    names: Sequence[str],
+    schema: Mapping[str, Type[FeatureType]],
+    chunks,
+) -> dict[str, Column]:
+    """Drain a :class:`CsvChunk` iterator into Dataset columns.  Shared
+    by the serial fast path and the pipelined reader - one assembly
+    implementation means serial and pipelined ingest cannot disagree
+    about column semantics (NaN-as-missing, masked slots hold 0.0)."""
+    num_parts: dict[str, list] = {}
+    mask_parts: dict[str, list] = {}
+    text_parts: dict[str, list] = {}
+    for chunk in chunks:
+        for n, (vals_c, mask_c) in chunk.numeric.items():
+            num_parts.setdefault(n, []).append(vals_c)
+            mask_parts.setdefault(n, []).append(mask_c)
+        for n, txt in chunk.text.items():
+            text_parts.setdefault(n, []).append(txt)
+    out: dict[str, Column] = {}
+    for n in names:
+        t = schema[n]
+        if issubclass(t, OPNumeric):
+            vals = _concat_parts(num_parts.get(n, []), np.zeros(0))
+            mask = _concat_parts(mask_parts.get(n, []),
+                                 np.zeros(0, bool))
+            # literal "nan" cells parse as NaN; the python path treats NaN
+            # as missing (NumericColumn contract: masked slots hold 0.0)
+            nan = np.isnan(vals)
+            out[n] = NumericColumn(np.where(nan, 0.0, vals), mask & ~nan, t)
+        elif issubclass(t, Text):
+            vals = _concat_parts(text_parts.get(n, []),
+                                 np.empty(0, object))
+            out[n] = TextColumn(vals, t)
+        else:
+            raise TypeError(
+                f"fast CSV path supports numeric/text columns; {n} is "
+                f"{t.__name__}"
+            )
+    return out
+
+
+def iter_csv_chunks(
     path: str,
     schema: Mapping[str, Type[FeatureType]],
     headers: Optional[Sequence[str]] = None,
@@ -171,8 +252,12 @@ def _read_csv_columnar(
     errors: str = "coerce",
     quarantine=None,
     telemetry=None,
-) -> dict[str, Column]:
-    """Stream a CSV into columnar form via the native scanner.
+):
+    """Stream a CSV as decoded :class:`CsvChunk`s via the native scanner.
+
+    THE chunk producer behind ``read_csv_columnar``, ``DeviceCSVIngest``,
+    and the sharded input pipeline's CSV workers - one scan loop, one
+    junk rule, one quarantine implementation for every consumer.
 
     ``schema`` types every column to materialize; ``wanted`` restricts
     which columns are materialized (all schema'd columns by default).
@@ -183,10 +268,15 @@ def _read_csv_columnar(
     cells as missing values (legacy); ``"strict"`` raises
     MalformedRowError at the first non-empty numeric cell that fails to
     parse; ``"quarantine"`` drops such rows across ALL materialized
-    columns, recording (global row index, cell excerpt, reason).  The
-    scanner has no per-row field counts, so ragged/truncated-row
-    detection is the python reader's job (CSVReader routes checked
-    modes there); this path owns type-flip detection at native speed.
+    columns, recording (row index, cell excerpt, reason).  The scanner
+    has no per-row field counts, so ragged/truncated-row detection is
+    the python reader's job (CSVReader routes checked modes there);
+    this path owns type-flip detection at native speed.
+
+    Copy discipline: chunk arrays are views into the freshly allocated
+    per-scan buffers - the old per-column ``.copy()`` in the consumer
+    loop is hoisted out entirely (assembly's final concatenate is the
+    one copy), which closes most of the parse-vs-ingest throughput gap.
     """
     from ..schema.quarantine import (
         MalformedRowError,
@@ -207,9 +297,6 @@ def _read_csv_columnar(
         _parse_header(path) if has_header else None
     )
     first = True
-    num_parts: dict[str, list] = {}
-    mask_parts: dict[str, list] = {}
-    text_parts: dict[str, list] = {}
     col_idx: dict[str, int] = {}
     modes: Optional[np.ndarray] = None
     names: list[str] = []
@@ -253,14 +340,25 @@ def _read_csv_columnar(
         # pure-ASCII chunks (the hot path) skip the unicode retry check
         # entirely; isascii() short-circuits at the first high byte
         retry = not chunk.isascii()
+        # copy discipline: when the numeric columns dominate the scan
+        # matrix, the chunk columns stay views (the matrix IS the data;
+        # the one copy is assembly's concatenate).  When they are a
+        # minority — a wanted subset, or a text-heavy schema — copy the
+        # wanted slices instead: a view would pin the full
+        # [ncols, nrows] scan buffers until assembly drains the file
+        n_numeric = sum(1 for n in names if modes[col_idx[n]] == 1)
+        subset = (len(names) < len(header)
+                  or n_numeric * 2 < len(header))
         chunk_num: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         chunk_text: dict[str, np.ndarray] = {}
         for n in names:
             c = col_idx[n]
             if modes[c] == 1:
-                vals_c = num_vals[c].copy()
-                mask_c = num_mask[c].copy()
+                vals_c = num_vals[c].copy() if subset else num_vals[c]
+                mask_c = num_mask[c].copy() if subset else num_mask[c]
                 if retry:
+                    # in-place mutation is safe either way: the scan
+                    # buffers are fresh per call, never reused
                     _retry_masked_unicode_cells(
                         chunk, cb[c], ce[c], vals_c, mask_c
                     )
@@ -271,7 +369,7 @@ def _read_csv_columnar(
         if checked:
             # a masked-but-NON-EMPTY cell is junk the parser refused: a
             # type flip.  Empty cells (ce <= cb) and literal-nan cells
-            # (parsed, mask flows from the NaN handling below) are
+            # (parsed, mask flows from the assembly NaN handling) are
             # legitimate missing values in every mode.
             bad = np.zeros(nrows, dtype=bool)
             bad_detail: dict[int, tuple[str, str, str]] = {}
@@ -308,20 +406,17 @@ def _read_csv_columnar(
                     reason, col, cell = bad_detail[r]
                     quarantine.add(rows_seen + r, reason, col, cell)
                 keep = ~bad
+        row_offset = rows_seen
         rows_seen += nrows
-        rows_kept += nrows if keep is None else int(keep.sum())
-        for n in names:
-            if n in chunk_num:
-                vals_c, mask_c = chunk_num[n]
-                if keep is not None:
-                    vals_c, mask_c = vals_c[keep], mask_c[keep]
-                num_parts.setdefault(n, []).append(vals_c)
-                mask_parts.setdefault(n, []).append(mask_c)
-            else:
-                txt = chunk_text[n]
-                if keep is not None:
-                    txt = txt[keep]
-                text_parts.setdefault(n, []).append(txt)
+        out_rows = nrows
+        if keep is not None:
+            out_rows = int(keep.sum())
+            chunk_num = {
+                n: (v[keep], m[keep]) for n, (v, m) in chunk_num.items()
+            }
+            chunk_text = {n: t[keep] for n, t in chunk_text.items()}
+        rows_kept += out_rows
+        yield CsvChunk(out_rows, chunk_num, chunk_text, row_offset)
     if checked:
         (telemetry or data_telemetry()).record_read(
             path, rows_seen, rows_kept, quarantine
@@ -333,28 +428,31 @@ def _read_csv_columnar(
         missing = [n for n in names if n not in (header or [])]
         if missing:
             raise KeyError(f"columns {missing} not in CSV {path}")
-    out: dict[str, Column] = {}
-    for n in names:
-        t = schema[n]
-        if issubclass(t, OPNumeric):
-            vals = (np.concatenate(num_parts[n]) if n in num_parts
-                    else np.zeros(0))
-            mask = (np.concatenate(mask_parts[n]) if n in mask_parts
-                    else np.zeros(0, bool))
-            # literal "nan" cells parse as NaN; the python path treats NaN
-            # as missing (NumericColumn contract: masked slots hold 0.0)
-            nan = np.isnan(vals)
-            out[n] = NumericColumn(np.where(nan, 0.0, vals), mask & ~nan, t)
-        elif issubclass(t, Text):
-            vals = (np.concatenate(text_parts[n]) if n in text_parts
-                    else np.empty(0, object))
-            out[n] = TextColumn(vals, t)
-        else:
-            raise TypeError(
-                f"fast CSV path supports numeric/text columns; {n} is "
-                f"{t.__name__}"
-            )
-    return out
+
+
+def chunk_to_block(
+    chunk: CsvChunk, columns: Sequence[str]
+) -> tuple[np.ndarray, np.ndarray]:
+    """:class:`CsvChunk` -> ([rows, d] float32 design block, [rows, d]
+    bool present-mask).  One strided cast per column straight into the
+    final layout - the old double copy (fancy-index [d, rows] f64
+    intermediate + ``ascontiguousarray`` transpose) is hoisted out of
+    the consumer loop.  Missing slots are 0 with mask False and
+    literal-NaN cells count as missing (the NumericColumn contract,
+    device-side).  Shared by :class:`DeviceCSVIngest` and the sharded
+    input pipeline's design-matrix consumers."""
+    d = len(columns)
+    block = np.empty((chunk.n_rows, d), dtype=np.float32)
+    mask = np.empty((chunk.n_rows, d), dtype=bool)
+    for j, name in enumerate(columns):
+        vals, m = chunk.numeric[name]
+        block[:, j] = vals
+        mask[:, j] = m
+    nan = np.isnan(block)  # literal "nan" cells -> missing
+    if nan.any():
+        block = np.where(nan, np.float32(0.0), block)
+        mask = mask & ~nan
+    return block, mask
 
 
 def double_buffered_to_device(producer, n_cols: int) -> tuple:
@@ -422,103 +520,14 @@ class DeviceCSVIngest:
         self.telemetry = telemetry
 
     def _parse_worker(self, q: queue.Queue) -> None:
-        from ..schema.quarantine import (
-            MalformedRowError,
-            data_telemetry,
-            excerpt_of,
-        )
-
-        checked = self.errors != "coerce"
-        rows_seen = rows_kept = 0
         try:
-            header: Optional[list[str]] = None
-            idx: Optional[list[int]] = None
-            modes: Optional[np.ndarray] = None
-            first = True
-            for chunk in _aligned_chunks(self.path, self.chunk_bytes):
-                if first:
-                    if chunk.startswith(b"\xef\xbb\xbf"):
-                        chunk = chunk[3:]  # same BOM strip as the
-                        # columnar path (headerless files especially)
-                    if self.has_header:
-                        nl = chunk.find(b"\n")
-                        header = _parse_header(self.path)
-                        chunk = chunk[nl + 1 :] if nl >= 0 else b""
-                    else:
-                        n = chunk.split(b"\n", 1)[0].count(b",") + 1
-                        header = [f"c{i}" for i in range(n)]
-                    idx = [header.index(c) for c in self.columns]
-                    modes = np.zeros(len(header), dtype=np.uint8)
-                    modes[idx] = 1  # wanted numerics; everything else skips
-                    first = False
-                if not chunk:
-                    continue
-                res = native.csv_scan(chunk, len(header), modes)
-                if res is None:
-                    raise RuntimeError("native CSV kernels unavailable")
-                nrows, num_vals, num_mask, cb, ce = res
-                if nrows == 0:
-                    continue
-                if not chunk.isascii():
-                    # same unicode-digit float() retry as the columnar
-                    # path: both native ingest routes must agree with the
-                    # python reader on every cell
-                    for c in idx:
-                        _retry_masked_unicode_cells(
-                            chunk, cb[c], ce[c], num_vals[c], num_mask[c]
-                        )
-                keep = None
-                if checked:
-                    # same junk rule as read_csv_columnar: a non-empty
-                    # cell the parser (plus unicode retry) refused is a
-                    # type flip, not a missing value
-                    bad = np.zeros(nrows, dtype=bool)
-                    for c in idx:
-                        bad |= ~num_mask[c] & (ce[c] > cb[c])
-                    if bad.any():
-                        if self.errors == "strict":
-                            r0 = int(np.nonzero(bad)[0][0])
-                            c0 = next(
-                                c for c in idx
-                                if not num_mask[c][r0]
-                                and ce[c][r0] > cb[c][r0]
-                            )
-                            (self.telemetry or data_telemetry()
-                             ).record_strict_error(self.path)
-                            raise MalformedRowError(
-                                self.path, rows_seen + r0, "type_flip",
-                                self.columns[idx.index(c0)],
-                                excerpt_of(chunk[cb[c0][r0]:ce[c0][r0]]),
-                            )
-                        for r in np.nonzero(bad)[0]:
-                            c_bad = next(
-                                c for c in idx
-                                if not num_mask[c][r] and ce[c][r] > cb[c][r]
-                            )
-                            self.quarantine.add(
-                                rows_seen + int(r), "type_flip",
-                                self.columns[idx.index(c_bad)],
-                                excerpt_of(chunk[cb[c_bad][r]:ce[c_bad][r]]),
-                            )
-                        keep = ~bad
-                block = np.ascontiguousarray(
-                    num_vals[idx].T, dtype=np.float32
-                )  # [rows, d]
-                mask = num_mask[idx].T  # [rows, d]
-                if keep is not None:
-                    block = block[keep]
-                    mask = mask[keep]
-                rows_seen += nrows
-                rows_kept += block.shape[0]
-                nan = np.isnan(block)  # literal "nan" cells -> missing
-                if nan.any():
-                    block = np.where(nan, np.float32(0.0), block)
-                    mask = mask & ~nan
-                q.put((block, mask))
-            if checked:
-                (self.telemetry or data_telemetry()).record_read(
-                    self.path, rows_seen, rows_kept, self.quarantine
-                )
+            for chunk in iter_csv_chunks(
+                self.path, self.schema, has_header=self.has_header,
+                chunk_bytes=self.chunk_bytes, wanted=self.columns,
+                errors=self.errors, quarantine=self.quarantine,
+                telemetry=self.telemetry,
+            ):
+                q.put(chunk_to_block(chunk, self.columns))
             q.put(None)
         except BaseException as e:  # surface parse errors to the consumer
             q.put(e)

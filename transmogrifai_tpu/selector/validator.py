@@ -51,16 +51,92 @@ def stratified_kfold_masks(
     per label class when requested (reference: OpCrossValidation.scala:161-167
     label-stratified kFold)."""
     n = len(y)
+    if stratify:
+        classes = np.unique(y)
+        class_indices = {c: np.nonzero(y == c)[0] for c in classes}
+        return _kfold_masks_from_indices(class_indices, n, k, seed)
     rng = np.random.RandomState(seed)
     fold_of = np.empty(n, dtype=np.int64)
-    if stratify:
-        for c in np.unique(y):
-            idx = np.nonzero(y == c)[0]
-            perm = rng.permutation(len(idx))
-            fold_of[idx[perm]] = np.arange(len(idx)) % k
-    else:
-        fold_of[rng.permutation(n)] = np.arange(n) % k
+    fold_of[rng.permutation(n)] = np.arange(n) % k
     return np.stack([fold_of != f for f in range(k)], axis=0)
+
+
+def _kfold_masks_from_indices(
+    class_indices: dict, n: int, k: int, seed: int
+) -> np.ndarray:
+    """Stratified fold masks from precomputed per-class row indices:
+    THE shared implementation behind the batch path and the streamed
+    fold builder — identical RNG consumption order (classes ascending),
+    so streamed and batch masks are bit-equal (pinned in tier-1)."""
+    rng = np.random.RandomState(seed)
+    fold_of = np.empty(n, dtype=np.int64)
+    for c in sorted(class_indices):
+        idx = np.asarray(class_indices[c])
+        perm = rng.permutation(len(idx))
+        fold_of[idx[perm]] = np.arange(len(idx)) % k
+    return np.stack([fold_of != f for f in range(k)], axis=0)
+
+
+class StreamingFoldBuilder:
+    """CV fold construction that consumes design-matrix chunks AS THEY
+    LAND from the sharded input pipeline (readers/pipeline.py), instead
+    of waiting for the full matrix.
+
+    ``observe`` runs the per-chunk work — per-class row scans for the
+    stratified split plus block retention — while worker threads are
+    still parsing later shards; ``finalize`` orders chunks by their
+    (shard_id, chunk_id) key, assembles X/y with one copy pass, and
+    computes fold masks bit-identical to the batch
+    :func:`stratified_kfold_masks` on the assembled y (same RNG
+    consumption), regardless of chunk ARRIVAL order.
+    """
+
+    def __init__(self, k: int, seed: int = 42,
+                 stratify: bool = False) -> None:
+        self.k = int(k)
+        self.seed = int(seed)
+        self.stratify = bool(stratify)
+        self._chunks: list[tuple] = []  # (order_key, X, y, local_idx)
+        self.rows = 0
+
+    def observe(self, order_key, X_block, y_block) -> None:
+        Xb = np.asarray(X_block)
+        yb = np.asarray(y_block)
+        local: dict = {}
+        if self.stratify:
+            for c in np.unique(yb):
+                local[float(c)] = np.nonzero(yb == c)[0]
+        self._chunks.append((tuple(order_key), Xb, yb, local))
+        self.rows += len(yb)
+
+    def finalize(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Returns (X [n, d] float32, y [n], train_masks [k, n])."""
+        if not self._chunks:
+            raise ValueError("no chunks observed")
+        self._chunks.sort(key=lambda t: t[0])
+        n = self.rows
+        d = self._chunks[0][1].shape[1]
+        X = np.empty((n, d), np.float32)
+        y = np.empty(n, self._chunks[0][2].dtype)
+        class_indices: dict = {}
+        at = 0
+        for _, Xb, yb, local in self._chunks:
+            m = len(yb)
+            X[at:at + m] = Xb
+            y[at:at + m] = yb
+            for c, idx in local.items():
+                class_indices.setdefault(c, []).append(idx + at)
+            at += m
+        if self.stratify:
+            merged = {
+                c: np.concatenate(parts)
+                for c, parts in class_indices.items()
+            }
+            masks = _kfold_masks_from_indices(merged, n, self.k,
+                                              self.seed)
+        else:
+            masks = stratified_kfold_masks(y, self.k, self.seed, False)
+        return X, y, masks
 
 
 class OpValidator:
@@ -129,6 +205,41 @@ class OpValidator:
     def train_masks(self, y: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    #: one-shot mask override installed by validate_stream (fold masks
+    #: already built chunk-by-chunk during ingest)
+    _streamed_masks: Optional[np.ndarray] = None
+
+    def validate_stream(
+        self,
+        models: Sequence[tuple[PredictorEstimator, Sequence[dict]]],
+        chunks,
+        weights: Optional[np.ndarray] = None,
+    ) -> ValidationResult:
+        """:meth:`validate` fed by a chunk stream: ``chunks`` yields
+        (order_key, X_block, y_block) as the input pipeline lands them
+        (readers/pipeline.py).  Fold construction — the stratified
+        per-class row scans and the design-matrix assembly — runs
+        per chunk DURING parsing; the candidate fits start the moment
+        the last chunk lands.  Selection is identical to the batch path
+        on the same data (same masks, same RNG), pinned in tier-1."""
+        is_cv = isinstance(self, OpCrossValidation)
+        k = getattr(self, "num_folds", 1)
+        # per-chunk stratified scans only pay off when the masks will
+        # actually be used: non-CV validators (train/validation split)
+        # compute their own masks in validate(), so the builder just
+        # assembles X/y for them
+        builder = StreamingFoldBuilder(
+            k, self.seed, self.stratify and is_cv)
+        for order_key, Xb, yb in chunks:
+            builder.observe(order_key, Xb, yb)
+        X, y, masks = builder.finalize()
+        if is_cv:
+            self._streamed_masks = masks
+        try:
+            return self.validate(models, X, y, weights=weights)
+        finally:
+            self._streamed_masks = None
+
     def _metric_of(self, y: np.ndarray, pred, raw, prob) -> float:
         m = self.evaluator.evaluate_arrays(
             y, PredictionColumn(pred, raw, prob)
@@ -147,7 +258,12 @@ class OpValidator:
         OpCrossValidation fold aggregation :60,118-124)."""
         n = len(y)
         w = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
-        masks = self.train_masks(y)  # [k, n] True=train
+        if self._streamed_masks is not None:
+            # fold masks already built chunk-by-chunk during ingest
+            # (validate_stream); bit-identical to train_masks(y)
+            masks = self._streamed_masks
+        else:
+            masks = self.train_masks(y)  # [k, n] True=train
         k = masks.shape[0]
         larger = self.evaluator.larger_better
         all_results = []

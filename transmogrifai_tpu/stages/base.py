@@ -189,6 +189,43 @@ class Transformer(PipelineStage):
 class Estimator(PipelineStage):
     """A stage that must observe data to produce a fitted Transformer."""
 
+    #: estimators whose fit-time statistics are mergeable across row
+    #: chunks flip this True and implement :meth:`partial_fit_chunk`:
+    #: the workflow's streaming ingest mode (readers/pipeline.py) then
+    #: accumulates their statistics WHILE shards parse, and the
+    #: subsequent fit() consumes the merged stats instead of re-scanning
+    #: the materialized columns — the ingest/fit overlap seam.
+    streaming_fittable = False
+
+    #: merged streaming statistics, set by :meth:`accept_partial_fits`
+    #: and consumed EXACTLY ONCE by the next fit (one-shot so a later
+    #: refit — e.g. a CV fold refit on a subset — can never silently
+    #: reuse full-data statistics)
+    _streamed_stats = None
+
+    def partial_fit_chunk(self, cols: Sequence[Column], ds: Dataset):
+        """Pure per-chunk fit statistics (no state mutation): whatever
+        :meth:`accept_partial_fits` can merge deterministically."""
+        raise NotImplementedError(
+            f"{type(self).__name__} is not streaming-fittable"
+        )
+
+    def accept_partial_fits(self, stats: Sequence) -> None:
+        """Install chunk statistics (in deterministic source order) for
+        the next fit.  Default merge: hand the ordered list to
+        fit_model via ``_streamed_stats``; stages override
+        ``_merge_partial_fits`` for their stat shape."""
+        self._streamed_stats = self._merge_partial_fits(list(stats))
+
+    def _merge_partial_fits(self, stats: list):
+        return stats
+
+    def _take_streamed(self):
+        """Pop the installed streaming statistics (None when absent)."""
+        s = self._streamed_stats
+        self._streamed_stats = None
+        return s
+
     def fit_model(self, cols: Sequence[Column], ds: Dataset) -> "Transformer":
         raise NotImplementedError
 

@@ -93,6 +93,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ]
         except AttributeError:
             pass
+        try:  # GIL-free byte counting (sharded-pipeline workers)
+            lib.tx_count_byte.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ]
+            lib.tx_count_byte.restype = ctypes.c_int64
+            lib.tx_set_csv_threads.argtypes = [ctypes.c_int64]
+            lib.tx_set_csv_threads.restype = None
+        except AttributeError:
+            pass
         try:  # tree learner entry points (native/txtrees.cpp)
             lib.tx_fit_forest_hist.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -233,7 +242,10 @@ def csv_scan(
     if data.size == 0:
         z = np.zeros((ncols, 0))
         return 0, z, z.astype(bool), z.astype(np.int64), z.astype(np.int64)
-    cap = int(np.count_nonzero(data == 0x0A)) + 1
+    cap = count_byte(buf, 0x0A)
+    if cap is None:  # stale lib without the symbol
+        cap = int(np.count_nonzero(data == 0x0A))
+    cap += 1
     row_starts = np.zeros(cap, dtype=np.int64)
     nrows = int(
         lib.tx_csv_index(data.ctypes.data, data.size, row_starts.ctypes.data)
@@ -253,6 +265,34 @@ def csv_scan(
         num_mask.ctypes.data, cell_begin.ctypes.data, cell_end.ctypes.data,
     )
     return nrows, num_vals, num_mask.astype(bool), cell_begin, cell_end
+
+
+def count_byte(buf: bytes, byte: int) -> Optional[int]:
+    """Count occurrences of one byte WITHOUT holding the GIL (ctypes
+    releases it for the native call) — the sharded input pipeline's
+    workers use this for the quote-parity and newline scans that
+    ``bytes.count`` would serialize.  None when the lib lacks the
+    symbol (callers fall back to bytes.count)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "tx_count_byte"):
+        return None
+    if not buf:
+        return 0
+    return int(lib.tx_count_byte(buf, len(buf), int(byte)))
+
+
+def set_csv_threads(n: int) -> bool:
+    """Install (n >= 1) or clear (n = 0) the dynamic per-scan thread cap
+    for ``tx_csv_cells`` — an atomic the kernel reads, NOT an environment
+    mutation (setenv while another thread's scan getenv()s is
+    use-after-free UB).  The sharded input pipeline caps fan-out through
+    this while its workers run.  Returns False when the lib (or symbol)
+    is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "tx_set_csv_threads"):
+        return False
+    lib.tx_set_csv_threads(int(n))
+    return True
 
 
 def parse_doubles(values: Sequence[Optional[str]]) -> Optional[tuple[np.ndarray, np.ndarray]]:

@@ -121,6 +121,22 @@ class OpWorkflowRunner:
     def _train(self, params: OpParams) -> OpWorkflowRunnerResult:
         if self.train_reader is not None:
             self.workflow.set_reader(self.train_reader)
+        # pipelined-ingest knobs (readers/pipeline.py): custom_params
+        # ingest_shards=[paths...] swaps in the sharded parallel reader
+        # (ingest_workers / ingest_buffer_chunks / ingest_errors tune it)
+        shards = params.custom_params.get("ingest_shards")
+        if shards:
+            from ..readers.pipeline import PipelinedCSVReader
+
+            self.workflow.set_reader(PipelinedCSVReader(
+                [str(p) for p in shards],
+                workers=int(params.custom_params.get(
+                    "ingest_workers", 4)),
+                buffer_chunks=int(params.custom_params.get(
+                    "ingest_buffer_chunks", 8)),
+                errors=str(params.custom_params.get(
+                    "ingest_errors", "coerce")),
+            ))
         model = self.workflow.train()
         summary = model.summary_json()
         if params.model_location:

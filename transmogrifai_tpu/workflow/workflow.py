@@ -329,8 +329,13 @@ class OpWorkflow:
 
         app_metrics = AppMetrics()
         t0 = time.perf_counter()
-        with _obs_trace.span("workflow.ingest"):
-            raw = self.generate_raw_data()
+        raw = None
+        if self._streaming_eligible():
+            with _obs_trace.span("workflow.ingest", mode="streaming"):
+                raw = self._ingest_streaming()
+        if raw is None:
+            with _obs_trace.span("workflow.ingest"):
+                raw = self.generate_raw_data()
         train_span.set_attr("rows", len(raw))
         dag = compute_dag(self.result_features)
         validate_dag(dag)
@@ -369,12 +374,8 @@ class OpWorkflow:
         # reserveTestFraction, tuning/Splitter.scala:57)
         holdout: Optional[Dataset] = None
         train_data = raw
-        frac = float(self.parameters.get("reserve_test_fraction", 0.0))
         selectors = self._find_selectors(dag)
-        for selector in selectors:
-            sp = getattr(selector, "splitter", None)
-            if sp is not None:
-                frac = max(frac, getattr(sp, "reserve_test_fraction", 0.0))
+        frac = self._reserve_fraction(dag)
         if frac > 0.0:
             seed = int(self.parameters.get("split_seed", 42))
             rng = np.random.RandomState(seed)
@@ -425,6 +426,83 @@ class OpWorkflow:
         model._holdout_data_cache = holdout_out
         model.app_metrics = app_metrics
         return model
+
+    # -- streaming ingest (readers/pipeline.py) -------------------------
+    def _streaming_eligible(self) -> bool:
+        """Streaming ingest applies when the reader exposes the chunk
+        stream seam and nothing downstream needs the whole dataset
+        before the first chunk (RawFeatureFilter does).  Opt out with
+        ``parameters(streaming_ingest=False)``."""
+        return (
+            self._reader is not None
+            and hasattr(self._reader, "stream_dataset")
+            and self._raw_feature_filter is None
+            and bool(self.parameters.get("streaming_ingest", True))
+        )
+
+    def _reserve_fraction(self, dag) -> float:
+        frac = float(self.parameters.get("reserve_test_fraction", 0.0))
+        for selector in self._find_selectors(dag):
+            sp = getattr(selector, "splitter", None)
+            if sp is not None:
+                frac = max(frac, getattr(sp, "reserve_test_fraction", 0.0))
+        return frac
+
+    def _ingest_streaming(self) -> Optional["Dataset"]:
+        """Consume the reader's chunk stream: raw-feature
+        materialization happens per chunk WHILE worker threads parse the
+        remaining shards, and first-layer estimators with mergeable fit
+        statistics (Estimator.streaming_fittable) accumulate their
+        partial fits on each chunk as it lands — the tf.data
+        ingest/transform/fit overlap, workflow-side.
+
+        Partial-fit accumulation is leakage-gated: it observes the FULL
+        raw stream, so it only arms when no holdout will be reserved
+        (reserve fraction 0) — otherwise the stream still overlaps
+        materialization but every estimator fits from the materialized
+        train split as usual.  Chunk statistics merge in deterministic
+        (shard_id, chunk_id) source order regardless of arrival order,
+        so a streamed fit is reproducible run to run.
+        """
+        dag = compute_dag(self.result_features)
+        raw_names = {f.name for f in self.raw_features}
+        eligible = []
+        if self._reserve_fraction(dag) == 0.0:
+            eligible = [
+                s for s in flatten(dag)
+                if isinstance(s, Estimator)
+                and getattr(s, "streaming_fittable", False)
+                and all(f.name in raw_names for f in s.input_features)
+            ]
+        parts: list[tuple] = []
+        stats: dict[str, list] = {s.uid: [] for s in eligible}
+        stream = self._reader.stream_dataset(
+            self.raw_features, self.parameters
+        )
+        for pc, ds_chunk in stream:
+            for st in eligible:
+                cols = [ds_chunk[f.name] for f in st.input_features]
+                stats[st.uid].append(
+                    (pc.order_key, st.partial_fit_chunk(cols, ds_chunk))
+                )
+            parts.append((pc.order_key, ds_chunk))
+        parts.sort(key=lambda kv: kv[0])
+        if parts and any(len(p) for _, p in parts):
+            raw = Dataset.concat([p for _, p in parts])
+        else:
+            # zero rows (header-only shards, or every row quarantined):
+            # keep the batch path's shape — schema'd 0-row columns, not
+            # a column-less Dataset that KeyErrors on the first raw
+            # feature
+            raw = Dataset({
+                f.name: column_from_list([], f.ftype)
+                for f in self.raw_features
+            })
+        for st in eligible:
+            per_chunk = sorted(stats[st.uid], key=lambda kv: kv[0])
+            if per_chunk:
+                st.accept_partial_fits([s for _, s in per_chunk])
+        return raw
 
     def _find_selectors(self, dag: Sequence[Layer]) -> list:
         return [
