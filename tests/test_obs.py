@@ -196,6 +196,9 @@ def test_prometheus_round_trips_all_four_legacy_snapshots():
     kinds = {k.split("/")[0] for k in doc["views"]}
     assert {"serving", "mesh", "data", "stage"} <= kinds
 
+    from transmogrifai_tpu.obs import process_instance
+
+    inst = process_instance()
     samples = _parse_prometheus(prometheus_text_from_json(doc))
     missing, wrong = [], []
     for key, snap in doc["views"].items():
@@ -204,18 +207,25 @@ def test_prometheus_round_trips_all_four_legacy_snapshots():
             from transmogrifai_tpu.obs import sanitize_metric_name
 
             name = sanitize_metric_name(kind + "_" + "_".join(path))
-            got = samples.get((name, (("instance", idx),)))
+            got = samples.get(
+                (name, (("instance", inst), ("view", idx))))
             if got is None:
                 missing.append(name)
             elif abs(got - float(value)) > 1e-9:
                 wrong.append((name, got, value))
     assert not missing, f"series missing from exposition: {missing[:10]}"
     assert not wrong, f"series value mismatch: {wrong[:10]}"
+
+    # every sample names the process it came from (ISSUE 11 satellite:
+    # the instance label is a stable pid+nonce identity, never empty)
+    def _lbl(view):
+        return (("instance", inst), ("view", view))
+
     # spot-pin a few load-bearing ones end to end
-    assert samples[("tx_serving_rows_scored", (("instance", "0"),))] == 1.0
-    assert samples[("tx_serving_generation", (("instance", "0"),))] == 3.0
-    assert samples[("tx_mesh_detections", (("instance", "0"),))] == 1.0
-    assert samples[("tx_data_rows_quarantined", (("instance", "0"),))] == 3.0
+    assert samples[("tx_serving_rows_scored", _lbl("0"))] == 1.0
+    assert samples[("tx_serving_generation", _lbl("0"))] == 3.0
+    assert samples[("tx_mesh_detections", _lbl("0"))] == 1.0
+    assert samples[("tx_data_rows_quarantined", _lbl("0"))] == 3.0
 
 
 def test_prometheus_renderer_shared_with_saved_json(tmp_path, capsys):
@@ -283,9 +293,12 @@ def test_broken_mesh_event_feed_is_counted_and_surfaced():
         app = tracing.AppMetrics()
         doc = app.to_json()  # calls mesh_events again -> second drop
         assert doc["obs_events_dropped"] >= 2
-        # and the scrape sees the self-metric
+        # and the scrape sees the self-metric (instance-labeled)
+        from transmogrifai_tpu.obs import process_instance
+
         samples = _parse_prometheus(metrics_registry().prometheus_text())
-        assert samples[("tx_obs_events_dropped", ())] >= 2
+        assert samples[("tx_obs_events_dropped",
+                        (("instance", process_instance()),))] >= 2
     finally:
         tracing.register_mesh_events_source(old)
 
@@ -500,3 +513,57 @@ def test_runner_metrics_path_knob_exports_plane(tmp_path):
     with open(os.path.join(out_dir, "spans.jsonl")) as f:
         names = {json.loads(line)["name"] for line in f if line.strip()}
     assert {"run.train", "workflow.train", "ingest.read"} <= names
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellites: instance identity + truncated-JSONL tolerance
+# ---------------------------------------------------------------------------
+def test_instance_label_stable_and_overridable():
+    """The exposition `instance` label is a stable per-process identity
+    (pid + start nonce), overridable for replica names, and a caller
+    override beats the document stamp."""
+    from transmogrifai_tpu.obs import (
+        process_instance,
+        prometheus_text_from_json,
+        set_process_instance,
+    )
+
+    inst = process_instance()
+    assert inst == process_instance()  # stable for the process lifetime
+    assert inst.split("-")[0] == str(os.getpid())
+    try:
+        set_process_instance("replica-7")
+        reg = metrics_registry()
+        reg.counter("x.c").inc()
+        text = reg.prometheus_text()
+        assert 'tx_x_c{instance="replica-7"} 1' in text
+        doc = dict(reg.to_json(), instance="replica-7")
+        t2 = prometheus_text_from_json(doc, instance="other")
+        assert 'instance="other"' in t2 and "replica-7" not in t2
+    finally:
+        set_process_instance(None)
+
+
+def test_trace_cli_skips_truncated_jsonl_lines(tmp_path, capsys):
+    """A process killed mid-export truncates the LAST spans.jsonl line;
+    ``tx obs trace --slowest`` must skip-and-count it, not fail the
+    whole read (ISSUE 11 satellite - the pre-fix behavior returned an
+    error for the entire file)."""
+    tr = tracer()
+    with tr.span("whole"):
+        pass
+    p = str(tmp_path / "spans.jsonl")
+    tr.export_jsonl(p)
+    with open(p) as f:
+        content = f.read()
+    with open(p, "w") as f:
+        f.write(content)
+        f.write('{"trace": "t", "span": 1, "name": "torn mid-wri')
+    from transmogrifai_tpu import cli
+
+    rc = cli.main(["obs", "trace", "--path", p, "--slowest", "3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["lines_skipped"] == 1
+    assert out["spans"] == 1
+    assert out["trees"][0]["name"] == "whole"

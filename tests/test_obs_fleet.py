@@ -1,0 +1,731 @@
+"""Cross-process observability drills (ISSUE 11: obs/fleet.py +
+obs/slo.py).
+
+Pins the acceptance criteria:
+* trace-context export/adopt through the ``TX_OBS_TRACE_CONTEXT`` env
+  seam, threaded through the supervisor's child dispatch - a supervised
+  run spawning >=2 child processes (re-dispatch + deploy grandchild)
+  produces ONE merged trace tree whose root trace id appears in spans
+  from every pid;
+* trace ids stay collision-free across 10k ids minted in 4 concurrent
+  processes (and span ids stay linkable across a merged fleet);
+* >=3 concurrent shippers into one aggregation dir with one SIGKILLed
+  mid-write: the aggregator never surfaces a torn read, and the dead
+  process ages out via heartbeat staleness;
+* one Prometheus scrape carries series from every live process under
+  distinct ``instance`` labels plus fleet-level sums/maxes;
+* an SLO burn-rate alert fires while ``serving.nan_scores`` is armed
+  and clears after recovery, and a firing alert is a hard
+  RollbackPolicy signal;
+* the fleet shipper stays within the tier-1 CPU floor (shipper-on
+  <= 1.25x obs-off; bench.py --obs-fleet proves the tight numbers).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.obs import (
+    TRACE_CONTEXT_ENV,
+    FleetAggregator,
+    ObsShipper,
+    SLObjective,
+    SLOEngine,
+    load_slo_config,
+    metrics_registry,
+    process_instance,
+    read_json_torn_safe,
+    reset_metrics_registry,
+    reset_tracer,
+    set_enabled,
+    set_process_instance,
+    ship_now,
+    tracer,
+)
+from transmogrifai_tpu.obs.fleet import SHARD_SUFFIX
+from transmogrifai_tpu.obs.trace import Tracer, parse_context
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    reset_metrics_registry()
+    reset_tracer()
+    faults.reset()
+    yield
+    faults.reset()
+    reset_metrics_registry()
+    reset_tracer()
+
+
+def _child_env() -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(TRACE_CONTEXT_ENV, None)
+    env.pop("TX_FAULTS", None)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation
+# ---------------------------------------------------------------------------
+def test_context_export_adopt_roundtrip(monkeypatch):
+    """A tracer constructed under TX_OBS_TRACE_CONTEXT roots every span
+    it mints into the exported trace, parented to the exporting span."""
+    tr = tracer()
+    with tr.span("parent.root") as root:
+        ctx = tr.current_context()
+        assert ctx == f"{root.trace_id}:{root.span_id}"
+        assert parse_context(ctx) == (root.trace_id, root.span_id)
+        monkeypatch.setenv(TRACE_CONTEXT_ENV, ctx)
+        child_tr = Tracer()  # the child process's construction path
+    assert child_tr.contexts_adopted == 1
+    with child_tr.span("child.root") as c:
+        assert c.trace_id == root.trace_id
+        assert c.parent_id == root.span_id
+    # nested child spans still parent locally
+    with child_tr.span("child.a") as a:
+        with child_tr.span("child.b") as b:
+            assert b.parent_id == a.span_id
+            assert b.trace_id == root.trace_id
+    # a middle process with no span open relays the ADOPTED context on
+    assert child_tr.current_context() == ctx
+    # malformed contexts degrade to fresh local traces, never raise
+    assert parse_context("garbage") == (None, None)
+    assert parse_context("") == (None, None)
+    assert parse_context("t:not-an-int") == (None, None)
+
+
+def test_child_env_sets_and_strips_context(monkeypatch):
+    from transmogrifai_tpu.obs import child_env
+
+    tr = tracer()
+    with tr.span("spawner"):
+        env = child_env({"KEEP": "1"})
+        assert env["KEEP"] == "1"
+        assert TRACE_CONTEXT_ENV in env
+    # no ambient span + nothing adopted: a stale inherited context is
+    # STRIPPED, not forwarded
+    monkeypatch.delenv(TRACE_CONTEXT_ENV, raising=False)
+    env = child_env({TRACE_CONTEXT_ENV: "stale:1", "KEEP": "1"})
+    assert TRACE_CONTEXT_ENV not in env and env["KEEP"] == "1"
+
+
+_ID_MINTER_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.obs.trace import Tracer
+tr = Tracer(capacity=8)
+with open({out!r}, "w") as f:
+    for _ in range({n}):
+        s = tr.span("mint")
+        f.write(s.trace_id + " " + str(s.span_id) + "\\n")
+"""
+
+
+def test_trace_and_span_ids_collision_safe_4_processes_10k(tmp_path):
+    """Acceptance: 10k trace ids minted in each of 4 CONCURRENT
+    processes collide nowhere (the seed scheme's pid+4-byte prefix is
+    widened to pid+8-byte start nonce), and span ids are globally
+    unique too - they are the join keys of the merged fleet tree."""
+    n = 10_000
+    outs = [str(tmp_path / f"ids-{i}.txt") for i in range(4)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             _ID_MINTER_CHILD.format(repo=REPO, out=out, n=n)],
+            env=_child_env(),
+        )
+        for out in outs
+    ]
+    for p in procs:
+        p.wait(timeout=180)
+        assert p.returncode == 0
+    trace_ids: set = set()
+    span_ids: set = set()
+    total = 0
+    for out in outs:
+        with open(out) as f:
+            for line in f:
+                t, _, s = line.strip().partition(" ")
+                trace_ids.add(t)
+                span_ids.add(int(s))
+                total += 1
+    assert total == 4 * n
+    assert len(trace_ids) == total, "trace-id collision across processes"
+    assert len(span_ids) == total, "span-id collision across processes"
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation
+# ---------------------------------------------------------------------------
+def test_aggregator_merges_instances_sums_and_maxes(tmp_path):
+    """Shards from several (simulated) processes merge into one scrape:
+    per-process samples under distinct instance labels, fleet rollup
+    sums counters and maxes gauges."""
+    agg_dir = str(tmp_path / "agg")
+    reg = metrics_registry()
+    c = reg.counter("work.rows")
+    g = reg.gauge("work.depth")
+    try:
+        for inst, rows, depth in (("r1", 10, 3.0), ("r2", 32, 7.0)):
+            # same registry re-shipped under two identities: the values
+            # differ per ship, exactly like two replicas at different
+            # points in their run
+            c.inc(rows - c.value)
+            g.set(depth)
+            set_process_instance(inst)
+            ship_now(agg_dir)
+    finally:
+        set_process_instance(None)
+    agg = FleetAggregator(agg_dir, stale_after_s=300.0)
+    text = agg.prometheus_text()
+    assert 'tx_work_rows{instance="r1"} 10' in text
+    assert 'tx_work_rows{instance="r2"} 32' in text
+    assert 'tx_work_rows{instance="fleet",agg="sum"} 42' in text
+    assert 'tx_work_rows{instance="fleet",agg="max"} 32' in text
+    assert 'tx_work_depth{instance="fleet",agg="max"} 7' in text
+    assert agg.last_report["instances"] == ["r1", "r2"]
+    # the whole-fleet JSON document names both processes
+    doc = agg.to_json()
+    assert set(doc["processes"]) == {"r1", "r2"}
+    assert doc["fleet"]["sum"]["tx_work_rows"] == 42
+
+
+def test_aggregator_skips_torn_and_ages_out_dead(tmp_path):
+    agg_dir = str(tmp_path / "agg")
+    os.makedirs(agg_dir)
+    try:
+        set_process_instance("live")
+        ship_now(agg_dir)
+    finally:
+        set_process_instance(None)
+    # a torn shard: a writer killed mid-write on a rename-less fs
+    torn = os.path.join(agg_dir, "torn" + SHARD_SUFFIX)
+    with open(torn, "w") as f:
+        f.write('{"instance": "torn", "metrics": {"ser')
+    # a dead process: valid shard, stale heartbeat
+    dead = os.path.join(agg_dir, "dead" + SHARD_SUFFIX)
+    with open(dead, "w") as f:
+        json.dump({"instance": "dead", "pid": 1, "metrics": {},
+                   "spans": []}, f)
+    old = time.time() - 3600.0
+    os.utime(dead, (old, old))
+    assert read_json_torn_safe(torn) is None
+    agg = FleetAggregator(agg_dir, stale_after_s=5.0)
+    shards = agg.shards()
+    assert [d["instance"] for d in shards] == ["live"]
+    assert agg.last_report["shards_torn"] == 1
+    assert agg.last_report["shards_stale"] == 1
+    # the scrape renders without the dead/torn processes and without
+    # raising
+    text = agg.prometheus_text()
+    assert 'instance="live"' in text
+    assert "torn" not in text and '"dead"' not in text
+
+
+def test_concurrent_shippers_sigkill_one_mid_write(tmp_path):
+    """Acceptance satellite: >=3 processes export into one aggregation
+    dir while the parent aggregates concurrently; one child is
+    SIGKILLed mid-loop.  The aggregator never surfaces a torn read, and
+    the killed process ages out via heartbeat staleness while the
+    survivors stay in the scrape."""
+    agg_dir = str(tmp_path / "agg")
+    from transmogrifai_tpu.testkit.drills import (
+        FLEET_SHIPPER_CHILD_TEMPLATE,
+    )
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", FLEET_SHIPPER_CHILD_TEMPLATE.format(
+                repo=REPO, agg_dir=agg_dir, interval=0.01, duration=30.0)],
+            env=_child_env(), stdout=subprocess.PIPE, text=True,
+        )
+        for _ in range(3)
+    ]
+    try:
+        pids = []
+        for p in procs:
+            line = p.stdout.readline()  # SHIPPER_READY <pid>
+            assert line.startswith("SHIPPER_READY"), line
+            pids.append(int(line.split()[1]))
+        agg = FleetAggregator(agg_dir, stale_after_s=1.0)
+        # all three appear once each has shipped at least once
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if agg.last_report.get("shards_live", 0) >= 3:
+                break
+            agg.shards()
+            time.sleep(0.02)
+        assert agg.last_report["shards_live"] == 3, agg.last_report
+        victim = procs[0]
+        os.kill(victim.pid, signal.SIGKILL)
+        # hammer the aggregator THROUGH the kill window: any torn read
+        # would raise out of shards()/prometheus_text() right here
+        deadline = time.monotonic() + 3.0
+        saw_two = False
+        while time.monotonic() < deadline:
+            shards = agg.shards()
+            text = agg.prometheus_text()
+            assert agg.last_report["shards_torn"] == 0, agg.last_report
+            live = set(agg.last_report["instances"])
+            if len(shards) == 2:
+                saw_two = True
+                assert not any(
+                    i.startswith(f"{victim.pid}-") for i in live), live
+                for pid in pids[1:]:
+                    assert any(i.startswith(f"{pid}-") for i in live), (
+                        pid, live)
+                    assert f'instance="{pid}-' in text
+                break
+            time.sleep(0.05)
+        assert saw_two, "killed shipper never aged out of the scrape"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)
+            p.stdout.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: supervised multi-process run -> one merged trace + scrape
+# ---------------------------------------------------------------------------
+def test_e2e_supervised_fleet_drill(tmp_path):
+    """A supervised run that spawns >=2 child processes (supervisor
+    re-dispatch after a die-once exit + a deploy grandchild per
+    attempt) produces ONE merged trace tree whose root trace id appears
+    in spans from every pid, and one Prometheus scrape with series from
+    every live process under distinct instance labels."""
+    from transmogrifai_tpu.testkit.drills import (
+        FLEET_DEPLOY_CHILD_TEMPLATE,
+        FLEET_DRILL_CHILD_TEMPLATE,
+        drill_env,
+    )
+    from transmogrifai_tpu.workflow.supervisor import supervise
+
+    agg_dir = str(tmp_path / "agg")
+    heartbeat = str(tmp_path / "beat")
+    marker = str(tmp_path / "marker")
+    grand_src = FLEET_DEPLOY_CHILD_TEMPLATE.format(
+        repo=REPO, agg_dir=agg_dir)
+    child_src = FLEET_DRILL_CHILD_TEMPLATE.format(
+        repo=REPO, agg_dir=agg_dir, heartbeat=heartbeat, marker=marker,
+        first_exit=7, grand=grand_src)
+    tr = tracer()
+    with tr.span("fleet.drill.root") as root:
+        result = supervise(
+            [sys.executable, "-c", child_src],
+            heartbeat_path=heartbeat,
+            stale_after_s=120.0,
+            max_restarts=2,
+            poll_s=0.1,
+            env=drill_env(),
+            backoff_base_s=0.05,
+            backoff_seed=0,
+        )
+    assert result.returncode == 0
+    assert result.attempts == 2  # die-once: one re-dispatch happened
+    ship_now(agg_dir)  # the parent's own shard (root span included)
+
+    agg = FleetAggregator(agg_dir, stale_after_s=300.0)
+    spans = agg.merged_spans()
+    ours = [r for r in spans if r["trace"] == root.trace_id]
+    pids_in_trace = {r["pid"] for r in ours}
+    # parent + two dispatch attempts + their grandchildren = >=5 pids,
+    # and at the very least the required parent/child/grandchild hop
+    assert len(pids_in_trace) >= 4, pids_in_trace
+    assert os.getpid() in pids_in_trace
+
+    trees = [t for t in agg.span_trees()
+             if t["trace"] == root.trace_id]
+    assert len(trees) == 1, [t["name"] for t in trees]
+    tree = trees[0]
+    assert tree["name"] == "fleet.drill.root"
+
+    def walk(node):
+        yield node
+        for c in node.get("children", ()):
+            yield from walk(c)
+
+    nodes = list(walk(tree))
+    names = [nd["name"] for nd in nodes]
+    assert names.count("supervisor.dispatch") == 2
+    assert names.count("child.work") == 2
+    assert names.count("deploy.child") == 2
+    # every node of the merged tree shares the ONE root trace id
+    assert {nd["trace"] for nd in nodes} == {root.trace_id}
+    # child.work parents under a dispatch attempt, deploy.child under
+    # child.work: the tree reflects the PROCESS topology
+    for nd in nodes:
+        if nd["name"] == "child.work":
+            assert any(c["name"] == "deploy.child"
+                       for c in nd["children"])
+
+    # one scrape, every live process, distinct instance labels
+    text = agg.prometheus_text()
+    instances = agg.last_report["instances"]
+    assert len(instances) == len(set(instances)) >= 5
+    for inst in instances:
+        assert f'tx_obs_tracer_spans_recorded{{instance="{inst}"' in text
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+def test_slo_ratio_burn_fires_and_clears_synthetic():
+    """Deterministic state machine: burn over threshold in BOTH windows
+    fires; short-window recovery clears."""
+    reg = metrics_registry()
+    bad = reg.counter("drill.bad")
+    total = reg.counter("drill.total")
+    obj = SLObjective(
+        name="bad-ratio", kind="ratio",
+        numerator="drill.bad", denominator="drill.total",
+        objective=0.01, windows_s=(0.8, 0.15), burn_threshold=1.0)
+    eng = SLOEngine([obj], register=False)
+    eng.observe()  # baseline
+    deadline = time.monotonic() + 3.0
+    fired = False
+    while time.monotonic() < deadline and not fired:
+        bad.inc(10)
+        total.inc(10)  # 100% failure, objective 1%
+        rep = eng.observe()
+        fired = rep["objectives"]["bad-ratio"]["state"] == "firing"
+        time.sleep(0.02)
+    assert fired, "alert never fired under sustained burn"
+    assert [a["name"] for a in eng.firing()] == ["bad-ratio"]
+    # recovery: clean traffic only; the short window clears it
+    deadline = time.monotonic() + 3.0
+    cleared = False
+    while time.monotonic() < deadline and not cleared:
+        total.inc(50)
+        rep = eng.observe()
+        cleared = rep["objectives"]["bad-ratio"]["state"] == "ok"
+        time.sleep(0.05)
+    assert cleared, "alert never cleared after recovery"
+    events = eng.report()["events"]
+    assert [e["transition"] for e in events] == ["fired", "cleared"]
+    # no traffic burns no budget: more evaluations stay ok
+    for _ in range(3):
+        rep = eng.observe()
+    assert rep["objectives"]["bad-ratio"]["state"] == "ok"
+
+
+def test_slo_alert_fires_on_nan_scores_and_clears_after_recovery():
+    """Acceptance: arm ``serving.nan_scores`` -> the NaN-guard refusals
+    burn the nonfinite-rows budget and the alert FIRES; disarm -> clean
+    traffic rolls the short window and it CLEARS."""
+    from transmogrifai_tpu.serving import compile_endpoint
+    from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+    wf, _data, records, _name = tiny_drill_pipeline()
+    model = wf.train()
+    # breaker threshold high: this drill measures the SLO plane, not
+    # the breaker (whose own opens are a different objective)
+    endpoint = compile_endpoint(model, batch_buckets=(32,),
+                                breaker_threshold=10_000)
+    endpoint.score_batch(records[:32])  # warm, clean baseline traffic
+    # denominator = clean batch-path rows + refused rows: the direct
+    # score_batch path counts successes in rows_batched (rows_scored /
+    # rows_failed belong to the scheduler's request accounting)
+    obj = SLObjective(
+        name="serving-nonfinite", kind="ratio",
+        numerator="serving.breaker.rows_nonfinite",
+        denominator=("serving.rows_batched",
+                     "serving.breaker.rows_nonfinite"),
+        objective=0.05, windows_s=(0.8, 0.15), burn_threshold=1.0)
+    eng = SLOEngine([obj], register=False)
+    eng.observe()
+    faults.configure("serving.nan_scores:every=1")
+    try:
+        deadline = time.monotonic() + 5.0
+        fired = False
+        while time.monotonic() < deadline and not fired:
+            endpoint.score_batch(records[:32])  # poisoned -> refused
+            fired = bool(eng.observe()["firing"])
+            time.sleep(0.02)
+        assert fired, "SLO alert never fired while nan_scores armed"
+    finally:
+        faults.reset()
+    # recovery: the same endpoint serves clean traffic again
+    deadline = time.monotonic() + 5.0
+    cleared = False
+    while time.monotonic() < deadline and not cleared:
+        out = endpoint.score_batch(records[:32])
+        assert len(out) == 32
+        cleared = not eng.observe()["firing"]
+        time.sleep(0.05)
+    assert cleared, "SLO alert never cleared after recovery"
+    events = eng.report()["events"]
+    assert [e["transition"] for e in events] == ["fired", "cleared"]
+
+
+def test_firing_slo_is_a_hard_rollback_signal():
+    """RollbackPolicy.slo_engine: a firing burn-rate alert becomes a
+    hard rollback reason (``slo:<name>``) with the report in the
+    evidence, regardless of canary sample size."""
+    from transmogrifai_tpu.registry.rollback import RollbackPolicy
+
+    reg = metrics_registry()
+    bad = reg.counter("fleet.bad")
+    total = reg.counter("fleet.total")
+    obj = SLObjective(
+        name="fleet-errors", kind="ratio",
+        numerator="fleet.bad", denominator="fleet.total",
+        objective=0.01, windows_s=(0.5, 0.05), burn_threshold=1.0)
+    eng = SLOEngine([obj], register=False)
+    eng.observe()
+    policy = RollbackPolicy(slo_engine=eng)
+    time.sleep(0.06)
+    bad.inc(100)
+    total.inc(100)
+    eng.observe()
+    time.sleep(0.06)
+    bad.inc(100)
+    total.inc(100)
+    # evaluate() re-observes the engine itself, then reads alerts
+    decision = policy.evaluate({"rows_scored": 0}, {"rows_scored": 0})
+    signals = [r["signal"] for r in decision.reasons]
+    assert "slo:fleet-errors" in signals
+    assert decision.rollback
+    assert decision.evidence["slo"]["firing"] == ["fleet-errors"]
+    # a clean engine contributes nothing
+    policy2 = RollbackPolicy()
+    d2 = policy2.evaluate({"rows_scored": 0}, {"rows_scored": 0})
+    assert not d2.rollback
+
+
+def test_slo_config_load_validates(tmp_path):
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"slos": [
+        {"name": "p99", "kind": "threshold",
+         "metric": "serving.latency_ms.p99", "objective": 100.0},
+        {"name": "errs", "kind": "ratio",
+         "numerator": "serving.rows_failed",
+         "denominator": ["serving.rows_scored", "serving.rows_failed"],
+         "objective": 0.05},
+    ]}))
+    objs = load_slo_config(str(cfg))
+    assert [o.name for o in objs] == ["p99", "errs"]
+    # unknown keys fail loudly (a typo must not silently disable a knob)
+    cfg.write_text(json.dumps({"slos": [
+        {"name": "x", "kind": "ratio", "numerator": "a",
+         "denominator": "b", "objectve": 0.1}]}))
+    with pytest.raises(ValueError, match="objectve"):
+        load_slo_config(str(cfg))
+    with pytest.raises(ValueError):
+        SLObjective(name="w", kind="nope")
+    with pytest.raises(ValueError):  # (long, short) ordering enforced
+        SLObjective(name="w", kind="rate", numerator="a",
+                    windows_s=(1.0, 2.0))
+
+
+def test_slo_cli_over_export_and_agg_dir(tmp_path, capsys):
+    """tx obs slo: exit 1 when an objective's lifetime totals blow the
+    budget, 0 when clean; works over a saved export and over a fleet
+    aggregation dir."""
+    from transmogrifai_tpu import cli
+    from transmogrifai_tpu.obs import export_obs
+
+    reg = metrics_registry()
+    reg.counter("jobs.bad").inc(50)
+    reg.counter("jobs.total").inc(100)
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"slos": [
+        {"name": "bad-jobs", "kind": "ratio", "numerator": "jobs.bad",
+         "denominator": "jobs.total", "objective": 0.01,
+         "windows_s": [300.0, 60.0]}]}))
+    out_dir = str(tmp_path / "export")
+    export_obs(out_dir)
+    rc = cli.main(["obs", "slo", "--path", out_dir,
+                   "--config", str(cfg)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["firing"] == ["bad-jobs"]
+    assert report["objectives"]["bad-jobs"]["ratio"] == 0.5
+    # fleet aggregation dir: the same config over shipped shards
+    agg_dir = str(tmp_path / "agg")
+    ship_now(agg_dir)
+    rc = cli.main(["obs", "slo", "--path", agg_dir,
+                   "--config", str(cfg)])
+    assert rc == 1
+    assert json.loads(capsys.readouterr().out)["firing"] == ["bad-jobs"]
+    # a clean objective exits 0
+    cfg.write_text(json.dumps({"slos": [
+        {"name": "bad-jobs", "kind": "ratio", "numerator": "jobs.bad",
+         "denominator": "jobs.total", "objective": 0.9}]}))
+    rc = cli.main(["obs", "slo", "--path", out_dir,
+                   "--config", str(cfg)])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["firing"] == []
+
+
+def test_trace_cli_merges_fleet_shards(tmp_path, capsys):
+    """tx obs trace over an aggregation dir merges every live shard's
+    spans into one forest and reports fleet membership."""
+    from transmogrifai_tpu import cli
+
+    agg_dir = str(tmp_path / "agg")
+    tr = tracer()
+    with tr.span("merge.root"):
+        with tr.span("merge.child"):
+            pass
+    ship_now(agg_dir)
+    rc = cli.main(["obs", "trace", "--path", agg_dir])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["fleet"]["shards_live"] == 1
+    roots = [t["name"] for t in out["trees"]]
+    assert "merge.root" in roots
+    root = next(t for t in out["trees"] if t["name"] == "merge.root")
+    assert [c["name"] for c in root["children"]] == ["merge.child"]
+
+
+def test_runner_slo_path_knob_exports_report(tmp_path):
+    """The slo_path runner knob evaluates the config after any run and
+    writes slo_report.json next to the obs export."""
+    from tests.test_obs import _small_csv, _small_workflow
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({"slos": [
+        {"name": "spans-flowing", "kind": "threshold",
+         "metric": "obs_tracer.spans_recorded", "objective": 1e9}]}))
+    wf = _small_workflow(_small_csv(tmp_path))
+    runner = OpWorkflowRunner(wf)
+    out_dir = str(tmp_path / "obs_out")
+    runner.run("train", OpParams(
+        model_location=str(tmp_path / "model"),
+        custom_params={"metrics_path": out_dir,
+                       "slo_path": str(cfg)},
+    ))
+    with open(os.path.join(out_dir, "slo_report.json")) as f:
+        report = json.load(f)
+    assert report["firing"] == []
+    obj = report["objectives"]["spans-flowing"]
+    assert obj["state"] == "ok" and obj["value"] > 0
+    # the engine registered as a view: the scrape carries alert gauges
+    with open(os.path.join(out_dir, "metrics.prom")) as f:
+        assert "tx_slo_alerts_firing" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 floor: shipper overhead (bench.py --obs-fleet proves the
+# tight numbers; this is the loose CI-stable version)
+# ---------------------------------------------------------------------------
+def test_fleet_shipper_within_cpu_floor_of_obs_off(tmp_path):
+    """Serving with the obs plane ON and a live ObsShipper beating must
+    stay within 1.25x the CPU time of the plane OFF entirely
+    (min-of-3, interleaved arms)."""
+    from transmogrifai_tpu.serving import compile_endpoint
+    from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+    wf, _data, records, _name = tiny_drill_pipeline(n=240)
+    model = wf.train()
+    endpoint = compile_endpoint(model, batch_buckets=(1, 8, 32, 128))
+    endpoint.score_batch(records)  # warm both arms' caches
+    ship_dir = str(tmp_path / "agg")
+
+    def cpu_pass() -> float:
+        t0 = time.process_time()
+        for _ in range(4):
+            out = endpoint.score_batch(records)
+        assert len(out) == len(records)
+        return max(time.process_time() - t0, 1e-9)
+
+    on_c = off_c = float("inf")
+    for _ in range(3):
+        set_enabled(True)
+        with ObsShipper(ship_dir, interval_s=0.25):
+            on_c = min(on_c, cpu_pass())
+        set_enabled(False)
+        off_c = min(off_c, cpu_pass())
+    set_enabled(True)
+    assert on_c <= off_c * 1.25 + 0.01, (
+        f"fleet shipper overhead too high: on={on_c:.4f}s "
+        f"off={off_c:.4f}s cpu"
+    )
+    # and the shipper actually shipped a readable shard
+    agg = FleetAggregator(ship_dir, stale_after_s=300.0)
+    assert agg.shards(), "shipper never produced a shard"
+    assert any(i == process_instance()
+               for i in agg.last_report["instances"])
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+def test_rollup_sums_multiple_views_of_one_kind(tmp_path):
+    """A process holding TWO views of one kind (a deploy's stable +
+    canary ServingTelemetry) contributes BOTH to the fleet rollup -
+    last-one-wins would silently drop an arm from the sums."""
+    from transmogrifai_tpu.serving.telemetry import ServingTelemetry
+
+    stable = ServingTelemetry()
+    canary = ServingTelemetry()
+    for _ in range(10):
+        stable.record_request(0.001, "ok")
+    for _ in range(3):
+        canary.record_request(0.001, "ok")
+    agg_dir = str(tmp_path / "agg")
+    try:
+        set_process_instance("deployer")
+        ship_now(agg_dir)
+    finally:
+        set_process_instance(None)
+    agg = FleetAggregator(agg_dir, stale_after_s=300.0)
+    rollup = agg.fleet_rollup()
+    assert rollup["sum"]["tx_serving_rows_scored"] == 13
+    assert rollup["max"]["tx_serving_rows_scored"] == 10
+
+
+def test_threshold_spike_outside_windows_does_not_hold_alert():
+    """A threshold breach sampled BEFORE both windows is delta-baseline
+    data, not a live reading: once fresh in-window samples are healthy
+    the alert must clear (and an unobserved gap must not re-fire it)."""
+    reg = metrics_registry()
+    g = reg.gauge("probe.p99")
+    obj = SLObjective(name="p99", kind="threshold", metric="probe.p99",
+                      objective=10.0, windows_s=(0.3, 0.1),
+                      burn_threshold=1.0)
+    eng = SLOEngine([obj], register=False)
+    g.set(1000.0)
+    rep = eng.observe()  # spike in both windows: fires
+    assert rep["objectives"]["p99"]["state"] == "firing"
+    g.set(1.0)
+    time.sleep(0.35)  # the spike ages past BOTH windows
+    rep = eng.observe()
+    assert rep["objectives"]["p99"]["state"] == "ok", rep
+    # and with no fresh samples at all in-window, nothing fires
+    time.sleep(0.35)
+    st = eng._alerts["p99"]
+    burn, _info = eng._burn(obj, st.samples, time.perf_counter(), 0.1)
+    assert burn == 0.0
+
+
+def test_instance_identity_sanitized_for_labels_and_filenames(tmp_path):
+    """A hostile/typoed instance name cannot inject Prometheus label
+    syntax or escape the aggregation dir through the shard filename."""
+    agg_dir = str(tmp_path / "agg")
+    path = ship_now(agg_dir, instance='evil"name/../../x')
+    assert os.path.dirname(path) == agg_dir
+    assert "/" not in os.path.basename(path)[: -len(SHARD_SUFFIX)]
+    agg = FleetAggregator(agg_dir, stale_after_s=300.0)
+    text = agg.prometheus_text()
+    assert '"evil' not in text.replace('="evil', "")  # no stray quotes
+    assert 'instance="evil_name_.._.._x"' in text
+    try:
+        set_process_instance('rep"lica\n2')
+        assert process_instance() == "rep_lica_2"
+    finally:
+        set_process_instance(None)

@@ -313,8 +313,14 @@ def test_library_modules_do_not_print():
 #: epoch-clock subtraction sites that are provably NOT durations, keyed
 #: (relative-path, lineno) - each needs a justification here:
 #: supervisor.staleness compares time.time() against a heartbeat file's
-#: os.path.getmtime(), and mtimes only exist on the epoch timeline
-_EPOCH_SUB_ALLOWLIST = {("workflow/supervisor.py", 55)}
+#: os.path.getmtime(), and mtimes only exist on the epoch timeline;
+#: obs/fleet.py's shard-staleness check is the same mtime comparison
+#: (the PeerHealth convention - obs/ cannot import the supervisor
+#: helper because the obs plane stays stdlib/intra-obs at module level)
+_EPOCH_SUB_ALLOWLIST = {
+    ("workflow/supervisor.py", 64),
+    ("obs/fleet.py", 280),
+}
 
 
 def _is_time_time_call(node: ast.AST) -> bool:
@@ -391,6 +397,43 @@ def test_obs_plane_importable_before_jax_numpy():
                     if root not in stdlib:
                         offenders.append(f"{p}:{node.lineno} from "
                                          f"{node.module} import ...")
+    assert not offenders, offenders
+
+
+#: the only functions in obs/fleet.py allowed to parse foreign JSON
+#: bytes: both degrade torn/partial input to a skip-and-count, never an
+#: exception escaping into the aggregator/scrape path
+_FLEET_LOADER_FUNCS = {"read_json_torn_safe", "read_jsonl_tolerant"}
+
+
+def test_fleet_reads_snapshots_only_via_torn_safe_loader():
+    """obs/fleet.py may call ``json.load``/``json.loads`` ONLY inside
+    the torn-read-safe loaders (ISSUE 11 satellite): shard files are
+    written by OTHER processes that can be SIGKILLed mid-write, so any
+    direct parse elsewhere in the module is a latent crash of the whole
+    fleet scrape on one dying process."""
+    p = ROOT / "obs" / "fleet.py"
+    tree = ast.parse(p.read_text(encoding="utf-8"))
+    offenders = []
+
+    def _walk(node, func_name):
+        for child in ast.iter_child_nodes(node):
+            name = func_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and child.func.attr in ("load", "loads")
+                and isinstance(child.func.value, ast.Name)
+                and child.func.value.id == "json"
+                and func_name not in _FLEET_LOADER_FUNCS
+            ):
+                offenders.append(f"{p}:{child.lineno} json.{child.func.attr}"
+                                 f" outside the torn-safe loaders")
+            _walk(child, name)
+
+    _walk(tree, "<module>")
     assert not offenders, offenders
 
 

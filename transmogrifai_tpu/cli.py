@@ -616,7 +616,7 @@ def _parse_override(s: str) -> tuple[str, type]:
 
 
 # ---------------------------------------------------------------------------
-# observability commands (obs/: metrics exposition + span trees)
+# observability commands (obs/: metrics exposition + span trees + SLOs)
 # ---------------------------------------------------------------------------
 def _obs_resolve(path: str, default_name: str) -> str:
     """Accept either the export directory (the ``metrics_path`` knob's
@@ -626,10 +626,50 @@ def _obs_resolve(path: str, default_name: str) -> str:
     return path
 
 
+def _is_agg_dir(path: str) -> bool:
+    """A fleet aggregation dir: per-process ``*.obsshard.json`` files
+    (obs.fleet shippers) rather than a single-process export."""
+    from .obs.fleet import SHARD_SUFFIX
+
+    if not os.path.isdir(path):
+        return False
+    try:
+        return any(n.endswith(SHARD_SUFFIX) for n in os.listdir(path))
+    except OSError:
+        return False
+
+
+def _obs_load_spans(args) -> tuple[list, int, Optional[dict]]:
+    """-> (records, lines_skipped, fleet_report).  An aggregation dir
+    merges every live shard's spans (dead processes age out, torn
+    shards are counted); a plain export reads spans.jsonl through the
+    torn-read-safe loader - a process killed mid-export truncates its
+    LAST line, which must cost one span, not the whole read."""
+    from .obs.fleet import FleetAggregator, read_jsonl_tolerant
+
+    if _is_agg_dir(args.path):
+        agg = FleetAggregator(args.path,
+                              stale_after_s=args.stale_after_s)
+        return agg.merged_spans(), 0, dict(agg.last_report)
+    records, skipped = read_jsonl_tolerant(
+        _obs_resolve(args.path, "spans.jsonl"))
+    return records, skipped, None
+
+
 def _obs_main(args) -> int:
     from .obs import build_trees, prometheus_text_from_json
+    from .obs.fleet import FleetAggregator
 
     if args.obs_cmd == "metrics":
+        if _is_agg_dir(args.path):
+            agg = FleetAggregator(args.path,
+                                  stale_after_s=args.stale_after_s)
+            if args.format == "prometheus":
+                print(agg.prometheus_text(), end="")
+            else:
+                print(json.dumps(agg.to_json(), indent=1, sort_keys=True,
+                                 default=str))
+            return 0
         path = _obs_resolve(args.path, "metrics.json")
         try:
             with open(path) as f:
@@ -643,21 +683,8 @@ def _obs_main(args) -> int:
             print(json.dumps(doc, indent=1, sort_keys=True, default=str))
         return 0
     if args.obs_cmd == "trace":
-        path = _obs_resolve(args.path, "spans.jsonl")
-        records = []
         try:
-            with open(path) as f:
-                for lineno, line in enumerate(f, 1):
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        records.append(json.loads(line))
-                    except ValueError as e:
-                        print(json.dumps({
-                            "error": f"{path}:{lineno}: not JSON: {e}"
-                        }))
-                        return 2
+            records, skipped, fleet_report = _obs_load_spans(args)
         except OSError as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
             return 2
@@ -668,33 +695,79 @@ def _obs_main(args) -> int:
             trees = sorted(
                 trees, key=lambda t: -float(t.get("wall_ms", 0.0))
             )[: args.slowest]
-        print(json.dumps({
+        out = {
             "spans": len(records),
             "roots": len(trees),
+            "lines_skipped": skipped,
             "trees": trees,
-        }, indent=1, sort_keys=True, default=str))
+        }
+        if fleet_report is not None:
+            out["fleet"] = fleet_report
+        print(json.dumps(out, indent=1, sort_keys=True, default=str))
         return 0
+    if args.obs_cmd == "slo":
+        from .obs.slo import SLOEngine, default_objectives, load_slo_config
+
+        try:
+            objectives = (load_slo_config(args.config) if args.config
+                          else default_objectives())
+            if _is_agg_dir(args.path):
+                agg = FleetAggregator(args.path,
+                                      stale_after_s=args.stale_after_s)
+                docs = agg.merged_metrics_docs()
+            else:
+                with open(_obs_resolve(args.path, "metrics.json")) as f:
+                    docs = json.load(f)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        # one-shot evaluation: cumulative totals ARE the window (the
+        # engine's baseline-less fallback), so a saved artifact whose
+        # lifetime error ratio blew the objective reads as firing
+        engine = SLOEngine(objectives, register=False)
+        engine.observe(docs)
+        report = engine.report()
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+        return 1 if report["firing"] else 0
     raise AssertionError(f"unhandled obs command {args.obs_cmd}")
 
 
 def _add_obs_parser(sub) -> None:
     o = sub.add_parser("obs", help="unified observability plane "
-                                   "(metrics exposition, span trees)")
+                                   "(metrics exposition, span trees, "
+                                   "SLO evaluation)")
     osub = o.add_subparsers(dest="obs_cmd", required=True)
     m = osub.add_parser("metrics",
-                        help="render an exported metrics document")
+                        help="render an exported metrics document or a "
+                             "fleet aggregation dir")
     m.add_argument("--path", required=True,
-                   help="export dir (metrics_path knob) or metrics.json")
+                   help="export dir (metrics_path knob), metrics.json, "
+                        "or a fleet aggregation dir (obsshard files)")
     m.add_argument("--format", choices=("prometheus", "json"),
                    default="prometheus")
     t = osub.add_parser("trace", help="reconstruct span trees from a "
-                                      "spans.jsonl export")
+                                      "spans.jsonl export or a fleet "
+                                      "aggregation dir")
     t.add_argument("--path", required=True,
-                   help="export dir (metrics_path knob) or spans.jsonl")
+                   help="export dir (metrics_path knob), spans.jsonl, "
+                        "or a fleet aggregation dir")
     t.add_argument("--trace-id", default=None,
                    help="only this trace id")
     t.add_argument("--slowest", type=int, default=None, metavar="N",
                    help="only the N slowest root spans")
+    s = osub.add_parser("slo", help="evaluate declarative SLOs against "
+                                    "exported/aggregated metrics "
+                                    "(exit 1 when any alert fires)")
+    s.add_argument("--path", required=True,
+                   help="export dir, metrics.json, or aggregation dir")
+    s.add_argument("--config", default=None,
+                   help="SLO config JSON ({'slos': [...]}); default: "
+                        "the built-in serving objectives")
+    for cmd in (m, t, s):
+        cmd.add_argument("--stale-after-s", type=float, default=None,
+                         dest="stale_after_s", metavar="S",
+                         help="aggregation-dir heartbeat staleness "
+                              "cutoff (default TX_OBS_FLEET_STALE_S/60)")
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,15 @@ One package, three coupled pieces (ISSUE 7):
 * :mod:`~transmogrifai_tpu.obs.profiler` - always-on per-span EWMA +
   histogram with a p99 tail sampler retaining full span trees for slow
   outliers.
+* :mod:`~transmogrifai_tpu.obs.fleet` (ISSUE 11) - cross-process
+  trace-context propagation (``TX_OBS_TRACE_CONTEXT``), per-process
+  metric/span shipping into an aggregation dir, and the
+  :class:`FleetAggregator` merging live shards into one scrape + one
+  trace forest.
+* :mod:`~transmogrifai_tpu.obs.slo` (ISSUE 11) - declarative SLOs
+  with multi-window burn-rate alerting over the (fleet-)aggregated
+  plane; consumed by ``tx obs slo``, the runner ``slo_path`` knob, and
+  ``RollbackPolicy.slo_engine``.
 
 The whole package is stdlib-only and importable before jax/numpy init
 (gated by tests/test_style.py), exactly like ``utils/tracing.py`` - the
@@ -24,6 +33,14 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .fleet import (
+    FleetAggregator,
+    ObsShipper,
+    child_env,
+    read_json_torn_safe,
+    read_jsonl_tolerant,
+    ship_now,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -31,16 +48,28 @@ from .metrics import (
     MetricsRegistry,
     metrics_registry,
     percentiles,
+    process_instance,
     prometheus_text_from_json,
     reset_metrics_registry,
     sanitize_metric_name,
+    set_process_instance,
     write_json_artifact,
 )
 from .profiler import SpanProfiler
+from .slo import (
+    SLOEngine,
+    SLObjective,
+    default_objectives,
+    load_slo_config,
+    resolve_metric,
+)
 from .trace import (
+    TRACE_CONTEXT_ENV,
     Span,
     Tracer,
     build_trees,
+    current_context,
+    parse_context,
     reset_tracer,
     set_enabled,
     span,
@@ -49,21 +78,37 @@ from .trace import (
 
 __all__ = [
     "Counter",
+    "FleetAggregator",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "ObsShipper",
+    "SLOEngine",
+    "SLObjective",
     "Span",
     "SpanProfiler",
+    "TRACE_CONTEXT_ENV",
     "Tracer",
     "build_trees",
+    "child_env",
+    "current_context",
+    "default_objectives",
     "export_obs",
+    "load_slo_config",
     "metrics_registry",
+    "parse_context",
     "percentiles",
+    "process_instance",
     "prometheus_text_from_json",
+    "read_json_torn_safe",
+    "read_jsonl_tolerant",
     "reset_metrics_registry",
     "reset_tracer",
+    "resolve_metric",
     "sanitize_metric_name",
     "set_enabled",
+    "set_process_instance",
+    "ship_now",
     "span",
     "tracer",
     "write_json_artifact",
@@ -79,7 +124,9 @@ def export_obs(path: str, extra: Optional[dict] = None) -> dict:
     who want a one-call dump share this.  Returns the JSON document."""
     os.makedirs(path, exist_ok=True)
     reg = metrics_registry()
-    doc = reg.to_json()
+    # stamped with the writing process's identity: the saved artifact
+    # renders under the instance that produced it, not whoever reads it
+    doc = dict(reg.to_json(), instance=process_instance())
     if extra:
         doc = dict(doc, **extra)
     write_json_artifact(os.path.join(path, "metrics.json"), doc)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import bisect
 import json
 import logging
+import os
 import re
 import threading
 import weakref
@@ -50,10 +51,57 @@ __all__ = [
     "MetricsRegistry",
     "metrics_registry",
     "percentiles",
+    "process_instance",
     "prometheus_text_from_json",
     "reset_metrics_registry",
+    "set_process_instance",
     "write_json_artifact",
 ]
+
+
+# ---------------------------------------------------------------------------
+# process identity (the exposition `instance` label)
+# ---------------------------------------------------------------------------
+#: pid + an 8-hex start nonce: stable for the life of the process,
+#: distinct across processes even when the kernel recycles pids (the
+#: trace-prefix reasoning in trace.py, applied to metric identity)
+_instance_lock = threading.Lock()
+_instance: Optional[str] = None
+
+#: instance identities are interpolated into Prometheus label VALUES
+#: and shard FILENAMES: a quote/backslash/newline would corrupt every
+#: consumer's scrape, and a path separator would write outside the
+#: aggregation dir - sanitize at the trust boundary, not per use
+_INSTANCE_BAD = re.compile(r"[^A-Za-z0-9._:-]")
+
+
+def _sanitize_instance(name: str) -> str:
+    return _INSTANCE_BAD.sub("_", str(name))[:128] or "unnamed"
+
+
+def process_instance() -> str:
+    """This process's stable exposition identity (ISSUE 11 satellite):
+    ``<pid>-<start-nonce>`` by default, overridable by
+    :func:`set_process_instance` or the ``TX_OBS_INSTANCE`` env var
+    (fleet replicas get operator-readable names that way); always
+    label- and filename-safe."""
+    global _instance
+    with _instance_lock:
+        if _instance is None:
+            named = os.environ.get("TX_OBS_INSTANCE", "").strip()
+            _instance = (
+                _sanitize_instance(named) if named
+                else f"{os.getpid()}-{os.urandom(4).hex()}"
+            )
+        return _instance
+
+
+def set_process_instance(name: Optional[str]) -> None:
+    """Override (or with ``None`` re-derive) the exposition identity -
+    a serving replica names itself ``replica-3`` instead of a pid."""
+    global _instance
+    with _instance_lock:
+        _instance = _sanitize_instance(name) if name else None
 
 
 def percentiles(
@@ -410,14 +458,27 @@ def _fmt_value(v: float) -> str:
     return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def prometheus_text_from_json(doc: dict) -> str:
+def prometheus_text_from_json(doc: dict,
+                              instance: Optional[str] = None) -> str:
     """Render a :meth:`MetricsRegistry.to_json` document as Prometheus
     text exposition.  ONE renderer for live scrapes and saved JSON
     artifacts (the ``tx obs metrics --format prometheus`` path), so the
-    two can never drift.  View snapshots flatten every finite numeric
-    leaf into a gauge named ``tx_<kind>_<path...>`` with an ``instance``
-    label; native histograms emit the canonical ``_bucket``/``_sum``/
-    ``_count`` triplet."""
+    two can never drift.  Every sample carries an ``instance`` label
+    naming the PROCESS it came from (ISSUE 11 satellite - the label
+    used to be the per-kind view index, which reads as empty identity
+    once shards from many processes merge): ``instance`` argument wins,
+    then the document's own ``instance`` stamp (saved artifacts render
+    as the process that wrote them, not the process reading them), then
+    this process's :func:`process_instance`.  View snapshots flatten
+    every finite numeric leaf into a gauge named ``tx_<kind>_<path...>``
+    with the per-kind index as a ``view`` label; native histograms emit
+    the canonical ``_bucket``/``_sum``/``_count`` triplet."""
+    inst = instance if instance is not None else doc.get("instance")
+    # re-sanitized here too: a hand-edited/foreign document's stamp (or
+    # a caller-supplied replica name) must not inject label syntax
+    inst = _sanitize_instance(inst) if inst is not None \
+        else process_instance()
+    ilabel = f'instance="{inst}"'
     lines: list[str] = []
     for name, s in sorted(doc.get("series", {}).items()):
         pname = sanitize_metric_name(name)
@@ -435,19 +496,22 @@ def prometheus_text_from_json(doc: dict) -> str:
             for edge in sorted((e for e in buckets if e != "+Inf"),
                                key=float):
                 acc += int(buckets[edge])
-                lines.append(f'{pname}_bucket{{le="{edge}"}} {acc}')
+                lines.append(
+                    f'{pname}_bucket{{{ilabel},le="{edge}"}} {acc}')
             acc += int(buckets.get("+Inf", 0))
-            lines.append(f'{pname}_bucket{{le="+Inf"}} {acc}')
-            lines.append(f"{pname}_sum {_fmt_value(s.get('sum', 0.0))}")
-            lines.append(f"{pname}_count {int(s.get('count', 0))}")
+            lines.append(f'{pname}_bucket{{{ilabel},le="+Inf"}} {acc}')
+            lines.append(
+                f"{pname}_sum{{{ilabel}}} {_fmt_value(s.get('sum', 0.0))}")
+            lines.append(f"{pname}_count{{{ilabel}}} {int(s.get('count', 0))}")
             continue
         lines.append(f"# TYPE {pname} {stype}")
-        lines.append(f"{pname} {_fmt_value(s.get('value', 0.0))}")
+        lines.append(f"{pname}{{{ilabel}}} {_fmt_value(s.get('value', 0.0))}")
     for key, snap in sorted(doc.get("views", {}).items()):
         kind, _, idx = key.partition("/")
         for path, value in sorted(_numeric_leaves(snap)):
             pname = sanitize_metric_name(kind + "_" + "_".join(path))
-            lines.append(f'{pname}{{instance="{idx}"}} {_fmt_value(value)}')
+            lines.append(
+                f'{pname}{{{ilabel},view="{idx}"}} {_fmt_value(value)}')
     return "\n".join(lines) + "\n"
 
 

@@ -46,12 +46,40 @@ log = logging.getLogger("transmogrifai_tpu.obs")
 
 __all__ = [
     "Span",
+    "TRACE_CONTEXT_ENV",
     "Tracer",
+    "current_context",
+    "parse_context",
     "reset_tracer",
     "set_enabled",
     "span",
     "tracer",
 ]
+
+#: the cross-process trace-context seam (ISSUE 11): a parent process
+#: exports ``<trace_id>:<span_id>`` of its ambient span into this env
+#: var before spawning a child, and the child's Tracer ADOPTS it at
+#: construction - every root span the child mints then joins the
+#: parent's trace (same trace id, parented to the exporting span), so
+#: one trace id follows a run through supervisor re-dispatch, mesh-peer
+#: bootstrap, and deploy-drill children.
+TRACE_CONTEXT_ENV = "TX_OBS_TRACE_CONTEXT"
+
+
+def parse_context(value: Optional[str]) -> tuple[Optional[str], Optional[int]]:
+    """Parse a ``<trace_id>:<span_id>`` context string (the
+    :data:`TRACE_CONTEXT_ENV` format).  Malformed input yields
+    ``(None, None)`` - a garbled env var must degrade to a fresh local
+    trace, never crash a child at import time."""
+    if not value:
+        return None, None
+    trace_id, sep, span_part = value.strip().rpartition(":")
+    if not sep or not trace_id:
+        return None, None
+    try:
+        return trace_id, int(span_part)
+    except ValueError:
+        return None, None
 
 #: the ambient span (contextvars so nested spans parent correctly per
 #: thread/task; a thread started without a copied context roots a new
@@ -79,11 +107,11 @@ class Span:
 
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
                  "attrs", "t_epoch", "_start_ns", "_children",
-                 "_children_dropped", "_token")
+                 "_children_dropped", "_token", "_root")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  span_id: int, parent_id: Optional[int],
-                 attrs: dict) -> None:
+                 attrs: dict, root: bool = False) -> None:
         self.tracer = tracer
         self.name = name
         self.trace_id = trace_id
@@ -95,6 +123,10 @@ class Span:
         self._children: list[dict] = []
         self._children_dropped = 0
         self._token = None
+        # local-rootness is a flag, not ``parent_id is None``: a root
+        # that ADOPTED a cross-process context carries the remote parent
+        # span id, yet is still this process's tree root
+        self._root = root
 
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
@@ -157,13 +189,30 @@ class Tracer:
         self.profiler = profiler if profiler is not None else SpanProfiler()
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=int(capacity))
-        self._ids = itertools.count(1)
+        # span ids count up from a random 63-bit base so they stay
+        # collision-safe when span shards from MANY processes merge into
+        # one tree (fleet.py): a per-process count-from-1 would collide
+        # on the very first merged pair.  Still one C-level next() on
+        # the hot path - no per-span entropy or formatting.
+        self._ids = itertools.count(
+            int.from_bytes(os.urandom(8), "big") >> 1 or 1
+        )
         # trace ids are prefix+counter, NOT per-root entropy: one
         # os.urandom at construction (it costs ~65us per call on older
         # kernels - measured, OBS_BENCH.json span_record) plus a C-level
-        # counter keeps root creation as cheap as child creation
-        self._trace_prefix = f"{os.getpid():x}-{os.urandom(4).hex()}-"
+        # counter keeps root creation as cheap as child creation.  The
+        # prefix is pid + an 8-byte start nonce: pid alone is recycled
+        # by the kernel, so two sequential processes could mint the same
+        # prefix and collide id-for-id (ISSUE 11).
+        self._trace_prefix = f"{os.getpid():x}-{os.urandom(8).hex()}-"
         self._trace_ids = itertools.count(1)
+        # cross-process context adoption (the TRACE_CONTEXT_ENV seam):
+        # when a parent process exported its ambient span, every root
+        # this tracer mints joins that trace instead of starting one
+        self._adopted_trace, self._adopted_parent = parse_context(
+            os.environ.get(TRACE_CONTEXT_ENV)
+        )
+        self.contexts_adopted = 1 if self._adopted_trace else 0
         self.spans_recorded = 0
         self.spans_evicted = 0
         self.traces_started = 0
@@ -177,14 +226,19 @@ class Tracer:
             return _NULL_SPAN
         parent = _current.get()
         if parent is None or parent.tracer is not self:
-            trace_id = self._trace_prefix + format(
-                next(self._trace_ids), "x")
-            parent_id = None
-        else:
-            trace_id = parent.trace_id
-            parent_id = parent.span_id
-        return Span(self, name, trace_id, next(self._ids), parent_id,
-                    attrs)
+            if self._adopted_trace is not None:
+                # adopted cross-process context: this root joins the
+                # parent process's trace, parented to the exporting span
+                trace_id = self._adopted_trace
+                parent_id = self._adopted_parent
+            else:
+                trace_id = self._trace_prefix + format(
+                    next(self._trace_ids), "x")
+                parent_id = None
+            return Span(self, name, trace_id, next(self._ids),
+                        parent_id, attrs, root=True)
+        return Span(self, name, parent.trace_id, next(self._ids),
+                    parent.span_id, attrs)
 
     def event(self, name: str, **attrs: Any) -> None:
         """A zero-duration marker span (registry lifecycle events,
@@ -216,7 +270,7 @@ class Tracer:
         parent = _current.get()  # __exit__ already reset the context
         tree = None
         dropped = 0
-        if (s.parent_id is None or parent is None
+        if (s._root or parent is None
                 or parent.tracer is not self):
             tree = node
         elif len(parent._children) < MAX_TREE_CHILDREN:
@@ -233,9 +287,23 @@ class Tracer:
             self._spans.append(record)
             self.spans_recorded += 1
             self.tree_children_dropped += dropped
-            if s.parent_id is None:
+            if s._root:
                 self.traces_started += 1
         self.profiler.observe(s.name, record["wall_ms"], tree)
+
+    # -- cross-process context ----------------------------------------------
+    def current_context(self) -> Optional[str]:
+        """The ambient span's ``<trace_id>:<span_id>`` context string
+        (the :data:`TRACE_CONTEXT_ENV` payload), or - with no span open
+        - the adopted context this tracer itself inherited, so a
+        middle process relays its parent's trace to grandchildren even
+        between spans.  None when there is nothing to propagate."""
+        cur = _current.get()
+        if cur is not None and cur.tracer is self:
+            return f"{cur.trace_id}:{cur.span_id}"
+        if self._adopted_trace is not None:
+            return f"{self._adopted_trace}:{self._adopted_parent}"
+        return None
 
     # -- reading ------------------------------------------------------------
     def spans(self, trace_id: Optional[str] = None) -> list[dict]:
@@ -274,6 +342,7 @@ class Tracer:
                 "spans_recorded": self.spans_recorded,
                 "spans_evicted": self.spans_evicted,
                 "traces_started": self.traces_started,
+                "contexts_adopted": self.contexts_adopted,
                 "tree_children_dropped": self.tree_children_dropped,
             }
 
@@ -340,3 +409,11 @@ def span(name: str, **attrs: Any):
     """Convenience: a span on the default tracer (the call-site idiom:
     ``with obs_trace.span("serve.batch", bucket=b): ...``)."""
     return tracer().span(name, **attrs)
+
+
+def current_context() -> Optional[str]:
+    """The default tracer's exportable trace context (see
+    :meth:`Tracer.current_context`); the payload child-process spawners
+    put in :data:`TRACE_CONTEXT_ENV` (``obs.fleet.child_env`` wraps
+    the env-dict plumbing)."""
+    return tracer().current_context()

@@ -42,6 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..faults import injection as _faults
+from ..obs import trace as _obs_trace
 
 _initialized = False
 
@@ -142,25 +143,34 @@ def initialize(
         except BaseException as e:  # noqa: BLE001 - re-raised on the caller
             outcome["error"] = e
 
-    worker = threading.Thread(
-        target=_connect, daemon=True, name="tx-mesh-bootstrap"
-    )
-    worker.start()
-    worker.join(timeout_s)
-    if "error" in outcome:
-        raise outcome["error"]  # _initialized stays False: retryable
-    if not outcome.get("ok"):
-        try:  # lazy: resilience imports this module
-            from .resilience import mesh_telemetry
-
-            mesh_telemetry().record_bootstrap_timeout(address, timeout_s)
-        except ImportError:
-            pass
-        raise MeshBootstrapError(
-            f"mesh bootstrap did not reach coordinator {address!r} within "
-            f"{timeout_s:.0f}s (TX_MESH_INIT_TIMEOUT_S): coordinator down, "
-            f"address wrong, or a peer never registered"
+    # one span per bootstrap attempt: a mesh peer launched with the
+    # parent run's TX_OBS_TRACE_CONTEXT (ISSUE 11) roots its bootstrap
+    # - and everything after - under the dispatching run's trace id, so
+    # a merged fleet trace shows which run brought which peer up
+    with _obs_trace.span("mesh.bootstrap", address=str(address),
+                         timeout_s=round(timeout_s, 3)) as _sp:
+        worker = threading.Thread(
+            target=_connect, daemon=True, name="tx-mesh-bootstrap"
         )
+        worker.start()
+        worker.join(timeout_s)
+        if "error" in outcome:
+            raise outcome["error"]  # _initialized stays False: retryable
+        if not outcome.get("ok"):
+            _sp.set_attr("outcome", "timeout")
+            try:  # lazy: resilience imports this module
+                from .resilience import mesh_telemetry
+
+                mesh_telemetry().record_bootstrap_timeout(address, timeout_s)
+            except ImportError:
+                pass
+            raise MeshBootstrapError(
+                f"mesh bootstrap did not reach coordinator {address!r} "
+                f"within {timeout_s:.0f}s (TX_MESH_INIT_TIMEOUT_S): "
+                "coordinator down, address wrong, or a peer never "
+                "registered"
+            )
+        _sp.set_attr("outcome", "ok")
     _initialized = True
 
 

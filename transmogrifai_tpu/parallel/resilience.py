@@ -74,6 +74,26 @@ _HEARTBEAT_RE = re.compile(r"^peer-(\d+)\.heartbeat$")
 _tls = threading.local()
 
 
+def _ship_degradation() -> None:
+    """Push this process's obs plane to the fleet aggregation dir
+    (``TX_OBS_FLEET_DIR``) the moment a degradation event lands:
+    detections, shrinks, and bootstrap timeouts are exactly the signals
+    a fleet aggregator must not learn about one heartbeat late (ISSUE
+    11 - rollback signals aggregate across replicas).  Best-effort:
+    a full disk must degrade the *report* of degradation, never the
+    recovery itself."""
+    agg_dir = os.environ.get("TX_OBS_FLEET_DIR")
+    if not agg_dir:
+        return
+    try:
+        from ..obs import fleet as _fleet
+
+        _fleet.ship_now(agg_dir)
+    except OSError as e:
+        log.warning("%s fleet ship after degradation event failed: %s",
+                    LOG_PREFIX, e)
+
+
 class CollectiveStallError(RuntimeError):
     """A mesh collective stalled past its deadline (and its retry, when
     classified straggler) and the caller provided no survivor recompute
@@ -275,6 +295,7 @@ class MeshTelemetry:
             "%s; dead peers: %s)", LOG_PREFIX, label, deadline_s,
             classification, list(dead_peers),
         )
+        _ship_degradation()
 
     def record_retry(self, label: str, ok: bool, deadline_s: float) -> None:
         with self._lock:
@@ -305,6 +326,7 @@ class MeshTelemetry:
                 "%s collective %r recomputed on survivor mesh in %.3fs",
                 LOG_PREFIX, label, overhead_s,
             )
+        _ship_degradation()
 
     def set_model_version(self, version: Optional[str],
                           generation: Optional[int] = None) -> None:
@@ -322,6 +344,7 @@ class MeshTelemetry:
                 event="bootstrap_timeout", address=str(address),
                 timeout_s=round(timeout_s, 3),
             )
+        _ship_degradation()
 
     # -- reporting ----------------------------------------------------------
     def events_json(self, since_epoch: Optional[float] = None) -> list[dict]:

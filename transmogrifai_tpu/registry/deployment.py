@@ -577,7 +577,7 @@ class DeploymentController:
         rollback decisions + evidence), and shadow deltas."""
         with self._route_lock:
             stable, canary = self._stable, self._canary
-        return {
+        out = {
             "stable": stable.snapshot() if stable else None,
             "canary": canary.snapshot() if canary else None,
             "canary_fraction": self.canary_fraction,
@@ -585,6 +585,16 @@ class DeploymentController:
             "events": self.events(),
             "shadow_stats": self.shadow_stats(),
         }
+        # SLO-aware deployments (policy.slo_engine, ISSUE 11) carry the
+        # burn-rate state in the deploy summary: a rollback decision's
+        # "why" must be readable next to the lifecycle event it caused
+        eng = getattr(self.policy, "slo_engine", None)
+        if eng is not None:
+            try:
+                out["slo"] = eng.report()
+            except Exception as e:  # noqa: BLE001 - summary only
+                log.warning("deploy summary: SLO report failed: %s", e)
+        return out
 
     def export(self, path: str, extra: Optional[dict] = None) -> dict:
         snap = self.summary_json()

@@ -24,9 +24,12 @@ Signal classes:
 """
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
+
+log = logging.getLogger("transmogrifai_tpu.registry")
 
 
 @dataclass
@@ -72,6 +75,15 @@ class RollbackPolicy:
     single excess trips regardless of traffic volume); the latency
     ratio, drift, and failure-ratio limits wait for ``min_canary_rows``
     canary rows.  Any limit set to ``None`` disables that signal.
+
+    ``slo_engine`` (ISSUE 11) plugs the declarative obs-plane SLOs in
+    as a third signal class: an :class:`~transmogrifai_tpu.obs.slo.
+    SLOEngine` attached here is re-observed at every evaluation and any
+    FIRING burn-rate alert becomes a hard rollback reason
+    (``slo:<name>``) - a fleet-level objective breach demotes the
+    canary even when the canary's own telemetry looks clean (e.g. the
+    aggregate error budget is burning because of the traffic the canary
+    sheds onto stable).  The runner's ``slo_path`` knob wires this.
     """
 
     min_canary_rows: int = 64
@@ -80,12 +92,33 @@ class RollbackPolicy:
     max_latency_ratio: Optional[float] = 3.0
     max_drift_js: Optional[float] = 0.25
     max_failed_ratio: Optional[float] = 0.2
+    slo_engine: Optional[Any] = None
+
+    def _slo_reasons(self) -> list[dict]:
+        """Firing SLO alerts as hard signals; a broken engine is
+        logged, never allowed to block (or force) a rollback check."""
+        if self.slo_engine is None:
+            return []
+        try:
+            self.slo_engine.observe()
+            alerts = self.slo_engine.firing()
+        except Exception as e:  # noqa: BLE001 - visible, non-fatal
+            log.warning("rollback policy: SLO engine failed: %s", e)
+            return []
+        return [
+            {
+                "signal": "slo:" + str(a.get("name")),
+                "value": a.get("burn_short"),
+                "threshold": a.get("burn_threshold"),
+            }
+            for a in alerts
+        ]
 
     def evaluate(self, stable_snap: dict,
                  canary_snap: dict) -> RollbackDecision:
         """Compare live canary signals against stable; breaches become
         ``reasons`` entries of ``{signal, value, threshold}``."""
-        reasons: list[dict] = []
+        reasons: list[dict] = list(self._slo_reasons())
         c_breaker = canary_snap.get("breaker", {})
         if (self.max_breaker_opens is not None
                 and c_breaker.get("opens", 0) > self.max_breaker_opens):
@@ -133,11 +166,22 @@ class RollbackPolicy:
                         canary_snap.get("rows_failed", 0) / c_rows, 4),
                     "threshold": self.max_failed_ratio,
                 })
+        evidence = {
+            "stable": _evidence_subset(stable_snap),
+            "canary": _evidence_subset(canary_snap),
+        }
+        if self.slo_engine is not None:
+            try:
+                slo_rep = self.slo_engine.report()
+                evidence["slo"] = {
+                    "firing": slo_rep.get("firing"),
+                    "objectives": slo_rep.get("objectives"),
+                }
+            except Exception as e:  # noqa: BLE001 - evidence only
+                log.warning(
+                    "rollback policy: SLO report failed: %s", e)
         return RollbackDecision(
             rollback=bool(reasons),
             reasons=reasons,
-            evidence={
-                "stable": _evidence_subset(stable_snap),
-                "canary": _evidence_subset(canary_snap),
-            },
+            evidence=evidence,
         )

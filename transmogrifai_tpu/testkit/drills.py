@@ -68,10 +68,14 @@ def corrupted_csv_drill(dirpath: str, n_rows: int = 500,
 def drill_env() -> dict:
     """Child-process env for supervision/crash drills: CPU backend, no
     inherited fault plan (TX_FAULTS would re-arm in the child), no axon
-    pool tunnel."""
+    pool tunnel.  The ambient trace context rides along (obs.fleet.
+    child_env): a drill child's spans join the test's trace, exactly
+    like a production child's join its dispatching run's (ISSUE 11)."""
     import os
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    from ..obs.fleet import child_env
+
+    env = child_env(dict(os.environ, JAX_PLATFORMS="cpu"))
     env.pop("TX_FAULTS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
@@ -180,4 +184,77 @@ from transmogrifai_tpu.faults import injection
 injection.configure({fault!r})            # arm the crash
 model.save({path!r})                      # dies at the injected point
 os._exit(0)                               # unreachable when armed
+"""
+
+
+#: child for the fleet-aggregation drills (tests/test_obs_fleet.py +
+#: ``bench.py --obs-fleet``): beats metrics + spans into its own obs
+#: shard every ``interval`` seconds for ``duration`` seconds, then
+#: exits 0.  Adopts the parent's trace context from the env seam
+#: automatically (Tracer reads TX_OBS_TRACE_CONTEXT at construction),
+#: so its spans merge into the dispatching test's trace; SIGKILLing it
+#: mid-loop is the torn-write/staleness drill - the atomic-rename
+#: shipping discipline must leave the aggregation dir readable.
+FLEET_SHIPPER_CHILD_TEMPLATE = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.obs import metrics_registry, ship_now, span
+ticks = metrics_registry().counter("drill.ticks")
+print("SHIPPER_READY", os.getpid(), flush=True)
+deadline = time.monotonic() + {duration}
+while time.monotonic() < deadline:
+    with span("shipper.tick", pid=os.getpid()):
+        ticks.inc()
+    ship_now({agg_dir!r})
+    time.sleep({interval})
+os._exit(0)
+"""
+
+
+#: grandchild for the supervised-fleet e2e drill: the "deploy child" -
+#: joins the trace via the env seam, records a span, ships its shard,
+#: exits.  Spawned BY :data:`FLEET_DRILL_CHILD_TEMPLATE` through
+#: ``obs.fleet.child_env()``, two process hops below the test.
+FLEET_DEPLOY_CHILD_TEMPLATE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.obs import ship_now, span
+with span("deploy.child", pid=os.getpid()):
+    pass
+ship_now({agg_dir!r})
+os._exit(0)
+"""
+
+
+#: supervised child for the e2e fleet drill (ISSUE 11 acceptance): the
+#: child adopts the supervisor's exported trace context, beats the
+#: supervision heartbeat, records spans, spawns the deploy grandchild
+#: (``grand`` is the already-formatted FLEET_DEPLOY_CHILD source) with
+#: the context re-exported, ships its own shard, then die-once exits
+#: ``first_exit`` on the run that creates ``marker`` and 0 after - so
+#: one supervise() call produces spans from at least three pids
+#: (attempt 1, attempt 2, grandchild) under ONE trace id.
+FLEET_DRILL_CHILD_TEMPLATE = """
+import os, subprocess, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.obs import fleet, ship_now, span
+from transmogrifai_tpu.workflow.supervisor import beat
+beat({heartbeat!r})
+with span("child.work", pid=os.getpid()):
+    rc = subprocess.run(
+        [sys.executable, "-c", {grand!r}],
+        env=fleet.child_env(), timeout=120,
+    ).returncode
+beat({heartbeat!r})
+ship_now({agg_dir!r})
+if rc != 0:
+    sys.exit(99)
+marker = {marker!r}
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit({first_exit})
+sys.exit(0)
 """

@@ -68,6 +68,9 @@ class OpWorkflowRunner:
         # target, so loading TWO versions (deploy run: stable + canary)
         # needs a fresh build per load whenever their blacklists differ
         self.workflow_factory = workflow_factory
+        # the run-scoped SLO engine (slo_path knob): built per run(),
+        # consumed by _deploy's rollback policy
+        self._slo_engine = None
 
     def _fresh_workflow(self) -> OpWorkflow:
         if self.workflow_factory is None:
@@ -84,33 +87,78 @@ class OpWorkflowRunner:
         dag = compute_dag(self.workflow.result_features)
         params.apply_to_dag(dag)
         run_type = run_type.lower().replace("-", "_")
-        # one root span per run: every subsystem span underneath
-        # (ingest, stage fits, save, publish, swap, serve batches)
-        # inherits this trace id - the ISSUE 7 causal spine
-        with _obs_trace.span("run." + run_type, run_type=run_type):
-            if run_type == "train":
-                result = self._train(params)
-            elif run_type == "score":
-                result = self._score(params)
-            elif run_type == "features":
-                result = self._features(params)
-            elif run_type == "evaluate":
-                result = self._evaluate(params)
-            elif run_type == "serve":
-                result = self._serve(params)
-            elif run_type == "deploy":
-                result = self._deploy(params)
-            else:
-                raise ValueError(f"unknown run type {run_type!r}")
+        # declarative SLOs (ISSUE 11): custom_params {"slo_path": FILE}
+        # loads the objective config and evaluates it over the live
+        # registry - built BEFORE the run so the deploy run can wire it
+        # into the RollbackPolicy as a hard rollback signal
+        slo_engine = None
+        sp = params.custom_params.get("slo_path")
+        if sp:
+            from ..obs.slo import SLOEngine, load_slo_config
+
+            slo_engine = SLOEngine(load_slo_config(str(sp)))
+        self._slo_engine = slo_engine
+        try:
+            # one root span per run: every subsystem span underneath
+            # (ingest, stage fits, save, publish, swap, serve batches)
+            # inherits this trace id - the ISSUE 7 causal spine
+            with _obs_trace.span("run." + run_type, run_type=run_type):
+                if run_type == "train":
+                    result = self._train(params)
+                elif run_type == "score":
+                    result = self._score(params)
+                elif run_type == "features":
+                    result = self._features(params)
+                elif run_type == "evaluate":
+                    result = self._evaluate(params)
+                elif run_type == "serve":
+                    result = self._serve(params)
+                elif run_type == "deploy":
+                    result = self._deploy(params)
+                else:
+                    raise ValueError(f"unknown run type {run_type!r}")
+        finally:
+            self._slo_engine = None
         result.wall_s = time.perf_counter() - t0
         # the observability-plane export knob: custom_params
         # {"metrics_path": DIR} dumps metrics.json + metrics.prom
         # (Prometheus text) + spans.jsonl after any run type
         mp = params.custom_params.get("metrics_path")
+        if slo_engine is not None:
+            from ..obs import write_json_artifact
+
+            slo_engine.observe()
+            report = slo_engine.report()
+            loc = str(mp) if mp else params.metrics_location
+            if loc:
+                os.makedirs(loc, exist_ok=True)
+                write_json_artifact(
+                    os.path.join(loc, "slo_report.json"), report)
+            if isinstance(result.metrics, dict):
+                result.metrics = dict(result.metrics, slo=report)
         if mp:
             from ..obs import export_obs
 
             export_obs(str(mp), extra={"run_type": run_type})
+        # fleet shipping (ISSUE 11): {"fleet_dir": DIR} custom param or
+        # TX_OBS_FLEET_DIR env ships this process's whole plane into
+        # the aggregation dir - the env seam is what makes supervised /
+        # re-dispatched children ship without any code of their own
+        fd = params.custom_params.get("fleet_dir") or os.environ.get(
+            "TX_OBS_FLEET_DIR")
+        if fd:
+            from ..obs import fleet as _fleet
+
+            try:
+                _fleet.ship_now(str(fd))
+            except OSError as e:
+                # best-effort like every other shipper seam: a full or
+                # read-only aggregation disk must cost the fleet this
+                # process's freshness, never the completed run's result
+                import logging
+
+                logging.getLogger("transmogrifai_tpu.obs").warning(
+                    "post-run fleet ship to %s failed: %s", fd, e)
         return result
 
     # ------------------------------------------------------------------
@@ -279,8 +327,11 @@ class OpWorkflowRunner:
         ``deploy_version`` (default: the registry's stable),
         ``canary_version`` + ``canary_fraction`` + ``canary_shadow``,
         ``canary_check_every_batches``, ``rollback_*`` (RollbackPolicy
-        fields, e.g. ``rollback_max_latency_ratio``), plus the serve
-        knobs ``serving_buckets`` / ``serving_drift_policy``.  The
+        fields, e.g. ``rollback_max_latency_ratio``), ``slo_path`` (SLO
+        config whose firing burn-rate alerts become hard rollback
+        signals and whose report lands in ``slo_report.json`` +
+        ``deploy_metrics.json``), plus the serve knobs
+        ``serving_buckets`` / ``serving_drift_policy``.  The
         deployment summary (generations + telemetry + lifecycle events
         with rollback evidence) exports to
         ``<metrics_location>/deploy_metrics.json``.  A canary still
@@ -324,9 +375,17 @@ class OpWorkflowRunner:
             k[len("rollback_"):]: v
             for k, v in cp.items() if k.startswith("rollback_")
         }
+        # an slo_path knob (built in run()) plugs the SLO engine into
+        # the rollback policy: firing burn-rate alerts are hard
+        # rollback signals next to breaker opens and NaN refusals
+        slo_engine = getattr(self, "_slo_engine", None)
+        policy = None
+        if policy_kw or slo_engine is not None:
+            policy = RollbackPolicy(**policy_kw)
+            policy.slo_engine = slo_engine
         controller = DeploymentController(
             registry=registry,
-            policy=RollbackPolicy(**policy_kw) if policy_kw else None,
+            policy=policy,
             canary_fraction=float(cp.get("canary_fraction", 0.05)),
             shadow=bool(cp.get("canary_shadow", False)),
             check_every_batches=int(

@@ -33,6 +33,15 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..faults import injection as _faults
+from ..obs import trace as _obs_trace
+from ..obs.fleet import child_env as _child_env
+
+# child env for one dispatch attempt: obs.fleet.child_env exports the
+# ambient trace context through the TX_OBS_TRACE_CONTEXT seam (ISSUE
+# 11) - the child's tracer adopts it at construction, so a supervised
+# run's spans, across EVERY re-dispatch, parent into the supervisor's
+# own trace - and, with nothing to export, STRIPS a stale inherited
+# context rather than grafting the child onto a long-finished trace.
 
 
 def beat(heartbeat_path: str) -> None:
@@ -120,7 +129,13 @@ def supervise(
     for attempt in range(max_restarts + 1):
         start = time.monotonic()  # durations never ride the epoch
         # clock (the tests/test_style.py timing gate)
-        proc = subprocess.Popen(list(cmd), env=env)
+        # one span per dispatch attempt, its context exported to the
+        # child while the span is ambient: the child's spans parent
+        # under THIS attempt, so a merged fleet trace shows
+        # re-dispatches as sibling subtrees (the span covers dispatch,
+        # not the child's lifetime)
+        with _obs_trace.span("supervisor.dispatch", attempt=attempt):
+            proc = subprocess.Popen(list(cmd), env=_child_env(env))
         killed_reason = None
         while True:
             rc = proc.poll()
