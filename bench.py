@@ -2194,6 +2194,205 @@ def _obs_section(result: dict) -> None:
         "native_render_ms"]
 
 
+def xla_bench(n_requests: int = 4096) -> dict:
+    """XLA fused-backend bench -> XLA_BENCH.json (ISSUE 12).
+
+    Measures, per config (RF winner + LR, the SERVING_BENCH pair):
+    * XLA-fused vs numpy-fused vs interpreted batched rows/s on the
+      same bucket set (top bucket 2048 - per-batch glue amortizes, the
+      regime the batched surface runs in), plus batch-of-1 p50 through
+      the XLA program's 1-bucket;
+    * per-bucket cold compile (trace+compile ms) vs warm cache-load ms
+      from the artifact's serialized executables;
+    * replica cold-start wall: build+warm a fresh endpoint from a
+      registry-style artifact WITH the executable cache vs WITHOUT.
+
+    The harness reports whatever backend jax selected: on any non-CPU
+    backend the same fields ARE the accelerator numbers
+    (``accelerator_present`` flips true and ``platform`` names it).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    from transmogrifai_tpu.serialization.model_io import (
+        load_model,
+        save_model,
+    )
+    from transmogrifai_tpu.serving import (
+        RowScoringError,
+        ServingTelemetry,
+        compile_endpoint,
+        records_from_dataset,
+    )
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    out: dict = {
+        "platform": jax.default_backend(),
+        "accelerator_present": jax.default_backend() != "cpu",
+        "n_requests": n_requests,
+    }
+    buckets = (1, 8, 32, 128, 512, 2048)
+    configs = [
+        (
+            "rf_winner",
+            lambda: OpRandomForestClassifier(num_trees=50, max_depth=12),
+            "OpRandomForestClassifier(num_trees=50, max_depth=12, "
+            "max_bins=32) behind the full stage pipeline (the CV-selected"
+            " winner family/config)",
+        ),
+        (
+            "lr",
+            lambda: OpLogisticRegression(reg_param=0.01),
+            "OpLogisticRegression(reg_param=0.01) behind the full stage "
+            "pipeline",
+        ),
+    ]
+    tmp = tempfile.mkdtemp(prefix="tx-xla-bench-")
+    try:
+        for key, make_est, config_name in configs:
+            # uid counters reset per build: the executable fingerprint
+            # keys on the code-defined workflow's stage uids, and the
+            # reload below must rebuild the SAME workflow
+            reset_uids()
+            wf, dataset_name = _serving_pipeline(make_est())
+            model = wf.train()
+            base = records_from_dataset(wf.generate_raw_data(),
+                                        model.raw_features)
+            records = (base * (n_requests // len(base) + 1))[:n_requests]
+
+            rates: dict = {}
+            xla_ep = None
+            for mode, kw in (
+                ("interpreted", {"fused": False}),
+                ("numpy_fused", {"fused_backend": "numpy"}),
+                ("xla_fused", {"fused_backend": "xla"}),
+            ):
+                ep = compile_endpoint(model, batch_buckets=buckets, **kw)
+                ep.score_batch(records)  # steady state (new buckets warm)
+                best = float("inf")
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    scored = ep.score_batch(records)
+                    best = min(best, max(time.perf_counter() - t0, 1e-9))
+                assert not any(
+                    isinstance(r, RowScoringError) for r in scored
+                )
+                rates[mode] = round(n_requests / best, 1)
+                if mode == "xla_fused":
+                    xla_ep = ep
+            assert xla_ep is not None and xla_ep.fused_backend == "xla", (
+                xla_ep.fused_reason if xla_ep else "no endpoint"
+            )
+            lats = []
+            for r in records[:300]:
+                t0 = time.perf_counter()
+                xla_ep(r)
+                lats.append(time.perf_counter() - t0)
+            lats.sort()
+            fused_snap = xla_ep.telemetry.snapshot()["fused"]
+
+            # artifact round trip: warm replica (cached executables) vs
+            # cold replica (cache stripped) building the same endpoint
+            path = os.path.join(tmp, f"{key}-model")
+            save_model(model, path)
+            reset_uids()
+            wf_warm, _ = _serving_pipeline(make_est())
+            m_warm = load_model(path, wf_warm)
+            tel_warm = ServingTelemetry()
+            t0 = time.perf_counter()
+            compile_endpoint(m_warm, batch_buckets=buckets,
+                             telemetry=tel_warm, fused_backend="xla")
+            warm_s = time.perf_counter() - t0
+            warm_snap = tel_warm.snapshot()["fused"]
+            reset_uids()
+            wf_cold, _ = _serving_pipeline(make_est())
+            m_cold = load_model(path, wf_cold)
+            m_cold.xla_executable_cache = None
+            t0 = time.perf_counter()
+            compile_endpoint(m_cold, batch_buckets=buckets,
+                             fused_backend="xla")
+            cold_s = time.perf_counter() - t0
+
+            compile_ms = {
+                b: round(t["trace_ms"] + t["compile_ms"], 1)
+                for b, t in fused_snap["bucket_timings"].items()
+            }
+            load_ms = {
+                b: t["load_ms"]
+                for b, t in warm_snap["bucket_timings"].items()
+            }
+            out[key] = {
+                "config": config_name,
+                "dataset": dataset_name,
+                "xla_batch_rows_per_s": rates["xla_fused"],
+                "numpy_fused_batch_rows_per_s": rates["numpy_fused"],
+                "interpreted_batch_rows_per_s": rates["interpreted"],
+                "xla_vs_numpy_fused": round(
+                    rates["xla_fused"] / rates["numpy_fused"], 3),
+                "xla_vs_interpreted": round(
+                    rates["xla_fused"] / rates["interpreted"], 3),
+                "xla_row_p50_ms": round(lats[150] * 1e3, 3),
+                "compile_ms_by_bucket": compile_ms,
+                "cached_load_ms_by_bucket": load_ms,
+                "cache_hits_on_warm_start": warm_snap["cache"]["hits"],
+                "cold_start_wall_s": {
+                    "with_cached_executables": round(warm_s, 3),
+                    "without_cache_retrace": round(cold_s, 3),
+                    "speedup": round(cold_s / max(warm_s, 1e-9), 2),
+                },
+            }
+            # every warm-start bucket must load faster than it compiled
+            out[key]["load_faster_than_compile"] = all(
+                load_ms.get(b, float("inf")) < compile_ms[b]
+                for b in compile_ms
+            )
+        # the CPU parity floor (ISSUE 12 acceptance): batched XLA within
+        # 0.9x of numpy-fused.  Pinned on the LR config - the tree
+        # configs race a native C++ early-exit kernel whose CPU ratio
+        # swings with thread availability (see performance.md), while
+        # LR isolates the whole-pipeline glue the floor is about.
+        out["cpu_parity_floor"] = {
+            "metric": "lr.xla_vs_numpy_fused",
+            "value": out["lr"]["xla_vs_numpy_fused"],
+            "floor": 0.9,
+            "met": out["lr"]["xla_vs_numpy_fused"] >= 0.9,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _xla_section(result: dict) -> None:
+    """Run the XLA backend bench: fields prefix xla_*, artifact
+    side-written to XLA_BENCH.json."""
+    bench = xla_bench()
+    path = os.environ.get(
+        "TX_XLA_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "XLA_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    for key in ("rf_winner", "lr"):
+        sec = bench.get(key, {})
+        result[f"xla_{key}_batch_rows_per_s"] = sec.get(
+            "xla_batch_rows_per_s")
+        result[f"xla_{key}_vs_numpy_fused"] = sec.get(
+            "xla_vs_numpy_fused")
+        result[f"xla_{key}_cold_start_speedup"] = sec.get(
+            "cold_start_wall_s", {}).get("speedup")
+    result["xla_cpu_parity_floor_met"] = bench.get(
+        "cpu_parity_floor", {}).get("met")
+
+
 def _serving_section(result: dict) -> None:
     """Run the serving microbench inside the full bench: fields prefix
     serving_*, artifact side-written to SERVING_BENCH.json."""
@@ -2547,6 +2746,24 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _obs_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--xla" in sys.argv:
+        # XLA fused backend + AOT executable cache bench: writes
+        # XLA_BENCH.json and prints it (ISSUE 12)
+        _ensure_working_backend()
+        _res = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _xla_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--serving" in sys.argv:

@@ -1,0 +1,418 @@
+"""XLA fused serving backend + AOT executable cache (ISSUE 12).
+
+Pins the accelerator half of the compile-to-kernel seam:
+
+* XLA-fused vs numpy-fused vs interpreted parity per winner family,
+  with explicit per-family ULP budgets: the tree/GBT gather traversal
+  and the pure elementwise heads are bit-exact (<= 1 ULP); matmul heads
+  carry a few ULP (XLA:CPU contracts a*b+c into single-rounded FMA,
+  BLAS does not); the deep MLP chain compounds that per layer
+* batch-of-1 and non-power-of-two batch lengths (internal pad-to-bucket)
+* empty batch, poison-row fallback, and the NaN/Inf output guard on an
+  XLA-backed endpoint
+* a lower_xla()-raises drill proving per-PIPELINE (never per-batch)
+  degradation to the numpy-fused backend with the reason in fused_reason
+* AOT executable cache: artifact round trip (save -> load -> warm-up
+  deserializes instead of re-tracing, bit-identical outputs), stale
+  fingerprint -> counted retrace-and-recache, and the per-bucket
+  trace/compile/load/first-exec telemetry split
+* ``tx registry verify`` reports stale cached executables as a NAMED
+  warning without failing the artifact check
+"""
+import math
+
+import numpy as np
+import pytest
+
+from test_fused_pipeline import (
+    CLS_FAMILIES,
+    REG_FAMILIES,
+    _mixed_pipeline,
+)
+
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.local import LocalScorer
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+from transmogrifai_tpu.serving import (
+    RowScoringError,
+    ServingTelemetry,
+    compile_endpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+#: per-family float tolerance for XLA-fused vs numpy-fused parity:
+#: (ulps, atol).  Families whose head is gathers + elementwise math are
+#: bit-exact; matmul heads differ where XLA:CPU fuses a*b+c into one
+#: FMA rounding (measured: lr 2, gbt <= 29); the MLP's relu matmul
+#: chain compounds it per layer, so it gets an absolute floor too.
+ULP_BUDGETS = {
+    "rf": (1, 0.0), "rf_reg": (1, 0.0), "svc": (1, 0.0),
+    "linreg": (1, 0.0), "glm": (1, 0.0),
+    "lr": (4, 0.0), "nb": (4, 0.0),
+    "gbt": (64, 0.0), "gbt_reg": (64, 0.0),
+    "mlp": (64, 1e-9),
+}
+
+
+def _assert_rows_close(xla_rows, ref_rows, ulps: int, atol: float):
+    assert len(xla_rows) == len(ref_rows)
+    for rx, rr in zip(xla_rows, ref_rows):
+        assert rx.keys() == rr.keys()
+        for name in rx:
+            dx, dr = rx[name], rr[name]
+            if not isinstance(dx, dict):
+                assert dx == dr, name
+                continue
+            assert dx.keys() == dr.keys(), name
+            for kk, vx in dx.items():
+                vr = dr[kk]
+                if isinstance(vx, float) and isinstance(vr, float):
+                    if vx == vr:
+                        continue
+                    assert math.isfinite(vx) and math.isfinite(vr), (
+                        name, kk, vx, vr)
+                    tol = max(ulps * np.spacing(abs(vr)), atol)
+                    assert abs(vx - vr) <= tol, (name, kk, vx, vr)
+                else:
+                    assert vx == vr, (name, kk)
+
+
+def _scorers(model):
+    xla = LocalScorer(model, drift_policy=None, fused_backend="xla")
+    assert xla.fused is not None and xla.fused_backend == "xla", (
+        xla.fused_reason)
+    npf = LocalScorer(model, drift_policy=None, fused_backend="numpy")
+    assert npf.fused_backend == "numpy"
+    interp = LocalScorer(model, drift_policy=None, fused=False)
+    return xla, npf, interp
+
+
+@pytest.mark.parametrize(
+    "name,make", CLS_FAMILIES, ids=[f[0] for f in CLS_FAMILIES]
+)
+def test_xla_parity_classifier_families(name, make):
+    model, records, _ = _mixed_pipeline(make())
+    xla, npf, interp = _scorers(model)
+    ulps, atol = ULP_BUDGETS[name]
+    rows_np = npf.score_batch(records)
+    # n=160 also exercises the internal pad-to-power-of-two bucket
+    _assert_rows_close(xla.score_batch(records), rows_np, ulps, atol)
+    _assert_rows_close(rows_np, interp.score_batch(records), 1, 0.0)
+    # batch-of-1 through its own shape bucket
+    _assert_rows_close([xla(records[0])], [npf(records[0])], ulps, atol)
+
+
+@pytest.mark.parametrize(
+    "name,make", REG_FAMILIES, ids=[f[0] for f in REG_FAMILIES]
+)
+def test_xla_parity_regressor_families(name, make):
+    model, records, _ = _mixed_pipeline(make(), classification=False)
+    xla, npf, interp = _scorers(model)
+    ulps, atol = ULP_BUDGETS[name]
+    rows_np = npf.score_batch(records)
+    _assert_rows_close(xla.score_batch(records), rows_np, ulps, atol)
+    _assert_rows_close(rows_np, interp.score_batch(records), 1, 0.0)
+    _assert_rows_close([xla(records[0])], [npf(records[0])], ulps, atol)
+
+
+def test_xla_empty_batch_is_empty_list():
+    model, _, _ = _mixed_pipeline(OpLogisticRegression())
+    xla = LocalScorer(model, drift_policy=None, fused_backend="xla")
+    assert xla.score_batch([]) == []
+    assert xla.fused.last_nonfinite_rows == ()
+
+
+def test_xla_poison_row_falls_back_per_row():
+    model, records, pred_name = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model, batch_buckets=(8,),
+                                fused_backend="xla")
+    assert endpoint.fused and endpoint.fused_backend == "xla"
+    batch = [dict(r) for r in records[:6]]
+    batch[2]["b"] = "not-a-number"  # poisons the numeric decode
+    out = endpoint.score_batch(batch)
+    assert isinstance(out[2], RowScoringError)
+    good = [r for i, r in enumerate(out) if i != 2]
+    assert all(isinstance(r, dict) and pred_name in r for r in good)
+
+
+def test_xla_nan_guard_refuses_nonfinite_scores():
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    from transmogrifai_tpu.models.base import PredictorModel
+
+    for layer in model._dag():
+        for stage in layer:
+            if isinstance(stage, PredictorModel):
+                stage.model_params["beta"] = np.full_like(
+                    stage.model_params["beta"], np.nan
+                )
+    tel = ServingTelemetry()
+    endpoint = compile_endpoint(model, batch_buckets=(4,), telemetry=tel,
+                                warm=False, fused_backend="xla")
+    assert endpoint.fused_backend == "xla"
+    out = endpoint.score_batch(records[:4])
+    assert all(isinstance(r, RowScoringError) for r in out)
+    assert all("non-finite" in r.error for r in out)
+    assert tel.snapshot()["breaker"]["rows_nonfinite"] == 4
+
+
+def test_xla_lowering_raise_degrades_per_pipeline_to_numpy_fused(
+        monkeypatch):
+    """A lower_xla() that raises must cost the XLA backend for the LIFE
+    of the pipeline - the scorer lands on the numpy-fused program with
+    the reason recorded, and every batch (not just the failing one)
+    rides numpy-fused."""
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+
+    def boom(self):
+        raise RuntimeError("drill: xla lowering exploded")
+
+    monkeypatch.setattr(VectorsCombiner, "lower_xla", boom)
+    tel = ServingTelemetry()
+    endpoint = compile_endpoint(model, batch_buckets=(8,), telemetry=tel,
+                                fused_backend="xla")
+    # degraded per-pipeline: fused on the numpy backend, reason recorded
+    assert endpoint.fused and endpoint.fused_backend == "numpy"
+    assert "xla" in endpoint.fused_reason
+    assert "drill" in endpoint.fused_reason
+    for _ in range(3):  # never per-batch: every batch stays numpy-fused
+        out = endpoint.score_batch(records[:8])
+        assert not any(isinstance(r, RowScoringError) for r in out)
+    snap = tel.snapshot()["fused"]
+    assert snap["enabled"] is True
+    assert snap["backend"] == "numpy"
+    assert "drill" in snap["reason"]
+    assert snap["batches_fused"] == 3
+
+
+def test_xla_telemetry_records_bucket_split_and_cache_events():
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    tel = ServingTelemetry()
+    endpoint = compile_endpoint(model, batch_buckets=(1, 8),
+                                telemetry=tel, fused_backend="xla")
+    snap = tel.snapshot()["fused"]
+    assert snap["enabled"] is True
+    assert snap["backend"] == "xla"
+    assert set(snap["bucket_timings"]) == {"1", "8"}
+    for timing in snap["bucket_timings"].values():
+        assert timing["cache_hit"] == 0
+        assert timing["trace_ms"] > 0.0
+        assert timing["compile_ms"] > 0.0
+        assert timing["first_exec_ms"] >= 0.0
+    assert snap["cache"]["misses"] == 2
+    assert snap["cache"]["hits"] == 0
+    assert snap["cache"]["stale"] == 0
+    # compile_ms_by_bucket stays populated for the legacy consumers
+    assert set(snap["compile_ms_by_bucket"]) == {"1", "8"}
+
+
+def _rf_workflow(n=120, seed=7):
+    """Deterministic small mixed pipeline returning the UNFITTED
+    workflow (uid counters reset first, so two builds in one process
+    produce identical stage uids - the replica cold-start contract the
+    executable fingerprint keys on)."""
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    rng = np.random.RandomState(seed)
+    data = {
+        "y": (rng.rand(n) > 0.5).astype(float).tolist(),
+        "a": [float(v) if rng.rand() > 0.2 else None
+              for v in rng.randn(n)],
+        "b": rng.uniform(0, 10, n).round(3).tolist(),
+        "c": [("u", "v", "w", None)[rng.randint(4)] for _ in range(n)],
+    }
+    yf = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a.fill_missing_with_mean().z_normalize(), b, c])
+    est = OpRandomForestClassifier(num_trees=6, max_depth=3)
+    pred = est.set_input(yf, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    records = [{nm: data[nm][i] for nm in ("a", "b", "c")}
+               for i in range(n)]
+    return wf, records
+
+
+def _run_replica_child(code: str) -> dict:
+    """Run replica/trainer-shaped child code in a FRESH python process
+    (sys.path wired for the tests dir + repo root, JAX on CPU) and
+    return its last-stdout-line JSON report."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    prelude = (
+        "import json, os, sys\n"
+        f"sys.path.insert(0, {os.path.dirname(here)!r})\n"
+        f"sys.path.insert(0, {here!r})\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", prelude + code], capture_output=True,
+        text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_xla_executable_cache_round_trips_through_artifact(tmp_path):
+    """The fleet cold-start flow end to end, each side in its own
+    process like the real trainer job and serving replica: the trainer
+    warms an XLA endpoint (compiled buckets land in
+    model.xla_executable_cache), save_model persists them in the
+    manifest, and the FRESH replica's endpoint warm-up LOADS the
+    binaries (cache hits, load_ms recorded, zero tracing) with
+    bit-identical outputs.
+
+    Both sides are fresh subprocesses ON PURPOSE: jaxlib 0.4.36's CPU
+    executable (de)serialization resolves process-uniquified LLVM
+    symbol names, so a long-lived process (this pytest run after ~900
+    tests) can produce or refuse payloads whose entry symbol carries a
+    history-dependent suffix ("Symbols not found: main.NNN") - the
+    pipeline then takes the counted retrace fallback by design (pinned
+    below in test_xla_stale_cache_*'s fallback machinery), but the
+    warm-start acceptance is about the trainer->artifact->replica flow,
+    which is deterministic."""
+    import json
+    import os
+
+    from transmogrifai_tpu.serialization.model_io import (
+        XLA_CACHE_JSON,
+        XLA_CACHE_NPZ,
+    )
+
+    path = str(tmp_path / "model")
+    trainer = (
+        "from test_fused_xla import _rf_workflow\n"
+        "from transmogrifai_tpu.serialization.model_io import save_model\n"
+        "from transmogrifai_tpu.serving import compile_endpoint\n"
+        "wf, records = _rf_workflow()\n"
+        "model = wf.train()\n"
+        "ep = compile_endpoint(model, batch_buckets=(1, 8),\n"
+        "                      fused_backend='xla')\n"
+        "assert ep.fused_backend == 'xla', ep.fused_reason\n"
+        "out = ep.score_batch(records[:8])\n"
+        "cache = model.xla_executable_cache\n"
+        "assert sorted(cache.entries) == [1, 8]\n"
+        f"save_model(model, {path!r})\n"
+        "print(json.dumps({'scores': out,\n"
+        "                  'fingerprint': cache.fingerprint}))\n"
+    )
+    trained = _run_replica_child(trainer)
+    assert os.path.exists(os.path.join(path, XLA_CACHE_JSON))
+    assert os.path.exists(os.path.join(path, XLA_CACHE_NPZ))
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert XLA_CACHE_JSON in manifest["files"]
+    assert XLA_CACHE_NPZ in manifest["files"]
+
+    replica = (
+        "from test_fused_xla import _rf_workflow\n"
+        "from transmogrifai_tpu.serialization.model_io import load_model\n"
+        "from transmogrifai_tpu.serving import (ServingTelemetry,\n"
+        "                                       compile_endpoint)\n"
+        "wf, records = _rf_workflow()\n"
+        f"model = load_model({path!r}, wf)\n"
+        "cache = model.xla_executable_cache\n"
+        "assert cache is not None and sorted(cache.entries) == [1, 8]\n"
+        "tel = ServingTelemetry()\n"
+        "ep = compile_endpoint(model, batch_buckets=(1, 8),\n"
+        "                      telemetry=tel, fused_backend='xla')\n"
+        "snap = tel.snapshot()['fused']\n"
+        "out = ep.score_batch(records[:8])\n"
+        "print(json.dumps({'backend': snap['backend'],\n"
+        "                  'cache': snap['cache'],\n"
+        "                  'timings': snap['bucket_timings'],\n"
+        "                  'fingerprint': cache.fingerprint,\n"
+        "                  'scores': out}))\n"
+    )
+    report = _run_replica_child(replica)
+    assert report["backend"] == "xla"
+    assert report["fingerprint"] == trained["fingerprint"]
+    assert report["cache"]["hits"] == 2
+    assert report["cache"]["misses"] == 0
+    assert report["cache"]["stale"] == 0
+    for timing in report["timings"].values():
+        assert timing["cache_hit"] == 1
+        assert timing["load_ms"] > 0.0
+        assert timing["trace_ms"] == 0.0
+        assert timing["compile_ms"] == 0.0
+    # the deserialized executable IS the serialized one: bit parity
+    assert report["scores"] == trained["scores"]
+
+
+def test_xla_stale_cache_fingerprint_retraces_and_recaches():
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model, batch_buckets=(4,),
+                                fused_backend="xla")
+    cache = model.xla_executable_cache
+    assert sorted(cache.entries) == [4]
+    good_fp = cache.fingerprint
+    # doctor the fingerprint: simulates a jaxlib upgrade / backend swap
+    cache.fingerprint = "deadbeef"
+    tel = ServingTelemetry()
+    endpoint2 = compile_endpoint(model, batch_buckets=(4,),
+                                 telemetry=tel, fused_backend="xla")
+    assert endpoint2.fused_backend == "xla"
+    snap = tel.snapshot()["fused"]
+    assert snap["cache"]["stale"] == 1
+    assert snap["cache"]["hits"] == 0
+    assert snap["cache"]["misses"] == 1
+    # recached under the CURRENT fingerprint, ready for the next save
+    assert cache.fingerprint == good_fp
+    assert sorted(cache.entries) == [4]
+    out = endpoint2.score_batch(records[:4])
+    assert not any(isinstance(r, RowScoringError) for r in out)
+
+
+def test_registry_verify_names_stale_executables(tmp_path):
+    """A version whose cached executables were built by a different
+    jax/jaxlib/backend shows up in ``verify()`` as a NAMED warning
+    (stale_executables) while the artifact itself stays ok - the
+    operator learns about the fleet-wide retrace before replicas pay
+    it at load."""
+    from transmogrifai_tpu.registry import ModelRegistry
+
+    model, records, _ = _mixed_pipeline(OpLogisticRegression())
+    endpoint = compile_endpoint(model, batch_buckets=(4,),
+                                fused_backend="xla")
+    assert endpoint.fused_backend == "xla"
+    # forge the recorded build environment (a jaxlib upgrade in reverse)
+    model.xla_executable_cache.runtime = {
+        "jax": "0.0.1", "jaxlib": "0.0.1", "backend": "tpu",
+    }
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    entry = reg.publish(model)
+    report = reg.verify()
+    assert report["ok"] is True
+    assert report["versions"][entry.version] is None
+    warn = report["stale_executables"][entry.version]
+    assert "stale xla executables" in warn
+    assert "jaxlib=0.0.1" in warn
+
+    # a current-runtime cache reports clean
+    model.xla_executable_cache.runtime = dict(
+        __import__(
+            "transmogrifai_tpu.local.fused_xla", fromlist=["x"]
+        ).runtime_fingerprint()
+    )
+    entry2 = reg.publish(model)
+    report2 = reg.verify(entry2.version)
+    assert report2["stale_executables"] == {}

@@ -437,6 +437,45 @@ def test_fleet_reads_snapshots_only_via_torn_safe_loader():
     assert not offenders, offenders
 
 
+#: the fused serving path (ISSUE 12 satellite): these modules import at
+#: LocalScorer construction on every CPU replica, so a module-level jax
+#: import would put jax/device init on the numpy-fused cold-start path.
+#: local/fused_xla.py is the XLA backend itself and STILL must defer -
+#: importing the cache/compiler types (model_io does, for the artifact
+#: round trip) must not initialize a backend.
+_FUSED_PATH_MODULES = (
+    ("local", "__init__.py"),
+    ("local", "fused.py"),
+    ("local", "fused_xla.py"),
+    ("local", "scorer.py"),
+)
+
+
+def test_no_module_level_jax_on_fused_serving_path():
+    """No module-level ``import jax``/``jaxlib`` anywhere on the fused
+    serving path (ISSUE 12 satellite): the numpy-fused default and the
+    artifact load path must never pay jax/device initialization; every
+    jax touch in the XLA backend goes through deferred in-function
+    imports."""
+    offenders = []
+    for p in MODULES:
+        if _rel(p) not in _FUSED_PATH_MODULES:
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in tree.body:  # module level only: lazy is the pattern
+            roots = []
+            if isinstance(node, ast.Import):
+                roots = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                roots = [(node.module or "").split(".")[0]]
+            for root in roots:
+                if root in ("jax", "jaxlib"):
+                    offenders.append(
+                        f"{p}:{node.lineno} module-level {root} import"
+                    )
+    assert not offenders, offenders
+
+
 def test_fused_module_stays_columnar():
     """The fused serving program (local/fused.py) must stay columnar end
     to end (ISSUE 6): no ``for``/``while`` statement loops anywhere in

@@ -1,4 +1,9 @@
-"""Engine-free local scoring (reference ``local`` module analog)."""
+"""Engine-free local scoring (reference ``local`` module analog).
+
+The XLA backend (``fused_xla``) is NOT imported here: the numpy-fused
+path must stay importable without touching jax (style-gated); import
+``transmogrifai_tpu.local.fused_xla`` explicitly for the cache/compiler
+types."""
 from .fused import (
     FusedPipeline,
     FusionError,
@@ -6,9 +11,10 @@ from .fused import (
     RecordDecoder,
     compile_pipeline,
 )
-from .scorer import LocalScorer, score_function
+from .scorer import FUSED_BACKENDS, LocalScorer, score_function
 
 __all__ = [
+    "FUSED_BACKENDS",
     "FusedPipeline",
     "FusionError",
     "LocalScorer",

@@ -1,0 +1,587 @@
+"""XLA backend for whole-pipeline fused serving.
+
+Takes the PR-6 compile-to-kernel seam the rest of the way to the
+accelerator (ROADMAP item 3; arXiv 1810.09868 compiles whole model+
+preprocessing programs to one XLA executable; TpuGraphs, arXiv
+2308.13490, treats exactly such whole-graph executables as the unit
+worth caching): every stage with an :class:`~..stages.base.XlaLowering`
+contributes one jax-traceable step, and :func:`compile_xla_pipeline`
+chains them into ONE jitted program per shape bucket -
+``jax.jit(...).lower(...).compile()``, ahead of time, under x64.
+
+Stages without a device lowering (text/one-hot pivots - strings cannot
+cross the XLA boundary) run their numpy :class:`~..stages.base.Lowering`
+as HOST PRE-STEPS whose numeric outputs feed the jitted program as
+inputs; a host stage that would need a device-produced key raises
+:class:`~.fused.FusionError` and the scorer degrades the WHOLE pipeline
+to the numpy-fused path (per-pipeline, never per-batch).
+
+AOT executable cache
+--------------------
+Each compiled bucket serializes via
+``jax.experimental.serialize_executable`` into an
+:class:`XlaExecutableCache` attached to the model
+(``model.xla_executable_cache``), which ``serialization/model_io.py``
+persists INSIDE the crash-consistent artifact (``xla_cache.json`` +
+``xla_cache.npz``, both in the manifest).  A replica warm-up therefore
+cold-starts by deserializing binaries instead of re-tracing; a
+jaxlib/backend/program fingerprint mismatch falls back to
+retrace-and-recache, counted in serving telemetry
+(``fused.cache.stale``) and reported by ``tx registry verify`` as a
+named warning.
+
+Per bucket the pipeline records a ``trace_ms / compile_ms /
+first_exec_ms / load_ms / cache_hit`` split (surfaced through the PR-7
+metrics registry), so warm-start-vs-retrace is observable fleet-wide.
+
+This module must stay importable without initializing jax (the style
+gate keeps jax imports out of module level on the fused serving path so
+numpy-fused cold-start stays fast); every jax touch is deferred.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import threading
+import time
+from functools import reduce
+from typing import Any, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..stages.base import MASK_SUFFIX, PROB_SUFFIX, RAW_SUFFIX
+from .fused import (
+    _MAX_SHAPE_PROGRAMS,
+    _assemble_prediction,
+    _nonfinite_mask,
+    _prediction_stack,
+    _row_builder,
+    FusionError,
+    PipelineCompiler,
+)
+
+log = logging.getLogger("transmogrifai_tpu.local.xla")
+
+XLA_CACHE_FORMAT_VERSION = 1
+
+#: serializes every AOT compile's persistent-compilation-cache toggle
+#: window PROCESS-WIDE: jax.config.update mutates global state, and two
+#: pipelines compiling concurrently under only their own per-instance
+#: locks could interleave save/restore - one would compile with the
+#: cache enabled (unsound serialization) and the final restore could
+#: leave the cache disabled for the whole process
+_COMPILE_CACHE_LOCK = threading.Lock()
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+@contextlib.contextmanager
+def _x64():
+    """x64 tracing/execution window: the fused env contract is float64
+    end to end, and jax canonicalizes f64 arguments to f32 outside this
+    context (compiled-executable calls included)."""
+    with _jax().experimental.enable_x64():
+        yield
+
+
+def runtime_fingerprint() -> dict:
+    """The environment half of the executable fingerprint: a serialized
+    executable is only trusted by the exact jax/jaxlib build and device
+    backend that produced it."""
+    jax = _jax()
+    import jaxlib
+
+    return {
+        "jax": getattr(jax, "__version__", "unknown"),
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+        "backend": jax.default_backend(),
+    }
+
+
+def program_fingerprint(describe: Sequence, device_inputs: Sequence[str],
+                        result_names: Sequence[str]) -> str:
+    """SHA-256 over (runtime, plan structure, program inputs, results):
+    the full cache key minus the shape bucket.  The plan description
+    carries stage uids and env key names, so a replica gets a cache hit
+    only when it rebuilt the SAME code-defined workflow - anything else
+    (different build, different stage zoo, new jaxlib) is a counted
+    stale miss that retraces, never a silently wrong executable."""
+    doc = {
+        # format version: bumping it invalidates every cached executable
+        # when the PROGRAM CONSTRUCTION here changes (e.g. the in-program
+        # guard-mask output) - an old binary's output pytree would no
+        # longer match what score_batch expects
+        "format": XLA_CACHE_FORMAT_VERSION,
+        "runtime": runtime_fingerprint(),
+        "plan": [list(entry) for entry in describe],
+        "inputs": list(device_inputs),
+        "results": list(result_names),
+    }
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, default=str).encode("utf-8")
+    ).hexdigest()
+
+
+class XlaExecutableCache:
+    """Serialized AOT-compiled executables, one per shape bucket.
+
+    Pure data (importable and persistable without jax): ``entries`` maps
+    bucket size -> ``{"payload": bytes, "sha256": str, "bytes": int,
+    "out_keys": tuple}``.  ``serialization/model_io.py`` writes it into
+    the artifact as ``xla_cache.json`` (meta) + ``xla_cache.npz``
+    (payloads as uint8 arrays), both checksummed in the manifest - the
+    payloads only ever deserialize out of a manifest-verified artifact,
+    and each blob re-verifies its own SHA-256 before loading.
+    """
+
+    def __init__(self, fingerprint: Optional[str] = None,
+                 runtime: Optional[dict] = None,
+                 entries: Optional[dict] = None) -> None:
+        self.fingerprint = fingerprint
+        self.runtime = dict(runtime or {})
+        self.entries: dict[int, dict] = dict(entries or {})
+
+    def reset(self, fingerprint: str, runtime: dict) -> None:
+        """Drop every stale executable and re-key the cache: called when
+        the owning pipeline's fingerprint no longer matches (new jaxlib,
+        new backend, different program), so the retraced executables
+        replace the stale ones on the next artifact save."""
+        self.fingerprint = fingerprint
+        self.runtime = dict(runtime)
+        self.entries.clear()
+
+    def put(self, bucket: int, payload: bytes, out_keys: Sequence[str]) -> None:
+        self.entries[int(bucket)] = {
+            "payload": payload,
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "bytes": len(payload),
+            "out_keys": tuple(out_keys),
+        }
+
+    # -- artifact round trip (no jax needed) --------------------------------
+    def to_artifact(self) -> tuple[dict, dict]:
+        """-> (meta json document, npz arrays): the two files model_io
+        writes into the crash-consistent artifact."""
+        meta: dict[str, Any] = {
+            "format_version": XLA_CACHE_FORMAT_VERSION,
+            "fingerprint": self.fingerprint,
+            "runtime": dict(self.runtime),
+            "buckets": {},
+        }
+        arrays: dict[str, np.ndarray] = {}
+        for bucket, entry in sorted(self.entries.items()):
+            key = f"bucket_{bucket}"
+            meta["buckets"][str(bucket)] = {
+                "npz_key": key,
+                "sha256": entry["sha256"],
+                "bytes": entry["bytes"],
+                "out_keys": list(entry["out_keys"]),
+            }
+            arrays[key] = np.frombuffer(entry["payload"], dtype=np.uint8)
+        return meta, arrays
+
+    @classmethod
+    def from_artifact(cls, meta: dict, arrays) -> "XlaExecutableCache":
+        entries: dict[int, dict] = {}
+        for bucket_s, ent in meta.get("buckets", {}).items():
+            payload = bytes(
+                np.asarray(arrays[ent["npz_key"]], dtype=np.uint8)
+            )
+            entries[int(bucket_s)] = {
+                "payload": payload,
+                "sha256": ent["sha256"],
+                "bytes": int(ent["bytes"]),
+                "out_keys": tuple(ent["out_keys"]),
+            }
+        return cls(
+            fingerprint=meta.get("fingerprint"),
+            runtime=dict(meta.get("runtime", {})),
+            entries=entries,
+        )
+
+
+#: device-program output key carrying the per-row non-finite guard mask
+#: (computed INSIDE the jitted program over the result arrays - the
+#: host walk over them costs ~5% of a 2048-row batch)
+NONFINITE_KEY = "__nonfinite@rows__"
+
+
+def _exec_bucket(n: int) -> int:
+    """Internal shape bucket: next power of two >= n.  The serving
+    endpoint already pads to its fixed buckets (1/8/32/128... - powers
+    of two), so endpoint traffic compiles exactly one program per
+    endpoint bucket; direct scorer callers with arbitrary batch lengths
+    are padded here so the number of AOT compiles stays logarithmic in
+    the largest batch instead of linear in distinct lengths."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def _pad0(a: np.ndarray, m: int) -> np.ndarray:
+    if a.shape[0] == m:
+        return a
+    pad = [(0, m - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+class XlaFusedPipeline:
+    """One AOT-compiled XLA program per shape bucket over the fitted plan.
+
+    Drop-in for :class:`~.fused.FusedPipeline` on the scorer/endpoint
+    seam (same ``score_batch`` / ``compile_ms`` / ``plan`` /
+    ``last_nonfinite_rows`` surface) plus the XLA-specific telemetry:
+    ``backend``, per-bucket ``bucket_stats`` (trace/compile/load/
+    first-exec ms + cache_hit) and ``cache_events`` (hits/misses/stale).
+    """
+
+    backend = "xla"
+
+    def __init__(self, decoder, host_steps: Sequence, device_steps: Sequence,
+                 device_inputs: Sequence[str], candidates: Sequence[str],
+                 result_plan: Sequence, describe: Sequence,
+                 cache: Optional[XlaExecutableCache],
+                 fingerprint: str) -> None:
+        self._decoder = decoder
+        self._host_steps = tuple(host_steps)
+        self._device_fns = tuple(xl.fn for xl in device_steps)
+        self._device_inputs = tuple(device_inputs)
+        self._input_set = frozenset(device_inputs)
+        self._candidates = tuple(candidates)
+        self._result_plan = tuple(result_plan)
+        self.plan = tuple(describe)
+        self.fingerprint = fingerprint
+        self._cache = cache
+        #: shape bucket -> total cold-start wall ms (compat with the
+        #: numpy FusedPipeline's telemetry contract)
+        self.compile_ms: dict[int, float] = {}
+        #: shape bucket -> {trace_ms, compile_ms, load_ms, first_exec_ms,
+        #: cache_hit} - the warm-start-vs-retrace observability split
+        self.bucket_stats: dict[int, dict] = {}
+        self.cache_events = {"hits": 0, "misses": 0, "stale": 0}
+        self._compiled: dict[int, Any] = {}
+        self._pending_first_exec: set[int] = set()
+        self._compile_lock = threading.Lock()
+        self._single_prediction = (
+            result_plan[0][0]
+            if len(result_plan) == 1
+            and result_plan[0][1] is _assemble_prediction
+            else None
+        )
+        self._nonfinite_tl = threading.local()
+        if cache is not None and cache.fingerprint != fingerprint:
+            if cache.entries:
+                # stale cache (new jaxlib/backend or different program):
+                # retrace-and-recache, loudly and counted - never run a
+                # foreign executable
+                self.cache_events["stale"] += 1
+                log.warning(
+                    "xla executable cache is stale (cached runtime %s vs "
+                    "current %s); retracing every bucket and recaching",
+                    cache.runtime or "unknown", runtime_fingerprint(),
+                )
+            cache.reset(fingerprint, runtime_fingerprint())
+
+    # -- telemetry surface ---------------------------------------------------
+    @property
+    def last_nonfinite_rows(self) -> tuple:
+        return getattr(self._nonfinite_tl, "rows", ())
+
+    @last_nonfinite_rows.setter
+    def last_nonfinite_rows(self, rows: tuple) -> None:
+        self._nonfinite_tl.rows = rows
+
+    # -- the device program --------------------------------------------------
+    def _device_fn(self, out_box: dict):
+        import jax.numpy as jnp
+
+        fns = self._device_fns
+        candidates = self._candidates
+        inputs = self._input_set
+        result_names = tuple(name for name, _ in self._result_plan)
+
+        def nonfinite(env: dict, out: dict, n: int):
+            """Traced mirror of fused._nonfinite_mask over the DEVICE-
+            resident result features (host-resident ones are walked on
+            the host in score_batch)."""
+            total = jnp.zeros(n, dtype=bool)
+            for name in result_names:
+                if name not in out:
+                    continue
+                arrays = [
+                    a for a in (env.get(name), env.get(name + RAW_SUFFIX),
+                                env.get(name + PROB_SUFFIX))
+                    if a is not None
+                    and jnp.issubdtype(a.dtype, jnp.floating)
+                ]
+                if not arrays:
+                    continue
+                bad = None
+                for a in arrays:
+                    b = (~jnp.isfinite(a) if a.ndim == 1
+                         else (~jnp.isfinite(a)).any(axis=1))
+                    bad = b if bad is None else (bad | b)
+                present = env.get(name + MASK_SUFFIX)
+                if present is not None:
+                    bad = bad & present
+                total = total | bad
+            return total
+
+        def program(xenv: dict) -> dict:
+            env = dict(xenv)
+            for fn in fns:
+                env.update(fn(env))
+            out = {
+                k: env[k] for k in candidates
+                if k in env and k not in inputs
+            }
+            n = next(iter(xenv.values())).shape[0]
+            out[NONFINITE_KEY] = nonfinite(env, out, n)
+            # trace-time capture: the produced key set (raw/prob
+            # companions included) keys the cache entry so a cache-hit
+            # load can rebuild the output pytree without tracing
+            out_box["out_keys"] = tuple(sorted(out))
+            return out
+
+        return program
+
+    def _deserialize(self, entry: dict, spec: dict):
+        jax = _jax()
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        payload = entry["payload"]
+        sha = hashlib.sha256(payload).hexdigest()
+        if sha != entry["sha256"]:
+            raise FusionError(
+                "cached xla executable payload fails its SHA-256 "
+                "(xla_cache.json / xla_cache.npz mismatch)"
+            )
+        in_tree = jax.tree_util.tree_structure(((spec,), {}))
+        out_tree = jax.tree_util.tree_structure(
+            {k: 0 for k in entry["out_keys"]}
+        )
+        return deserialize_and_load(payload, in_tree, out_tree)
+
+    def _compile_bucket(self, m: int, xenv: dict):
+        jax = _jax()
+        spec = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+            for k, v in xenv.items()
+        }
+        stats = {"trace_ms": 0.0, "compile_ms": 0.0, "load_ms": 0.0,
+                 "first_exec_ms": 0.0, "cache_hit": 0}
+        cache = self._cache
+        entry = cache.entries.get(m) if cache is not None else None
+        if entry is not None:
+            try:
+                t0 = time.perf_counter()
+                exe = self._deserialize(entry, spec)
+                stats["load_ms"] = (time.perf_counter() - t0) * 1e3
+                stats["cache_hit"] = 1
+                self.cache_events["hits"] += 1
+            except Exception as e:  # noqa: BLE001 - degrade to retrace
+                log.warning(
+                    "cached xla executable for bucket %d failed to "
+                    "load (%s: %s); retracing", m, type(e).__name__, e,
+                )
+                entry = None
+        if entry is None:
+            self.cache_events["misses"] += 1
+            out_box: dict = {}
+            program = self._device_fn(out_box)
+            with _x64():
+                t0 = time.perf_counter()
+                lowered = jax.jit(program).lower(spec)
+                t1 = time.perf_counter()
+                # serialization-sound compile (jaxlib 0.4.36 CPU):
+                # (a) the persistent compilation cache is OFF for this
+                # compile - serialize() of an executable REHYDRATED
+                # from it yields a payload missing its compiled symbol
+                # definitions; (b) the CPU thunk runtime dedupes JIT
+                # symbols against process state, so its serialized
+                # executables fail with "Symbols not found" whenever a
+                # same-named fusion was already resident - the legacy
+                # runtime embeds everything and round-trips cleanly
+                # (both reproduced under the tier-1 8-device config)
+                opts = (
+                    {"xla_cpu_use_thunk_runtime": False}
+                    if jax.default_backend() == "cpu" else None
+                )
+                with _COMPILE_CACHE_LOCK:
+                    cc_old = jax.config.jax_enable_compilation_cache
+                    try:
+                        jax.config.update(
+                            "jax_enable_compilation_cache", False)
+                        exe = lowered.compile(compiler_options=opts)
+                    finally:
+                        jax.config.update(
+                            "jax_enable_compilation_cache", cc_old)
+                t2 = time.perf_counter()
+            stats["trace_ms"] = (t1 - t0) * 1e3
+            stats["compile_ms"] = (t2 - t1) * 1e3
+            if cache is not None:
+                try:
+                    from jax.experimental.serialize_executable import (
+                        serialize,
+                    )
+
+                    payload, _in_tree, _out_tree = serialize(exe)
+                    cache.put(m, payload, out_box["out_keys"])
+                except Exception as e:  # noqa: BLE001 - cache is optional
+                    log.warning(
+                        "could not serialize xla executable for bucket "
+                        "%d (%s: %s); serving uncached", m,
+                        type(e).__name__, e,
+                    )
+        self.bucket_stats[m] = stats
+        self.compile_ms[m] = round(
+            stats["trace_ms"] + stats["compile_ms"] + stats["load_ms"], 3
+        )
+        self._pending_first_exec.add(m)
+        return exe
+
+    def _execute(self, m: int, xenv: dict) -> dict:
+        exe = self._compiled.get(m)
+        if exe is None:
+            with self._compile_lock:
+                exe = self._compiled.get(m)
+                if exe is None:
+                    if len(self._compiled) >= _MAX_SHAPE_PROGRAMS:
+                        # runaway shape diversity: drop the oldest
+                        # program (insertion order) instead of growing
+                        # compile memory without bound
+                        oldest = next(iter(self._compiled))
+                        del self._compiled[oldest]
+                    exe = self._compile_bucket(m, xenv)
+                    self._compiled[m] = exe
+        first = m in self._pending_first_exec
+        t0 = time.perf_counter() if first else 0.0
+        with _x64():
+            out = exe(xenv)
+            # materialize INSIDE the x64 window as real contiguous
+            # numpy copies: conversion outside the window pays a slow
+            # per-array dispatch, and downstream .tolist()/concatenate
+            # over XLA buffer views measures ~10% slower than over
+            # owned numpy memory
+            res = {k: np.array(v) for k, v in out.items()}
+        if first:
+            stats = self.bucket_stats.get(m)
+            if stats is not None and not stats["first_exec_ms"]:
+                stats["first_exec_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3
+                )
+            self._pending_first_exec.discard(m)
+        return res
+
+    # -- scoring -------------------------------------------------------------
+    def score_batch(
+        self, records: Sequence[Mapping[str, Any]]
+    ) -> list[dict[str, Any]]:
+        n = len(records)
+        if n == 0:
+            self.last_nonfinite_rows = ()
+            return []
+        env = self._decoder.decode_env(records)
+        for fn in self._host_steps:
+            env.update(fn(env))
+        m = _exec_bucket(n)
+        xenv = {
+            k: _pad0(np.asarray(env[k]), m) for k in self._device_inputs
+        }
+        out = self._execute(m, xenv)
+        nf = out.pop(NONFINITE_KEY)[:n]
+        env.update({k: v[:n] for k, v in out.items()})
+        if self._single_prediction is not None:
+            name = self._single_prediction
+            keys, stacked = _prediction_stack(env, name)
+            result = list(map(_row_builder(name, keys), stacked))
+        elif len(self._result_plan) == 1:
+            (name, fn), = self._result_plan
+            result = [{name: v} for v in fn(env, name)]
+        else:
+            names = [name for name, _ in self._result_plan]
+            columns = [fn(env, name) for name, fn in self._result_plan]
+            result = [dict(zip(names, row)) for row in zip(*columns)]
+        # the device program already guarded its own result arrays;
+        # only results served from host steps / raw passthrough (rare)
+        # still need the host walk
+        host_masks = [
+            _nonfinite_mask(env, name, n)
+            for name, _ in self._result_plan if name not in out
+        ]
+        self.last_nonfinite_rows = tuple(
+            np.flatnonzero(reduce(np.logical_or, host_masks, nf)).tolist()
+        )
+        return result
+
+    def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
+        return self.score_batch([record])[0]
+
+
+def compile_xla_pipeline(steps, raw_features, result_features,
+                         cache: Optional[XlaExecutableCache] = None
+                         ) -> XlaFusedPipeline:
+    """Fuse a fitted plan into one AOT-compilable XLA program (plus host
+    pre-steps), or raise FusionError naming the first stage that cannot
+    be compiled - the scorer then degrades the whole pipeline to the
+    numpy-fused backend."""
+    base = PipelineCompiler(steps, raw_features, result_features)
+    np_fused = base.compile()  # validates plan + builds decoder/assembly
+    host_steps: list = []
+    device_steps: list = []
+    device_out: set[str] = set()
+    for stage, _ins, _out in steps:
+        try:
+            xl = stage.lower_xla()
+        except Exception as e:  # noqa: BLE001 - open extension seam
+            raise FusionError(
+                f"stage {stage.uid} ({type(stage).__name__}) lower_xla "
+                f"raised {type(e).__name__}: {e}"
+            ) from e
+        if xl is not None:
+            device_steps.append(xl)
+            device_out.update(xl.outputs)
+            continue
+        lw = stage.lower()  # non-None: the base compile succeeded
+        dev_deps = sorted(k for k in lw.inputs if k in device_out)
+        if dev_deps:
+            raise FusionError(
+                f"stage {stage.uid} ({type(stage).__name__}) has no XLA "
+                f"lowering but consumes device-produced keys {dev_deps}; "
+                "cannot stage it on the host"
+            )
+        host_steps.append(lw.fn)
+    if not device_steps:
+        raise FusionError(
+            "no stage lowers to XLA; the numpy-fused program is the "
+            "right backend for this pipeline"
+        )
+    device_inputs = sorted(
+        {k for xl in device_steps for k in xl.inputs} - device_out
+    )
+    candidates: list[str] = []
+    for f in result_features:
+        for key in (f.name, f.name + MASK_SUFFIX, f.name + RAW_SUFFIX,
+                    f.name + PROB_SUFFIX):
+            if key not in candidates:
+                candidates.append(key)
+    fingerprint = program_fingerprint(
+        np_fused.plan, device_inputs, [f.name for f in result_features]
+    )
+    return XlaFusedPipeline(
+        decoder=np_fused._decoder,
+        host_steps=host_steps,
+        device_steps=device_steps,
+        device_inputs=device_inputs,
+        candidates=candidates,
+        result_plan=np_fused._result_plan,
+        describe=np_fused.plan,
+        cache=cache,
+        fingerprint=fingerprint,
+    )

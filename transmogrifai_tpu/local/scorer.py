@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import os
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
 from ..models.base import PredictorModel
@@ -35,6 +36,35 @@ from .fused import DECODABLE_KINDS, FusionError, RecordDecoder, \
     compile_pipeline
 
 log = logging.getLogger("transmogrifai_tpu.local")
+
+FUSED_BACKENDS = ("auto", "numpy", "xla")
+
+
+#: memoized accelerator probe result (at most ONE backend init/process)
+_accel_memo: Optional[bool] = None
+
+
+def _accelerator_present() -> bool:
+    """True when jax's default backend is a real accelerator - the
+    'auto' policy compiles to XLA only where the device pays for it;
+    numpy-fused stays the CPU default (it wins there, SERVING_BENCH).
+
+    A ``JAX_PLATFORMS=cpu`` pin (the tier-1 config and the standard
+    CPU-replica deployment) answers WITHOUT touching jax, so the
+    numpy-fused cold-start path never initializes a device backend;
+    otherwise the probe runs once per process (jax.default_backend()
+    initializes the client) and memoizes."""
+    global _accel_memo
+    if _accel_memo is None:
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            return False  # unmemoized: the env pin can change per test
+        try:
+            import jax
+
+            _accel_memo = jax.default_backend() != "cpu"
+        except Exception:  # noqa: BLE001 - no jax, no accelerator
+            _accel_memo = False
+    return _accel_memo
 
 
 class LocalScorer:
@@ -51,7 +81,8 @@ class LocalScorer:
     def __init__(self, model: OpWorkflowModel,
                  contract=None,
                  drift_policy: Optional[str] = "warn",
-                 fused: bool = True) -> None:
+                 fused: bool = True,
+                 fused_backend: Optional[str] = None) -> None:
         self.raw_features = tuple(
             f for f in model.raw_features
             if not any(f.name == b.name for b in model.blacklisted_features)
@@ -111,29 +142,85 @@ class LocalScorer:
             f for f in self.raw_features
             if f.ftype.kind not in DECODABLE_KINDS
         )
-        # whole-pipeline fused compilation (ROADMAP item 1, local/
-        # fused.py): when every fitted stage lowers, batches score
-        # through ONE array program; otherwise the pipeline serves
-        # interpreted for its whole life (per-pipeline choice, recorded
-        # in fused_reason and surfaced by serving telemetry)
+        # whole-pipeline fused compilation (ROADMAP items 1+3, local/
+        # fused.py + local/fused_xla.py): when every fitted stage
+        # lowers, batches score through ONE array program - the XLA
+        # backend (AOT-compiled jitted program per shape bucket) when
+        # requested/auto-selected, else the numpy-fused program.  Every
+        # degradation is per-PIPELINE, never per-batch: xla falls back
+        # to numpy-fused, numpy-fused to interpreted, each step recorded
+        # in fused_reason and surfaced by serving telemetry.
+        backend = (
+            fused_backend
+            or os.environ.get("TX_FUSED_BACKEND", "").strip()
+            or "auto"
+        )
+        if backend not in FUSED_BACKENDS:
+            raise ValueError(
+                f"fused_backend must be one of {FUSED_BACKENDS}, "
+                f"got {backend!r}"
+            )
         self.fused = None
+        self.fused_backend: Optional[str] = None
         self.fused_reason: Optional[str] = (
             None if fused else "disabled by caller"
         )
-        if fused:
+        reasons: list[str] = []
+        want_xla = fused and (
+            backend == "xla"
+            or (backend == "auto" and _accelerator_present())
+        )
+        if want_xla:
+            try:
+                from .fused_xla import (
+                    XlaExecutableCache,
+                    compile_xla_pipeline,
+                )
+
+                # the AOT executable cache rides the MODEL, so the
+                # artifact save persists whatever this scorer compiles
+                # and a registry-loaded model warm-starts from binaries
+                cache = getattr(model, "xla_executable_cache", None)
+                if cache is None:
+                    cache = XlaExecutableCache()
+                    model.xla_executable_cache = cache
+                self.fused = compile_xla_pipeline(
+                    self._steps, self.raw_features, self.result_features,
+                    cache=cache,
+                )
+                self.fused_backend = "xla"
+            except FusionError as e:
+                reasons.append(f"xla backend unavailable: {e}")
+                log.info(
+                    "pipeline not XLA-fusable, degrading to numpy-fused:"
+                    " %s", e,
+                )
+            except Exception as e:  # noqa: BLE001 - degrade, don't die
+                reasons.append(
+                    f"xla lowering raised {type(e).__name__}: {e}"
+                )
+                log.warning(
+                    "XLA fusion failed, degrading to numpy-fused: %s",
+                    reasons[-1],
+                )
+        if fused and self.fused is None:
             try:
                 self.fused = compile_pipeline(
                     self._steps, self.raw_features, self.result_features
                 )
+                self.fused_backend = "numpy"
+                # fused, but not on the requested backend: keep the
+                # degradation visible in telemetry
+                self.fused_reason = "; ".join(reasons) or None
             except FusionError as e:
-                self.fused_reason = str(e)
+                reasons.append(str(e))
+                self.fused_reason = "; ".join(reasons)
                 log.info("pipeline not fusable, serving interpreted: %s", e)
             except Exception as e:  # noqa: BLE001 - degrade, don't die
                 # lower() is an open extension seam: a buggy third-party
                 # lowering must cost the fused path, not the endpoint
-                self.fused_reason = (
-                    f"lowering raised {type(e).__name__}: {e}"
-                )
+                reasons.append(f"lowering raised {type(e).__name__}: {e}")
+                self.fused_reason = "; ".join(reasons)
                 log.warning(
                     "pipeline fusion failed, serving interpreted: %s",
                     self.fused_reason,

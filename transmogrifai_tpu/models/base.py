@@ -28,6 +28,7 @@ from ..stages.base import (
     Estimator,
     Lowering,
     Transformer,
+    XlaLowering,
 )
 from ..types.columns import Column, NumericColumn, PredictionColumn, VectorColumn
 from ..types.dataset import Dataset
@@ -130,6 +131,53 @@ class PredictorModel(Transformer):
         # the result assembler reads the suffixed keys tolerantly via
         # env.get, so emitting undeclared keys is fine.
         return Lowering(
+            fn=fn, inputs=(vec_name,),
+            outputs=(out,),
+            signature={out: "float64[n]"},
+        )
+
+    def lower_xla(self) -> Optional[XlaLowering]:
+        """Compile the fitted head to a jax-traceable call through the
+        family's ``predict_arrays_xla`` mirror of its numpy predict
+        path.  Gated on both the ``lowerable`` opt-in and the family
+        actually providing the jnp mirror - a family without one keeps
+        the whole pipeline on the numpy-fused path."""
+        import jax.numpy as jnp  # deferred: models import before jax use
+
+        est = self.estimator_ref
+        predict_xla = getattr(est, "predict_arrays_xla", None)
+        if not getattr(est, "lowerable", False) or predict_xla is None:
+            return None
+        vec_name = self.input_features[-1].name
+        out = self.output_name
+        params = self.model_params
+        in_dtype = (
+            jnp.float32 if getattr(est, "predict_f32_exact", False)
+            else jnp.float64
+        )
+
+        def fn(env: dict) -> dict:
+            pred, raw, prob = predict_xla(
+                params, env[vec_name].astype(in_dtype)
+            )
+            res = {out: pred.astype(jnp.float64).reshape(-1)}
+            if raw is not None:
+                raw = raw.astype(jnp.float64)
+                res[out + RAW_SUFFIX] = (
+                    raw[:, None] if raw.ndim == 1 else raw
+                )
+            if prob is not None:
+                prob = prob.astype(jnp.float64)
+                res[out + PROB_SUFFIX] = (
+                    prob[:, None] if prob.ndim == 1 else prob
+                )
+            return res
+
+        # only the guaranteed key is DECLARED (the numpy lower() has the
+        # same contract and rationale); the traced program's actual
+        # output set - raw/prob included when the family emits them - is
+        # discovered at trace time and recorded in the executable cache
+        return XlaLowering(
             fn=fn, inputs=(vec_name,),
             outputs=(out,),
             signature={out: "float64[n]"},

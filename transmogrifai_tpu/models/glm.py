@@ -264,5 +264,23 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
             pred = eta
         return pred.astype(np.float64), None, None
 
+    def predict_arrays_xla(self, params: Any, X):
+        """jax-traceable mirror of the numpy link-function head for the
+        XLA fused backend (local/fused_xla.py)."""
+        eta = X @ jnp.asarray(params["beta"]) + params["intercept"]
+        fam = _norm_family(params["family"])
+        lp = float(params.get("link_power", 0.0))
+        if fam == "tweedie" and lp != 0.0:
+            pred = jnp.clip(
+                jnp.maximum(eta, 1e-6) ** (1.0 / lp), 1e-6, 1e8
+            )
+        elif fam in ("poisson", "gamma", "tweedie"):
+            pred = jnp.exp(jnp.clip(eta, -30, 30))
+        elif fam == "binomial":
+            pred = 1.0 / (1.0 + jnp.exp(-eta))
+        else:
+            pred = eta
+        return pred.astype(jnp.float64), None, None
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         return np.abs(params["beta"])

@@ -218,5 +218,12 @@ class OpLinearRegression(PredictorEstimator):
         pred = (X @ params["beta"] + params["intercept"]).astype(np.float64)
         return pred, None, None
 
+    def predict_arrays_xla(self, params: Any, X):
+        """jax-traceable mirror of the numpy head for the XLA fused
+        backend (local/fused_xla.py)."""
+        pred = (X @ jnp.asarray(params["beta"])
+                + params["intercept"]).astype(jnp.float64)
+        return pred, None, None
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         return np.abs(params["beta"])

@@ -166,5 +166,13 @@ class OpLinearSVC(PredictorEstimator):
         raw = np.stack([-z, z], axis=1)
         return pred, raw, None
 
+    def predict_arrays_xla(self, params: Any, X):
+        """jax-traceable mirror of the numpy margin head for the XLA
+        fused backend (local/fused_xla.py)."""
+        z = X @ jnp.asarray(params["beta"]) + params["intercept"]
+        pred = (z > 0).astype(jnp.float64)
+        raw = jnp.stack([-z, z], axis=1)
+        return pred, raw, None
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         return np.abs(params["beta"])

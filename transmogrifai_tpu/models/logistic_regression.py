@@ -547,6 +547,29 @@ class OpLogisticRegression(PredictorEstimator):
         pred = (p1 > 0.5).astype(np.float64)
         return pred, raw, prob
 
+    def predict_arrays_xla(self, params: Any, X):
+        """jax-traceable mirror of ``predict_arrays_np`` for the XLA
+        fused backend (local/fused_xla.py); the margin matmul rides
+        XLA's dot emitter, so parity vs BLAS is a few-ULP budget, not
+        bit-exact (pinned in tests/test_fused_xla.py)."""
+        if "betas" in params:
+            z = X @ jnp.asarray(params["betas"]).T + jnp.asarray(
+                params["intercepts"]
+            )
+            z = jnp.clip(z, -500, 500)
+            e = jnp.exp(z - z.max(axis=1, keepdims=True))
+            prob = e / e.sum(axis=1, keepdims=True)
+            classes = jnp.asarray(np.asarray(params["classes"],
+                                             dtype=np.float64))
+            pred = classes[jnp.argmax(prob, axis=1)]
+            return pred.astype(jnp.float64), z, prob
+        z = X @ jnp.asarray(params["beta"]) + params["intercept"]
+        p1 = 1.0 / (1.0 + jnp.exp(-jnp.clip(z, -500, 500)))
+        prob = jnp.stack([1.0 - p1, p1], axis=1)
+        raw = jnp.stack([-z, z], axis=1)
+        pred = (p1 > 0.5).astype(jnp.float64)
+        return pred, raw, prob
+
     def contributions(self, params: Any) -> Optional[np.ndarray]:
         if "betas" in params:
             return np.abs(params["betas"]).mean(axis=0)

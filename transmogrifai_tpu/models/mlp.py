@@ -188,3 +188,21 @@ class OpMultilayerPerceptronClassifier(PredictorEstimator):
         prob /= prob.sum(axis=1, keepdims=True)
         pred = params["classes"][prob.argmax(axis=1)].astype(np.float64)
         return pred, logits, prob
+
+    def predict_arrays_xla(self, params: Any, X):
+        """jax-traceable mirror of ``predict_arrays_np`` for the XLA
+        fused backend (local/fused_xla.py): f64 relu matmul chain +
+        softmax head; parity vs BLAS accumulates a few ULP per layer
+        (budget pinned in tests/test_fused_xla.py)."""
+        h = ((X - jnp.asarray(params["mu"]))
+             / jnp.asarray(params["sd"])).astype(jnp.float64)
+        for W, b in params["layers"][:-1]:
+            h = jnp.maximum(h @ jnp.asarray(W) + jnp.asarray(b), 0.0)
+        W, b = params["layers"][-1]
+        logits = h @ jnp.asarray(W) + jnp.asarray(b)
+        prob = jnp.exp(logits - logits.max(axis=1, keepdims=True))
+        prob = prob / prob.sum(axis=1, keepdims=True)
+        classes = jnp.asarray(np.asarray(params["classes"],
+                                         dtype=np.float64))
+        pred = classes[jnp.argmax(prob, axis=1)].astype(jnp.float64)
+        return pred, logits, prob

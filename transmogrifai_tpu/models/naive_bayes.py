@@ -112,3 +112,18 @@ class OpNaiveBayes(PredictorEstimator):
         prob = ex / ex.sum(axis=1, keepdims=True)
         pred = params["classes"][np.argmax(prob, axis=1)].astype(np.float64)
         return pred, raw, prob
+
+    def predict_arrays_xla(self, params: Any, X):
+        """jax-traceable mirror of the numpy head for the XLA fused
+        backend (local/fused_xla.py)."""
+        raw = (
+            (X - jnp.asarray(params["shift"]))
+            @ jnp.asarray(params["theta"]).T
+            + jnp.asarray(params["prior"])[None, :]
+        )
+        ex = jnp.exp(raw - raw.max(axis=1, keepdims=True))
+        prob = ex / ex.sum(axis=1, keepdims=True)
+        classes = jnp.asarray(np.asarray(params["classes"],
+                                         dtype=np.float64))
+        pred = classes[jnp.argmax(prob, axis=1)].astype(jnp.float64)
+        return pred, raw, prob

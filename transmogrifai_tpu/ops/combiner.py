@@ -11,7 +11,7 @@ from typing import Callable, Sequence
 
 import numpy as np
 
-from ..stages.base import MASK_SUFFIX, Lowering, Transformer
+from ..stages.base import MASK_SUFFIX, Lowering, Transformer, XlaLowering
 from ..types.columns import Column, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import OPVector
@@ -61,6 +61,22 @@ class VectorsCombiner(Transformer):
             return {out: np.concatenate([env[k] for k in names], axis=1)}
 
         return Lowering(
+            fn=fn, inputs=names, outputs=(out,),
+            signature={out: "float32[n,d]"},
+        )
+
+    def lower_xla(self):
+        import jax.numpy as jnp  # deferred: combiner imports sans jax
+
+        if not self.input_features:
+            return None
+        names = tuple(f.name for f in self.input_features)
+        out = self.output_name
+
+        def fn(env: dict) -> dict:
+            return {out: jnp.concatenate([env[k] for k in names], axis=1)}
+
+        return XlaLowering(
             fn=fn, inputs=names, outputs=(out,),
             signature={out: "float32[n,d]"},
         )
@@ -116,6 +132,27 @@ class AliasTransformer(Transformer):
             return res
 
         return Lowering(
+            fn=fn, inputs=(name,) + tuple(name + s for s in aux),
+            outputs=(out,) + tuple(out + s for s in aux),
+            signature={out: "passthrough"},
+        )
+
+    def lower_xla(self):
+        (feat,) = self.input_features
+        kind = feat.ftype.kind
+        # text aliases stay host-side (object arrays cannot cross into
+        # XLA); the host pre-step route covers them
+        if kind not in ("numeric", "vector"):
+            return None
+        name, out = feat.name, self.output_name
+        aux = (MASK_SUFFIX,) if kind == "numeric" else ()
+
+        def fn(env: dict) -> dict:
+            res = {out: env[name]}
+            res.update({out + s: env[name + s] for s in aux})
+            return res
+
+        return XlaLowering(
             fn=fn, inputs=(name,) + tuple(name + s for s in aux),
             outputs=(out,) + tuple(out + s for s in aux),
             signature={out: "passthrough"},

@@ -74,6 +74,23 @@ class NumericVectorizerModel(SequenceVectorizerModel):
 
         return block
 
+    def lower_block_xla(self, i: int):
+        import jax.numpy as jnp  # deferred: module imports sans jax
+
+        name = self.input_features[i].name
+        fill = self.fill_values[i]
+        track_nulls = self.track_nulls
+
+        def block(env: dict):
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            filled = jnp.where(mask, vals, fill)
+            blocks = [filled]
+            if track_nulls:
+                blocks.append((~mask).astype(jnp.float64))
+            return jnp.stack(blocks, axis=1)
+
+        return block
+
 
 class RealVectorizer(SequenceVectorizer):
     """Impute mean (default) or constant + null indicators (reference:

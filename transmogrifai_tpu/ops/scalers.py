@@ -11,7 +11,13 @@ from typing import Sequence
 
 import numpy as np
 
-from ..stages.base import MASK_SUFFIX, Estimator, Lowering, Transformer
+from ..stages.base import (
+    MASK_SUFFIX,
+    Estimator,
+    Lowering,
+    Transformer,
+    XlaLowering,
+)
 from ..types.columns import Column, NumericColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import Real, RealNN
@@ -45,6 +51,25 @@ class _ScaleModel(Transformer):
                     out + MASK_SUFFIX: mask}
 
         return Lowering(
+            fn=fn, inputs=(name, name + MASK_SUFFIX),
+            outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
+        )
+
+    def lower_xla(self):
+        import jax.numpy as jnp  # deferred: scalers must import sans jax
+
+        (feat,) = self.input_features
+        name, out = feat.name, self.output_name
+        mean = self.mean
+        std = self.std if self.std > 0 else 1.0
+
+        def fn(env: dict) -> dict:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            return {out: jnp.where(mask, (vals - mean) / std, 0.0),
+                    out + MASK_SUFFIX: mask}
+
+        return XlaLowering(
             fn=fn, inputs=(name, name + MASK_SUFFIX),
             outputs=(out, out + MASK_SUFFIX),
             signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
@@ -124,6 +149,24 @@ class _FillMeanModel(Transformer):
             signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
         )
 
+    def lower_xla(self):
+        import jax.numpy as jnp
+
+        (feat,) = self.input_features
+        name, out = feat.name, self.output_name
+        fill = self.fill
+
+        def fn(env: dict) -> dict:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            return {out: jnp.where(mask, vals, fill),
+                    out + MASK_SUFFIX: jnp.ones(vals.shape[0], dtype=bool)}
+
+        return XlaLowering(
+            fn=fn, inputs=(name, name + MASK_SUFFIX),
+            outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
+        )
+
 
 class FillMissingWithMean(Estimator):
     """Real -> RealNN mean imputation (reference: FillMissingWithMean.scala)."""
@@ -188,6 +231,31 @@ class _PercentileModel(Transformer):
                     out + MASK_SUFFIX: mask}
 
         return Lowering(
+            fn=fn, inputs=(name, name + MASK_SUFFIX),
+            outputs=(out, out + MASK_SUFFIX),
+            signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},
+        )
+
+    def lower_xla(self):
+        import jax.numpy as jnp
+
+        (feat,) = self.input_features
+        name, out = feat.name, self.output_name
+        splits = np.asarray(self.splits)
+        scale = 99.0 / max(len(self.splits), 1)
+
+        def fn(env: dict) -> dict:
+            vals, mask = env[name], env[name + MASK_SUFFIX]
+            # numpy's searchsorted treats NaN as greater than every
+            # finite edge (rank = len(splits)); XLA comparisons would
+            # rank it 0 instead - map NaN to +inf so both agree
+            safe = jnp.where(jnp.isnan(vals), jnp.inf, vals)
+            ranks = jnp.searchsorted(splits, safe, side="right")
+            scaled = ranks.astype(jnp.float64) * scale
+            return {out: jnp.where(mask, jnp.clip(scaled, 0, 99), 0.0),
+                    out + MASK_SUFFIX: mask}
+
+        return XlaLowering(
             fn=fn, inputs=(name, name + MASK_SUFFIX),
             outputs=(out, out + MASK_SUFFIX),
             signature={out: "float64[n]", out + MASK_SUFFIX: "bool[n]"},

@@ -12,7 +12,13 @@ from typing import Callable, Optional, Sequence, Type
 
 import numpy as np
 
-from ..stages.base import MASK_SUFFIX, Estimator, Lowering, Transformer
+from ..stages.base import (
+    MASK_SUFFIX,
+    Estimator,
+    Lowering,
+    Transformer,
+    XlaLowering,
+)
 from ..types.columns import Column, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import FeatureType, OPVector
@@ -113,6 +119,42 @@ class SequenceVectorizerModel(Transformer):
             return {out: np.concatenate(arrays, axis=1)}
 
         return Lowering(
+            fn=fn,
+            inputs=tuple(inputs),
+            outputs=(out,),
+            signature={out: "float32[n,d]"},
+        )
+
+    # -- XLA seam (stages/base.XlaLowering) ---------------------------------
+    def lower_block_xla(self, i: int) -> Optional[Callable[[dict], "np.ndarray"]]:
+        """jax-traceable analog of ``lower_block`` for input ``i``.  None
+        (the default) keeps the stage off the device program; a stage
+        whose numpy lowering consumes only host-available keys (one-hot
+        text pivots) then runs as a host pre-step instead."""
+        return None
+
+    def lower_xla(self) -> Optional[XlaLowering]:
+        import jax.numpy as jnp  # deferred: vectorizers import sans jax
+
+        blocks = []
+        inputs: list[str] = []
+        for i, feat in enumerate(self.input_features):
+            fn_i = self.lower_block_xla(i)
+            if fn_i is None:
+                return None
+            blocks.append(fn_i)
+            inputs.append(feat.name)
+            if feat.ftype.kind == "numeric":
+                inputs.append(feat.name + MASK_SUFFIX)
+        if not blocks:
+            return None
+        out = self.output_name
+
+        def fn(env: dict) -> dict:
+            arrays = [b(env).astype(jnp.float32) for b in blocks]
+            return {out: jnp.concatenate(arrays, axis=1)}
+
+        return XlaLowering(
             fn=fn,
             inputs=tuple(inputs),
             outputs=(out,),

@@ -28,7 +28,7 @@ import numpy as np
 
 from ..parallel.mesh import data_mesh_or_none
 from ..parallel.pallas_kernels import fused_moments, fused_moments_sharded
-from ..stages.base import Estimator, Lowering, Transformer
+from ..stages.base import Estimator, Lowering, Transformer, XlaLowering
 from ..types.columns import Column, NumericColumn, VectorColumn
 from ..types.dataset import Dataset
 from ..types.feature_types import OPVector, RealNN
@@ -84,6 +84,19 @@ class SanityCheckerModel(Transformer):
             return {out: env[vec_name][:, keep]}
 
         return Lowering(
+            fn=fn, inputs=(vec_name,), outputs=(out,),
+            signature={out: f"float32[n,{len(keep)}]"},
+        )
+
+    def lower_xla(self):
+        vec_name = self.input_features[1].name
+        out = self.output_name
+        keep = np.asarray(self.indices_to_keep, dtype=np.int32)
+
+        def fn(env: dict) -> dict:
+            return {out: env[vec_name][:, keep]}
+
+        return XlaLowering(
             fn=fn, inputs=(vec_name,), outputs=(out,),
             signature={out: f"float32[n,{len(keep)}]"},
         )

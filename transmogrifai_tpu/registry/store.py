@@ -57,6 +57,7 @@ from ..obs import trace as _obs_trace
 from ..serialization.model_io import (
     MANIFEST_JSON,
     SCHEMA_JSON,
+    XLA_CACHE_JSON,
     _fsync_dir,
     _sha256,
     _sha256_file,
@@ -616,14 +617,22 @@ class ModelRegistry:
         """Checksum-verify the index and version artifacts.
 
         Returns ``{"index_ok": bool, "versions": {vid: None|error},
-        "orphans": [...], "ok": bool}``.  ``ok`` requires BOTH the
-        primary index and every checked version to verify: a registry
-        serving from its ``.last-good`` copy is one commit stale (a
-        promote may have silently reverted), so it must fail the check
-        loudly even though it remains operable.  ``version=None`` checks
-        every recorded version; orphaned artifact directories (published
-        but never committed — the publish crash window) are reported,
-        never trusted."""
+        "orphans": [...], "stale_executables": {vid: warning},
+        "ok": bool}``.  ``ok`` requires BOTH the primary index and every
+        checked version to verify: a registry serving from its
+        ``.last-good`` copy is one commit stale (a promote may have
+        silently reverted), so it must fail the check loudly even though
+        it remains operable.  ``version=None`` checks every recorded
+        version; orphaned artifact directories (published but never
+        committed — the publish crash window) are reported, never
+        trusted.
+
+        ``stale_executables`` names versions whose cached AOT XLA
+        executables (``xla_cache.json``, local/fused_xla.py) were built
+        by a DIFFERENT jax/jaxlib build or device backend than this
+        process runs: loading them will retrace and recache instead of
+        warm-starting.  A named WARNING, not damage — the artifact
+        itself is intact, so ``ok`` is unaffected."""
         index_ok = self._verify_doc(self._index_path()) is not None
         doc = self._read()
         targets = [version] if version is not None else sorted(
@@ -633,6 +642,7 @@ class ModelRegistry:
             "recovered_from_last_good": not index_ok,
             "versions": {},
             "orphans": [],
+            "stale_executables": {},
         }
         for vid in targets:
             entry = doc["versions"].get(vid)
@@ -650,6 +660,11 @@ class ModelRegistry:
                         "outside the registry)"
                     )
             report["versions"][vid] = err
+            if err is None:
+                warn = self._stale_executable_warning(path)
+                if warn is not None:
+                    report["stale_executables"][vid] = warn
+                    log.warning("op_registry version %s: %s", vid, warn)
         vdir = os.path.join(self.root, VERSIONS_DIR)
         if version is None and os.path.isdir(vdir):
             known = {e["path"] for e in doc["versions"].values()}
@@ -661,6 +676,41 @@ class ModelRegistry:
         report["ok"] = index_ok and all(
             v is None for v in report["versions"].values())
         return report
+
+    @staticmethod
+    def _stale_executable_warning(path: str) -> Optional[str]:
+        """Named staleness warning for a version's cached AOT XLA
+        executables, or None when absent/current.  Checksum damage is
+        the manifest's job (already verified by the caller); this
+        compares the cache's recorded jax/jaxlib/backend against the
+        running process so the operator learns about a fleet-wide
+        retrace BEFORE replicas silently pay it at load."""
+        meta_path = os.path.join(path, XLA_CACHE_JSON)
+        if not os.path.exists(meta_path):
+            return None
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"xla executable cache meta unreadable: {e}"
+        cached = meta.get("runtime", {})
+        try:
+            from ..local.fused_xla import runtime_fingerprint
+
+            current = runtime_fingerprint()
+        except Exception as e:  # noqa: BLE001 - verify must not die on jax
+            return (f"cannot determine the current runtime to check the "
+                    f"xla executable cache against: {e}")
+        if cached != current:
+            return (
+                "stale xla executables: cached for "
+                f"jax={cached.get('jax')} jaxlib={cached.get('jaxlib')} "
+                f"backend={cached.get('backend')}, this process runs "
+                f"jax={current['jax']} jaxlib={current['jaxlib']} "
+                f"backend={current['backend']}; loading will retrace "
+                "and recache instead of warm-starting"
+            )
+        return None
 
     def load(self, version: str, workflow):
         """Restore one version into a code-defined workflow (the

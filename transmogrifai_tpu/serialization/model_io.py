@@ -44,6 +44,11 @@ MODEL_JSON = "model.json"
 ARRAYS_NPZ = "arrays.npz"
 MANIFEST_JSON = "manifest.json"
 SCHEMA_JSON = "schema.json"
+#: AOT-compiled XLA executables (local/fused_xla.py): meta + payload
+#: blobs, persisted inside the same crash-consistent artifact so a
+#: replica cold-starts by deserializing binaries instead of re-tracing
+XLA_CACHE_JSON = "xla_cache.json"
+XLA_CACHE_NPZ = "xla_cache.npz"
 LAST_GOOD_SUFFIX = ".last-good"
 
 
@@ -274,6 +279,19 @@ def save_model(model, path: str) -> None:
             contract.to_json(), indent=1, default=str
         ).encode("utf-8")
 
+    # AOT-compiled XLA executables (local/fused_xla.py attaches the
+    # cache to the model once an XLA-backed scorer compiles): persisted
+    # as meta json + uint8-array npz, both in the manifest, so replica
+    # warm-up deserializes binaries instead of re-tracing every bucket
+    xla_cache = getattr(model, "xla_executable_cache", None)
+    xla_meta_bytes = None
+    xla_arrays = None
+    if xla_cache is not None and getattr(xla_cache, "entries", None):
+        xla_meta, xla_arrays = xla_cache.to_artifact()
+        xla_meta_bytes = json.dumps(
+            xla_meta, indent=1, sort_keys=True
+        ).encode("utf-8")
+
     parent = os.path.dirname(path) or "."
     os.makedirs(parent, exist_ok=True)
     # reap tempdirs leaked by CRASHED saves: each holds a full artifact
@@ -311,6 +329,20 @@ def save_model(model, path: str) -> None:
         manifest["files"][SCHEMA_JSON] = {
             "sha256": _sha256(schema_bytes), "bytes": len(schema_bytes),
         }
+    if xla_meta_bytes is not None:
+        _write_fsync(os.path.join(tmp, XLA_CACHE_JSON), xla_meta_bytes)
+        manifest["files"][XLA_CACHE_JSON] = {
+            "sha256": _sha256(xla_meta_bytes),
+            "bytes": len(xla_meta_bytes),
+        }
+        xla_npz_tmp = os.path.join(tmp, XLA_CACHE_NPZ)
+        np.savez_compressed(xla_npz_tmp, **xla_arrays)
+        with open(xla_npz_tmp, "rb") as f:
+            os.fsync(f.fileno())
+        xla_sha, xla_size = _sha256_file(xla_npz_tmp)
+        manifest["files"][XLA_CACHE_NPZ] = {
+            "sha256": xla_sha, "bytes": xla_size,
+        }
     _write_fsync(
         os.path.join(tmp, MANIFEST_JSON),
         json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8"),
@@ -344,8 +376,14 @@ def save_model(model, path: str) -> None:
 
 
 _ARTIFACT_FILES = frozenset(
-    (MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON, SCHEMA_JSON)
+    (MODEL_JSON, ARRAYS_NPZ, MANIFEST_JSON, SCHEMA_JSON,
+     XLA_CACHE_JSON, XLA_CACHE_NPZ)
 )
+
+#: artifact files that are OPTIONAL per model: absent from the new save,
+#: a stale copy from the replaced artifact must not survive a
+#: publish-by-copy to masquerade as this model's
+_OPTIONAL_ARTIFACT_FILES = (SCHEMA_JSON, XLA_CACHE_JSON, XLA_CACHE_NPZ)
 
 
 def _carry_extras(old_dir: str, new_dir: str) -> None:
@@ -388,11 +426,13 @@ def _publish_by_copy(tmp: str, path: str, last_good: str,
     os.makedirs(path, exist_ok=True)
     # payload before manifest: until the manifest flips, verification
     # sees old-manifest-vs-new-payload and rejects the half-published dir
-    for name in (MODEL_JSON, ARRAYS_NPZ, SCHEMA_JSON, MANIFEST_JSON):
+    for name in (MODEL_JSON, ARRAYS_NPZ, SCHEMA_JSON, XLA_CACHE_JSON,
+                 XLA_CACHE_NPZ, MANIFEST_JSON):
         src = os.path.join(tmp, name)
-        if name == SCHEMA_JSON and not os.path.exists(src):
-            # contract-less model: a STALE schema.json from the replaced
-            # artifact must not survive to masquerade as this model's
+        if name in _OPTIONAL_ARTIFACT_FILES and not os.path.exists(src):
+            # contract-less / cache-less model: a STALE optional file
+            # from the replaced artifact must not survive to masquerade
+            # as this model's
             stale = os.path.join(path, name)
             if os.path.exists(stale):
                 os.remove(stale)
@@ -607,4 +647,29 @@ def load_model(path: str, workflow):
                 f"model artifact {schema_path} is not a valid schema "
                 f"contract: {e}"
             ) from e
+    # AOT-compiled XLA executable cache (optional; local/fused_xla.py):
+    # re-attached so an XLA-backed endpoint warm-up deserializes the
+    # per-bucket binaries instead of re-tracing.  Best-effort: a cache
+    # that cannot be read never fails the model load - the scorer just
+    # retraces (and recaches) as if the artifact carried none.
+    xla_meta_path = os.path.join(path, XLA_CACHE_JSON)
+    xla_npz_path = os.path.join(path, XLA_CACHE_NPZ)
+    if os.path.exists(xla_meta_path) and os.path.exists(xla_npz_path):
+        # deferred import: model_io loads during workflow import, and
+        # local/ imports workflow back - module scope would be circular
+        from ..local.fused_xla import XlaExecutableCache
+
+        try:
+            with open(xla_meta_path) as f:
+                xla_meta = json.load(f)
+            with np.load(xla_npz_path, allow_pickle=False) as blobs:
+                model.xla_executable_cache = (
+                    XlaExecutableCache.from_artifact(xla_meta, blobs)
+                )
+        except (OSError, ValueError, KeyError, TypeError,
+                zipfile.BadZipFile, zlib.error) as e:
+            log.warning(
+                "model artifact %s has an unreadable xla executable "
+                "cache (%s); serving will re-trace", xla_meta_path, e,
+            )
     return model

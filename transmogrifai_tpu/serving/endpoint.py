@@ -93,6 +93,7 @@ class CompiledEndpoint:
         drift_policy: str = "warn",
         drift_scores: bool = True,
         fused: bool = True,
+        fused_backend: Optional[str] = None,
     ) -> None:
         if not batch_buckets or any(int(b) < 1 for b in batch_buckets):
             raise ValueError("batch_buckets must be positive sizes")
@@ -124,7 +125,8 @@ class CompiledEndpoint:
         if (drift_scores and self.contract is not None
                 and self.contract.distributions):
             self._drift_monitor = DriftMonitor(self.contract)
-        self._scorer = LocalScorer(model, drift_policy=None, fused=fused)
+        self._scorer = LocalScorer(model, drift_policy=None, fused=fused,
+                                   fused_backend=fused_backend)
         # the pad row: scored to fill a bucket, sliced off before return.
         # All-None raw features ride the same missing-value handling every
         # stage already implements; a caller-provided warm_record is used
@@ -164,10 +166,18 @@ class CompiledEndpoint:
     def fused_reason(self) -> Optional[str]:
         return self._scorer.fused_reason
 
+    @property
+    def fused_backend(self) -> Optional[str]:
+        """'xla' | 'numpy' | None: which fused program serves batches
+        (None = interpreted DAG walk)."""
+        return self._scorer.fused_backend
+
     def _push_fused_status(self) -> None:
         """Mirror the scorer's fused status + per-bucket compile times
-        into whatever telemetry accumulator is currently attached (the
-        choice and its cost must ride every serving artifact)."""
+        (and, on the XLA backend, the trace/compile/load/first-exec
+        split + executable-cache events) into whatever telemetry
+        accumulator is currently attached (the choice and its cost must
+        ride every serving artifact)."""
         scorer = getattr(self, "_scorer", None)
         if scorer is None:  # telemetry attached before construction done
             return
@@ -179,6 +189,17 @@ class CompiledEndpoint:
             fp is not None,
             scorer.fused_reason,
             dict(fp.compile_ms) if fp is not None else None,
+            backend=scorer.fused_backend,
+            bucket_timings=(
+                {k: dict(v) for k, v in fp.bucket_stats.items()}
+                if fp is not None and getattr(fp, "bucket_stats", None)
+                else None
+            ),
+            cache_events=(
+                dict(fp.cache_events)
+                if fp is not None and getattr(fp, "cache_events", None)
+                else None
+            ),
         )
 
     # -- warm-up ------------------------------------------------------------

@@ -75,6 +75,15 @@ class ServingTelemetry:
         self.fused_compile_ms: dict = {}
         self.batches_fused = 0
         self.rows_fused = 0
+        # XLA fused backend (local/fused_xla.py): which backend serves,
+        # the per-bucket trace/compile/load/first-exec split, and the
+        # AOT executable-cache outcome counters (warm-start hits vs
+        # retraces vs stale-fingerprint retrace-and-recache events)
+        self.fused_backend: Optional[str] = None
+        self.fused_bucket_timings: dict = {}
+        self.fused_cache_events: dict = {
+            "hits": 0, "misses": 0, "stale": 0,
+        }
         self.shed_deadline = 0
         self.shed_queue_full = 0
         self.request_timeouts = 0
@@ -141,17 +150,39 @@ class ServingTelemetry:
             )
 
     def set_fused_status(self, enabled: bool, reason: Optional[str],
-                         compile_ms_by_bucket: Optional[dict] = None) -> None:
+                         compile_ms_by_bucket: Optional[dict] = None,
+                         backend: Optional[str] = None,
+                         bucket_timings: Optional[dict] = None,
+                         cache_events: Optional[dict] = None) -> None:
         """Record whether this endpoint serves through the fused
-        program, why not (when interpreted), and the per-shape-bucket
-        compile/warm wall times (keyed by batch length, ms)."""
+        program, which backend ('numpy' | 'xla'), why not (when
+        degraded), the per-shape-bucket compile/warm wall times (keyed
+        by batch length, ms) and - on the XLA backend - the per-bucket
+        ``trace_ms / compile_ms / load_ms / first_exec_ms / cache_hit``
+        split plus executable-cache hit/miss/stale counters."""
         with self._lock:
             self.fused_enabled = bool(enabled)
             self.fused_reason = reason
+            if backend is not None:
+                self.fused_backend = backend
             if compile_ms_by_bucket:
                 self.fused_compile_ms.update(
                     {int(k): round(float(v), 3)
                      for k, v in compile_ms_by_bucket.items()}
+                )
+            if bucket_timings:
+                self.fused_bucket_timings.update({
+                    int(k): {
+                        kk: (round(float(vv), 3)
+                             if kk != "cache_hit" else int(vv))
+                        for kk, vv in v.items()
+                    }
+                    for k, v in bucket_timings.items()
+                })
+            if cache_events:
+                # absolute counters from the pipeline, not deltas
+                self.fused_cache_events.update(
+                    {k: int(v) for k, v in cache_events.items()}
                 )
 
     def record_fallback_rows(self, n: int) -> None:
@@ -316,11 +347,18 @@ class ServingTelemetry:
                 "batch_rows_per_s": round(self.rows_batched / batch_wall, 1),
                 "fused": {
                     "enabled": self.fused_enabled,
+                    "backend": self.fused_backend,
                     "reason": self.fused_reason,
                     "compile_ms_by_bucket": {
                         str(k): v
                         for k, v in sorted(self.fused_compile_ms.items())
                     },
+                    "bucket_timings": {
+                        str(k): dict(v)
+                        for k, v in sorted(
+                            self.fused_bucket_timings.items())
+                    },
+                    "cache": dict(self.fused_cache_events),
                     "batches_fused": self.batches_fused,
                     "rows_fused": self.rows_fused,
                 },

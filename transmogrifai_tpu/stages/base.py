@@ -73,6 +73,40 @@ class Lowering:
     signature: dict = field(default_factory=dict)  # key -> "dtype[shape]"
 
 
+@dataclass(frozen=True)
+class XlaLowering:
+    """A fitted stage compiled to a jax-traceable array function.
+
+    The accelerator half of the compile-to-kernel seam (ROADMAP item 3,
+    the arXiv 1810.09868 whole-program-to-XLA move): where
+    :class:`Lowering` closes over numpy, an ``XlaLowering.fn`` must be
+    traceable by ``jax.jit`` - pure jnp ops over a flat dict of numeric
+    arrays, no host python on any value.  The XLA pipeline compiler
+    (local/fused_xla.py) chains every device-lowered stage into ONE
+    jitted program per shape bucket, AOT-compiles it, and serializes
+    the executable into the model artifact.
+
+    The env contract narrows to what can cross the XLA boundary:
+    float64 [n] values + bool [n] ``@mask`` companions for numerics,
+    float32 [n, d] vectors, float64 [n(, k)] prediction arrays.  Text
+    and list features never enter the device program: stages consuming
+    them (one-hot pivots, string indexer) keep their numpy
+    :class:`Lowering` and run as HOST PRE-STEPS whose numeric outputs
+    feed the jitted program as inputs - the compiler rejects (with
+    FusionError -> numpy-fused fallback) any host stage that would
+    need a device-produced key.
+
+    ``fn`` runs under x64 (float64 end to end); it must mirror the
+    numpy lowering's arithmetic closely enough that parity stays
+    within the pinned ULP budgets of tests/test_fused_xla.py.
+    """
+
+    fn: Callable[[dict], dict]
+    inputs: tuple  # env keys read
+    outputs: tuple  # env keys written
+    signature: dict = field(default_factory=dict)  # key -> "dtype[shape]"
+
+
 class PipelineStage:
     """Base of all stages: uid, typed inputs, single typed output feature."""
 
@@ -183,6 +217,15 @@ class Transformer(PipelineStage):
         interpreted stage-by-stage path).  Implementations must produce
         bit-identical arrays to ``transform_columns`` - parity is pinned
         by tests/test_fused_pipeline.py."""
+        return None
+
+    def lower_xla(self) -> Optional[XlaLowering]:
+        """Compile this FITTED stage to a jax-traceable array function,
+        or None when it has no device lowering.  A None is NOT a
+        pipeline-wide failure: when the stage's numpy :meth:`lower`
+        consumes only host-available keys (raw decodes or other host
+        outputs), the XLA compiler runs it as a host pre-step feeding
+        the jitted program - the route every text/one-hot stage takes."""
         return None
 
 

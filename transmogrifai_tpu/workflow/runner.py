@@ -252,7 +252,9 @@ class OpWorkflowRunner:
         serving_breaker_threshold, serving_breaker_cooldown_s,
         serving_guard_nonfinite, serving_drift_policy (raise|warn|shed,
         enforced against the artifact's schema contract), serving_fused
-        (off-switch for the whole-pipeline fused program)."""
+        (off-switch for the whole-pipeline fused program),
+        serving_fused_backend (auto|numpy|xla: 'xla' routes batches
+        through the AOT-compiled XLA program, local/fused_xla.py)."""
         from ..serving import (
             MicroBatchScheduler,
             RowScoringError,
@@ -281,6 +283,7 @@ class OpWorkflowRunner:
             guard_nonfinite=bool(cp.get("serving_guard_nonfinite", True)),
             drift_policy=str(cp.get("serving_drift_policy", "warn")),
             fused=bool(cp.get("serving_fused", True)),
+            fused_backend=cp.get("serving_fused_backend"),
         )
         deadline = cp.get("serving_deadline_ms")
         with MicroBatchScheduler(
@@ -392,6 +395,7 @@ class OpWorkflowRunner:
                 cp.get("canary_check_every_batches", 8)),
             batch_buckets=tuple(cp.get("serving_buckets", (1, 8, 32, 128))),
             drift_policy=str(cp.get("serving_drift_policy", "warn")),
+            fused_backend=cp.get("serving_fused_backend"),
         )
         controller.deploy_version(stable_version, self._fresh_workflow())
         if cp.get("canary_version"):
