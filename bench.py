@@ -2424,6 +2424,320 @@ def _serving_section(result: dict) -> None:
         ).get("p99")
 
 
+def _autotune_section(result: dict) -> None:
+    """Cost-model-driven autotuning proof (ISSUE 13) ->
+    AUTOTUNE_BENCH.json.
+
+    Three arms:
+
+    * selection  - the 2M-row synth LR-grid CV sweep (the ~288s
+      BENCH_r05 ``synth2m_cv_wall_s`` workload) exhaustive vs
+      successive-halving pruned: same winner, AUROC equal to 1e-9,
+      wall-time speedup, candidate-fold fit counts (pruned never
+      exceeds exhaustive), predicted-vs-actual from the decision trail.
+      The cost model trains online from measured probe fits at four
+      scales plus the exhaustive run's tagged ``cv.fit_batch`` span.
+    * serving    - micro-batch knob A/B (max_batch_size/max_wait_us
+      around the hand-set 128/2000us SERVING_BENCH defaults) through a
+      live scheduler ``retune``, plus shape-bucket edges proposed from
+      the OBSERVED batch-size distribution and A/B-validated on the
+      batch surface.  Tuned must match or beat hand-set (the tuner
+      keeps the default on ties by construction - both sides recorded).
+    * pipeline   - ingest worker/buffer knobs proposed from the
+      producer/consumer stall snapshot (tf.data-style) and A/B-probed
+      against the hand-set workers=4/buffer=8 INPUT_PIPELINE_BENCH
+      defaults on an 8-shard CSV parse.
+    """
+    import io
+    import tempfile
+
+    import numpy as np
+
+    from transmogrifai_tpu.autotune import (
+        AutotuneConfig,
+        CostModel,
+        KnobTuner,
+        candidate_features,
+        key_for_fit,
+        microbatch_candidates,
+        propose_bucket_edges,
+        propose_pipeline_knobs,
+    )
+    from transmogrifai_tpu.evaluators.binary import (
+        OpBinaryClassificationEvaluator,
+    )
+    from transmogrifai_tpu.examples.synthetic import (
+        synthetic_design_matrix,
+    )
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression,
+    )
+    from transmogrifai_tpu.obs import trace as obs_trace
+    from transmogrifai_tpu.selector.factories import lr_grid
+    from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+    out: dict = {}
+
+    # -- arm 1: selection (exhaustive vs pruned at 2M rows) -----------------
+    n2 = int(os.environ.get("TX_AUTOTUNE_ROWS", 2_000_000))
+    block = min(250_000, n2)
+    X = y = None
+    t0 = time.perf_counter()
+    for b in range((n2 + block - 1) // block):
+        Xb, yb, _meta = synthetic_design_matrix(block, text_dims=32, seed=b)
+        if X is None:
+            X = np.empty((n2, Xb.shape[1]), np.float32)
+            y = np.empty((n2,), np.asarray(yb).dtype)
+        lo, hi = b * block, min((b + 1) * block, n2)
+        X[lo:hi] = np.asarray(Xb, np.float32)[: hi - lo]
+        y[lo:hi] = np.asarray(yb)[: hi - lo]
+    t_gen = time.perf_counter() - t0
+    d = int(X.shape[1])
+    est = OpLogisticRegression()
+    grid = lr_grid()
+    ev = OpBinaryClassificationEvaluator()
+
+    cv_ex = OpCrossValidation(num_folds=3, evaluator=ev, stratify=True)
+    t0 = time.perf_counter()
+    res_ex = cv_ex.validate([(est, grid)], X, y)
+    t_ex = time.perf_counter() - t0
+
+    # train the cost model online: the exhaustive run's tagged span +
+    # measured single-fit probes at four scales (the observations a
+    # production deployment accumulates across runs)
+    cm = CostModel()
+    cm.ingest_spans(obs_trace.tracer().spans())
+    rng = np.random.RandomState(0)
+    balance = float(np.mean(y))
+    for rows in (25_000, 50_000, 100_000, 200_000):
+        idx = rng.permutation(n2)[:rows]
+        t0 = time.perf_counter()
+        est.fit_arrays(X[idx], y[idx], np.ones(rows))
+        cm.observe(
+            key_for_fit(est.model_type),
+            candidate_features(rows, d, {}, balance, folds=1.0),
+            (time.perf_counter() - t0) * 1e3,
+        )
+
+    # bench ladder config (recorded in the report): a smaller rung and
+    # a 3-of-8 survivor budget - the committed artifact pins that the
+    # winner still survives and parity holds at this aggressiveness;
+    # the library default stays keep_fraction=0.5
+    rung_rows = int(os.environ.get("TX_AUTOTUNE_RUNG_ROWS", 125_000))
+    cfg = AutotuneConfig(
+        cost_model=cm, rung_rows=rung_rows,
+        keep_fraction=float(os.environ.get("TX_AUTOTUNE_KEEP", 0.375)),
+    )
+    cv_pr = OpCrossValidation(num_folds=3, evaluator=ev, stratify=True,
+                              autotune=cfg)
+    t0 = time.perf_counter()
+    res_pr = cv_pr.validate([(est, grid)], X, y)
+    t_pr = time.perf_counter() - t0
+    rep = cv_pr.last_autotune_report
+    out["selection"] = {
+        "rows": n2,
+        "dims": d,
+        "candidates": len(grid),
+        "folds": 3,
+        "gen_wall_s": round(t_gen, 3),
+        "exhaustive_wall_s": round(t_ex, 3),
+        "pruned_wall_s": round(t_pr, 3),
+        "speedup": round(t_ex / max(t_pr, 1e-9), 3),
+        "exhaustive_winner": {
+            "family": res_ex.best_estimator.model_type,
+            "params": res_ex.best_params,
+            "auroc": res_ex.best_metric,
+        },
+        "pruned_winner": {
+            "family": res_pr.best_estimator.model_type,
+            "params": res_pr.best_params,
+            "auroc": res_pr.best_metric,
+        },
+        "winner_match": (
+            res_ex.best_estimator.model_type
+            == res_pr.best_estimator.model_type
+            and res_ex.best_params == res_pr.best_params
+        ),
+        "auroc_abs_diff": abs(res_ex.best_metric - res_pr.best_metric),
+        "fits": rep["fits"] if rep else None,
+        "mode": rep["mode"] if rep else None,
+        "predicted_speedup": rep.get("predicted_speedup") if rep else None,
+        "report": rep,
+    }
+    del X, y
+
+    # -- arm 2: serving knobs (micro-batch + shape buckets) -----------------
+    from transmogrifai_tpu.serving import (
+        MicroBatchScheduler,
+        ServingTelemetry,
+        compile_endpoint,
+        records_from_dataset,
+    )
+
+    n_requests = int(os.environ.get("TX_AUTOTUNE_REQUESTS", 2000))
+    wf, dataset_name = _serving_pipeline(OpLogisticRegression(reg_param=0.01))
+    model = wf.train()
+    base = records_from_dataset(wf.generate_raw_data(), model.raw_features)
+    records = (base * (n_requests // len(base) + 1))[:n_requests]
+    hand_buckets = (1, 8, 32, 128)  # the serve-run default
+    endpoint = compile_endpoint(model, batch_buckets=hand_buckets)
+    tel = ServingTelemetry()
+    endpoint.telemetry = tel
+    tuner = KnobTuner(cost_model=cm, margin=0.03, repeats=2)
+    with MicroBatchScheduler(endpoint, max_wait_us=2000,
+                             telemetry=tel) as scheduler:
+        baseline = scheduler.knobs()
+
+        def measure_sched(knobs: dict) -> float:
+            scheduler.retune(knobs["max_batch_size"],
+                             knobs["max_wait_us"], source="probe")
+            t0 = time.perf_counter()
+            res = list(scheduler.score_stream(records, window=256))
+            assert len(res) == n_requests
+            return n_requests / max(time.perf_counter() - t0, 1e-9)
+
+        decision = tuner.ab_probe(
+            "serving.microbatch", baseline,
+            microbatch_candidates(baseline), measure_sched,
+        )
+        scheduler.retune(
+            decision.winner["max_batch_size"],
+            decision.winner["max_wait_us"],
+            source="autotune" if decision.tuned else "hand_set",
+        )
+        snap = tel.snapshot()
+    base_probe = next(p for p in decision.probes if p["is_baseline"])
+    win_probe = next(p for p in decision.probes if p["is_winner"])
+    # bucket edges proposed from the OBSERVED batch-size spread
+    observed = [s for s in (snap["batch_size_p50"], snap["batch_size_p95"],
+                            snap["batch_size_max"]) if s]
+    proposed_buckets = propose_bucket_edges(observed)
+    t_hand = t_tuned = float("inf")
+    endpoint_t = compile_endpoint(model, batch_buckets=proposed_buckets,
+                                  knob_source="autotune")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        endpoint.score_batch(records)
+        t_hand = min(t_hand, max(time.perf_counter() - t0, 1e-9))
+        t0 = time.perf_counter()
+        endpoint_t.score_batch(records)
+        t_tuned = min(t_tuned, max(time.perf_counter() - t0, 1e-9))
+    bucket_tuned = t_tuned < t_hand * (1.0 - tuner.margin)
+    out["serving"] = {
+        "dataset": dataset_name,
+        "n_requests": n_requests,
+        "microbatch": {
+            "hand_set": decision.baseline,
+            "hand_set_rows_per_s": round(base_probe["value"] or 0.0, 1),
+            "tuned": decision.winner,
+            "tuned_rows_per_s": round(win_probe["value"] or 0.0, 1),
+            "tuner_dethroned_default": decision.tuned,
+            "probes": decision.probes,
+        },
+        "buckets": {
+            "hand_set": list(hand_buckets),
+            "proposed": list(proposed_buckets),
+            "observed_batch_sizes": observed,
+            "hand_set_rows_per_s": round(n_requests / t_hand, 1),
+            "proposed_rows_per_s": round(n_requests / t_tuned, 1),
+            "tuner_dethroned_default": bool(bucket_tuned),
+            "winner": list(proposed_buckets) if bucket_tuned
+            else list(hand_buckets),
+        },
+        "tuned_knobs_telemetry": snap["tuned_knobs"],
+        "knob_source": snap["knob_source"],
+    }
+
+    # -- arm 3: pipeline knobs (workers / buffer depth) ---------------------
+    from transmogrifai_tpu.readers import fast_csv
+    from transmogrifai_tpu.readers import pipeline as txpipe
+    from transmogrifai_tpu.types import feature_types as ft
+
+    if not fast_csv.fast_path_available():
+        out["pipeline"] = {"skipped": "native CSV kernels unavailable"}
+    else:
+        rng = np.random.RandomState(0)
+        dp = 39
+        np_rows = int(os.environ.get("TX_AUTOTUNE_PIPELINE_ROWS", 800_000))
+        nshards = 8
+        block_rows = np_rows // nshards
+        M = rng.randn(block_rows, dp)
+        yv = M @ rng.randn(dp) + 0.1 * rng.randn(block_rows)
+        buf = io.StringIO()
+        np.savetxt(buf, np.column_stack([yv, M]), delimiter=",",
+                   fmt="%.5f")
+        blk = buf.getvalue().encode()
+        del M, yv, buf
+        hdr = ("y," + ",".join(f"x{i}" for i in range(dp)) + "\n").encode()
+        schema = {"y": ft.Real, **{f"x{i}": ft.Real for i in range(dp)}}
+        tmp = tempfile.mkdtemp(prefix="tx_autotune_bench_")
+        paths = [os.path.join(tmp, f"s{i}.csv") for i in range(nshards)]
+        try:
+            for p in paths:
+                with open(p, "wb") as f:
+                    f.write(hdr)
+                    f.write(blk)
+            for p in paths:  # warm the page cache for every arm
+                with open(p, "rb") as f:
+                    f.read()
+
+            last_snap: dict = {}
+
+            def measure_pipe(knobs: dict) -> float:
+                pipe = txpipe.InputPipeline(
+                    txpipe.shard(paths), schema,
+                    workers=int(knobs["workers"]),
+                    buffer_chunks=int(knobs["buffer_chunks"]),
+                )
+                t0 = time.perf_counter()
+                rows = sum(pc.n_rows for pc in pipe.chunks())
+                wall = max(time.perf_counter() - t0, 1e-9)
+                last_snap.clear()
+                last_snap.update(pipe.stats.snapshot())
+                return rows / wall
+
+            hand_knobs = {"workers": 4, "buffer_chunks": 8}
+            measure_pipe(hand_knobs)  # signal probe for the proposer
+            proposal = propose_pipeline_knobs(last_snap, hand_knobs)
+            candidates = [proposal] + [
+                {"workers": w, "buffer_chunks": hand_knobs["buffer_chunks"]}
+                for w in (2, 8) if w != proposal.get("workers")
+            ]
+            pdec = tuner.ab_probe("pipeline.ingest", hand_knobs,
+                                  candidates, measure_pipe)
+            pbase = next(p for p in pdec.probes if p["is_baseline"])
+            pwin = next(p for p in pdec.probes if p["is_winner"])
+            out["pipeline"] = {
+                "rows": np_rows,
+                "shards": nshards,
+                "hand_set": pdec.baseline,
+                "hand_set_rows_per_s": round(pbase["value"] or 0.0, 1),
+                "proposed_from_stalls": proposal,
+                "tuned": pdec.winner,
+                "tuned_rows_per_s": round(pwin["value"] or 0.0, 1),
+                "tuner_dethroned_default": pdec.tuned,
+                "probes": pdec.probes,
+            }
+        finally:
+            for p in paths:
+                if os.path.exists(p):
+                    os.remove(p)
+            os.rmdir(tmp)
+
+    out["cost_model"] = cm.snapshot()
+    path = os.environ.get(
+        "TX_AUTOTUNE_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "AUTOTUNE_BENCH.json"),
+    )
+    with open(path, "w") as f:
+        json.dump(dict(out, bench_commit=result.get("bench_commit",
+                                                    "unknown")),
+                  f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    result["autotune"] = out
+
+
 def main() -> None:
     _ensure_working_backend()
     t_start = time.time()
@@ -2652,6 +2966,25 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _registry_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--autotune" in sys.argv:
+        # cost-model-driven autotuning proof: writes AUTOTUNE_BENCH.json
+        # (pruned vs exhaustive 2M selection at equal winner AUROC,
+        # tuned-vs-hand-set serving and pipeline knobs) and prints it
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _autotune_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--input-pipeline" in sys.argv:

@@ -1,0 +1,619 @@
+"""Cost-model-driven autotuning drills (ISSUE 13): successive-halving
+selection parity + floor, cost-model training/persistence from the obs
+plane, knob proposals and A/B probes, runner/CLI wiring."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.autotune import (
+    AutotuneConfig,
+    CostModel,
+    KnobDecision,
+    KnobTuner,
+    candidate_features,
+    key_for_fit,
+    microbatch_candidates,
+    params_hash,
+    propose_bucket_edges,
+    propose_pipeline_knobs,
+    report_from_path,
+)
+from transmogrifai_tpu.evaluators.binary import (
+    OpBinaryClassificationEvaluator,
+)
+from transmogrifai_tpu.models.logistic_regression import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+from transmogrifai_tpu.obs import trace as obs_trace
+from transmogrifai_tpu.obs.metrics import (
+    metrics_registry,
+    prometheus_text_from_json,
+)
+from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+def _binary_arrays(n=40_000, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    beta = np.linspace(1.5, -1.5, d)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-(X @ beta)))).astype(float)
+    return X, y
+
+
+def _models():
+    lr_grid = [{"reg_param": r, "elastic_net_param": e}
+               for r in (0.001, 0.1) for e in (0.1, 0.5)]
+    rf_grid = [{"min_info_gain": g} for g in (0.001, 0.01, 0.1)]
+    return [
+        (OpLogisticRegression(), lr_grid),
+        (OpRandomForestClassifier(num_trees=4, max_depth=3), rf_grid),
+    ]
+
+
+def _warm_cost_model(cm, families, d=8):
+    """Synthetic multi-scale observations: what a production deployment
+    accumulates across runs (walls scale with rows)."""
+    for fam, base_ms in families:
+        for rows in (4_000, 8_000, 20_000, 40_000):
+            cm.observe(
+                key_for_fit(fam),
+                candidate_features(rows, d, {}, 0.5, folds=1.0),
+                base_ms * rows / 40_000,
+            )
+
+
+def _warmed_config(**kw):
+    cm = CostModel()
+    _warm_cost_model(cm, [("OpLogisticRegression", 60.0),
+                          ("OpRandomForestClassifier", 400.0)])
+    kw.setdefault("rung_rows", 8_000)
+    kw.setdefault("min_rows", 10_000)
+    return AutotuneConfig(cost_model=cm, **kw)
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+def test_cost_model_learns_row_scaling_and_roundtrips(tmp_path):
+    cm = CostModel()
+    key = key_for_fit("OpLogisticRegression")
+    assert cm.predict_wall_ms(key, candidate_features(1000, 8)) is None
+    for rows in (1_000, 4_000, 16_000, 64_000, 256_000):
+        cm.observe(key, candidate_features(rows, 8), 0.01 * rows)
+    lo = cm.predict_wall_ms(key, candidate_features(2_000, 8))
+    hi = cm.predict_wall_ms(key, candidate_features(128_000, 8))
+    assert lo is not None and hi is not None and hi > lo > 0
+    p = str(tmp_path / "autotune.json")
+    cm.save(p)
+    cm2 = CostModel.load(p)
+    assert cm2.load_error is None
+    assert cm2.n_observations(key) == cm.n_observations(key)
+    assert cm2.predict_wall_ms(
+        key, candidate_features(128_000, 8)) == pytest.approx(hi)
+
+
+def test_cost_model_load_tolerates_missing_and_torn(tmp_path):
+    cold = CostModel.load(str(tmp_path / "missing.json"))
+    assert cold.n_observations() == 0 and cold.load_error is None
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"version": 1, "keys": {"fit:')
+    cm = CostModel.load(str(torn))
+    assert cm.n_observations() == 0
+    assert cm.load_error and "Error" in cm.load_error
+    # version mismatch: cold + named, never mis-predicting
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({"version": 999, "keys": {}}))
+    cm3 = CostModel.load(str(stale))
+    assert cm3.load_error == "version_mismatch"
+    assert cm3.n_observations() == 0
+
+
+def test_cost_model_trains_from_tagged_validator_spans():
+    """Satellite 1: the spans OpValidator tags (family, params_hash,
+    fold, n_rows, n_features) are sufficient to train the cost model
+    from any exported ring - and re-ingesting the same ring dedupes."""
+    obs_trace.reset_tracer()
+    X, y = _binary_arrays(n=6_000)
+    cv = OpCrossValidation(
+        num_folds=2, evaluator=OpBinaryClassificationEvaluator(),
+        seed=7, stratify=True)
+    cv.validate(_models(), X, y)
+    records = obs_trace.tracer().spans()
+    fit_spans = [r for r in records if r["name"].startswith("cv.fit")]
+    assert fit_spans, "validator did not tag fit spans"
+    for r in fit_spans:
+        attrs = r["attrs"]
+        assert attrs["family"] in ("OpLogisticRegression",
+                                   "OpRandomForestClassifier")
+        assert attrs["n_rows"] > 0 and attrs["n_features"] == 8
+        if r["name"] != "cv.fit_batch":
+            assert "params_hash" in attrs
+        if r["name"] == "cv.fit":
+            assert "fold" in attrs
+    cm = CostModel(min_obs=2)
+    added = cm.ingest_spans(records)
+    # rung-fit spans are deliberately NOT ingested (the validator
+    # observes those fits directly; re-ingesting would double-count)
+    assert added == len([
+        r for r in records
+        if r["name"] in ("cv.fit", "cv.fit_folds", "cv.fit_batch",
+                         "serve.batch")
+    ])
+    assert cm.ingest_spans(records) == 0  # dedupe on re-ingest
+    assert cm.n_observations(key_for_fit("OpRandomForestClassifier")) > 0
+
+
+def test_params_hash_stable_and_order_free():
+    a = params_hash({"x": 1, "y": 2.0})
+    b = params_hash({"y": 2.0, "x": 1})
+    assert a == b and len(a) == 12
+    assert params_hash({"x": 2, "y": 2.0}) != a
+
+
+# ---------------------------------------------------------------------------
+# successive-halving selection
+# ---------------------------------------------------------------------------
+def test_cold_start_degrades_to_exhaustive_with_reason():
+    """Satellite drill: first run (no observations) must take the
+    exhaustive path, record why, and return the identical result."""
+    X, y = _binary_arrays()
+    ev = OpBinaryClassificationEvaluator()
+    res_ex = OpCrossValidation(
+        num_folds=3, evaluator=ev, seed=7, stratify=True,
+    ).validate(_models(), X, y)
+    cfg = AutotuneConfig(cost_model=CostModel(), rung_rows=8_000,
+                         min_rows=10_000)
+    cv = OpCrossValidation(num_folds=3, evaluator=ev, seed=7,
+                           stratify=True, autotune=cfg)
+    res = cv.validate(_models(), X, y)
+    rep = cv.last_autotune_report
+    assert rep["mode"] == "exhaustive"
+    assert rep["reason"].startswith("cost_model_cold:")
+    assert "OpLogisticRegression" in rep["reason"]
+    assert rep["fits"]["total"] == rep["fits"]["exhaustive"]
+    assert res.best_params == res_ex.best_params
+    assert res.best_metric == res_ex.best_metric
+    assert res.autotune is rep
+
+
+def test_pruned_selection_parity_and_floor():
+    """The selection-parity drill at tier-1 scale (the 2M version is
+    the AUTOTUNE_BENCH acceptance artifact): pruning enabled must
+    return the same winner family/params and AUROC within 1e-9 of the
+    exhaustive sweep, while never evaluating more candidate-fold fits
+    than the exhaustive count (the floor)."""
+    X, y = _binary_arrays()
+    ev = OpBinaryClassificationEvaluator()
+    res_ex = OpCrossValidation(
+        num_folds=3, evaluator=ev, seed=7, stratify=True,
+    ).validate(_models(), X, y)
+    cfg = _warmed_config()
+    cv = OpCrossValidation(num_folds=3, evaluator=ev, seed=7,
+                           stratify=True, autotune=cfg)
+    res = cv.validate(_models(), X, y)
+    rep = cv.last_autotune_report
+    assert rep["mode"] == "pruned"
+    assert rep["candidates_pruned"] > 0
+    # the tier-1 FLOOR: pruned total fits never exceed exhaustive
+    assert rep["fits"]["total"] <= rep["fits"]["exhaustive"]
+    assert rep["fits"]["total"] == (
+        rep["fits"]["rung"] + rep["fits"]["full"])
+    # parity: winner family + params identical, AUROC within 1e-9
+    assert (res.best_estimator.model_type
+            == res_ex.best_estimator.model_type)
+    assert res.best_params == res_ex.best_params
+    assert abs(res.best_metric - res_ex.best_metric) <= 1e-9
+    # the decision trail carries predicted-vs-actual evidence
+    assert rep["predicted_speedup"] is not None
+    assert rep["actual_full_ms_by_family"]
+    for c in rep["rungs"]:
+        assert c["rung_wall_ms"] is not None
+        assert c["predicted_fit_ms"] is not None
+        assert c["params_hash"]
+    # pruned candidates visible (flagged) in all_results, never winners
+    pruned = [r for r in res.all_results if r.get("pruned")]
+    assert len(pruned) == rep["candidates_pruned"]
+    assert all(r["metric_kind"] == "rung" for r in pruned)
+
+
+def test_pruned_selection_visible_in_obs_plane():
+    """Acceptance: pruning decisions scrape via the metrics registry
+    (tx_autotune_*) and the decision event rides the trace."""
+    obs_trace.reset_tracer()
+    X, y = _binary_arrays(n=20_000)
+    cfg = _warmed_config()
+    cv = OpCrossValidation(
+        num_folds=3, evaluator=OpBinaryClassificationEvaluator(),
+        seed=7, stratify=True, autotune=cfg)
+    cv.validate(_models(), X, y)
+    doc = metrics_registry().to_json()
+    assert doc["series"]["autotune.selections"]["value"] >= 1
+    assert doc["series"]["autotune.candidates_pruned"]["value"] > 0
+    text = prometheus_text_from_json(doc)
+    assert "tx_autotune_selections" in text
+    assert "tx_autotune_candidates_pruned" in text
+    names = {s["name"] for s in obs_trace.tracer().spans()}
+    assert "autotune.decision" in names
+    assert "autotune.rung_fit" in names
+
+
+def test_winner_ties_break_identically_with_autotune_on_and_off():
+    """RandomParamBuilder satellite: duplicated grid points produce
+    exact metric ties; the FIRST candidate must win in both modes
+    (survivors keep original grid order, rank ties break by index)."""
+    X, y = _binary_arrays(n=20_000)
+    p = {"reg_param": 0.01, "elastic_net_param": 0.1}
+    grid = [dict(p), dict(p), {"reg_param": 0.2, "elastic_net_param": 0.5}]
+    models = [(OpLogisticRegression(), grid)]
+    ev = OpBinaryClassificationEvaluator()
+    res_off = OpCrossValidation(
+        num_folds=2, evaluator=ev, seed=7, stratify=True,
+    ).validate(models, X, y)
+    cm = CostModel()
+    _warm_cost_model(cm, [("OpLogisticRegression", 60.0)])
+    cfg = AutotuneConfig(cost_model=cm, rung_rows=6_000,
+                         min_rows=10_000, min_keep=2)
+    res_on = OpCrossValidation(
+        num_folds=2, evaluator=ev, seed=7, stratify=True, autotune=cfg,
+    ).validate(models, X, y)
+    assert res_off.best_params == res_on.best_params == p
+
+
+def test_random_param_builder_same_seed_same_order():
+    from transmogrifai_tpu.selector.random_param_builder import (
+        RandomParamBuilder,
+    )
+
+    def build(n):
+        return (
+            RandomParamBuilder(seed=11)
+            .log_uniform("reg_param", 1e-4, 1.0)
+            .choice("elastic_net_param", [0.1, 0.5])
+            .int_uniform("max_depth", 2, 12)
+            .build(n)
+        )
+
+    assert build(6) == build(6)
+    # grid identity is call-history-free: a builder that already drew a
+    # DIFFERENT count still reproduces the same next-call stream
+    b1 = (RandomParamBuilder(seed=11)
+          .log_uniform("reg_param", 1e-4, 1.0))
+    b1.build(9)
+    b2 = (RandomParamBuilder(seed=11)
+          .log_uniform("reg_param", 1e-4, 1.0))
+    b2.build(2)
+    assert b1.build(3) == b2.build(3)
+
+
+def test_tiny_grid_degrades_rather_than_undercut_min_keep():
+    """g=2, k=3: the fits-floor clamp allows only 1 survivor, below
+    min_keep=2 - the plan must degrade to exhaustive, never keep
+    fewer survivors than the contract promises."""
+    X, y = _binary_arrays(n=20_000)
+    cm = CostModel()
+    _warm_cost_model(cm, [("OpLogisticRegression", 60.0)])
+    cfg = AutotuneConfig(cost_model=cm, rung_rows=6_000, min_rows=10_000)
+    grid = [{"reg_param": r, "elastic_net_param": 0.1}
+            for r in (0.001, 0.1)]
+    cv = OpCrossValidation(
+        num_folds=3, evaluator=OpBinaryClassificationEvaluator(),
+        seed=7, stratify=True, autotune=cfg)
+    cv.validate([(OpLogisticRegression(), grid)], X, y)
+    rep = cv.last_autotune_report
+    assert rep["mode"] == "exhaustive"
+    assert rep["reason"] == "no_fit_budget"
+    assert rep["fits"]["total"] == rep["fits"]["exhaustive"]
+
+
+def test_single_fold_validator_never_prunes():
+    """k=1 has no fit budget for a rung (g + s*1 can never undercut
+    g*1): the plan must degrade, keeping the floor invariant."""
+    from transmogrifai_tpu.selector.validator import (
+        OpTrainValidationSplit,
+    )
+
+    X, y = _binary_arrays(n=20_000)
+    cfg = _warmed_config()
+    tv = OpTrainValidationSplit(
+        evaluator=OpBinaryClassificationEvaluator(), seed=7,
+        stratify=True, autotune=cfg)
+    tv.validate(_models(), X, y)
+    rep = tv.last_autotune_report
+    assert rep["mode"] == "exhaustive"
+    assert rep["reason"] == "too_few_folds"
+    assert rep["fits"]["total"] == rep["fits"]["exhaustive"]
+
+
+# ---------------------------------------------------------------------------
+# knob tuning
+# ---------------------------------------------------------------------------
+def test_ab_probe_keeps_baseline_on_tie_and_picks_clear_winner():
+    tuner = KnobTuner(margin=0.05, repeats=1)
+    base = {"max_batch_size": 128, "max_wait_us": 2000}
+    better = {"max_batch_size": 256, "max_wait_us": 1000}
+    worse = {"max_batch_size": 64, "max_wait_us": 4000}
+
+    def measure_tied(knobs):
+        return 1000.0  # identical everywhere: hand-set default holds
+
+    d = tuner.ab_probe("s", base, [better, worse], measure_tied)
+    assert isinstance(d, KnobDecision)
+    assert not d.tuned and d.winner == base
+
+    def measure(knobs):
+        return 2000.0 if knobs == better else 1000.0
+
+    d2 = tuner.ab_probe("s", base, [better, worse], measure)
+    assert d2.tuned and d2.winner == better
+    assert len(d2.probes) == 3
+    assert [p["is_baseline"] for p in d2.probes] == [True, False, False]
+    # a candidate whose probe raises is recorded, never crashes the run
+    def measure_err(knobs):
+        if knobs == worse:
+            raise RuntimeError("bad knobs")
+        return 1000.0
+
+    d3 = tuner.ab_probe("s", base, [better, worse], measure_err)
+    assert d3.probes[2]["error"] and not d3.tuned
+    # an arm that errors on a LATER repeat is disqualified even though
+    # an earlier repeat measured well - flaky configs never win
+    calls = {"n": 0}
+
+    def measure_flaky(knobs):
+        if knobs == better:
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise RuntimeError("intermittent")
+            return 9999.0
+        return 1000.0
+
+    d4 = KnobTuner(margin=0.05, repeats=2).ab_probe(
+        "s", base, [better], measure_flaky)
+    assert not d4.tuned and d4.winner == base
+
+
+def test_ab_probe_records_obs_gauges():
+    tuner = KnobTuner(margin=0.01, repeats=1)
+    base = {"max_wait_us": 2000}
+    d = tuner.ab_probe(
+        "unit.scope", base, [{"max_wait_us": 500}],
+        lambda k: 1.0 / (1 + k["max_wait_us"]))
+    assert d.tuned
+    doc = metrics_registry().to_json()
+    assert doc["series"]["autotune.knob.unit.scope.max_wait_us"][
+        "value"] == 500.0
+    assert doc["series"]["autotune.knob.unit.scope.tuned"]["value"] == 1.0
+
+
+def test_microbatch_candidates_surround_defaults():
+    base = {"max_batch_size": 128, "max_wait_us": 2000}
+    cands = microbatch_candidates(base)
+    assert base not in cands and cands
+    sizes = {c["max_batch_size"] for c in cands}
+    assert sizes <= {64, 128, 256}
+    assert all(c["max_wait_us"] in (1000, 2000, 4000) for c in cands)
+
+
+def test_propose_bucket_edges_covers_observed_spread():
+    edges = propose_bucket_edges([3, 7, 20, 90, 110])
+    assert edges[0] == 1 and edges[-1] >= 110
+    assert list(edges) == sorted(set(edges))
+    assert all(e & (e - 1) == 0 for e in edges)  # powers of two
+    assert propose_bucket_edges([]) == (1, 8, 32, 128)
+    assert len(propose_bucket_edges(range(1, 3000), max_buckets=5)) <= 5
+    # the TOP edge survives overflow trimming (review repro): dropping
+    # it would re-pad exactly the large batches the spread came from
+    wide = propose_bucket_edges([2, 5, 17, 65, 257, 1000], max_buckets=5)
+    assert len(wide) <= 5 and wide[0] == 1 and wide[-1] >= 1000
+    assert propose_bucket_edges(range(1, 3000), max_buckets=5)[-1] >= 2999
+    # observed sizes past the cap clamp to it instead of crashing
+    assert propose_bucket_edges([5000])[-1] == 4096
+
+
+def test_propose_pipeline_knobs_follows_stall_signals():
+    cur = {"workers": 4, "buffer_chunks": 8}
+    # consumer starved -> more parsers + deeper buffer
+    starved = {"producer_busy_s": 10.0, "producer_stall_s": 0.1,
+               "consumer_stall_s": 5.0}
+    prop = propose_pipeline_knobs(starved, cur)
+    assert prop["workers"] == 8 and prop["buffer_chunks"] == 16
+    # producers blocked on a full buffer -> fewer parsers
+    blocked = {"producer_busy_s": 10.0, "producer_stall_s": 6.0,
+               "consumer_stall_s": 0.1}
+    prop2 = propose_pipeline_knobs(blocked, cur)
+    assert prop2["workers"] == 2
+    # balanced -> keep hands off
+    balanced = {"producer_busy_s": 10.0, "producer_stall_s": 0.2,
+                "consumer_stall_s": 0.2}
+    assert propose_pipeline_knobs(balanced, cur) == cur
+
+
+def test_scheduler_retune_applies_live_and_lands_in_telemetry(rng):
+    from transmogrifai_tpu.serving import MicroBatchScheduler
+
+    class _Endpoint:
+        batch_buckets = (1, 8, 32, 128)
+
+        def __init__(self):
+            from transmogrifai_tpu.serving import ServingTelemetry
+
+            self.telemetry = ServingTelemetry()
+
+        def score_batch(self, records):
+            return [dict(r) for r in records]
+
+    ep = _Endpoint()
+    sched = MicroBatchScheduler(ep, max_wait_us=2000, start=False)
+    assert sched.knobs() == {"max_batch_size": 128, "max_wait_us": 2000}
+    applied = sched.retune(max_batch_size=256, max_wait_us=500)
+    assert applied == {"max_batch_size": 256, "max_wait_us": 500}
+    assert sched.max_batch_size == 256
+    snap = ep.telemetry.snapshot()
+    assert snap["tuned_knobs"]["max_batch_size"] == 256.0
+    assert snap["knob_source"] == "autotune"
+    with pytest.raises(ValueError):
+        sched.retune(max_batch_size=0)
+    sched.close()
+
+
+def test_pipeline_stats_snapshot_carries_knobs(tmp_path):
+    from transmogrifai_tpu.readers import pipeline as txpipe
+    from transmogrifai_tpu.types import feature_types as ft
+
+    p = tmp_path / "s.csv"
+    p.write_text("a,b\n" + "\n".join(
+        f"{i},{i * 2}" for i in range(50)) + "\n")
+    pipe = txpipe.InputPipeline(
+        txpipe.shard([str(p)]), {"a": ft.Real, "b": ft.Real},
+        workers=1, buffer_chunks=3,
+    )
+    rows = sum(pc.n_rows for pc in pipe.chunks())
+    assert rows == 50
+    snap = pipe.stats.snapshot()
+    assert snap["knobs"] == {"workers": 1, "buffer_chunks": 3}
+    doc = metrics_registry().to_json()
+    assert doc["series"]["pipeline.workers"]["value"] == 1.0
+    assert doc["series"]["pipeline.buffer_chunks"]["value"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# runner + CLI wiring
+# ---------------------------------------------------------------------------
+def _selector_workflow(rng, n=1200):
+    import transmogrifai_tpu.dsl  # noqa: F401
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector.factories import (
+        BinaryClassificationModelSelector,
+    )
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    a_v = rng.randn(n)
+    b_v = rng.randn(n)
+    data = {
+        "y": ((a_v - b_v + 0.3 * rng.randn(n)) > 0).astype(float).tolist(),
+        "a": a_v.tolist(),
+        "b": b_v.tolist(),
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([a, b])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models_and_parameters=[
+            (OpLogisticRegression(),
+             [{"reg_param": r, "elastic_net_param": 0.1}
+              for r in (0.001, 0.01, 0.1, 0.2)]),
+        ],
+        splitter=None,
+    )
+    pred = selector.set_input(y, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    return wf
+
+
+def test_runner_train_autotune_cold_start_report_and_artifact(
+        tmp_path, rng):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    wf = _selector_workflow(rng)
+    runner = OpWorkflowRunner(wf)
+    loc = str(tmp_path / "model")
+    params = OpParams(model_location=loc,
+                      custom_params={"autotune": True,
+                                     "autotune_rung_rows": 400,
+                                     "autotune_min_rows": 200})
+    r = runner.run("train", params)
+    # the cold-start contract end to end: reason recorded in the run
+    # summary's selection metadata, cost model persisted NEXT TO the
+    # model as a versioned artifact
+    md = next(
+        s["metadata"]["model_selector_summary"]
+        for s in r.summary["stages"]
+        if "model_selector_summary" in s.get("metadata", {})
+    )
+    assert md["autotune"]["mode"] == "exhaustive"
+    assert md["autotune"]["reason"].startswith("cost_model_cold")
+    assert r.summary["autotune"]["cost_model"]["observations"] > 0
+    at_path = os.path.join(loc, "autotune.json")
+    assert os.path.exists(at_path)
+    assert CostModel.load(at_path).n_observations() > 0
+    with open(os.path.join(loc, "summary.json")) as f:
+        saved = json.load(f)
+    assert saved["autotune"]["cost_model"]["path"] == at_path
+    # the CLI report renders the model-dir trail
+    report = report_from_path(loc)
+    assert report["selection"][0]["autotune"]["mode"] == "exhaustive"
+    assert report["cost_model"]["observations"] > 0
+
+
+def test_runner_serve_autotune_probes_and_records_decision(
+        tmp_path, rng):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    wf = _selector_workflow(rng, n=400)
+    runner = OpWorkflowRunner(wf)
+    loc = str(tmp_path / "model")
+    runner.run("train", OpParams(model_location=loc))
+    wf2 = _selector_workflow(rng, n=400)
+    runner2 = OpWorkflowRunner(wf2)
+    r = runner2.run("serve", OpParams(
+        model_location=loc,
+        custom_params={
+            "serving_autotune": True,
+            "autotune_probe_rows": 64,
+            "autotune_probe_repeats": 1,
+        },
+    ))
+    dec = r.metrics["autotune"]
+    assert dec["scope"] == "serving.microbatch"
+    assert dec["baseline"] == {"max_batch_size": 128,
+                               "max_wait_us": 2000}
+    assert dec["winner"]["max_batch_size"] >= 1
+    assert any(p["is_winner"] for p in dec["probes"])
+    # tuned values visible in serving telemetry (obs acceptance)
+    assert "max_batch_size" in r.metrics["tuned_knobs"]
+
+
+def test_cli_autotune_report(tmp_path, rng, capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    wf = _selector_workflow(rng, n=400)
+    loc = str(tmp_path / "model")
+    OpWorkflowRunner(wf).run("train", OpParams(
+        model_location=loc,
+        custom_params={"autotune": True, "autotune_min_rows": 200},
+    ))
+    rc = cli_main(["autotune", "report", "--path", loc])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cost_model"]["observations"] > 0
+    assert doc["selection"]
+    rc2 = cli_main(["autotune", "report", "--path",
+                    str(tmp_path / "nowhere")])
+    assert rc2 == 2
+
+
+def test_profiler_observations_export():
+    from transmogrifai_tpu.obs.profiler import SpanProfiler
+
+    prof = SpanProfiler()
+    for ms in (1.0, 2.0, 3.0):
+        prof.observe("stage.fit", ms)
+    rows = prof.observations()
+    row = next(r for r in rows if r["name"] == "stage.fit")
+    assert row["count"] == 3 and row["ewma_ms"] is not None
+    cm = CostModel(min_obs=1)
+    assert cm.ingest_profiler(prof.snapshot()) >= 1
+    assert cm.n_observations("span:stage.fit") == 1

@@ -504,3 +504,30 @@ def test_fused_module_stays_columnar():
         ):
             offenders.append(f"{fused}:{node.lineno} column_from_list")
     assert not offenders, offenders
+
+
+def test_autotune_reads_telemetry_via_public_apis_only():
+    """autotune/ may read observations only through public obs-plane
+    APIs - registry/profiler/tracer snapshots, span exports, snapshot
+    dicts (ISSUE 13 satellite, the PR-9 torn-safe-loader discipline):
+    no single-underscore attribute of ANY foreign object is touched
+    anywhere in the package (``self._x``/``cls._x`` own-state access is
+    the only exception).  A private reach into a telemetry object would
+    couple the tuner to accumulator internals that every telemetry
+    class is free to change under its own lock discipline."""
+    offenders = []
+    for p in sorted((ROOT / "autotune").rglob("*.py")):
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            attr = node.attr
+            if not attr.startswith("_") or attr.startswith("__"):
+                continue
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")
+            ):
+                continue
+            offenders.append(f"{p}:{node.lineno} .{attr}")
+    assert not offenders, offenders

@@ -771,6 +771,43 @@ def _add_obs_parser(sub) -> None:
 
 
 # ---------------------------------------------------------------------------
+# autotune commands (autotune/: cost model + decision trails, ISSUE 13)
+# ---------------------------------------------------------------------------
+def _autotune_main(args) -> int:
+    from .autotune import report_from_path
+
+    if args.autotune_cmd == "report":
+        try:
+            doc = report_from_path(args.path)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        return 0
+    raise AssertionError(f"unhandled autotune command {args.autotune_cmd}")
+
+
+def _add_autotune_parser(sub) -> None:
+    a = sub.add_parser(
+        "autotune",
+        help="cost-model-driven autotuning (selection pruning trails, "
+             "cost-model state, tuned knobs)",
+    )
+    asub = a.add_subparsers(dest="autotune_cmd", required=True)
+    r = asub.add_parser(
+        "report",
+        help="render the autotune decision trail: pruning rungs, "
+             "predicted-vs-actual times, cost-model state, tuned knobs",
+    )
+    r.add_argument(
+        "--path", required=True,
+        help="a trained model directory (summary.json + autotune.json "
+             "written by a train run with the autotune knob) or an obs "
+             "export dir (metrics_path knob: metrics.json + spans.jsonl)",
+    )
+
+
+# ---------------------------------------------------------------------------
 # registry commands (registry/: versioned store + lifecycle)
 # ---------------------------------------------------------------------------
 def _registry_main(args) -> int:
@@ -840,6 +877,7 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     _add_registry_parser(sub)
     _add_obs_parser(sub)
+    _add_autotune_parser(sub)
     g = sub.add_parser("gen", help="generate a project from data")
     g.add_argument("--input", required=True, help="CSV or .avsc path")
     g.add_argument("--response", required=True)
@@ -864,6 +902,8 @@ def main(argv=None) -> int:
         return _registry_main(args)
     if args.cmd == "obs":
         return _obs_main(args)
+    if args.cmd == "autotune":
+        return _autotune_main(args)
     answers = load_answers(args.answers) if args.answers else None
     path = generate(
         args.input, args.response, args.name, args.output, args.kind,

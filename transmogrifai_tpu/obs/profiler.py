@@ -119,6 +119,17 @@ class SpanProfiler:
         with self._lock:
             return [dict(e) for e in self._exemplars]
 
+    def observations(self) -> list[dict]:
+        """Per-span-name observation rows (ISSUE 13): the profiler's
+        accumulated timings as flat records a cost model can train
+        from (``CostModel.ingest_profiler``) without reaching into any
+        internal state - name, count, EWMA, histogram quantiles, max.
+        One row per name, sorted by name for determinism."""
+        snap = self.snapshot()
+        return [
+            dict(st, name=name) for name, st in snap["spans"].items()
+        ]
+
     def snapshot(self) -> dict:
         with self._lock:
             names = dict(self._stats)

@@ -173,6 +173,10 @@ class PipelineStats:
         self.producer_stall_s = 0.0
         self.consumer_stall_s = 0.0
         self.shards: dict[int, dict] = {}
+        #: the knob settings this pipeline ran under (stamped by
+        #: InputPipeline) so one snapshot carries signal + knobs for
+        #: the autotune proposer (autotune/knobs.py)
+        self.knobs: dict = {}
 
     def _add(self, **kw: float) -> None:
         with self._lock:
@@ -213,6 +217,7 @@ class PipelineStats:
                 "producer_stall_s": round(self.producer_stall_s, 4),
                 "consumer_stall_s": round(self.consumer_stall_s, 4),
                 "overlap_fraction": round(self.overlap_fraction, 4),
+                "knobs": dict(self.knobs),
                 "shards": {k: dict(v) for k, v in self.shards.items()},
             }
 
@@ -632,6 +637,10 @@ class InputPipeline:
         self.use_native = use_native
         self.quarantine_max_rows = quarantine_max_rows
         self.stats = PipelineStats()
+        self.stats.knobs = {
+            "workers": self.workers,
+            "buffer_chunks": self.buffer_chunks,
+        }
         self.shard_quarantines: dict[int, QuarantineBuffer] = {}
         self._shard_rows_seen: dict[int, int] = {}
         self._stop = threading.Event()
@@ -666,6 +675,19 @@ class InputPipeline:
         self._m_chunks = reg.counter(
             "pipeline.chunks", help="chunks delivered to the consumer",
         )
+        # knob visibility (ISSUE 13): the live worker/buffer settings
+        # next to the stall counters they explain, so the autotune
+        # pipeline proposer (autotune/knobs.propose_pipeline_knobs) and
+        # a Prometheus scrape both see knob + signal in one place
+        reg.gauge(
+            "pipeline.workers",
+            help="parser worker threads of the most recent pipeline",
+        ).set(float(self.workers))
+        reg.gauge(
+            "pipeline.buffer_chunks",
+            help="prefetch buffer capacity (chunks) of the most recent "
+                 "pipeline",
+        ).set(float(self.buffer_chunks))
 
     # -- producer side -------------------------------------------------------
     def _put(self, item) -> bool:

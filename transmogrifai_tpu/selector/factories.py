@@ -97,11 +97,13 @@ class BinaryClassificationModelSelector:
         splitter: Optional[Splitter] = None,
         seed: int = 42,
         models_and_parameters=None,
+        autotune=None,
     ) -> ModelSelector:
         ev = validation_metric or OpBinaryClassificationEvaluator()
         return ModelSelector(
             validator=OpCrossValidation(
-                num_folds=num_folds, evaluator=ev, seed=seed, stratify=True
+                num_folds=num_folds, evaluator=ev, seed=seed, stratify=True,
+                autotune=autotune,
             ),
             models=models_and_parameters or _binary_models(model_types_to_use),
             splitter=splitter
@@ -118,11 +120,13 @@ class BinaryClassificationModelSelector:
         splitter: Optional[Splitter] = None,
         seed: int = 42,
         models_and_parameters=None,
+        autotune=None,
     ) -> ModelSelector:
         ev = validation_metric or OpBinaryClassificationEvaluator()
         return ModelSelector(
             validator=OpTrainValidationSplit(
-                train_ratio=train_ratio, evaluator=ev, seed=seed, stratify=True
+                train_ratio=train_ratio, evaluator=ev, seed=seed,
+                stratify=True, autotune=autotune,
             ),
             models=models_and_parameters or _binary_models(model_types_to_use),
             splitter=splitter
@@ -170,11 +174,13 @@ class MultiClassificationModelSelector:
         splitter: Optional[Splitter] = None,
         seed: int = 42,
         models_and_parameters=None,
+        autotune=None,
     ) -> ModelSelector:
         ev = validation_metric or OpMultiClassificationEvaluator()
         return ModelSelector(
             validator=OpCrossValidation(
-                num_folds=num_folds, evaluator=ev, seed=seed, stratify=True
+                num_folds=num_folds, evaluator=ev, seed=seed, stratify=True,
+                autotune=autotune,
             ),
             models=models_and_parameters or _multiclass_models(model_types_to_use),
             splitter=splitter
@@ -214,10 +220,12 @@ class RegressionModelSelector:
         splitter: Optional[Splitter] = None,
         seed: int = 42,
         models_and_parameters=None,
+        autotune=None,
     ) -> ModelSelector:
         ev = validation_metric or OpRegressionEvaluator()
         return ModelSelector(
-            validator=OpCrossValidation(num_folds=num_folds, evaluator=ev, seed=seed),
+            validator=OpCrossValidation(num_folds=num_folds, evaluator=ev,
+                                        seed=seed, autotune=autotune),
             models=models_and_parameters or _regression_models(model_types_to_use),
             splitter=splitter
             if splitter is not None

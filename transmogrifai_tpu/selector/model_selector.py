@@ -288,5 +288,12 @@ class ModelSelector(Estimator):
                 "n_features": int(X.shape[1]),
             }
         }
+        if result.autotune is not None:
+            # the successive-halving decision trail (ISSUE 13): rungs,
+            # prunes, predicted-vs-actual times - rides the stage
+            # metadata into summary_json() and the saved summary.json
+            model.metadata["model_selector_summary"]["autotune"] = (
+                result.autotune
+            )
         self.metadata = model.metadata
         return model

@@ -21,8 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..autotune.cost_model import params_hash as _params_hash
 from ..evaluators.base import OpEvaluatorBase
 from ..models.base import PredictorEstimator
+from ..obs import trace as _obs_trace
 from ..parallel.mesh import cv_mesh_or_none
 from ..types.columns import PredictionColumn
 
@@ -42,6 +44,38 @@ class ValidationResult:
     metric_name: str
     larger_better: bool
     all_results: list = field(default_factory=list)  # per (model, grid) dicts
+    #: successive-halving decision trail (ISSUE 13): rungs, prunes,
+    #: predicted-vs-actual times; None when autotune was off
+    autotune: Optional[dict] = None
+
+
+def _numeric_params(pmap: dict) -> dict:
+    """The numeric hyperparameters of one grid point, flattened for
+    span attrs: exactly the features the cost model trains on."""
+    return {
+        k: float(v) for k, v in pmap.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+
+
+def _rung_train_mask(ys: np.ndarray, train_fraction: float,
+                     seed: int) -> np.ndarray:
+    """Deterministic train mask over the rung subsample: stratified per
+    label class when the label is discrete (<=32 classes), else a plain
+    shuffled split - regression rungs must not np.unique-explode."""
+    n = len(ys)
+    rng = np.random.RandomState(seed + 1)
+    mask = np.zeros(n, dtype=bool)
+    classes = np.unique(ys)
+    if len(classes) <= 32:
+        for c in classes:
+            idx = np.nonzero(ys == c)[0]
+            perm = rng.permutation(idx)
+            mask[perm[: int(np.ceil(len(idx) * train_fraction))]] = True
+    else:
+        perm = rng.permutation(n)
+        mask[perm[: int(np.ceil(n * train_fraction))]] = True
+    return mask
 
 
 def stratified_kfold_masks(
@@ -151,11 +185,18 @@ class OpValidator:
         seed: int = 42,
         stratify: bool = False,
         checkpoint_path: Optional[str] = None,
+        autotune=None,
     ) -> None:
         self.evaluator = evaluator
         self.seed = seed
         self.stratify = stratify
         self.checkpoint_path = checkpoint_path
+        #: successive-halving config (autotune.AutotuneConfig) - None
+        #: runs the exhaustive sweep (ISSUE 13)
+        self.autotune = autotune
+        #: decision trail of the LAST validate() call (also carried on
+        #: ValidationResult.autotune); None when autotune was off
+        self.last_autotune_report: Optional[dict] = None
 
     # -- CV checkpoint ------------------------------------------------------
     def _ckpt_load(self) -> dict:
@@ -246,6 +287,154 @@ class OpValidator:
         )
         return self.evaluator.default_metric(m)
 
+    # -- successive-halving pre-pass (ISSUE 13) -----------------------------
+    def _autotune_prune(self, models, X, y, w, masks, larger):
+        """Budget-ladder rung 0: every candidate fits ONCE on a
+        deterministic row subsample, the cost model plus interim eval
+        scores pick survivors, and only survivors proceed to the full
+        fold x grid spend.  Decision logic lives in autotune/pruning.py
+        (go/no-go BEFORE any rung fit, so a degraded run costs exactly
+        the exhaustive budget); this method owns execution.  Returns
+        (models-to-run, decision report, all_results entries for the
+        pruned candidates)."""
+        import time as _time
+
+        from ..autotune import pruning as _at
+        from ..autotune.cost_model import (
+            candidate_features,
+            key_for_fit,
+            params_hash,
+        )
+
+        cfg = self.autotune
+        k = masks.shape[0]
+        n = len(y)
+        d = int(X.shape[1])
+        models = [(est, list(grid) or [{}]) for est, grid in models]
+        infos = []
+        gi = 0
+        for ei, (est, grid) in enumerate(models):
+            for j, pmap in enumerate(grid):
+                infos.append(_at.CandidateInfo(
+                    index=gi, est_index=ei, grid_index=j,
+                    family=est.model_type, params=dict(pmap),
+                    params_hash=params_hash(pmap),
+                ))
+                gi += 1
+        classes, counts = np.unique(y, return_counts=True)
+        balance = float(counts.min() / counts.sum()) \
+            if len(classes) > 1 else 1.0
+        plan = _at.plan_pruning(cfg, infos, n, d, k,
+                                class_balance=balance)
+        if not plan.pruning:
+            report = plan.report()
+            self._record_autotune(report)
+            return models, report, []
+        # rung subsample + split: seeded by the validator seed, so the
+        # ladder is reproducible run to run
+        rng = np.random.RandomState(self.seed)
+        sub = np.sort(rng.permutation(n)[: plan.rung_rows])
+        Xs, ys, ws = X[sub], y[sub], w[sub]
+        rtr = _rung_train_mask(ys, cfg.rung_train_fraction, self.seed)
+        n_rtr = int(rtr.sum())
+        cm = cfg.cost_model
+        with _obs_trace.span("autotune.rung", rows=int(plan.rung_rows),
+                             candidates=len(infos)):
+            for c in infos:
+                est, _grid = models[c.est_index]
+                cand = est.with_params(**c.params)
+                t0 = _time.perf_counter()
+                try:
+                    with _obs_trace.span(
+                        "autotune.rung_fit", family=c.family,
+                        params_hash=c.params_hash, n_rows=n_rtr,
+                        n_features=d, **_numeric_params(c.params),
+                    ):
+                        params = cand.fit_arrays(
+                            Xs[rtr], ys[rtr], ws[rtr])
+                    pred, raw, prob = cand.predict_arrays(
+                        params, Xs[~rtr])
+                    c.interim_metric = self._metric_of(
+                        ys[~rtr], pred, raw, prob)
+                except Exception as e:  # noqa: BLE001 - a failed rung
+                    # fit ranks the candidate last (recorded in the
+                    # trail) but must never kill the whole selection
+                    c.rung_error = f"{type(e).__name__}: {e}"
+                c.rung_wall_ms = (_time.perf_counter() - t0) * 1e3
+                if c.rung_error is None:
+                    # a failed fit's time-to-exception is NOT a cost
+                    # observation - a ~0ms sample would drag the ridge
+                    # toward "this family fits for free"
+                    cm.observe(
+                        key_for_fit(c.family),
+                        candidate_features(n_rtr, d, c.params, balance),
+                        c.rung_wall_ms,
+                    )
+        _at.select_survivors(plan, larger)
+        pruned_models = []
+        for ei, (est, grid) in enumerate(models):
+            keep = sorted(
+                c.grid_index for c in infos
+                if c.est_index == ei and c.kept
+            )
+            if keep:
+                # survivors keep their ORIGINAL grid order, so the main
+                # loop's evaluation order - and therefore winner
+                # tie-breaks - match the exhaustive sweep's
+                pruned_models.append((est, [grid[j] for j in keep]))
+        pruned_results = [
+            {
+                "model_type": c.family,
+                "model_uid": models[c.est_index][0].uid,
+                "params": dict(c.params),
+                "metric": float("nan") if c.interim_metric is None
+                else float(c.interim_metric),
+                "fold_metrics": [],
+                "pruned": True,
+                "metric_kind": "rung",
+                "rung_rows": int(plan.rung_rows),
+            }
+            for c in infos if not c.kept
+        ]
+        report = plan.report()
+        self._record_autotune(report)
+        return pruned_models, report, pruned_results
+
+    def _record_autotune(self, report: dict) -> None:
+        """Every pruning decision is visible in the obs plane: counters
+        and gauges in the metrics registry (scraped as tx_autotune_*)
+        plus a decision event on the ambient trace."""
+        from ..obs.metrics import metrics_registry
+
+        reg = metrics_registry()
+        reg.counter(
+            "autotune.selections",
+            help="validate() calls that consulted the autotune ladder",
+        ).inc()
+        if report["mode"] == "pruned":
+            reg.counter(
+                "autotune.candidates_pruned",
+                help="grid candidates pruned at the rung",
+            ).inc(report["candidates_pruned"])
+            if report.get("predicted_speedup"):
+                reg.gauge(
+                    "autotune.predicted_speedup",
+                    help="cost-model predicted exhaustive/pruned "
+                         "selection speedup",
+                ).set(float(report["predicted_speedup"]))
+        else:
+            reg.counter(
+                "autotune.exhaustive_runs",
+                help="autotune-enabled selections that degraded to the "
+                     "exhaustive sweep (reason in the report)",
+            ).inc()
+        _obs_trace.tracer().event(
+            "autotune.decision", mode=report["mode"],
+            reason=report.get("reason") or "",
+            pruned=int(report["candidates_pruned"]),
+            survivors=int(report["survivors"]),
+        )
+
     def validate(
         self,
         models: Sequence[tuple[PredictorEstimator, Sequence[dict]]],
@@ -266,9 +455,17 @@ class OpValidator:
             masks = self.train_masks(y)  # [k, n] True=train
         k = masks.shape[0]
         larger = self.evaluator.larger_better
+        at_report = None
+        pruned_results: list = []
+        if self.autotune is not None:
+            models, at_report, pruned_results = self._autotune_prune(
+                models, X, y, w, masks, larger
+            )
+        self.last_autotune_report = at_report
         all_results = []
         best = None  # (metric, estimator, params)
         import json as _json
+        import time as _time
 
         # The 1024-bin device approximation of AuROC/AuPR (~5e-3 error)
         # only pays for itself where it saves host-device transfers of the
@@ -318,6 +515,7 @@ class OpValidator:
         for est, grid in models:
             grid = list(grid) or [{}]
             g = len(grid)
+            t_est0 = _time.perf_counter()
             mode = _est_mode(est, grid)
             metrics = np.zeros((g, k))
             done_mask = [
@@ -399,24 +597,34 @@ class OpValidator:
                         jnp.asarray(ens, jnp.float32),
                         NamedSharding(mesh, P("replica")),
                     )
-                if mesh is not None:
-                    # the fold x grid fit is THE mesh collective of this
-                    # path: run it under the collective watchdog so a hung
-                    # or dead peer degrades (straggler retry, then a
-                    # survivor/single-host recompute) instead of wedging
-                    # the whole selection forever
-                    from ..parallel import resilience as _resilience
+                # ONE span for the whole one-dispatch batch: per-
+                # candidate walls do not exist here, so the cost model
+                # amortizes the batch wall across `candidates`
+                # (satellite: fit spans identify the candidate set)
+                with _obs_trace.span(
+                    "cv.fit_batch", family=est.model_type,
+                    candidates=int(k * g), folds=int(k),
+                    n_rows=int(n), n_features=int(X.shape[1]),
+                ):
+                    if mesh is not None:
+                        # the fold x grid fit is THE mesh collective of
+                        # this path: run it under the collective
+                        # watchdog so a hung or dead peer degrades
+                        # (straggler retry, then a survivor/single-host
+                        # recompute) instead of wedging the whole
+                        # selection forever
+                        from ..parallel import resilience as _resilience
 
-                    betas, b0s = _resilience.guarded_collective(
-                        "validator.fit_arrays_batched",
-                        lambda: est.fit_arrays_batched(
-                            Xj, y_fit, Wj, regs, ens),
-                        shrink_fn=lambda: est.fit_arrays_batched(
-                            *(np.asarray(a) for a in host_fit_args)),
-                    )
-                else:
-                    betas, b0s = est.fit_arrays_batched(
-                        Xj, y_fit, Wj, regs, ens)
+                        betas, b0s = _resilience.guarded_collective(
+                            "validator.fit_arrays_batched",
+                            lambda: est.fit_arrays_batched(
+                                Xj, y_fit, Wj, regs, ens),
+                            shrink_fn=lambda: est.fit_arrays_batched(
+                                *(np.asarray(a) for a in host_fit_args)),
+                        )
+                    else:
+                        betas, b0s = est.fit_arrays_batched(
+                            Xj, y_fit, Wj, regs, ens)
                 if mode == "approx":
                     # rank-based binary metrics computed ON DEVICE against
                     # the already-resident X: no per-fold slices ever leave
@@ -454,16 +662,28 @@ class OpValidator:
                 todo = [j for j in range(g) if not done_mask[j]]
                 grid_fold_params = None
                 if todo and hasattr(est, "fit_arrays_folds_grid"):
-                    grid_fold_params = est.fit_arrays_folds_grid(
-                        Xh, y, W, [grid[j] for j in todo]
-                    )
+                    with _obs_trace.span(
+                        "cv.fit_batch", family=est.model_type,
+                        candidates=int(len(todo) * k), folds=int(k),
+                        n_rows=int(n), n_features=int(X.shape[1]),
+                    ):
+                        grid_fold_params = est.fit_arrays_folds_grid(
+                            Xh, y, W, [grid[j] for j in todo]
+                        )
                 for pos, j in enumerate(todo):
                     pmap = grid[j]
                     cand = est.with_params(**pmap)
                     if grid_fold_params is not None:
                         fold_params = grid_fold_params[pos]
                     else:
-                        fold_params = cand.fit_arrays_folds(Xh, y, W)
+                        with _obs_trace.span(
+                            "cv.fit_folds", family=est.model_type,
+                            params_hash=_params_hash(pmap),
+                            folds=int(k), n_rows=int(n),
+                            n_features=int(X.shape[1]),
+                            **_numeric_params(pmap),
+                        ):
+                            fold_params = cand.fit_arrays_folds(Xh, y, W)
                     for f in range(k):
                         val = ~masks[f]
                         pred, raw, prob = cand.predict_arrays(
@@ -480,7 +700,15 @@ class OpValidator:
                     cand = est.with_params(**pmap)
                     for f in range(k):
                         tr, val = masks[f], ~masks[f]
-                        params = cand.fit_arrays(Xh[tr], y[tr], w[tr])
+                        with _obs_trace.span(
+                            "cv.fit", family=est.model_type,
+                            params_hash=_params_hash(pmap), fold=int(f),
+                            n_rows=int(tr.sum()),
+                            n_features=int(X.shape[1]),
+                            **_numeric_params(pmap),
+                        ):
+                            params = cand.fit_arrays(
+                                Xh[tr], y[tr], w[tr])
                         pred, raw, prob = cand.predict_arrays(params, Xh[val])
                         metrics[j, f] = self._metric_of(y[val], pred, raw, prob)
                     ckpt[_key(est, pmap, mode)] = metrics[j].tolist()
@@ -511,8 +739,21 @@ class OpValidator:
                 cand_metric > best[0] if larger else cand_metric < best[0]
             ):
                 best = (cand_metric, est, dict(grid[j_best]))
+            if at_report is not None:
+                # predicted-vs-actual trail: measured full-spend wall
+                # per family next to the cost model's predictions
+                walls = at_report.setdefault(
+                    "actual_full_ms_by_family", {})
+                walls[est.model_type] = round(
+                    walls.get(est.model_type, 0.0)
+                    + (_time.perf_counter() - t_est0) * 1e3, 3)
 
         assert best is not None, "no models to validate"
+        if pruned_results:
+            # pruned candidates stay visible in the selection metadata
+            # (flagged, rung-scored) but can never win - the best scan
+            # above saw only survivors' full-CV means
+            all_results.extend(pruned_results)
         return ValidationResult(
             best_estimator=best[1].with_params(**best[2]),
             best_params=best[2],
@@ -520,6 +761,7 @@ class OpValidator:
             metric_name=self.evaluator.metric_name,
             larger_better=larger,
             all_results=all_results,
+            autotune=at_report,
         )
 
 
@@ -561,8 +803,10 @@ class OpCrossValidation(OpValidator):
         seed: int = 42,
         stratify: bool = False,
         checkpoint_path: Optional[str] = None,
+        autotune=None,
     ) -> None:
-        super().__init__(evaluator, seed, stratify, checkpoint_path)
+        super().__init__(evaluator, seed, stratify, checkpoint_path,
+                         autotune=autotune)
         self.num_folds = num_folds
 
     def train_masks(self, y: np.ndarray) -> np.ndarray:
@@ -579,8 +823,10 @@ class OpTrainValidationSplit(OpValidator):
         seed: int = 42,
         stratify: bool = False,
         checkpoint_path: Optional[str] = None,
+        autotune=None,
     ) -> None:
-        super().__init__(evaluator, seed, stratify, checkpoint_path)
+        super().__init__(evaluator, seed, stratify, checkpoint_path,
+                         autotune=autotune)
         self.train_ratio = train_ratio
 
     def train_masks(self, y: np.ndarray) -> np.ndarray:

@@ -94,6 +94,7 @@ class CompiledEndpoint:
         drift_scores: bool = True,
         fused: bool = True,
         fused_backend: Optional[str] = None,
+        knob_source: str = "hand_set",
     ) -> None:
         if not batch_buckets or any(int(b) < 1 for b in batch_buckets):
             raise ValueError("batch_buckets must be positive sizes")
@@ -103,6 +104,9 @@ class CompiledEndpoint:
                 f"{drift_policy!r}"
             )
         self.batch_buckets = tuple(sorted({int(b) for b in batch_buckets}))
+        #: who owns the shape buckets: 'hand_set' defaults or the
+        #: autotune bucket proposer (ISSUE 13)
+        self.knob_source = str(knob_source)
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             failure_threshold=breaker_threshold,
             cooldown_s=breaker_cooldown_s,
@@ -139,9 +143,26 @@ class CompiledEndpoint:
         self.shape_misses = 0
         self.warmed_buckets: tuple[int, ...] = ()
         self.warm_error: Optional[str] = None
+        self._push_knob_status()
         if warm:
             self.warm_up()
         self._push_fused_status()
+
+    def _push_knob_status(self) -> None:
+        """Record bucket-knob provenance (ISSUE 13) into whatever
+        telemetry accumulator is currently attached, so tuned-vs-
+        hand-set stays visible across accumulator swaps."""
+        bb = getattr(self, "batch_buckets", None)
+        if not bb:  # telemetry attached before construction finished
+            return
+        self._telemetry.set_tuned_knobs(
+            {
+                "batch_bucket_top": bb[-1],
+                "batch_bucket_count": len(bb),
+                "batch_buckets": ",".join(str(b) for b in bb),
+            },
+            source=getattr(self, "knob_source", "hand_set"),
+        )
 
     @property
     def telemetry(self) -> ServingTelemetry:
@@ -154,6 +175,7 @@ class CompiledEndpoint:
         self._telemetry = value
         self.breaker.telemetry = value
         self._push_fused_status()
+        self._push_knob_status()
 
     # -- fused-path status --------------------------------------------------
     @property

@@ -107,6 +107,12 @@ class ServingTelemetry:
         self.schema_drift_actions: dict = {}
         self._drift_last: dict = {}
         self._drift_max: dict = {}
+        # autotune (ISSUE 13): which serving knobs the tuner owns for
+        # this endpoint/scheduler and the values it chose - scraped as
+        # tx_serving_tuned_knobs_* so tuned-vs-hand-set is visible in
+        # the obs plane, not just in run artifacts
+        self.tuned_knobs: dict = {}
+        self.knob_source: str = "hand_set"
 
     # -- recording ----------------------------------------------------------
     def _sample(self, bucket: list, value) -> None:
@@ -275,6 +281,20 @@ class ServingTelemetry:
             if len(self._lifecycle) > self._MAX_LIFECYCLE:
                 del self._lifecycle[0]
 
+    def set_tuned_knobs(self, knobs: dict,
+                        source: str = "autotune") -> None:
+        """Record the knob values the tuner (or a hand-set override)
+        chose for this serving surface; numeric values surface as
+        scrapeable series, the source ('hand_set' | 'autotune') says
+        who owns them now (docs/serving.md knob table)."""
+        with self._lock:
+            self.tuned_knobs.update({
+                str(k): (float(v) if isinstance(v, (int, float))
+                         and not isinstance(v, bool) else str(v))
+                for k, v in knobs.items()
+            })
+            self.knob_source = str(source)
+
     def record_drift_scores(self, scores: dict) -> None:
         """Latest per-feature JS divergence vs the training
         distributions; running max kept per feature."""
@@ -343,6 +363,8 @@ class ServingTelemetry:
                         max(self._drift_max.values(), default=0.0), 6),
                 },
                 "rows_per_s": round(rows / wall, 1),
+                "tuned_knobs": dict(self.tuned_knobs),
+                "knob_source": self.knob_source,
                 "rows_batched": self.rows_batched,
                 "batch_rows_per_s": round(self.rows_batched / batch_wall, 1),
                 "fused": {
@@ -369,6 +391,13 @@ class ServingTelemetry:
                 "batches": self.batches,
                 "mean_batch_size": round(
                     sum(sizes) / len(sizes), 2) if sizes else 0.0,
+                # observed batch-size spread (ISSUE 13): what the
+                # autotune bucket proposer reads to shape bucket edges
+                "batch_size_p50": _finite(
+                    percentiles(sizes, (50.0,))["p50"], 1),
+                "batch_size_p95": _finite(
+                    percentiles(sizes, (95.0,))["p95"], 1),
+                "batch_size_max": max(sizes) if sizes else 0,
                 "batch_fill_histogram": fill_hist,
                 "queue_depth": {
                     "max": max(depths) if depths else 0,

@@ -185,17 +185,79 @@ class OpWorkflowRunner:
                 errors=str(params.custom_params.get(
                     "ingest_errors", "coerce")),
             ))
+        at_cfg = self._setup_autotune(params)
         model = self.workflow.train()
         summary = model.summary_json()
+        if at_cfg is not None:
+            summary["autotune"] = self._autotune_summary(at_cfg, params)
         if params.model_location:
             model.save(params.model_location)
             with open(
                 os.path.join(params.model_location, "summary.json"), "w"
             ) as f:
                 json.dump(summary, f, indent=1, default=str)
+        if at_cfg is not None and at_cfg.model_path:
+            # the versioned cost-model artifact rides next to the model
+            # - AFTER model.save (the artifact swap must not eat it),
+            # and also when only autotune_model_path was given (online
+            # training must persist wherever the caller pointed it)
+            at_cfg.cost_model.save(at_cfg.model_path)
         return OpWorkflowRunnerResult(
             run_type="train", model=model, summary=summary
         )
+
+    def _setup_autotune(self, params: OpParams):
+        """The ``autotune`` custom param (ISSUE 13): build the cost
+        model (loaded from the versioned artifact next to the model
+        when one exists) and install successive-halving on every
+        ModelSelector validator in the DAG.  Knobs:
+        ``autotune_model_path`` (default <model_location>/autotune.json),
+        ``autotune_rung_rows``, ``autotune_keep_fraction``,
+        ``autotune_min_rows``."""
+        cp = params.custom_params
+        if not cp.get("autotune"):
+            return None
+        from ..autotune import (
+            COST_MODEL_FILENAME,
+            AutotuneConfig,
+            CostModel,
+        )
+        from .dag import compute_dag
+
+        at_path = cp.get("autotune_model_path") or (
+            os.path.join(params.model_location, COST_MODEL_FILENAME)
+            if params.model_location else None
+        )
+        cfg = AutotuneConfig(
+            cost_model=CostModel.load(at_path),
+            rung_rows=int(cp.get("autotune_rung_rows", 250_000)),
+            keep_fraction=float(cp.get("autotune_keep_fraction", 0.5)),
+            min_rows=int(cp.get("autotune_min_rows", 20_000)),
+            model_path=at_path,
+        )
+        for layer in compute_dag(self.workflow.result_features):
+            for stage in layer:
+                if getattr(stage, "is_model_selector", False):
+                    stage.validator.autotune = cfg
+        return cfg
+
+    def _autotune_summary(self, at_cfg, params: OpParams) -> dict:
+        """Post-train autotune bookkeeping: fold this run's tagged fit
+        spans into the cost model (the online-training loop) and report
+        the model's state; the per-selection decision trail already
+        rides each selector's stage metadata in the summary."""
+        from ..obs import trace as _obs_trace
+
+        cm = at_cfg.cost_model
+        ingested = cm.ingest_spans(_obs_trace.tracer().spans())
+        return {
+            "cost_model": dict(
+                cm.snapshot(),
+                ingested_spans=ingested,
+                path=at_cfg.model_path,
+                load_error=cm.load_error,
+            ),
+        }
 
     def _load_model(self, params: OpParams) -> OpWorkflowModel:
         if not params.model_location:
@@ -286,12 +348,16 @@ class OpWorkflowRunner:
             fused_backend=cp.get("serving_fused_backend"),
         )
         deadline = cp.get("serving_deadline_ms")
+        tuner_decision = None
         with MicroBatchScheduler(
             endpoint,
             max_wait_us=int(cp.get("serving_max_wait_us", 2000)),
             max_queue=int(cp.get("serving_max_queue", 1024)),
             default_deadline_ms=None if deadline is None else float(deadline),
         ) as scheduler:
+            if cp.get("serving_autotune"):
+                tuner_decision = self._autotune_scheduler(
+                    scheduler, records, cp)
             results = list(scheduler.score_stream(
                 records, window=int(cp.get("serving_window", 256))
             ))
@@ -300,6 +366,8 @@ class OpWorkflowRunner:
             "rows_submitted": n,
             "model_location": params.model_location,
         }
+        if tuner_decision is not None:
+            extra["autotune"] = tuner_decision
         if params.metrics_location:
             os.makedirs(params.metrics_location, exist_ok=True)
             metrics = endpoint.telemetry.export(
@@ -321,6 +389,46 @@ class OpWorkflowRunner:
         return OpWorkflowRunnerResult(
             run_type="serve", model=model, metrics=metrics
         )
+
+    @staticmethod
+    def _autotune_scheduler(scheduler, records: list, cp: dict):
+        """The ``serving_autotune`` knob (ISSUE 13): short measured A/B
+        probes of micro-batch knob candidates against the hand-set
+        defaults on a record prefix, applying the winner to the LIVE
+        scheduler via ``retune``.  Probe rows score through the real
+        endpoint (their latencies land in telemetry like any other
+        request); the decision trail returns into run metrics and the
+        tuned values into ``ServingTelemetry.tuned_knobs``."""
+        from ..autotune import KnobTuner, microbatch_candidates
+
+        baseline = scheduler.knobs()
+        probe_n = max(1, min(len(records),
+                             int(cp.get("autotune_probe_rows", 512))))
+        probe_records = records[:probe_n]
+        window = int(cp.get("serving_window", 256))
+        tuner = KnobTuner(
+            margin=float(cp.get("autotune_margin", 0.03)),
+            repeats=int(cp.get("autotune_probe_repeats", 2)),
+        )
+
+        def measure(knobs: dict) -> float:
+            scheduler.retune(knobs["max_batch_size"],
+                             knobs["max_wait_us"], source="probe")
+            t0 = time.perf_counter()
+            res = list(scheduler.score_stream(probe_records,
+                                              window=window))
+            return len(res) / max(time.perf_counter() - t0, 1e-9)
+
+        decision = tuner.ab_probe(
+            "serving.microbatch", baseline,
+            microbatch_candidates(baseline), measure,
+        )
+        scheduler.retune(
+            decision.winner["max_batch_size"],
+            decision.winner["max_wait_us"],
+            source="autotune" if decision.tuned else "hand_set",
+        )
+        return decision.to_json()
 
     def _deploy(self, params: OpParams) -> OpWorkflowRunnerResult:
         """Registry-driven deployment run.  Knobs ride
