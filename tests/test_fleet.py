@@ -1,0 +1,594 @@
+"""Scale-out serving fleet drills (ISSUE 14).
+
+The three acceptance drills - zero-drop rolling deploy across >= 3
+replicas, one replica SIGKILLed mid-run with exact row conservation on
+survivors, and router backpressure with every replica full (shed,
+never hang) - plus the satellites: per-tenant quotas on the admission
+controller, the fleet-aggregated SLO/rollback loop, the one-scrape
+fleet Prometheus exposition, the router-overhead CPU floor, the
+``tx fleet`` CLI, and the autotune report over an aggregation dir.
+
+All drills are seeded: the drill pipeline's data seed, the fault specs
+(``on=``/``every=`` triggers), and the deterministic canary hash split
+pin every run to the same schedule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.fleet import (
+    FleetController,
+    FleetRouter,
+    encode_records,
+    merge_serving_snapshots,
+)
+from transmogrifai_tpu.registry import ModelRegistry
+from transmogrifai_tpu.serving import TenantQuotaError
+from transmogrifai_tpu.serving.admission import AdmissionController
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+WORKFLOW_SPEC = "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline"
+
+
+# ---------------------------------------------------------------------------
+# shared registry: one tiny trained model published as three versions
+# (v1 stable; v2, v3 candidates for the rolling-deploy / canary drills)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fleet_registry(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fleet-registry"))
+    wf, _data, records, pred_name = tiny_drill_pipeline()
+    model = wf.train()
+    reg = ModelRegistry(root)
+    v1 = reg.publish(model, stage="stable")
+    v2 = reg.publish(model)
+    v3 = reg.publish(model)
+    return {
+        "root": root, "records": records, "pred_name": pred_name,
+        "v1": v1.version, "v2": v2.version, "v3": v3.version,
+    }
+
+
+def _controller(fleet_registry, tmp_path, n_replicas, **kw):
+    kw.setdefault("router_kw", {})
+    kw["router_kw"].setdefault("max_in_flight_per_replica", 2)
+    kw["router_kw"].setdefault("max_queue", 64)
+    return FleetController(
+        fleet_registry["root"], WORKFLOW_SPEC,
+        n_replicas=n_replicas, work_dir=str(tmp_path / "fleet"),
+        ship_interval_s=0.15, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-tenant quotas on the admission controller
+# ---------------------------------------------------------------------------
+def test_tenant_quota_bounds_one_tenant_not_the_rest():
+    ac = AdmissionController(max_queue=10, tenant_quota=0.3)
+    assert ac.tenant_limit == 3
+    for _ in range(3):
+        ac.admit({"r": 1}, tenant="hog")
+    with pytest.raises(TenantQuotaError) as ei:
+        ac.admit({"r": 1}, tenant="hog")
+    assert ei.value.tenant == "hog" and ei.value.limit == 3
+    # other tenants (and the anonymous pool) still admit
+    ac.admit({"r": 1}, tenant="polite")
+    ac.admit({"r": 1})
+    assert ac.tenants_held() == {"hog": 3, "polite": 1, None: 1}
+    # dequeue releases the hog's slots: it can admit again
+    live, shed = ac.take(10)
+    assert len(live) == 5 and not shed
+    assert ac.tenants_held() == {}
+    ac.admit({"r": 1}, tenant="hog")
+
+
+def test_tenant_quota_is_off_by_default():
+    ac = AdmissionController(max_queue=4)
+    for _ in range(4):
+        ac.admit({"r": 1}, tenant="only")
+    assert ac.tenant_limit is None
+
+
+def test_scheduler_counts_shed_quota_and_scrapes_it():
+    from transmogrifai_tpu.obs import prometheus_text_from_json
+    from transmogrifai_tpu.serving import (
+        MicroBatchScheduler,
+        ServingTelemetry,
+        compile_endpoint,
+    )
+
+    wf, _data, records, _pred = tiny_drill_pipeline(n=40)
+    model = wf.train()
+    telemetry = ServingTelemetry()
+    endpoint = compile_endpoint(model, telemetry=telemetry,
+                                batch_buckets=(1, 8, 32))
+    with MicroBatchScheduler(endpoint, start=False, max_queue=10,
+                             tenant_quota=0.2,
+                             telemetry=telemetry) as sched:
+        for _ in range(2):
+            sched.submit(records[0], tenant="hog")
+        with pytest.raises(TenantQuotaError):
+            sched.submit(records[0], tenant="hog")
+        sched.submit(records[0], tenant="other")  # unaffected
+        sched.run_once()
+    snap = telemetry.snapshot()
+    assert snap["shed_quota"] == 1
+    assert snap["rows_scored"] == 3
+    from transmogrifai_tpu.obs import metrics_registry
+
+    text = prometheus_text_from_json(metrics_registry().to_json())
+    assert "tx_serving_shed_quota" in text
+
+
+# ---------------------------------------------------------------------------
+# satellite: merged fleet rollback snapshots
+# ---------------------------------------------------------------------------
+def test_merge_serving_snapshots_sums_counters_maxes_tails():
+    a = {"rows_scored": 10, "rows_failed": 1,
+         "breaker": {"opens": 1, "rows_nonfinite": 2},
+         "latency_ms": {"p99": 5.0},
+         "data_contract": {"drift_js_max": 0.1},
+         "model_version": "v1", "generation": 1}
+    b = {"rows_scored": 20, "rows_failed": 2,
+         "breaker": {"opens": 0, "rows_nonfinite": 1},
+         "latency_ms": {"p99": 9.0},
+         "data_contract": {"drift_js_max": 0.05}}
+    merged = merge_serving_snapshots([a, b])
+    assert merged["rows_scored"] == 30
+    assert merged["rows_failed"] == 3
+    assert merged["breaker"]["opens"] == 1
+    assert merged["breaker"]["rows_nonfinite"] == 3
+    assert merged["latency_ms"]["p99"] == 9.0
+    assert merged["data_contract"]["drift_js_max"] == 0.1
+    assert merged["replicas"] == 2
+    assert merged["model_version"] == "v1"
+
+
+# ---------------------------------------------------------------------------
+# channel: bounded waits, closed-peer detection
+# ---------------------------------------------------------------------------
+def test_channel_roundtrip_idle_and_peer_death():
+    import socket as socket_mod
+
+    from transmogrifai_tpu.fleet.channel import (
+        OP_SCORE,
+        ChannelClosedError,
+        FleetChannel,
+    )
+
+    a, b = socket_mod.socketpair(socket_mod.AF_UNIX,
+                                 socket_mod.SOCK_STREAM)
+    ca, cb = FleetChannel(a), FleetChannel(b)
+    payload = encode_records([{"x": 1.0}] * 8)
+    ca.send(OP_SCORE, 7, {"tenant": None, "n_rows": 8}, payload)
+    op, rid, meta, got = cb.recv()
+    assert (op, rid, meta["n_rows"], got) == (OP_SCORE, 7, 8, payload)
+    # idle recv hands back within ~one quantum, never blocks
+    t0 = time.perf_counter()
+    assert cb.recv(idle_return=True) is None
+    assert time.perf_counter() - t0 < 1.0
+    # peer death surfaces as ChannelClosedError, not a hang
+    ca.close()
+    with pytest.raises(ChannelClosedError):
+        cb.recv()
+
+
+def test_router_with_no_replicas_fails_loudly_not_hanging():
+    router = FleetRouter(max_queue=4)
+    try:
+        from transmogrifai_tpu.fleet import FleetError
+
+        req = router.submit(records=[{"x": 1}])
+        with pytest.raises(FleetError):
+            req.wait(5.0)
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# drill 1: zero-drop rolling deploy across 3 replicas
+# ---------------------------------------------------------------------------
+def test_rolling_deploy_zero_drop_three_replicas(fleet_registry,
+                                                 tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:40]
+    with _controller(fleet_registry, tmp_path, 3) as fc:
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        results: list = []
+        errors: list = []
+        stop = threading.Event()
+
+        def pump() -> None:
+            while not stop.is_set():
+                try:
+                    req = fc.router.submit(records=batch)
+                    res = req.wait(60.0)
+                    results.append(res)
+                except Exception as e:  # noqa: BLE001 - the drill counts
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=pump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        report = fc.rolling_deploy(fleet_registry["v2"])
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60.0)
+
+        # the roll covered every replica, one at a time
+        assert [s["instance"] for s in report] == [
+            "replica-0", "replica-1", "replica-2"]
+        # zero dropped: every submitted request came back scored
+        assert errors == []
+        assert all(res.n_rows == len(batch) for res in results)
+        # zero mixed-generation responses: each response names exactly
+        # one (version, generation) pair, and both generations served
+        versions = {res.version for res in results}
+        assert all(res.version is not None
+                   and res.generation is not None for res in results)
+        assert versions <= {fleet_registry["v1"], fleet_registry["v2"]}
+        assert fleet_registry["v2"] in versions
+        # after the roll every replica serves v2
+        for h in fc.router.live_replicas():
+            doc = fc.router.control(h.instance, "status")
+            assert doc["version"] == fleet_registry["v2"]
+        # registry agrees: v2 is the stable pointer
+        assert fc.registry.stable == fleet_registry["v2"]
+        # exact conservation, double-entry: the router's delivered-rows
+        # ledger equals the client-side sum, split by generation
+        snap = fc.router.snapshot()
+        assert snap["rows_ok"] == sum(r.n_rows for r in results) \
+            + len(batch)  # + the warm batch
+        assert sum(snap["rows_by_generation"].values()) \
+            == snap["rows_ok"]
+
+        # acceptance: ONE Prometheus scrape of the aggregation dir
+        # covers the whole fleet - every replica under its own instance
+        # label plus the fleet rollup
+        time.sleep(0.4)  # one shipper beat
+        text = fc.aggregator.prometheus_text()
+        for i in range(3):
+            assert f'instance="replica-{i}"' in text
+        assert 'instance="fleet",agg="sum"' in text
+        assert "tx_serving_rows_scored" in text
+
+        # `tx fleet status` renders the controller's one consistent doc
+        from transmogrifai_tpu.cli import main as cli_main
+
+        rc = cli_main(["fleet", "status", "--path", fc.control_dir])
+        assert rc == 0
+        status_doc = json.load(open(
+            os.path.join(fc.control_dir, "fleet_status.json")))
+        assert set(status_doc["replicas"]) == {
+            "replica-0", "replica-1", "replica-2"}
+        for rep in status_doc["replicas"].values():
+            assert rep["running"] is True
+
+        # satellite: a deployment controller pointed at the published
+        # status document carries the SAME one fleet view in its
+        # summary (not N shard re-reads)
+        from transmogrifai_tpu.registry import DeploymentController
+
+        ctl = DeploymentController()
+        ctl.fleet_status_source = os.path.join(fc.control_dir,
+                                               "fleet_status.json")
+        summary = ctl.summary_json()
+        assert set(summary["fleet"]["replicas"]) == {
+            "replica-0", "replica-1", "replica-2"}
+        for rep in summary["fleet"]["replicas"].values():
+            assert "generation" in rep and "heartbeat_age_s" in rep \
+                and "in_flight" in rep
+
+
+# ---------------------------------------------------------------------------
+# drill 2: one replica SIGKILLed mid-run, exact conservation on survivors
+# ---------------------------------------------------------------------------
+def test_replica_sigkill_conserves_every_accepted_request(
+        fleet_registry, tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:30]
+    # slow batches keep every replica busy so the victim dies with
+    # requests genuinely in flight; no restarts - survivors carry the
+    # load (the controller restart path is drilled separately)
+    with _controller(
+        fleet_registry, tmp_path, 3, max_restarts=0,
+        worker_env={"TX_FAULTS": "serving.slow_batch:every=1:delay=0.05"},
+    ) as fc:
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        delivered: list = []
+        errors: list = []
+        submitted = 60
+
+        def pump(k: int) -> None:
+            for _ in range(k):
+                try:
+                    res = fc.router.submit(records=batch).wait(120.0)
+                    delivered.append(res.n_rows)
+                except Exception as e:  # noqa: BLE001 - the drill counts
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=pump, args=(submitted // 4,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # the fleet is saturated (2 in flight each)
+        victim = fc._replicas["replica-1"]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=180.0)
+
+        # EXACT conservation: every accepted request was answered on a
+        # survivor - nothing lost, nothing double-delivered
+        assert errors == []
+        assert len(delivered) == submitted
+        assert sum(delivered) == submitted * len(batch)
+        snap = fc.router.snapshot()
+        assert snap["replica_deaths"] == 1
+        assert snap["retries"] >= 1  # the victim died holding work
+        assert snap["rows_ok"] == submitted * len(batch) + len(batch)
+        # the survivors are intact and still serving
+        live = {h.instance for h in fc.router.live_replicas()}
+        assert live == {"replica-0", "replica-2"}
+        post = fc.router.score_batch(batch, timeout_s=60.0)
+        assert len(post) == len(batch)
+
+
+# ---------------------------------------------------------------------------
+# drill 3: router backpressure - every replica full -> shed, never hang
+# ---------------------------------------------------------------------------
+def test_router_backpressure_sheds_never_hangs(fleet_registry,
+                                               tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:20]
+    with _controller(
+        fleet_registry, tmp_path, 1,
+        router_kw={"max_in_flight_per_replica": 1, "max_queue": 3},
+        worker_env={"TX_FAULTS": "serving.slow_batch:every=1:delay=0.3"},
+    ) as fc:
+        from transmogrifai_tpu.serving import QueueFullError
+
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        pending = []
+        sheds = 0
+        t0 = time.perf_counter()
+        # the single replica sustains ~3 batches/s; flood it: 1 in
+        # flight + 3 queued saturate, everything beyond MUST shed fast
+        for _ in range(12):
+            try:
+                pending.append(fc.router.submit(records=batch))
+            except QueueFullError:
+                sheds += 1
+        submit_wall = time.perf_counter() - t0
+        assert sheds >= 6, "a full fleet must shed at the front door"
+        assert submit_wall < 2.0, "shedding must be fast, not a hang"
+        assert fc.router.snapshot()["shed_queue_full"] == sheds
+        # everything actually admitted completes; nothing hangs
+        for req in pending:
+            res = req.wait(60.0)
+            assert res.n_rows == len(batch)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide canary: aggregated signals + firing SLO roll back everywhere
+# ---------------------------------------------------------------------------
+def test_fleet_slo_and_signals_roll_canary_back_everywhere(
+        fleet_registry, tmp_path):
+    from transmogrifai_tpu.obs.slo import SLObjective
+
+    records = fleet_registry["records"]
+    batch = records[:40]
+    # the fleet-level SLO: any NaN-guard refusal across the fleet blows
+    # the objective (threshold over the merged docs' MAX)
+    slo = SLObjective(
+        name="fleet-nonfinite", kind="threshold",
+        metric="serving.breaker.rows_nonfinite", objective=0.5,
+        windows_s=(30.0, 5.0),
+    )
+    with _controller(
+        fleet_registry, tmp_path, 2, slo_objectives=[slo],
+        worker_env={"TX_FAULTS": "canary.regression:every=1"},
+    ) as fc:
+        out = fc.start_canary(fleet_registry["v3"], fraction=0.5)
+        assert all(doc.get("ok") for doc in out.values())
+        assert fc.registry.canary == fleet_registry["v3"]
+        # pump traffic: the deterministic hash split sends ~half the
+        # rows to the canary on EVERY replica, where the armed
+        # canary.regression fault poisons live outputs through the real
+        # NaN-guard accounting
+        for _ in range(6):
+            fc.router.score_batch(batch, timeout_s=60.0)
+        time.sleep(0.5)  # shards ship the poisoned canary telemetry
+        decision = fc.check_canary()
+        assert decision is not None and decision.rollback
+        signals = {r["signal"] for r in decision.reasons}
+        assert "nonfinite_rows" in signals
+        assert any(s.startswith("slo:fleet-nonfinite")
+                   for s in signals), signals
+        # the rollback reached EVERY replica and the registry
+        assert fc.canary_version is None
+        for h in fc.router.live_replicas():
+            doc = fc.router.control(h.instance, "status")
+            assert doc["canary_version"] is None
+        assert fc.registry.get(
+            fleet_registry["v3"]).stage == "rolled_back"
+        # serving continues on stable after the rollback
+        post = fc.router.score_batch(batch, timeout_s=60.0)
+        assert len(post) == len(batch)
+
+
+# ---------------------------------------------------------------------------
+# CPU floor: router overhead <= 10% of direct endpoint scoring
+# ---------------------------------------------------------------------------
+def test_router_cpu_overhead_within_floor_of_direct(tmp_path):
+    """The dispatch layer must never become the fleet's bottleneck:
+    the router process's OWN CPU per routed row (framing via one
+    sendmsg gather call, least-loaded pick, single-buffer recv_into,
+    response ledger - the wire payload passes through encoded, decoded
+    lazily by the caller) stays <= 10% of what scoring a row directly
+    on an in-process endpoint costs.  Measured at the REAL fleet
+    workload (the full mixed-type serving pipeline the fleet bench
+    drives) and an AMORTIZING wire batch (8192 rows): the router's
+    per-request fixed cost - thread wakeups, syscalls, whose kernel
+    accounting swings hundreds of us per message on this host - is
+    designed to amortize, and the per-ROW cost is the floor's
+    question.  Best-of-3 on CPU time so wall noise cannot flake it -
+    process_time excludes the blocked waits, which is exactly the
+    router-overhead question."""
+    from collections import deque
+
+    from transmogrifai_tpu.serving import compile_endpoint
+    from transmogrifai_tpu.testkit.drills import serving_fleet_workflow
+
+    wf, records = serving_fleet_workflow()
+    model = wf.train()
+    root = str(tmp_path / "registry")
+    ModelRegistry(root).publish(model, stage="stable")
+    buckets = (1, 8, 32, 128, 512, 2048, 8192)
+    n_rows = 8192
+    batch = (records * (n_rows // len(records) + 1))[:n_rows]
+    endpoint = compile_endpoint(model, batch_buckets=buckets)
+    endpoint.score_batch(batch)  # warm
+    n_iters = 8
+    direct_cpu_per_row = float("inf")
+    for _ in range(3):
+        t0 = time.process_time()
+        for _ in range(n_iters):
+            endpoint.score_batch(batch)
+        direct_cpu_per_row = min(
+            direct_cpu_per_row,
+            (time.process_time() - t0) / (n_iters * n_rows))
+    with FleetController(
+        root, "transmogrifai_tpu.testkit.drills:serving_fleet_workflow",
+        n_replicas=1, work_dir=str(tmp_path / "fleet"),
+        monitor_interval_s=5.0,
+        router_kw={"max_in_flight_per_replica": 3, "max_queue": 64},
+        worker_args=["--buckets", ",".join(str(b) for b in buckets)],
+    ) as fc:
+        payload = encode_records(batch)
+        fc.router.submit(payload=payload, n_rows=n_rows).wait(60.0)
+        router_cpu_per_row = float("inf")
+        # the routed window runs MORE iterations than the direct one:
+        # the router's per-row CPU is ~30x smaller, and the window must
+        # still span many scheduler jiffies for process_time to resolve
+        # the ratio honestly
+        n_routed = 4 * n_iters
+        for _ in range(3):
+            rows = 0
+            pend: deque = deque()
+            t0 = time.process_time()
+            for _ in range(n_routed):
+                pend.append(fc.router.submit(payload=payload,
+                                             n_rows=n_rows))
+                if len(pend) >= 3:
+                    rows += pend.popleft().wait(60.0).n_rows
+            while pend:
+                res = pend.popleft()
+                rows += res.wait(60.0).n_rows
+            router_cpu_per_row = min(
+                router_cpu_per_row, (time.process_time() - t0) / rows)
+            assert rows == n_routed * n_rows
+        # decode outside the measured window proves the payload is real
+        assert len(res.wait(1.0).results) == n_rows
+    assert router_cpu_per_row <= 0.10 * direct_cpu_per_row, (
+        f"router overhead {router_cpu_per_row * 1e6:.2f}us/row vs "
+        f"direct {direct_cpu_per_row * 1e6:.2f}us/row"
+    )
+
+
+# ---------------------------------------------------------------------------
+# operator surfaces over saved artifacts (no live fleet needed)
+# ---------------------------------------------------------------------------
+def test_fleet_status_cli_over_agg_dir_and_drain_command(tmp_path,
+                                                         capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    from transmogrifai_tpu.obs import metrics_registry, ship_now
+
+    agg = tmp_path / "obs"
+    metrics_registry().counter("drill.fleet_cli").inc()
+    ship_now(str(agg), instance="replica-9",
+             extra={"fleet": {"generation": 3, "version": "v7",
+                              "rows_scored": 123}})
+    rc = cli_main(["fleet", "status", "--path", str(agg)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["replicas"]["replica-9"]["fleet"]["version"] == "v7"
+    assert doc["replicas"]["replica-9"]["heartbeat_age_s"] is not None
+    # drain queues an atomic command file the controller consumes
+    control = tmp_path / "control"
+    rc = cli_main(["fleet", "drain", "--path", str(control),
+                   "--replica", "replica-9"])
+    assert rc == 0
+    cmd = json.load(open(control / "commands" / "replica-9.json"))
+    assert cmd == {"replica": "replica-9", "drain": True,
+                   "t": pytest.approx(cmd["t"])}
+    rc = cli_main(["fleet", "drain", "--path", str(control),
+                   "--replica", "replica-9", "--undrain"])
+    assert rc == 0
+    cmd = json.load(open(control / "commands" / "replica-9.json"))
+    assert cmd["drain"] is False
+    # status on garbage fails loudly with exit 2
+    rc = cli_main(["fleet", "status", "--path", str(tmp_path / "nope")])
+    assert rc == 2
+
+
+def test_autotune_report_over_aggregation_dir(tmp_path):
+    from transmogrifai_tpu.autotune import report_from_path
+    from transmogrifai_tpu.obs import metrics_registry, ship_now
+    from transmogrifai_tpu.serving import ServingTelemetry
+
+    tel = ServingTelemetry()
+    tel.set_tuned_knobs({"max_batch_size": 256}, source="autotune")
+    metrics_registry().counter("autotune.observations").inc(3)
+    agg = tmp_path / "obs"
+    ship_now(str(agg), instance="replica-0")
+    doc = report_from_path(str(agg))
+    rep = doc["replicas"]["replica-0"]
+    assert "autotune.observations" in rep["series"]
+    knob_views = list(rep["serving_knobs"].values())
+    assert any(v["knob_source"] == "autotune"
+               and v["tuned_knobs"].get("max_batch_size") == 256.0
+               for v in knob_views)
+    assert doc["fleet"]["shards_live"] == 1
+
+
+def test_router_reads_observed_throughput_from_shards():
+    """Satellite: dispatch weights follow the shards' observed
+    batch_rows_per_s (a fast replica reads as a shorter expected
+    wait)."""
+    router = FleetRouter(start=False)
+    try:
+        from transmogrifai_tpu.fleet.channel import FleetChannel
+        import socket as socket_mod
+
+        a, _b = socket_mod.socketpair(socket_mod.AF_UNIX,
+                                      socket_mod.SOCK_STREAM)
+        from transmogrifai_tpu.fleet.router import ReplicaHandle
+
+        fast = ReplicaHandle("replica-0", FleetChannel(a))
+        slow = ReplicaHandle("replica-1", FleetChannel(_b))
+        router._handles = {"replica-0": fast, "replica-1": slow}
+        docs = [
+            {"instance": "replica-0",
+             "views": {"serving/0": {"batch_rows_per_s": 100000.0,
+                                     "latency_ms": {"p99": 4.0},
+                                     "queue_depth": {},
+                                     "rows_scored": 10}}},
+            {"instance": "replica-1",
+             "views": {"serving/0": {"batch_rows_per_s": 10000.0,
+                                     "latency_ms": {"p99": 40.0},
+                                     "queue_depth": {},
+                                     "rows_scored": 10}}},
+        ]
+        assert router.refresh_from_shards(docs) == 2
+        assert fast.expected_wait_s(512) < slow.expected_wait_s(512)
+        assert router._pick(512) is fast
+    finally:
+        router.close()

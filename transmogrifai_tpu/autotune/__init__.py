@@ -83,10 +83,18 @@ def report_from_path(path: str) -> dict:
     * an OBS EXPORT directory (the runner's ``metrics_path`` knob:
       ``metrics.json`` + ``spans.jsonl``) - reports the autotune
       series scraped from the metrics document and the tagged
-      ``cv.fit*`` / ``autotune.*`` spans.
+      ``cv.fit*`` / ``autotune.*`` spans; or
+    * a fleet AGGREGATION directory (per-process ``*.obsshard.json``
+      from the PR-9 shippers; ISSUE 14) - reports every live
+      replica's autotune series and tuner-owned serving knobs in one
+      document, the fleet-wide view of who tuned what.
 
     Raises ``ValueError`` when the path holds neither shape."""
     out: dict = {"path": path}
+    agg_report = _report_from_agg_dir(path)
+    if agg_report is not None:
+        out.update(agg_report)
+        return out
     summary_p = os.path.join(path, "summary.json")
     model_p = os.path.join(path, COST_MODEL_FILENAME)
     metrics_p = os.path.join(path, "metrics.json")
@@ -142,6 +150,47 @@ def report_from_path(path: str) -> dict:
             f"{COST_MODEL_FILENAME}) nor an obs export (metrics.json)"
         )
     return out
+
+
+def _report_from_agg_dir(path: str) -> Optional[dict]:
+    """Fleet-aggregation-dir half of :func:`report_from_path` (ISSUE
+    14 satellite): None when ``path`` is not an aggregation dir, else
+    per-live-replica autotune series (``autotune.*`` native series),
+    tuner-owned serving knobs (``tuned_knobs``/``knob_source`` from
+    every serving view), and the shard membership report.  Reads ride
+    the torn-safe aggregator - a replica SIGKILLed mid-ship costs its
+    freshness, never this report."""
+    from ..obs.fleet import SHARD_SUFFIX, FleetAggregator, serving_views
+
+    if not os.path.isdir(path):
+        return None
+    try:
+        has_shards = any(n.endswith(SHARD_SUFFIX)
+                         for n in os.listdir(path))
+    except OSError:
+        return None
+    if not has_shards:
+        return None
+    agg = FleetAggregator(path)
+    replicas: dict = {}
+    for shard in agg.shards():
+        inst = str(shard.get("instance"))
+        metrics = shard.get("metrics") or {}
+        series = {
+            name: s for name, s in (metrics.get("series") or {}).items()
+            if str(name).startswith("autotune.")
+        }
+        knobs: dict = {}
+        for key, snap in serving_views(metrics):
+            tk = snap.get("tuned_knobs")
+            if tk:
+                knobs[key] = {
+                    "knob_source": snap.get("knob_source"),
+                    "tuned_knobs": dict(tk),
+                    "model_version": snap.get("model_version"),
+                }
+        replicas[inst] = {"series": series, "serving_knobs": knobs}
+    return {"fleet": dict(agg.last_report), "replicas": replicas}
 
 
 def _count_by(records: list, key: str) -> dict:

@@ -38,6 +38,18 @@ by the runner's ``metrics_path`` knob or ``obs.export_obs``):
 exposition (the SAME renderer a live scrape uses) or JSON; ``trace``
 reconstructs span trees from a JSONL export, optionally only the
 slowest N roots (the profiler's p99-exemplar view, offline).
+
+Fleet commands over the scale-out serving fleet (fleet/; ISSUE 14):
+
+    tx fleet status --path <control-or-agg-dir>   # one fleet document
+    tx fleet drain  --path <control-dir> --replica replica-1 [--undrain]
+
+``status`` prefers the controller's atomically-published
+``fleet_status.json`` (per-replica generation, heartbeat age,
+in-flight, restart budget) and falls back to assembling the view from
+the obs aggregation shards; ``drain`` queues a command file the live
+controller applies (the router stops dispatching to the replica while
+it stays warm - the manual half of a rolling deploy).
 """
 from __future__ import annotations
 
@@ -808,6 +820,137 @@ def _add_autotune_parser(sub) -> None:
 
 
 # ---------------------------------------------------------------------------
+# fleet commands (fleet/: replica status + operator drain, ISSUE 14)
+# ---------------------------------------------------------------------------
+def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
+    """Build the fleet status document for ``path``: the controller's
+    one consistent ``fleet_status.json`` when present (a control dir,
+    a fleet work dir, or the file itself), else assembled from the obs
+    aggregation shards (per-replica ``fleet`` info + serving views +
+    heartbeat ages)."""
+    from .fleet.controller import STATUS_FILENAME
+    from .obs.fleet import (
+        SHARD_SUFFIX,
+        FleetAggregator,
+        read_json_torn_safe,
+        serving_views,
+    )
+    from .workflow.supervisor import staleness
+
+    candidates = [path] if path.endswith(".json") else [
+        os.path.join(path, STATUS_FILENAME),
+        os.path.join(path, "control", STATUS_FILENAME),
+    ]
+    for cand in candidates:
+        if os.path.exists(cand):
+            doc = read_json_torn_safe(cand)
+            if doc is not None:
+                return {"source": cand, "status": doc}
+            raise ValueError(f"{cand}: torn/unreadable status document")
+    for agg_path in (path, os.path.join(path, "obs")):
+        if _is_agg_dir(agg_path):
+            agg = FleetAggregator(agg_path, stale_after_s=stale_after_s)
+            replicas = {}
+            for shard in agg.shards():
+                inst = str(shard.get("instance"))
+                shard_file = os.path.join(agg_path,
+                                          inst + SHARD_SUFFIX)
+                serving = {}
+                for _key, snap in serving_views(
+                        shard.get("metrics", {})):
+                    if snap.get("rows_scored", 0) >= serving.get(
+                            "rows_scored", -1):
+                        serving = {
+                            "version": snap.get("model_version"),
+                            "generation": snap.get("generation"),
+                            "rows_scored": snap.get("rows_scored"),
+                            "rows_per_s": snap.get("rows_per_s"),
+                            "p99_ms": (snap.get("latency_ms")
+                                       or {}).get("p99"),
+                        }
+                age = staleness(shard_file)
+                replicas[inst] = {
+                    "heartbeat_age_s": (None if age is None
+                                        else round(age, 3)),
+                    "fleet": shard.get("fleet"),
+                    "serving": serving or None,
+                }
+            return {"source": agg_path, "shards": dict(agg.last_report),
+                    "replicas": replicas}
+    raise ValueError(
+        f"{path!r} holds neither a fleet status document nor an obs "
+        "aggregation dir")
+
+
+def _fleet_main(args) -> int:
+    from .fleet.controller import COMMANDS_DIR
+
+    if args.fleet_cmd == "status":
+        try:
+            doc = _fleet_status_doc(args.path,
+                                    stale_after_s=args.stale_after_s)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        return 0
+    if args.fleet_cmd == "drain":
+        import tempfile
+        import time as _time
+
+        cdir = os.path.join(args.path, COMMANDS_DIR)
+        try:
+            os.makedirs(cdir, exist_ok=True)
+            doc = {"replica": args.replica,
+                   "drain": not args.undrain,
+                   "t": _time.time()}
+            # atomic drop: the controller's poll must never read a torn
+            # command and apply half an intention
+            fd, tmp = tempfile.mkstemp(dir=cdir, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, os.path.join(cdir, args.replica + ".json"))
+        except OSError as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        print(json.dumps({"queued": doc,
+                          "path": os.path.join(cdir,
+                                               args.replica + ".json")}))
+        return 0
+    raise AssertionError(f"unhandled fleet command {args.fleet_cmd}")
+
+
+def _add_fleet_parser(sub) -> None:
+    f = sub.add_parser(
+        "fleet",
+        help="scale-out serving fleet (replica status, operator drain)")
+    fsub = f.add_subparsers(dest="fleet_cmd", required=True)
+    s = fsub.add_parser(
+        "status",
+        help="one consistent fleet document: per-replica generation, "
+             "heartbeat age, in-flight, router counters")
+    s.add_argument("--path", required=True,
+                   help="fleet control dir (fleet_status.json), fleet "
+                        "work dir, or obs aggregation dir")
+    s.add_argument("--stale-after-s", type=float, default=None,
+                   dest="stale_after_s", metavar="S",
+                   help="shard heartbeat staleness cutoff when reading "
+                        "an aggregation dir")
+    d = fsub.add_parser(
+        "drain",
+        help="queue a drain (or --undrain) command the fleet "
+             "controller applies: the router stops dispatching to the "
+             "replica while it stays warm")
+    d.add_argument("--path", required=True,
+                   help="fleet control dir (the controller polls its "
+                        "commands/ subdirectory)")
+    d.add_argument("--replica", required=True,
+                   help="replica instance name, e.g. replica-1")
+    d.add_argument("--undrain", action="store_true",
+                   help="resume dispatch to the replica")
+
+
+# ---------------------------------------------------------------------------
 # registry commands (registry/: versioned store + lifecycle)
 # ---------------------------------------------------------------------------
 def _registry_main(args) -> int:
@@ -878,6 +1021,7 @@ def main(argv=None) -> int:
     _add_registry_parser(sub)
     _add_obs_parser(sub)
     _add_autotune_parser(sub)
+    _add_fleet_parser(sub)
     g = sub.add_parser("gen", help="generate a project from data")
     g.add_argument("--input", required=True, help="CSV or .avsc path")
     g.add_argument("--response", required=True)
@@ -904,6 +1048,8 @@ def main(argv=None) -> int:
         return _obs_main(args)
     if args.cmd == "autotune":
         return _autotune_main(args)
+    if args.cmd == "fleet":
+        return _fleet_main(args)
     answers = load_answers(args.answers) if args.answers else None
     path = generate(
         args.input, args.response, args.name, args.output, args.kind,

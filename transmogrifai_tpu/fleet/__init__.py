@@ -1,0 +1,63 @@
+"""Scale-out serving fleet: N replica workers behind a least-loaded
+router, with fleet-wide rolling hot-swap (ISSUE 14, ROADMAP item 1).
+
+The "millions of users" tier over everything the repo already has: the
+PR-1/6/12 compiled serving stack becomes N supervised worker processes
+(:mod:`.worker`), a least-loaded front router dispatches over bounded
+per-replica channels with at-least-once failover (:mod:`.router` /
+:mod:`.channel`), and the PR-5 registry drives fleet lifecycle -
+rolling zero-drop hot-swap, fleet-wide canary with rollback signals
+aggregated through the PR-9 obs plane and SLO engine
+(:mod:`.controller`).
+
+    registry = ModelRegistry(root); registry.publish(model, stage="stable")
+    with FleetController(root, "myapp:build_workflow", n_replicas=4) as fc:
+        results = fc.router.score_batch(records)
+        fc.rolling_deploy("v2")          # zero-drop, one replica at a time
+
+Fault points: ``fleet.replica_kill`` (a worker dies mid-serve like a
+SIGKILL), ``fleet.router_stall`` (the dispatcher wedges for a beat).
+``tx fleet status|drain`` is the operator surface; ``python bench.py
+--fleet`` writes FLEET_BENCH.json.
+"""
+from .channel import (
+    ChannelClosedError,
+    ChannelTimeoutError,
+    FleetChannel,
+    decode_records,
+    decode_results,
+    encode_records,
+    encode_results,
+)
+from .controller import (
+    FleetController,
+    merge_serving_snapshots,
+)
+from .router import (
+    FleetBatch,
+    FleetError,
+    FleetResult,
+    FleetRouter,
+    FleetWorkerError,
+    ReplicaHandle,
+)
+from .worker import ReplicaWorker
+
+__all__ = [
+    "ChannelClosedError",
+    "ChannelTimeoutError",
+    "FleetBatch",
+    "FleetChannel",
+    "FleetController",
+    "FleetError",
+    "FleetResult",
+    "FleetRouter",
+    "FleetWorkerError",
+    "ReplicaHandle",
+    "ReplicaWorker",
+    "decode_records",
+    "decode_results",
+    "encode_records",
+    "encode_results",
+    "merge_serving_snapshots",
+]

@@ -1,0 +1,292 @@
+"""Bounded request/response IPC channel between router and replicas.
+
+The transport tier of the scale-out serving fleet (ISSUE 14; reference
+frame: the TensorFlow system paper's position that throughput scaling
+comes from many coordinated workers behind one dispatch layer, arXiv
+1605.08695 §3 - the dataflow workers there talk over explicit Send/Recv
+edges, and this module is that edge for serving): one AF_UNIX stream
+socket per replica carrying length-framed messages, with a wire format
+deliberately split into a tiny header/meta part and an OPAQUE payload:
+
+* the router never (un)pickles record batches - it forwards the
+  caller's encoded payload bytes verbatim and hands responses back with
+  the result payload still encoded (decoded lazily by the caller), so
+  the dispatch layer's per-row cost is framing + syscalls, not object
+  graph serialization.  That is what keeps one router process able to
+  feed 4+ replicas at aggregate rates a single GIL could never pickle;
+* encode-once/retry-many: a batch is encoded at submission and the
+  SAME bytes are re-sent when a SIGKILLed replica's in-flight requests
+  are retried on survivors (at-least-once delivery with idempotent
+  scoring - the fleet may score a row twice, the caller sees it once);
+* every blocking wait is bounded at ``QUANTUM_S`` (50 ms) quanta - the
+  PR-8 pipeline discipline, style-gated for fleet/ in
+  tests/test_style.py: sockets run under ``settimeout(QUANTUM_S)`` and
+  every send/recv loop re-checks its stop flag/deadline per quantum, so
+  a wedged or vanished peer can never block the router or a worker
+  forever (a SIGKILLed peer closes the socket -> ``ChannelClosedError``
+  immediately).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+#: the bounded-wait quantum every blocking socket operation runs under
+QUANTUM_S = 0.05
+
+#: message ops (u8 on the wire)
+OP_SCORE = 1
+OP_RESULT = 2
+OP_ERROR = 3
+OP_CONTROL = 4
+OP_CONTROL_RESULT = 5
+
+#: frame = u64 body length; body = u8 op, u64 req_id, u32 meta_len,
+#: meta bytes (pickled small dict), payload bytes (the rest, opaque)
+_FRAME = struct.Struct("<Q")
+_HEADER = struct.Struct("<BQI")
+
+#: a frame larger than this is a protocol error, not a request (guards
+#: the length-prefix read against garbage bytes from a foreign writer)
+MAX_FRAME_BYTES = 1 << 31
+
+
+class ChannelClosedError(RuntimeError):
+    """The peer closed (or was SIGKILLed out from under) the socket."""
+
+
+class ChannelTimeoutError(TimeoutError):
+    """A bounded channel operation ran past its deadline."""
+
+
+def encode_records(records: Sequence[Any]) -> bytes:
+    """Record batch -> opaque payload bytes (the caller-side half of
+    the encode-once contract; a retried request reuses these bytes)."""
+    return pickle.dumps(list(records), protocol=5)
+
+
+def decode_records(payload: bytes) -> list:
+    return pickle.loads(payload)
+
+
+def encode_results(results: Sequence[Any]) -> bytes:
+    """Score results -> opaque payload bytes (worker side; the router
+    relays them undecoded and the caller decodes lazily)."""
+    return pickle.dumps(list(results), protocol=5)
+
+
+def decode_results(payload: bytes) -> list:
+    return pickle.loads(payload)
+
+
+class FleetChannel:
+    """Length-framed messages over one connected AF_UNIX socket with
+    every blocking primitive bounded at :data:`QUANTUM_S` quanta.
+
+    Thread contract: any number of threads may :meth:`send` (a lock
+    serializes frames); exactly ONE thread may :meth:`recv` (the
+    router's per-replica receiver thread / the worker's serve loop).
+    """
+
+    #: socket buffer request: large enough that a whole wire batch
+    #: lands in one or two kernel chunks - the receiver then wakes
+    #: once or twice per message instead of once per 64 KB default
+    #: buffer (the wakeup churn, not the memcpy, dominates the
+    #: router's per-row CPU; the kernel clamps this to wmem_max)
+    SOCK_BUF_BYTES = 4 << 20
+
+    def __init__(self, sock: socket.socket) -> None:
+        sock.settimeout(QUANTUM_S)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                            self.SOCK_BUF_BYTES)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                            self.SOCK_BUF_BYTES)
+        except OSError:
+            pass  # clamped/refused: the default buffer still works
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self.closed = False
+
+    # -- low-level bounded IO -----------------------------------------------
+    def _send_all(self, data, deadline: Optional[float],
+                  stop: Optional[threading.Event]) -> None:
+        view = memoryview(data)
+        off = 0
+        while off < len(view):
+            if stop is not None and stop.is_set():
+                raise ChannelClosedError("channel stopping")
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError("send deadline exceeded")
+            try:
+                off += self._sock.send(view[off:])
+            except socket.timeout:
+                continue
+            except OSError as e:
+                self.closed = True
+                raise ChannelClosedError(f"peer gone mid-send: {e}") from e
+
+    def send(self, op: int, req_id: int, meta: dict,
+             payload=b"", timeout_s: Optional[float] = None,
+             stop: Optional[threading.Event] = None) -> None:
+        """Send one framed message.  Head+meta and the payload go out
+        in ONE ``sendmsg`` gather call when possible - no
+        concatenation copy of a potentially-large batch and one fewer
+        syscall per message (the router's per-row cost is syscalls +
+        kernel copies; see the fleet CPU floor)."""
+        meta_b = pickle.dumps(meta, protocol=5)
+        body_len = _HEADER.size + len(meta_b) + len(payload)
+        head = (_FRAME.pack(body_len)
+                + _HEADER.pack(op, req_id, len(meta_b)) + meta_b)
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._send_lock:
+            if payload:
+                try:
+                    sent = self._sock.sendmsg([head, payload])
+                except socket.timeout:
+                    sent = 0
+                except OSError as e:
+                    self.closed = True
+                    raise ChannelClosedError(
+                        f"peer gone mid-send: {e}") from e
+                if sent >= len(head) + len(payload):
+                    return
+                # partial gather write (full socket buffer): finish
+                # byte-exactly with the bounded loop
+                if sent < len(head):
+                    self._send_all(memoryview(head)[sent:], deadline,
+                                   stop)
+                    self._send_all(payload, deadline, stop)
+                else:
+                    self._send_all(
+                        memoryview(payload)[sent - len(head):],
+                        deadline, stop)
+            else:
+                self._send_all(head, deadline, stop)
+
+    def _recv_exact(self, n: int, stop: Optional[threading.Event],
+                    idle_return: bool) -> Optional[bytearray]:
+        """Read exactly ``n`` bytes into ONE preallocated buffer via
+        ``recv_into`` - the payload never makes an extra userspace copy
+        (the router's per-row cost is this loop; see the fleet CPU
+        floor in tests/test_fleet.py).  Returns None when
+        ``idle_return`` and a quantum passed with nothing read yet;
+        once bytes have arrived it keeps reading - a live peer
+        mid-frame finishes, a dead one raises."""
+        buf = bytearray(n)
+        view = memoryview(buf)
+        off = 0
+        while off < n:
+            if stop is not None and stop.is_set():
+                return None
+            try:
+                k = self._sock.recv_into(view[off:], n - off)
+            except socket.timeout:
+                if idle_return and off == 0:
+                    return None
+                continue
+            except OSError as e:
+                self.closed = True
+                raise ChannelClosedError(f"peer gone mid-recv: {e}") from e
+            if k == 0:
+                self.closed = True
+                raise ChannelClosedError("peer closed the channel")
+            off += k
+        return buf
+
+    def recv(self, stop: Optional[threading.Event] = None,
+             idle_return: bool = True) -> Optional[tuple]:
+        """One message as ``(op, req_id, meta, payload)``, or ``None``
+        when idle for a quantum (``idle_return``) or ``stop`` is set.
+        The payload comes back as a memoryview over the single receive
+        buffer (``decode_records``/``decode_results`` consume it
+        directly; ``send`` re-sends it on failover without a copy).
+        Raises :class:`ChannelClosedError` on peer death/EOF."""
+        head = self._recv_exact(_FRAME.size, stop, idle_return)
+        if head is None:
+            return None
+        (body_len,) = _FRAME.unpack_from(head)
+        if body_len > MAX_FRAME_BYTES:
+            self.closed = True
+            raise ChannelClosedError(
+                f"oversized frame ({body_len} bytes): protocol corruption"
+            )
+        body = self._recv_exact(body_len, stop, idle_return=False)
+        if body is None:
+            return None
+        op, req_id, meta_len = _HEADER.unpack_from(body)
+        meta_off = _HEADER.size
+        meta = pickle.loads(
+            memoryview(body)[meta_off:meta_off + meta_len])
+        payload = memoryview(body)[meta_off + meta_len:body_len]
+        return op, req_id, meta, payload
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# connection establishment (both bounded)
+# ---------------------------------------------------------------------------
+def listen(socket_path: str) -> socket.socket:
+    """Bind + listen a worker's AF_UNIX socket (stale file replaced);
+    the returned listener runs under the bounded-accept quantum."""
+    try:
+        os.unlink(socket_path)
+    except OSError:
+        pass  # first bind: nothing stale to replace
+    lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    lsock.bind(socket_path)
+    lsock.listen(1)
+    lsock.settimeout(QUANTUM_S)
+    return lsock
+
+
+def accept(lsock: socket.socket, timeout_s: float,
+           stop: Optional[threading.Event] = None
+           ) -> Optional[FleetChannel]:
+    """Accept one peer within ``timeout_s`` (quantum-bounded); None on
+    deadline/stop."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() <= deadline:
+        if stop is not None and stop.is_set():
+            return None
+        try:
+            sock, _ = lsock.accept()
+        except socket.timeout:
+            continue
+        except OSError as e:
+            raise ChannelClosedError(f"listener closed: {e}") from e
+        return FleetChannel(sock)
+    return None
+
+
+def connect(socket_path: str, timeout_s: float = 30.0) -> FleetChannel:
+    """Connect to a worker's socket, retrying per quantum until the
+    worker has bound it (startup race) or the deadline passes."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(QUANTUM_S)
+        try:
+            sock.connect(socket_path)
+            return FleetChannel(sock)
+        except (FileNotFoundError, ConnectionRefusedError, socket.timeout,
+                OSError):
+            sock.close()
+            if time.monotonic() > deadline:
+                raise ChannelTimeoutError(
+                    f"no worker listening at {socket_path} within "
+                    f"{timeout_s}s"
+                ) from None
+            time.sleep(QUANTUM_S)

@@ -1,0 +1,658 @@
+"""Fleet lifecycle: spawn/supervise N replicas, rolling hot-swap,
+fleet-wide canary with aggregated rollback signals.
+
+The control plane of the scale-out serving fleet (ISSUE 14), with the
+PR-5 registry as the source of truth for WHAT each replica serves:
+
+* **supervised replicas** - N :mod:`~.worker` processes spawned with the
+  PR-9 trace-context env seam, each beating a heartbeat file; a dead or
+  heartbeat-stale replica is killed (if needed) and re-dispatched with
+  the PR-2 exponential backoff, while the router fails its in-flight
+  requests over to survivors (at-least-once, no lost accepted
+  requests).
+* **rolling hot-swap** - :meth:`FleetController.rolling_deploy` flips
+  generations ONE replica at a time: drain (router stops dispatching,
+  in-flight batches finish on the old generation), send the ``deploy``
+  control (the worker's PR-5 zero-drop pointer flip), undrain, next
+  replica.  Traffic keeps flowing to the rest of the fleet the whole
+  time - zero dropped, zero mixed-generation responses.
+* **fleet-wide canary** - :meth:`start_canary` brings the candidate up
+  on every replica at one deterministic hash fraction;
+  :meth:`check_canary` merges the per-replica stable/canary telemetry
+  from the obs aggregation dir (sum counters, max p99/drift - the
+  fleet rollup convention) and evaluates the PR-5
+  :class:`~..registry.rollback.RollbackPolicy` plus the PR-9 fleet
+  :class:`~..obs.slo.SLOEngine` over the merged docs: one firing
+  fleet-level SLO rolls the canary back across ALL replicas.
+* **one consistent status document** - the controller atomically
+  publishes ``fleet_status.json`` (per-replica generation, heartbeat
+  age, in-flight, restart budget) which ``tx fleet status``, the
+  workers' deploy summaries, and operators read instead of N shard
+  re-reads; ``tx fleet drain`` drops command files the controller
+  applies.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+from ..obs.fleet import FleetAggregator, child_env, read_json_torn_safe
+from ..obs.slo import SLOEngine, default_objectives
+from ..registry import ModelRegistry, RollbackDecision, RollbackPolicy
+from ..workflow.supervisor import backoff_delay_s, staleness
+from .channel import QUANTUM_S
+from .router import FleetError, FleetRouter
+
+log = logging.getLogger("transmogrifai_tpu.fleet")
+
+LOG_PREFIX = "op_fleet_metrics"
+
+#: fleet status document filename (atomically replaced in control_dir)
+STATUS_FILENAME = "fleet_status.json"
+
+#: drain/undrain command files dropped by ``tx fleet drain``
+COMMANDS_DIR = "commands"
+
+
+def merge_serving_snapshots(snaps: Sequence[dict]) -> dict:
+    """Merge per-replica ServingTelemetry snapshots into ONE
+    RollbackPolicy-consumable snapshot: counters SUM (how much fleet
+    traffic failed), p99/drift MAX (how bad is the worst replica) -
+    the FleetAggregator rollup convention applied to the rollback
+    signal set."""
+    out: dict = {
+        "rows_scored": 0, "rows_failed": 0,
+        "breaker": {"opens": 0, "closes": 0, "probes": 0,
+                    "rows_shed": 0, "rows_nonfinite": 0},
+        "latency_ms": {"p50": None, "p95": None, "p99": None},
+        "data_contract": {"drift_js_max": 0.0},
+        "model_version": None, "generation": None,
+        "replicas": 0,
+    }
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        out["replicas"] += 1
+        out["rows_scored"] += int(snap.get("rows_scored", 0) or 0)
+        out["rows_failed"] += int(snap.get("rows_failed", 0) or 0)
+        for k in out["breaker"]:
+            out["breaker"][k] += int(
+                (snap.get("breaker") or {}).get(k, 0) or 0)
+        for p in ("p50", "p95", "p99"):
+            v = (snap.get("latency_ms") or {}).get(p)
+            if v is not None and (out["latency_ms"][p] is None
+                                  or v > out["latency_ms"][p]):
+                out["latency_ms"][p] = v
+        drift = (snap.get("data_contract") or {}).get("drift_js_max")
+        if drift is not None and drift > out["data_contract"][
+                "drift_js_max"]:
+            out["data_contract"]["drift_js_max"] = drift
+        if out["model_version"] is None:
+            out["model_version"] = snap.get("model_version")
+            out["generation"] = snap.get("generation")
+    return out
+
+
+@dataclass
+class _Replica:
+    index: int
+    instance: str
+    socket_path: str
+    heartbeat_path: str
+    proc: Optional[subprocess.Popen] = None
+    restarts: int = 0
+    restart_at: Optional[float] = None  # monotonic; None = not scheduled
+    gave_up: bool = False
+    #: a reconnect thread is in flight (the connect can take as long as
+    #: a replica warm-up; supervision of the REST of the fleet must not
+    #: stall behind it)
+    reconnecting: bool = False
+    events: list = field(default_factory=list)
+
+
+class FleetController:
+    """Spawn, supervise, and lifecycle a replica fleet (module
+    docstring)."""
+
+    def __init__(
+        self,
+        registry_root: str,
+        workflow_spec: str,
+        n_replicas: int = 2,
+        work_dir: Optional[str] = None,
+        fleet_dir: Optional[str] = None,
+        control_dir: Optional[str] = None,
+        version: Optional[str] = None,
+        policy: Optional[RollbackPolicy] = None,
+        slo_objectives: Optional[list] = None,
+        router_kw: Optional[dict] = None,
+        worker_args: Optional[Sequence[str]] = None,
+        worker_env: Optional[dict] = None,
+        max_restarts: int = 2,
+        stale_after_s: float = 60.0,
+        connect_timeout_s: float = 180.0,
+        ship_interval_s: float = 0.25,
+        use_cost_model: bool = True,
+        monitor_interval_s: float = 0.2,
+    ) -> None:
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.registry_root = registry_root
+        self.workflow_spec = workflow_spec
+        self.n_replicas = int(n_replicas)
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="tx-fleet-")
+        self.fleet_dir = fleet_dir or os.path.join(self.work_dir, "obs")
+        self.control_dir = control_dir or os.path.join(self.work_dir,
+                                                       "control")
+        self.version = version
+        self.worker_args = list(worker_args or ())
+        self.worker_env = dict(worker_env or {})
+        self.max_restarts = int(max_restarts)
+        self.stale_after_s = float(stale_after_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.ship_interval_s = float(ship_interval_s)
+        self.use_cost_model = bool(use_cost_model)
+        self.monitor_interval_s = max(0.05, float(monitor_interval_s))
+        self.registry = ModelRegistry(registry_root, create=False)
+        self.aggregator = FleetAggregator(self.fleet_dir)
+        self.slo_engine = SLOEngine(
+            slo_objectives if slo_objectives is not None
+            else default_objectives(),
+            doc_fn=self.aggregator.merged_metrics_docs,
+            register=False,
+        )
+        self.policy = policy if policy is not None else RollbackPolicy()
+        self.policy.slo_engine = self.slo_engine
+        self._router_kw = dict(router_kw or {})
+        self.router: Optional[FleetRouter] = None
+        self.canary_version: Optional[str] = None
+        self._replicas: dict[str, _Replica] = {}
+        self._events: list[dict] = []
+        self._events_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.started = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def _event(self, event: str, **kw: Any) -> None:
+        entry = {"event": event, "t": time.time(), **kw}
+        with self._events_lock:
+            self._events.append(entry)
+            if len(self._events) > 256:
+                del self._events[0]
+
+    def start(self) -> "FleetController":
+        os.makedirs(self.work_dir, exist_ok=True)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.control_dir, COMMANDS_DIR),
+                    exist_ok=True)
+        cost_model = self._load_cost_model() if self.use_cost_model \
+            else None
+        self.router = FleetRouter(cost_model=cost_model,
+                                  **self._router_kw)
+        try:
+            for i in range(self.n_replicas):
+                rep = _Replica(
+                    index=i,
+                    instance=f"replica-{i}",
+                    socket_path=os.path.join(self.work_dir,
+                                             f"replica-{i}.sock"),
+                    heartbeat_path=os.path.join(self.work_dir,
+                                                f"replica-{i}.hb"),
+                )
+                self._replicas[rep.instance] = rep
+                self._spawn(rep)
+            # connect AFTER spawning: replicas warm concurrently
+            for rep in self._replicas.values():
+                self.router.add_replica(
+                    rep.instance, rep.socket_path,
+                    connect_timeout_s=self.connect_timeout_s,
+                    pid=rep.proc.pid if rep.proc else None)
+        except BaseException:
+            # a partially-failed bring-up (bad workflow spec, worker
+            # crash at startup) must not leak spawned processes, the
+            # router's threads, or its registered metrics view onto the
+            # caller - `with FleetController(...)` never reaches
+            # __exit__ when start() raises
+            self.stop(timeout_s=5.0)
+            raise
+        self._event("fleet_start", replicas=self.n_replicas,
+                    registry=self.registry_root)
+        self.started = True
+        self._write_status()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="tx-fleet-monitor",
+            daemon=True)
+        self._monitor.start()
+        log.info("%s fleet up: %d replicas over %s", LOG_PREFIX,
+                 self.n_replicas, self.registry_root)
+        return self
+
+    def _load_cost_model(self):
+        """The PR-13 cost model rides the deployed artifact
+        (``autotune.json`` next to the model); when present the router
+        weights its dispatch with it (ISSUE 14 satellite)."""
+        try:
+            version = self.version or self.registry.stable
+            if version is None:
+                return None
+            path = os.path.join(self.registry.artifact_path(version),
+                                "autotune.json")
+            if not os.path.exists(path):
+                return None
+            from ..autotune import CostModel
+
+            cm = CostModel.load(path)
+            log.info("%s router dispatch weighted by cost model %s",
+                     LOG_PREFIX, path)
+            return cm
+        except Exception as e:  # noqa: BLE001 - weighting is optional
+            log.warning("cost model load failed (round-robin-ish "
+                        "weights): %s", e)
+            return None
+
+    def _worker_cmd(self, rep: _Replica) -> list[str]:
+        cmd = [
+            sys.executable, "-m", "transmogrifai_tpu.fleet.worker",
+            "--registry-root", self.registry_root,
+            "--workflow", self.workflow_spec,
+            "--socket", rep.socket_path,
+            "--instance", rep.instance,
+            "--heartbeat", rep.heartbeat_path,
+            "--fleet-dir", self.fleet_dir,
+            "--fleet-status-path",
+            os.path.join(self.control_dir, STATUS_FILENAME),
+            "--ship-interval-s", str(self.ship_interval_s),
+        ]
+        if self.version:
+            cmd += ["--version", self.version]
+        cmd += self.worker_args
+        return cmd
+
+    def _spawn(self, rep: _Replica) -> None:
+        env = child_env(dict(os.environ, **self.worker_env))
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the package is not pip-installed: children import it from the
+        # repo root, wherever the controller process found it
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        for stale in (rep.socket_path, rep.heartbeat_path):
+            # the DEAD incarnation's heartbeat file must go too: its
+            # frozen mtime is by construction older than stale_after_s
+            # by restart time, and judging the fresh warming process by
+            # it would stale-kill every restart (staleness() returns
+            # None until the new process actually beats)
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass  # nothing stale to clear
+        rep.proc = subprocess.Popen(self._worker_cmd(rep), env=env)
+        rep.events.append({"event": "spawn", "pid": rep.proc.pid,
+                           "t": time.time()})
+
+    # -- supervision --------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        last_status = 0.0
+        last_refresh = 0.0
+        while not self._stop.wait(self.monitor_interval_s):
+            try:
+                self._check_replicas()
+                self._poll_commands()
+                now = time.monotonic()
+                shards = None
+                if now - last_refresh >= 0.5:
+                    last_refresh = now
+                    # ONE shard read serves both the weight refresh and
+                    # the status publish this tick - shards carry the
+                    # whole span ring, and double-parsing them twice a
+                    # second is pure waste
+                    shards = self.aggregator.shards()
+                    self.router.refresh_from_shards([
+                        dict(d.get("metrics", {}),
+                             instance=str(d.get("instance")))
+                        for d in shards
+                    ])
+                if now - last_status >= 0.5:
+                    last_status = now
+                    self._write_status(shards=shards)
+            except Exception:  # noqa: BLE001 - supervision must survive
+                log.exception("fleet monitor loop error")
+
+    def _reconnect(self, rep: _Replica) -> None:
+        """Connect a restarted worker's channel on a side thread: the
+        connect blocks for the replica's whole warm-up (up to
+        ``connect_timeout_s``), and the monitor loop must keep
+        supervising the REST of the fleet - heartbeat kills, drain
+        commands, status publishing - meanwhile."""
+        try:
+            self.router.add_replica(
+                rep.instance, rep.socket_path,
+                connect_timeout_s=self.connect_timeout_s,
+                pid=rep.proc.pid if rep.proc else None)
+            self._event("replica_restarted", instance=rep.instance,
+                        attempt=rep.restarts)
+        except Exception as e:  # noqa: BLE001 - keep supervising
+            log.warning("restarted replica %s did not come up: %s",
+                        rep.instance, e)
+        finally:
+            rep.reconnecting = False
+
+    def _check_replicas(self) -> None:
+        for rep in list(self._replicas.values()):
+            if rep.gave_up or rep.proc is None or rep.reconnecting:
+                continue
+            rc = rep.proc.poll()
+            stale = staleness(rep.heartbeat_path)
+            if rc is None and stale is not None \
+                    and stale > self.stale_after_s:
+                # alive but wedged: the supervision rule - kill it and
+                # let the restart path take over (PR-2 semantics)
+                log.warning("%s replica %s heartbeat stale %.0fs: "
+                            "killing", LOG_PREFIX, rep.instance, stale)
+                rep.proc.kill()
+                try:
+                    rep.proc.wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    continue  # D-state child: retry next tick
+                rc = rep.proc.returncode
+            if rc is None:
+                continue
+            # dead: the router's receiver notices the closed channel on
+            # its own and fails in-flight work over; supervision owns
+            # the restart budget
+            handle = None
+            try:
+                handle = self.router.handle(rep.instance)
+            except FleetError:
+                pass
+            if handle is not None and handle.alive:
+                self.router._on_replica_dead(
+                    handle, f"process exit {rc}")
+            if rep.restart_at is None:
+                if rep.restarts >= self.max_restarts:
+                    rep.gave_up = True
+                    self._event("replica_gave_up", instance=rep.instance,
+                                exit_code=rc, restarts=rep.restarts)
+                    log.error("%s replica %s exhausted its restart "
+                              "budget (%d)", LOG_PREFIX, rep.instance,
+                              rep.restarts)
+                    continue
+                import random
+
+                delay = backoff_delay_s(rep.restarts, 0.2, 10.0, 0.1,
+                                        random.Random(rep.index))
+                rep.restart_at = time.monotonic() + delay
+                self._event("replica_down", instance=rep.instance,
+                            exit_code=rc, backoff_s=round(delay, 3))
+            elif time.monotonic() >= rep.restart_at:
+                rep.restart_at = None
+                rep.restarts += 1
+                self._spawn(rep)
+                rep.reconnecting = True
+                threading.Thread(
+                    target=self._reconnect, args=(rep,),
+                    name=f"tx-fleet-reconnect-{rep.instance}",
+                    daemon=True).start()
+
+    def _poll_commands(self) -> None:
+        """Apply (and consume) ``tx fleet drain`` command files."""
+        cdir = os.path.join(self.control_dir, COMMANDS_DIR)
+        try:
+            names = os.listdir(cdir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(cdir, name)
+            doc = read_json_torn_safe(path)
+            if doc is None:
+                continue  # torn write in flight: retry next tick
+            instance = str(doc.get("replica", name[:-len(".json")]))
+            try:
+                drained = bool(doc.get("drain", True))
+                self.router.set_drained(instance, drained)
+                self._event("drain" if drained else "undrain",
+                            instance=instance, source="command_file")
+            except FleetError as e:
+                self._event("command_rejected", instance=instance,
+                            error=str(e))
+            try:
+                os.unlink(path)
+            except OSError as e:
+                log.warning("could not consume command file %s: %s",
+                            path, e)
+
+    # -- rolling deploy -----------------------------------------------------
+    def rolling_deploy(self, version: str,
+                       drain_timeout_s: float = 60.0,
+                       ctl_timeout_s: float = 300.0) -> list[dict]:
+        """Flip the whole fleet to ``version``, one replica at a time
+        (module docstring).  Returns the per-replica step report; a
+        replica that cannot drain or deploy raises with the fleet left
+        in a loudly-reported mixed state (the registry already names
+        the intended stable - retry completes the roll)."""
+        if self.registry.get(version).stage != "stable":
+            self.registry.promote(version, to="stable")
+        self.version = version
+        report = []
+        for h in list(self.router.live_replicas()):
+            step = {"instance": h.instance, "version": version}
+            self.router.set_drained(h.instance, True)
+            try:
+                if not self.router.wait_drained(h.instance,
+                                                drain_timeout_s):
+                    raise FleetError(
+                        f"replica {h.instance} did not drain within "
+                        f"{drain_timeout_s}s")
+                t0 = time.perf_counter()
+                doc = self.router.control(
+                    h.instance, "deploy", {"version": version},
+                    timeout_s=ctl_timeout_s)
+                step["generation"] = doc.get("generation")
+                step["swap_s"] = round(time.perf_counter() - t0, 4)
+            finally:
+                self.router.set_drained(h.instance, False)
+            report.append(step)
+            self._event("rolling_deploy_step", **step)
+        self._event("rolling_deploy_done", version=version,
+                    replicas=len(report))
+        self._write_status()
+        log.info("%s rolling deploy of %s complete across %d replicas",
+                 LOG_PREFIX, version, len(report))
+        return report
+
+    # -- fleet canary -------------------------------------------------------
+    def start_canary(self, version: str, fraction: float = 0.05,
+                     shadow: bool = False,
+                     ctl_timeout_s: float = 300.0) -> dict:
+        """Bring ``version`` up as the canary on every live replica at
+        one deterministic hash fraction (the same record routes to the
+        same arm on every replica - the PR-5 split, fleet-wide)."""
+        out = self.router.broadcast(
+            "canary",
+            {"version": version, "fraction": fraction, "shadow": shadow},
+            timeout_s=ctl_timeout_s)
+        errors = {k: v for k, v in out.items()
+                  if isinstance(v, dict) and v.get("error")}
+        if len(errors) == len(out):
+            raise FleetError(f"canary {version} failed on every "
+                             f"replica: {errors}")
+        self.canary_version = version
+        self._event("fleet_canary_start", version=version,
+                    fraction=fraction, shadow=shadow,
+                    replicas=sorted(set(out) - set(errors)),
+                    errors=errors or None)
+        return out
+
+    def _arm_snapshots(self) -> tuple[list[dict], list[dict]]:
+        """Split every live shard's serving views into (stable pool,
+        canary pool) by model version."""
+        from ..obs.fleet import serving_views
+
+        stable_snaps: list[dict] = []
+        canary_snaps: list[dict] = []
+        for doc in self.aggregator.shards():
+            for _key, snap in serving_views(doc.get("metrics", {})):
+                if snap.get("model_version") == self.canary_version:
+                    canary_snaps.append(snap)
+                else:
+                    stable_snaps.append(snap)
+        return stable_snaps, canary_snaps
+
+    def check_canary(self) -> Optional[RollbackDecision]:
+        """Evaluate the rollback policy (and the fleet SLO engine)
+        against the MERGED per-replica telemetry; a breach rolls the
+        canary back across the whole fleet."""
+        if self.canary_version is None:
+            return None
+        stable_snaps, canary_snaps = self._arm_snapshots()
+        decision = self.policy.evaluate(
+            merge_serving_snapshots(stable_snaps),
+            merge_serving_snapshots(canary_snaps),
+        )
+        if decision.rollback:
+            self.rollback_canary(decision=decision)
+        return decision
+
+    def rollback_canary(self,
+                        decision: Optional[RollbackDecision] = None,
+                        reason: str = "fleet-policy") -> dict:
+        """Demote the canary on EVERY replica (each worker's rollback
+        is its own pointer flip; the first one also records the
+        registry rollback, the rest observe it already rolled back)."""
+        out = self.router.broadcast(
+            "rollback",
+            {"reason": reason if decision is None else "policy"})
+        version, self.canary_version = self.canary_version, None
+        self._event(
+            "fleet_rollback", version=version,
+            reason=reason if decision is None else "policy",
+            reasons=[dict(r) for r in decision.reasons] if decision
+            else [],
+            replicas=sorted(out),
+        )
+        self._write_status()
+        log.warning("%s fleet canary %s ROLLED BACK across %d "
+                    "replicas", LOG_PREFIX, version, len(out))
+        return out
+
+    def promote_canary(self) -> dict:
+        out = self.router.broadcast("promote_canary")
+        version, self.canary_version = self.canary_version, None
+        self.version = version
+        self._event("fleet_canary_promote", version=version,
+                    replicas=sorted(out))
+        self._write_status()
+        return out
+
+    # -- status -------------------------------------------------------------
+    def status(self, shards=None) -> dict:
+        """The one consistent fleet document (per-replica generation,
+        heartbeat age, in-flight, restart budget + router + registry
+        pointers) - what ``tx fleet status`` renders and
+        ``fleet_status.json`` persists.  ``shards`` reuses an
+        already-read shard list (the monitor's once-per-tick read)."""
+        shard_fleet = {}
+        if shards is None:
+            shards = self.aggregator.shards()
+        for doc in shards:
+            info = doc.get("fleet")
+            if isinstance(info, dict):
+                shard_fleet[str(doc.get("instance"))] = info
+        replicas = {}
+        router_snap = self.router.snapshot() if self.router else {}
+        for rep in self._replicas.values():
+            hb = staleness(rep.heartbeat_path)
+            handle_snap = (router_snap.get("replicas") or {}).get(
+                rep.instance, {})
+            replicas[rep.instance] = {
+                "pid": rep.proc.pid if rep.proc else None,
+                "running": (rep.proc is not None
+                            and rep.proc.poll() is None),
+                "restarts": rep.restarts,
+                "gave_up": rep.gave_up,
+                "heartbeat_age_s": (None if hb is None
+                                    else round(hb, 3)),
+                "generation": handle_snap.get("generation"),
+                "version": handle_snap.get("version"),
+                "in_flight": handle_snap.get("in_flight"),
+                "in_flight_rows": handle_snap.get("in_flight_rows"),
+                "drained": handle_snap.get("drained"),
+                "alive": handle_snap.get("alive"),
+                "rows_ok": handle_snap.get("rows_ok"),
+                "worker": shard_fleet.get(rep.instance),
+            }
+        with self._events_lock:
+            events = [dict(e) for e in self._events]
+        return {
+            "t": time.time(),
+            "registry_root": self.registry_root,
+            "stable_version": self.registry.stable,
+            "canary_version": self.canary_version,
+            "replicas": replicas,
+            "router": {k: v for k, v in router_snap.items()
+                       if k != "replicas"},
+            "shards": dict(self.aggregator.last_report),
+            "events": events,
+        }
+
+    def _write_status(self, shards=None) -> None:
+        """Atomically publish the status doc (tempfile + replace: a
+        reader - worker deploy summaries, ``tx fleet status`` - sees a
+        complete document or the previous one, never a torn one)."""
+        path = os.path.join(self.control_dir, STATUS_FILENAME)
+        try:
+            doc = self.status(shards=shards)
+            fd, tmp = tempfile.mkstemp(dir=self.control_dir,
+                                       suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("fleet status publish failed: %s", e)
+
+    # -- shutdown -----------------------------------------------------------
+    def stop(self, timeout_s: float = 15.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout_s)
+        if self.router is not None:
+            try:
+                self.router.broadcast("stop", timeout_s=5.0)
+            except Exception as e:  # noqa: BLE001 - best-effort goodbye
+                log.debug("fleet stop broadcast failed: %s", e)
+            self.router.close()
+        for rep in self._replicas.values():
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for rep in self._replicas.values():
+            if rep.proc is None:
+                continue
+            while rep.proc.poll() is None \
+                    and time.monotonic() < deadline:
+                time.sleep(QUANTUM_S)
+            if rep.proc.poll() is None:
+                rep.proc.kill()
+                try:
+                    rep.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    log.warning("replica %s did not reap", rep.instance)
+        self._write_status()
+
+    def __enter__(self) -> "FleetController":
+        return self.start() if not self.started else self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,801 @@
+"""Least-loaded front router over N replica serving workers.
+
+The dispatch layer of the scale-out fleet (ISSUE 14, ROADMAP item 1;
+reference frame: the TensorFlow system paper's many-workers-behind-one-
+dispatch-layer scaling story, arXiv 1605.08695, with the TpuGraphs
+learned-cost-signal idea, arXiv 2308.13490, supplying the load
+estimate):
+
+* **front door** - the PR-1 :class:`AdmissionController` unchanged
+  (bounded queue, deadline shed at dequeue) with the ISSUE-14
+  per-tenant quotas layered on: one chatty tenant sheds with
+  ``TenantQuotaError`` while the rest of the fleet's traffic admits.
+* **least-loaded dispatch** - one dispatcher thread assigns each queued
+  batch to the replica with the smallest *expected wait*:
+  ``(in_flight_rows + batch_rows) * service_s_per_row``, where the
+  per-replica service time blends a live EWMA over this router's own
+  response walls with the replica's shipped obs shard
+  (``batch_rows_per_s`` / p99 from its ServingTelemetry view, read via
+  :meth:`FleetRouter.refresh_from_shards`) and - when the deployed
+  artifact carries an ``autotune.json`` - the PR-13 :class:`CostModel`
+  (per-replica ``serve.batch/<instance>`` keys trained online from
+  observed batch walls; its prediction replaces the cold-start default
+  until live EWMAs exist).
+* **at-least-once failover** - requests stay registered on their
+  replica until the response arrives; a replica that dies (SIGKILL,
+  channel EOF) has every in-flight request re-dispatched to survivors
+  from the SAME encoded payload (encode-once), so an accepted request
+  is never lost - the fleet may score a row twice, the caller sees
+  exactly one response (idempotent scoring).
+* **backpressure, never hang** - per-replica in-flight is capped; when
+  every replica is full the dispatcher waits in 50 ms quanta while the
+  bounded admission queue sheds new submissions at the front door.
+  Every blocking wait in this module is quantum-bounded
+  (tests/test_style.py extends the parallel/ bounded-wait gate to
+  fleet/).
+
+Fault points: ``fleet.router_stall`` (inject_sleep in the dispatch
+loop) drills a wedged router without touching replica health.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+from ..faults import injection as _faults
+from ..obs.metrics import metrics_registry
+from ..serving.admission import (
+    AdmissionController,
+    DeadlineExceededError,
+    QueueFullError,
+    RequestTimeoutError,
+    TenantQuotaError,
+    _Request,
+)
+from .channel import (
+    OP_CONTROL,
+    OP_CONTROL_RESULT,
+    OP_ERROR,
+    OP_RESULT,
+    OP_SCORE,
+    QUANTUM_S,
+    ChannelClosedError,
+    ChannelTimeoutError,
+    FleetChannel,
+    connect,
+    decode_results,
+)
+
+log = logging.getLogger("transmogrifai_tpu.fleet")
+
+LOG_PREFIX = "op_fleet_metrics"
+
+#: cold-start per-row service-time guess (10 us ~ a fused CPU replica at
+#: 100k rows/s) used only until an observation or cost-model prediction
+#: replaces it
+_DEFAULT_SVC_S = 1e-5
+
+#: EWMA smoothing for the per-replica observed service time
+_SVC_ALPHA = 0.3
+
+#: failover budget per request: a batch that has already killed (or
+#: been orphaned by) this many replicas is POISON, not bad luck - it
+#: fails loudly instead of cascading through every survivor and
+#: burning the whole fleet's restart budget
+MAX_FAILOVERS = 2
+
+
+class FleetError(RuntimeError):
+    """Fleet-level routing failure (no live replica to serve on)."""
+
+
+class FleetWorkerError(RuntimeError):
+    """A replica reported a scoring/control failure for one request."""
+
+
+@dataclass
+class FleetBatch:
+    """One queued unit of fleet work (rides ``_Request.record``): the
+    encoded payload is retained until the response resolves so a
+    failover re-sends the SAME bytes."""
+
+    payload: bytes
+    n_rows: int
+    tenant: Optional[str] = None
+    kind: str = "score"  # score | ctl
+    ctl: dict = field(default_factory=dict)
+    retries: int = 0
+
+
+class FleetResult:
+    """A replica's response with the result payload still encoded -
+    decoded lazily so counting/relaying responses never pays the
+    object-graph cost (the router-overhead floor in tests/test_fleet.py
+    measures exactly this seam)."""
+
+    __slots__ = ("meta", "payload", "_decoded")
+
+    def __init__(self, meta: dict, payload: bytes) -> None:
+        self.meta = meta
+        self.payload = payload
+        self._decoded: Optional[list] = None
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.meta.get("n_rows", 0))
+
+    @property
+    def version(self) -> Optional[str]:
+        return self.meta.get("version")
+
+    @property
+    def generation(self) -> Optional[int]:
+        return self.meta.get("generation")
+
+    @property
+    def instance(self) -> Optional[str]:
+        return self.meta.get("instance")
+
+    @property
+    def results(self) -> list:
+        if self._decoded is None:
+            self._decoded = decode_results(self.payload) \
+                if self.payload else []
+        return self._decoded
+
+    @property
+    def doc(self) -> Any:
+        """Control-response document (status/deploy acknowledgements)."""
+        return decode_results(self.payload)[0] if self.payload else None
+
+
+class ReplicaHandle:
+    """Router-side state for one replica worker."""
+
+    def __init__(self, instance: str, channel: FleetChannel,
+                 pid: Optional[int] = None) -> None:
+        self.instance = instance
+        self.channel = channel
+        self.pid = pid
+        self.lock = threading.Lock()
+        self.pending: dict[int, _Request] = {}
+        self.in_flight_rows = 0
+        self.alive = True
+        self.drained = False
+        self.rows_ok = 0
+        self.requests_ok = 0
+        self.last_version: Optional[str] = None
+        self.last_generation: Optional[int] = None
+        self.svc_s_ewma: Optional[float] = None
+        #: latest shard-observed stats (refresh_from_shards)
+        self.obs: dict = {}
+        self.receiver: Optional[threading.Thread] = None
+
+    # -- load estimate ------------------------------------------------------
+    def service_s_per_row(self, cost_model=None) -> float:
+        """Best current per-row service-time estimate: live EWMA >
+        cost-model prediction > shipped-shard throughput > default."""
+        if self.svc_s_ewma is not None:
+            return self.svc_s_ewma
+        if cost_model is not None:
+            try:
+                from ..autotune import candidate_features
+
+                pred_ms = cost_model.predict_wall_ms(
+                    "serve.batch/" + self.instance,
+                    candidate_features(512, 0),
+                )
+                if pred_ms is not None and pred_ms > 0:
+                    return pred_ms / 1e3 / 512.0
+            except Exception as e:  # noqa: BLE001 - estimate only
+                log.debug("cost-model estimate failed for %s: %s",
+                          self.instance, e)
+        rps = self.obs.get("batch_rows_per_s")
+        if rps:
+            return 1.0 / float(rps)
+        return _DEFAULT_SVC_S
+
+    def expected_wait_s(self, n_rows: int, cost_model=None) -> float:
+        svc = self.service_s_per_row(cost_model)
+        with self.lock:
+            backlog = self.in_flight_rows
+        return (backlog + n_rows) * svc
+
+    def in_flight(self) -> int:
+        with self.lock:
+            return len(self.pending)
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "instance": self.instance,
+                "pid": self.pid,
+                "alive": self.alive,
+                "drained": self.drained,
+                "in_flight": len(self.pending),
+                "in_flight_rows": self.in_flight_rows,
+                "rows_ok": self.rows_ok,
+                "requests_ok": self.requests_ok,
+                "version": self.last_version,
+                "generation": self.last_generation,
+                "service_us_per_row": (
+                    round(self.svc_s_ewma * 1e6, 3)
+                    if self.svc_s_ewma is not None else None),
+                "obs": dict(self.obs),
+            }
+
+
+class FleetRouter:
+    """Least-loaded dispatch + at-least-once failover over replica
+    channels (module docstring).  In-process: the router lives in the
+    controller/runner process, replicas are separate worker processes
+    behind AF_UNIX channels."""
+
+    def __init__(
+        self,
+        max_queue: int = 256,
+        max_in_flight_per_replica: int = 4,
+        tenant_quota: Optional[float] = None,
+        cost_model=None,
+        clock=time.monotonic,
+        send_timeout_s: float = 10.0,
+        start: bool = True,
+    ) -> None:
+        if max_in_flight_per_replica < 1:
+            raise ValueError("max_in_flight_per_replica must be >= 1")
+        self.max_in_flight_per_replica = int(max_in_flight_per_replica)
+        self.cost_model = cost_model
+        self.clock = clock
+        self.send_timeout_s = float(send_timeout_s)
+        self.admission = AdmissionController(
+            max_queue=max_queue, clock=clock, tenant_quota=tenant_quota)
+        self._handles: dict[str, ReplicaHandle] = {}
+        self._handles_lock = threading.Lock()
+        self._retry: deque[_Request] = deque()
+        self._retry_lock = threading.Lock()
+        self._req_ids = itertools.count(1)
+        self._stop = threading.Event()
+        #: set by every response arrival: the dispatcher parked on
+        #: "every replica full" wakes the moment capacity frees instead
+        #: of burning the whole 50 ms quantum (the wait itself stays
+        #: quantum-BOUNDED - the event only makes it prompt)
+        self._capacity = threading.Event()
+        # counters (the fleet_router metrics view)
+        self._ctr_lock = threading.Lock()
+        self.rows_ok = 0
+        self.rows_failed = 0
+        self.requests_ok = 0
+        self.requests_failed = 0
+        self.shed_queue_full = 0
+        self.shed_quota = 0
+        self.shed_deadline = 0
+        self.retries = 0
+        self.replica_deaths = 0
+        self.router_stalls = 0
+        self._rows_by_generation: dict[str, int] = {}
+        metrics_registry().register_view("fleet_router", self)
+        self._dispatcher: Optional[threading.Thread] = None
+        if start:
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, name="tx-fleet-dispatch",
+                daemon=True)
+            self._dispatcher.start()
+
+    # -- replica membership -------------------------------------------------
+    def add_replica(self, instance: str, socket_path: str,
+                    connect_timeout_s: float = 60.0,
+                    pid: Optional[int] = None) -> ReplicaHandle:
+        """Connect a replica's channel and start its receiver thread.
+        Re-adding an instance name (a restarted worker) replaces the
+        dead handle; its in-flight work was already failed over."""
+        channel = connect(socket_path, timeout_s=connect_timeout_s)
+        handle = ReplicaHandle(instance, channel, pid=pid)
+        handle.receiver = threading.Thread(
+            target=self._receive_loop, args=(handle,),
+            name=f"tx-fleet-recv-{instance}", daemon=True)
+        with self._handles_lock:
+            old = self._handles.get(instance)
+            self._handles[instance] = handle
+        if old is not None and old.alive:
+            self._on_replica_dead(old, "replaced by a new connection")
+        handle.receiver.start()
+        return handle
+
+    def replicas(self) -> list[ReplicaHandle]:
+        with self._handles_lock:
+            return list(self._handles.values())
+
+    def live_replicas(self) -> list[ReplicaHandle]:
+        return [h for h in self.replicas() if h.alive]
+
+    def handle(self, instance: str) -> ReplicaHandle:
+        with self._handles_lock:
+            h = self._handles.get(instance)
+        if h is None:
+            raise FleetError(f"unknown replica {instance!r}")
+        return h
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, records: Optional[Sequence] = None,
+               payload: Optional[bytes] = None,
+               n_rows: Optional[int] = None,
+               tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> _Request:
+        """Queue one batch; returns the admission ``_Request`` handle
+        (``.wait(timeout)`` -> :class:`FleetResult`).  Pass ``records``
+        (encoded here, once) or an already-encoded ``payload`` +
+        ``n_rows`` - the wire-form path for callers that hold the
+        serialized batch already (a network front end, the bench's
+        sustained-load driver)."""
+        if payload is None:
+            if records is None:
+                raise ValueError("submit needs records or payload")
+            from .channel import encode_records
+
+            payload = encode_records(records)
+            n_rows = len(records)
+        if n_rows is None:
+            raise ValueError("payload submission needs n_rows")
+        batch = FleetBatch(payload=payload, n_rows=int(n_rows),
+                           tenant=tenant)
+        slept = _faults.inject_sleep("fleet.router_stall")
+        if slept:
+            with self._ctr_lock:
+                self.router_stalls += 1
+        try:
+            req = self.admission.admit(
+                batch,
+                None if deadline_ms is None else deadline_ms / 1e3,
+                tenant=tenant,
+            )
+        except TenantQuotaError:
+            with self._ctr_lock:
+                self.shed_quota += 1
+            raise
+        except QueueFullError:
+            with self._ctr_lock:
+                self.shed_queue_full += 1
+            raise
+        self._try_fast_dispatch()
+        return req
+
+    def score_batch(self, records: Sequence, timeout_s: float = 30.0,
+                    tenant: Optional[str] = None,
+                    deadline_ms: Optional[float] = None) -> list:
+        """Synchronous scoring through the fleet; element i aligns with
+        records[i] (the endpoint contract, preserved end to end)."""
+        req = self.submit(records=records, tenant=tenant,
+                          deadline_ms=deadline_ms)
+        res: FleetResult = req.wait(timeout_s)
+        return res.results
+
+    # -- dispatch -----------------------------------------------------------
+    def _try_fast_dispatch(self) -> None:
+        """Caller-thread fast path: when nothing waits ahead (no
+        failover retries) and a replica has capacity, take the queue
+        head and send it right here - two context switches cheaper per
+        request than waking the dispatcher thread, which remains the
+        slow path for the queued/backpressure case.  FIFO holds: only
+        the queue HEAD is taken, and only when the retry deque is
+        empty."""
+        with self._retry_lock:
+            if self._retry:
+                return
+        if self._pick(0) is None:
+            return  # every replica full: the dispatcher's park owns it
+        live, shed = self.admission.take(1)
+        for r in shed:
+            if not r.abandoned:
+                with self._ctr_lock:
+                    self.shed_deadline += 1
+        if not live:
+            return
+        req = live[0]
+        while not self._stop.is_set():
+            handle = self._pick(req.record.n_rows)
+            if handle is None:
+                # capacity vanished between the probe and the take
+                # (racing caller): hand the head back to the FRONT of
+                # the retry lane - the dispatcher drains it within one
+                # quantum, order preserved
+                with self._retry_lock:
+                    self._retry.appendleft(req)
+                return
+            done, _rid = self._send_to(handle, req)
+            if done:
+                return
+        # the router closed while we held a taken request: it is in no
+        # queue and no pending map, so close()'s drain cannot reach it
+        # - fail it here or its caller blocks out its full wait timeout
+        req.resolve(error=FleetError("router closed"))
+
+    def _next_request(self) -> Optional[_Request]:
+        """Failover retries first (they already waited once), then the
+        admission queue; returns None after a bounded idle quantum."""
+        with self._retry_lock:
+            if self._retry:
+                return self._retry.popleft()
+        if not self.admission.wait_nonempty(QUANTUM_S):
+            return None
+        live, shed = self.admission.take(1)
+        for req in shed:
+            if not req.abandoned:
+                with self._ctr_lock:
+                    self.shed_deadline += 1
+        return live[0] if live else None
+
+    def _pick(self, n_rows: int) -> Optional[ReplicaHandle]:
+        candidates = [
+            h for h in self.replicas()
+            if h.alive and not h.drained
+            and h.in_flight() < self.max_in_flight_per_replica
+        ]
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda h: h.expected_wait_s(n_rows,
+                                                   self.cost_model))
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req = self._next_request()
+                if req is None:
+                    continue
+                slept = _faults.inject_sleep("fleet.router_stall")
+                if slept:
+                    with self._ctr_lock:
+                        self.router_stalls += 1
+                self._dispatch_one(req)
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("fleet dispatch loop error")
+
+    def _dispatch_one(self, req: _Request) -> None:
+        """Assign one request to the least-loaded replica, waiting in
+        bounded quanta while every replica is at its in-flight cap; a
+        request whose deadline passes while waiting sheds, and a fleet
+        with no live replica fails it loudly."""
+        batch: FleetBatch = req.record  # type: ignore[assignment]
+        while not self._stop.is_set():
+            if req.deadline is not None and self.clock() > req.deadline:
+                if req.resolve_delivered(error=DeadlineExceededError(
+                        "deadline exceeded waiting for replica "
+                        "capacity")):
+                    with self._ctr_lock:
+                        self.shed_deadline += 1
+                return
+            # clear BEFORE picking: a response landing between the pick
+            # and the wait still wakes the next wait immediately
+            self._capacity.clear()
+            handle = self._pick(batch.n_rows)
+            if handle is not None:
+                done, _rid = self._send_to(handle, req)
+                if done:
+                    return
+                continue  # the picked replica died mid-send: repick
+            if not self.live_replicas():
+                req.resolve_delivered(error=FleetError(
+                    "no live replica to serve on"))
+                with self._ctr_lock:
+                    self.requests_failed += 1
+                return
+            # all replicas full: park until a response frees capacity,
+            # bounded at one quantum either way
+            self._capacity.wait(QUANTUM_S)
+        req.resolve_delivered(error=FleetError("router closed"))
+
+    def _send_to(self, handle: ReplicaHandle, req: _Request,
+                 op: int = OP_SCORE) -> tuple[bool, Optional[int]]:
+        """-> (owned_elsewhere_or_sent, rid).  ``True`` means the
+        caller must NOT touch ``req`` again: it was either sent (rid
+        returned, response pending) or - on a send failure that raced
+        the receiver's death handling - already harvested into the
+        retry lane by ``_on_replica_dead`` (rid None).  ``False`` means
+        the send failed and the caller still OWNS the request (exactly
+        one of the two failure paths keeps it: whoever popped the rid)
+        and may re-dispatch it inline."""
+        batch: FleetBatch = req.record  # type: ignore[assignment]
+        rid = next(self._req_ids)
+        if op == OP_SCORE:
+            meta = {"tenant": batch.tenant, "n_rows": batch.n_rows}
+        else:
+            meta = dict(batch.ctl)
+        with handle.lock:
+            if not handle.alive:
+                return False, None
+            if (op == OP_SCORE
+                    and len(handle.pending)
+                    >= self.max_in_flight_per_replica):
+                # the cap is enforced HERE, under the lock: _pick's
+                # unlocked probe can race concurrent fast-path
+                # submitters, and the per-replica in-flight bound is a
+                # promise, not a hint (control ops bypass it - a
+                # drained replica must still take its deploy).  The
+                # caller repicks; _pick's own locked read then sees the
+                # replica full.
+                return False, None
+            handle.pending[rid] = req
+            handle.in_flight_rows += batch.n_rows
+        # stash for the service-time EWMA (send->response wall)
+        req.record._sent_at = time.perf_counter()  # type: ignore
+        try:
+            handle.channel.send(op, rid, meta, batch.payload,
+                                timeout_s=self.send_timeout_s,
+                                stop=self._stop)
+        except (ChannelClosedError, ChannelTimeoutError) as e:
+            # ownership race with the receiver thread's death handling:
+            # if IT noticed the dead channel first, _on_replica_dead
+            # already popped our rid and queued the request into the
+            # retry lane - retrying here too would DOUBLE-dispatch (two
+            # survivors both scoring, the ledger counting one request
+            # twice).  Whoever pops the rid owns the retry.
+            with handle.lock:
+                popped = handle.pending.pop(rid, None)
+                if popped is not None:
+                    handle.in_flight_rows -= batch.n_rows
+            self._on_replica_dead(handle, f"send failed: {e}")
+            return (popped is None), None
+        return True, rid
+
+    # -- responses ----------------------------------------------------------
+    def _receive_loop(self, handle: ReplicaHandle) -> None:
+        while not self._stop.is_set() and handle.alive:
+            try:
+                msg = handle.channel.recv(stop=self._stop)
+            except ChannelClosedError as e:
+                self._on_replica_dead(handle, str(e))
+                return
+            if msg is None:
+                continue
+            op, rid, meta, payload = msg
+            with handle.lock:
+                req = handle.pending.pop(rid, None)
+                if req is not None:
+                    handle.in_flight_rows -= req.record.n_rows
+            self._capacity.set()  # a parked dispatcher can send again
+            if req is None:
+                continue  # unknown id: already failed over elsewhere
+            if op in (OP_RESULT, OP_CONTROL_RESULT):
+                self._resolve_ok(handle, req, meta, payload,
+                                 scored=op == OP_RESULT)
+            elif op == OP_ERROR:
+                if req.resolve_delivered(error=FleetWorkerError(
+                        str(meta.get("error", "worker error")))):
+                    with self._ctr_lock:
+                        self.requests_failed += 1
+                        self.rows_failed += req.record.n_rows
+
+    def _resolve_ok(self, handle: ReplicaHandle, req: _Request,
+                    meta: dict, payload: bytes, scored: bool) -> None:
+        batch: FleetBatch = req.record  # type: ignore[assignment]
+        meta = dict(meta, instance=handle.instance)
+        delivered = req.resolve_delivered(result=FleetResult(meta, payload))
+        if not scored:
+            return
+        n = int(meta.get("n_rows", batch.n_rows))
+        wall = time.perf_counter() - getattr(batch, "_sent_at",
+                                             time.perf_counter())
+        if n > 0 and wall > 0:
+            per_row = wall / n
+            handle.svc_s_ewma = (
+                per_row if handle.svc_s_ewma is None
+                else (1 - _SVC_ALPHA) * handle.svc_s_ewma
+                + _SVC_ALPHA * per_row
+            )
+            if self.cost_model is not None:
+                try:
+                    from ..autotune import candidate_features
+
+                    self.cost_model.observe(
+                        "serve.batch/" + handle.instance,
+                        candidate_features(n, 0), wall * 1e3)
+                except Exception as e:  # noqa: BLE001 - estimate only
+                    log.debug("cost-model observe failed: %s", e)
+        handle.last_version = meta.get("version")
+        handle.last_generation = meta.get("generation")
+        with handle.lock:
+            handle.rows_ok += n
+            handle.requests_ok += 1
+        gen_key = f"{meta.get('version')}/g{meta.get('generation')}"
+        with self._ctr_lock:
+            if delivered:
+                self.requests_ok += 1
+                self.rows_ok += n
+                self._rows_by_generation[gen_key] = (
+                    self._rows_by_generation.get(gen_key, 0) + n)
+
+    # -- failover -----------------------------------------------------------
+    def _on_replica_dead(self, handle: ReplicaHandle,
+                         reason: str) -> None:
+        with handle.lock:
+            if not handle.alive:
+                return
+            handle.alive = False
+            orphans = list(handle.pending.items())
+            handle.pending.clear()
+            handle.in_flight_rows = 0
+        handle.channel.close()
+        self._capacity.set()  # wake a parked dispatcher to re-plan
+        with self._ctr_lock:
+            self.replica_deaths += 1
+        log.warning("%s replica %s dead (%s): failing over %d in-flight "
+                    "request(s) to survivors", LOG_PREFIX,
+                    handle.instance, reason, len(orphans))
+        for _rid, req in orphans:
+            if req.done.is_set():
+                continue
+            if req.record.kind == "ctl":
+                # control ops are not idempotent-by-construction the way
+                # scoring is: surface the failure to the operator path
+                req.resolve_delivered(error=FleetError(
+                    f"replica {handle.instance} died during a control "
+                    f"operation ({reason})"))
+                continue
+            if req.record.retries >= MAX_FAILOVERS:
+                # a poison batch must not cascade replica to replica
+                if req.resolve_delivered(error=FleetError(
+                        f"request failed over {req.record.retries} "
+                        f"times (last replica {handle.instance}: "
+                        f"{reason}); refusing further retries")):
+                    with self._ctr_lock:
+                        self.requests_failed += 1
+                        self.rows_failed += req.record.n_rows
+                continue
+            req.record.retries += 1
+            with self._ctr_lock:
+                self.retries += 1
+            with self._retry_lock:
+                self._retry.append(req)
+
+    # -- control plane ------------------------------------------------------
+    def control(self, instance: str, cmd: str,
+                args: Optional[dict] = None,
+                timeout_s: float = 120.0) -> Any:
+        """One control round trip to a named replica (deploy / canary /
+        status / ...); bypasses admission and the drain flag - draining
+        a replica is exactly how a rolling deploy makes room to send it
+        control traffic."""
+        handle = self.handle(instance)
+        if not handle.alive:
+            raise FleetError(f"replica {instance!r} is not alive")
+        batch = FleetBatch(payload=b"", n_rows=0, kind="ctl",
+                           ctl=dict(args or {}, cmd=cmd))
+        req = _Request(record=batch, enqueued_at=self.clock())
+        sent, rid = self._send_to(handle, req, op=OP_CONTROL)
+        if not sent or rid is None:
+            raise FleetError(f"replica {instance!r} died mid-control")
+        try:
+            res: FleetResult = req.wait(timeout_s)
+        except RequestTimeoutError:
+            # reclaim the in-flight slot: a leaked pending entry would
+            # hold one max_in_flight slot forever and keep
+            # wait_drained() from ever seeing zero (a late reply finds
+            # the rid gone and is dropped)
+            with handle.lock:
+                handle.pending.pop(rid, None)
+            raise
+        return res.doc
+
+    def broadcast(self, cmd: str, args: Optional[dict] = None,
+                  timeout_s: float = 120.0) -> dict:
+        """The control op on every LIVE replica; per-instance results
+        (exceptions captured as ``{"error": ...}`` so one dead replica
+        cannot abort a fleet-wide rollback)."""
+        out = {}
+        for h in self.live_replicas():
+            try:
+                out[h.instance] = self.control(h.instance, cmd, args,
+                                               timeout_s)
+            except (FleetError, FleetWorkerError,
+                    RequestTimeoutError) as e:
+                out[h.instance] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def set_drained(self, instance: str, drained: bool = True) -> None:
+        self.handle(instance).drained = bool(drained)
+
+    def wait_drained(self, instance: str, timeout_s: float = 30.0) -> bool:
+        """True once the replica has zero in-flight requests (its
+        drained flag stops NEW dispatches; in-flight batches finish on
+        the old generation - the zero-drop half of a rolling deploy)."""
+        handle = self.handle(instance)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() <= deadline:
+            if handle.in_flight() == 0:
+                return True
+            time.sleep(QUANTUM_S)
+        return False
+
+    # -- observed load refresh ----------------------------------------------
+    def refresh_from_shards(self, metrics_docs: Sequence[dict]) -> int:
+        """Fold the fleet aggregation dir's per-replica serving stats
+        into the dispatch weights (ISSUE 14 satellite: the router reads
+        observed throughput/p99 from fleet shards).  ``metrics_docs``
+        is ``FleetAggregator.merged_metrics_docs()``; returns how many
+        handles were updated."""
+        from ..obs.fleet import serving_views
+
+        by_instance = {str(d.get("instance")): d for d in metrics_docs}
+        updated = 0
+        for h in self.replicas():
+            doc = by_instance.get(h.instance)
+            if doc is None:
+                continue
+            best: dict = {}
+            for _key, snap in serving_views(doc):
+                rps = snap.get("batch_rows_per_s") or 0
+                if rps >= best.get("batch_rows_per_s", 0):
+                    best = {
+                        "batch_rows_per_s": rps,
+                        "p99_ms": (snap.get("latency_ms") or {}).get(
+                            "p99"),
+                        "queue_depth_p99": (snap.get("queue_depth")
+                                            or {}).get("p99"),
+                        "rows_scored": snap.get("rows_scored"),
+                    }
+            if best:
+                h.obs = best
+                updated += 1
+        return updated
+
+    # -- reporting ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``fleet_router`` metrics view: fleet-level counters plus
+        per-replica dispatch state, scraped as ``tx_fleet_router_*``."""
+        with self._ctr_lock:
+            out = {
+                "rows_ok": self.rows_ok,
+                "rows_failed": self.rows_failed,
+                "requests_ok": self.requests_ok,
+                "requests_failed": self.requests_failed,
+                "shed_queue_full": self.shed_queue_full,
+                "shed_quota": self.shed_quota,
+                "shed_deadline": self.shed_deadline,
+                "retries": self.retries,
+                "replica_deaths": self.replica_deaths,
+                "router_stalls": self.router_stalls,
+                "rows_by_generation": dict(self._rows_by_generation),
+            }
+        out["queue_depth"] = len(self.admission)
+        out["tenants_held"] = {
+            str(k): v for k, v in self.admission.tenants_held().items()
+        }
+        out["replicas"] = {
+            h.instance: h.snapshot() for h in self.replicas()
+        }
+        return out
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop dispatching, fail everything still pending loudly, and
+        close every channel (all joins bounded)."""
+        self._stop.set()
+        self.admission.close()
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout_s)
+        for req in self.admission.drain():
+            req.resolve(error=FleetError("router closed"))
+        with self._retry_lock:
+            retry, self._retry = list(self._retry), deque()
+        for req in retry:
+            req.resolve(error=FleetError("router closed"))
+        for h in self.replicas():
+            with h.lock:
+                pending = list(h.pending.values())
+                h.pending.clear()
+                h.alive = False
+            for req in pending:
+                req.resolve(error=FleetError("router closed"))
+            h.channel.close()
+            if h.receiver is not None:
+                h.receiver.join(timeout_s)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

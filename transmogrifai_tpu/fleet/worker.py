@@ -1,0 +1,447 @@
+"""Replica serving worker: one supervised endpoint process of the fleet.
+
+Each replica (ISSUE 14) is its own process owning one
+:class:`~..registry.deployment.DeploymentController` over the shared
+model registry:
+
+* **warm-up is deserialize, not compile** - the replica loads the
+  registry-stable artifact, and the PR-12 AOT executable cache inside
+  it means an XLA-backed endpoint rehydrates compiled binaries instead
+  of re-tracing (``fused_backend`` rides the CLI);
+* **observability ships from birth** - the worker stamps its process
+  instance (``--instance`` -> the Prometheus ``instance`` label and the
+  obs shard filename) and runs a PR-9 :class:`~..obs.fleet.ObsShipper`
+  into the fleet aggregation dir, with per-replica ``fleet`` info
+  (version/generation, rows scored, in-flight) merged into every shard
+  - one scrape of the dir covers the whole fleet;
+* **lifecycle over the control channel** - the router sends
+  ``deploy`` / ``canary`` / ``promote_canary`` / ``rollback`` /
+  ``status`` / ``stop`` control messages; a deploy is the PR-5
+  zero-drop hot-swap (build+warm off-pointer, one pointer flip), run
+  while the router has the replica DRAINED so in-flight batches
+  finished on the old generation - the per-replica step of the
+  fleet-wide rolling deploy;
+* **bounded everything** - the serve loop runs on the channel's 50 ms
+  quanta (style-gated), beats the supervision heartbeat file between
+  messages, and a router that goes away (EOF) ends the worker cleanly.
+
+Fault point ``fleet.replica_kill`` (``inject_kill``) dies mid-serve
+exactly like a SIGKILL - the router's at-least-once failover and the
+controller's restart-with-backoff are drilled against it.
+
+Run as ``python -m transmogrifai_tpu.fleet.worker --registry-root R
+--workflow mod:fn --socket S --instance NAME [...]``.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from ..faults import injection as _faults
+from ..obs import set_process_instance
+from ..obs.fleet import ObsShipper
+from ..obs.metrics import metrics_registry
+from ..registry import DeploymentController, ModelRegistry, RollbackPolicy
+from ..workflow.supervisor import beat
+from . import channel as _ch
+from .channel import (
+    OP_CONTROL,
+    OP_CONTROL_RESULT,
+    OP_ERROR,
+    OP_RESULT,
+    OP_SCORE,
+    ChannelClosedError,
+    ChannelTimeoutError,
+    FleetChannel,
+    decode_records,
+    encode_results,
+)
+
+log = logging.getLogger("transmogrifai_tpu.fleet")
+
+#: how long a freshly-started worker waits for its router to connect
+#: before concluding it is orphaned (bounded in 50 ms accept quanta)
+DEFAULT_ACCEPT_TIMEOUT_S = 300.0
+
+#: heartbeat throttle: at most one beat per this interval
+_BEAT_EVERY_S = 0.25
+
+#: bound on any single response send: a router that stops DRAINING its
+#: socket (frozen process, GIL stall) while staying connected must not
+#: wedge the serve loop forever - the response is dropped (the router
+#: retries or fails the request on its side) and the loop lives on
+DEFAULT_SEND_TIMEOUT_S = 30.0
+
+
+def load_workflow_factory(spec: str):
+    """``module:function`` -> the zero-arg factory (the runner-CLI
+    convention); the factory may return a workflow or a tuple whose
+    first element is one."""
+    mod_name, _, fn_name = spec.partition(":")
+    if not mod_name or not fn_name:
+        raise ValueError(
+            f"workflow spec must be module:function, got {spec!r}")
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+class ReplicaWorker:
+    """One replica process: deployment controller + obs shipper behind
+    a bounded fleet channel (module docstring)."""
+
+    def __init__(
+        self,
+        registry_root: str,
+        workflow_spec: str,
+        socket_path: str,
+        instance: str,
+        version: Optional[str] = None,
+        fleet_dir: Optional[str] = None,
+        heartbeat_path: Optional[str] = None,
+        fleet_status_path: Optional[str] = None,
+        ship_interval_s: float = 0.5,
+        accept_timeout_s: float = DEFAULT_ACCEPT_TIMEOUT_S,
+        **endpoint_kw,
+    ) -> None:
+        self.registry_root = registry_root
+        self.workflow_spec = workflow_spec
+        self.socket_path = socket_path
+        self.instance = instance
+        self.version = version
+        self.fleet_dir = fleet_dir
+        self.heartbeat_path = heartbeat_path
+        self.fleet_status_path = fleet_status_path
+        self.ship_interval_s = float(ship_interval_s)
+        self.accept_timeout_s = float(accept_timeout_s)
+        self._endpoint_kw = dict(endpoint_kw)
+        self._factory = load_workflow_factory(workflow_spec)
+        self._stopping = False
+        self._in_flight_rows = 0
+        self.rows_scored = 0
+        self.batches = 0
+        self.started_at = time.monotonic()
+        self.controller: Optional[DeploymentController] = None
+        self.registry: Optional[ModelRegistry] = None
+        self._shipper: Optional[ObsShipper] = None
+
+    def _fresh_workflow(self):
+        built = self._factory()
+        return built[0] if isinstance(built, tuple) else built
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ReplicaWorker":
+        set_process_instance(self.instance)
+        self.registry = ModelRegistry(self.registry_root, create=False)
+        self.controller = DeploymentController(
+            registry=self.registry, policy=RollbackPolicy(),
+            **self._endpoint_kw)
+        if self.fleet_status_path:
+            # satellite: the deploy summary's `fleet` view reads the
+            # controller-published one-document fleet status instead of
+            # re-reading N obs shards
+            self.controller.fleet_status_source = self.fleet_status_path
+        version = self.version or self.registry.stable
+        if version is None:
+            raise RuntimeError(
+                f"registry at {self.registry_root} has no stable version "
+                "to serve")
+        self.controller.deploy_version(version, self._fresh_workflow())
+        metrics_registry().register_view("fleet_replica", self)
+        if self.fleet_dir:
+            self._shipper = ObsShipper(
+                self.fleet_dir, interval_s=self.ship_interval_s,
+                instance=self.instance,
+                extra_fn=lambda: {"fleet": self.replica_info()},
+            ).start()
+        return self
+
+    def replica_info(self) -> dict:
+        gen = self.controller.stable_generation if self.controller \
+            else None
+        can = self.controller.canary_generation if self.controller \
+            else None
+        return {
+            "instance": self.instance,
+            "pid": os.getpid(),
+            "version": gen.version if gen else None,
+            "generation": gen.generation if gen else None,
+            "canary_version": can.version if can else None,
+            "canary_generation": can.generation if can else None,
+            "rows_scored": self.rows_scored,
+            "batches": self.batches,
+            "in_flight_rows": self._in_flight_rows,
+            "uptime_s": round(time.monotonic() - self.started_at, 3),
+        }
+
+    def snapshot(self) -> dict:
+        """Metrics-view shape (kind ``fleet_replica``) so per-replica
+        serving state rides the ordinary scrape."""
+        return self.replica_info()
+
+    def _beat(self, last: float) -> float:
+        now = time.monotonic()
+        if self.heartbeat_path and now - last >= _BEAT_EVERY_S:
+            beat(self.heartbeat_path)
+            return now
+        return last
+
+    def _send(self, chan: FleetChannel, op: int, rid: int, meta: dict,
+              payload: bytes = b"") -> bool:
+        """Every worker->router send is BOUNDED (the channel contract:
+        a wedged peer must never block the serve loop forever).  A
+        timed-out send drops the response - the router's failover/
+        timeout machinery owns the request from there - and the worker
+        keeps serving (and beating) instead of being stale-killed for
+        the ROUTER's stall."""
+        try:
+            chan.send(op, rid, meta, payload,
+                      timeout_s=DEFAULT_SEND_TIMEOUT_S)
+            return True
+        except ChannelTimeoutError as e:
+            log.warning("replica %s: response %d dropped (router not "
+                        "draining: %s)", self.instance, rid, e)
+            return False
+
+    # -- serving ------------------------------------------------------------
+    def serve_forever(self) -> None:
+        """Accept the router, then serve until it disconnects or sends
+        ``stop``.  An orphaned worker (no router within
+        ``accept_timeout_s``) exits on its own."""
+        lsock = _ch.listen(self.socket_path)
+        try:
+            chan = _ch.accept(lsock, timeout_s=self.accept_timeout_s)
+            if chan is None:
+                log.warning("no router connected to %s within %.0fs; "
+                            "exiting", self.socket_path,
+                            self.accept_timeout_s)
+                return
+            self._serve_channel(chan)
+        finally:
+            try:
+                lsock.close()
+                os.unlink(self.socket_path)
+            except OSError:
+                pass  # socket file already gone
+            if self._shipper is not None:
+                self._shipper.stop()
+
+    def _serve_channel(self, chan: FleetChannel) -> None:
+        """Single-threaded serve loop: decode -> score -> encode in
+        order on the one scoring lane.  (A three-stage threaded
+        pipeline was tried and measured SLOWER - the codec stages are
+        GIL-bound, so splitting them onto threads only added switch
+        overhead against the scoring thread's GIL hold.)"""
+        last_beat = 0.0
+        while not self._stopping:
+            last_beat = self._beat(last_beat)
+            try:
+                # idle_return: one 50 ms quantum with no traffic hands
+                # control back so the loop can beat its heartbeat
+                msg = chan.recv(idle_return=True)
+            except ChannelClosedError:
+                log.info("router disconnected; replica %s exiting",
+                         self.instance)
+                return
+            if msg is None:
+                continue
+            op, rid, meta, payload = msg
+            if op == OP_SCORE:
+                self._handle_score(chan, rid, payload)
+            elif op == OP_CONTROL:
+                self._handle_control(chan, rid, meta)
+
+    def _handle_score(self, chan: FleetChannel, rid: int,
+                      payload) -> None:
+        try:
+            records = decode_records(payload)
+        except Exception as e:  # noqa: BLE001 - poison payload isolation
+            self._send(chan, OP_ERROR, rid,
+                       {"error": f"undecodable batch: "
+                                 f"{type(e).__name__}: {e}"})
+            return
+        # the SIGKILL drill: dies here exactly like a preemption landing
+        # mid-serve - the request is accepted but unanswered, and the
+        # router must retry it on survivors
+        _faults.inject_kill("fleet.replica_kill")
+        self._in_flight_rows = len(records)
+        try:
+            results, info = self.controller.score_batch_with_info(records)
+        except Exception as e:  # noqa: BLE001 - per-request isolation
+            self._send(chan, OP_ERROR, rid,
+                       {"error": f"{type(e).__name__}: {e}"})
+            return
+        finally:
+            self._in_flight_rows = 0
+        self.rows_scored += len(results)
+        self.batches += 1
+        out_meta = {
+            "n_rows": len(results),
+            "version": info.get("stable_version"),
+            "generation": info.get("stable_generation"),
+            "canary_rows": info.get("canary_rows", 0),
+            "canary_version": info.get("canary_version"),
+        }
+        self._send(chan, OP_RESULT, rid, out_meta,
+                   encode_results(results))
+
+    # -- control ------------------------------------------------------------
+    def _handle_control(self, chan: FleetChannel, rid: int,
+                        meta: dict) -> None:
+        cmd = str(meta.get("cmd", ""))
+        # a deploy/canary control blocks this lane for a whole model
+        # load + endpoint build + warm (budgeted up to the router's
+        # ctl timeout - minutes), so a side thread keeps the
+        # supervision heartbeat alive: the controller's staleness rule
+        # must not kill a replica for doing exactly what it was asked.
+        # SCORING deliberately gets no such keeper - a wedged endpoint
+        # stopping the beat is the liveness signal working.
+        stop_beats = threading.Event()
+        keeper = None
+        if self.heartbeat_path:
+            def _keep_beating() -> None:
+                while not stop_beats.wait(0.25):
+                    beat(self.heartbeat_path)
+            keeper = threading.Thread(target=_keep_beating,
+                                      name="tx-fleet-ctl-beats",
+                                      daemon=True)
+            keeper.start()
+        try:
+            doc = self._control(cmd, meta)
+        except Exception as e:  # noqa: BLE001 - operator path isolation
+            self._send(chan, OP_ERROR, rid,
+                       {"error": f"{type(e).__name__}: {e}",
+                        "cmd": cmd})
+            return
+        finally:
+            stop_beats.set()
+            if keeper is not None:
+                keeper.join(timeout=2.0)
+        self._send(chan, OP_CONTROL_RESULT, rid, {"cmd": cmd},
+                   encode_results([doc]))
+
+    def _control(self, cmd: str, meta: dict) -> dict:
+        ctl = self.controller
+        if cmd == "ping":
+            return {"ok": True, "instance": self.instance,
+                    "pid": os.getpid()}
+        if cmd == "status":
+            return dict(self.replica_info(),
+                        events=len(ctl.events()),
+                        telemetry=self._stable_telemetry())
+        if cmd == "deploy":
+            gen = ctl.deploy_version(str(meta["version"]),
+                                     self._fresh_workflow())
+            self._ship_soon()
+            return {"ok": True, "version": gen.version,
+                    "generation": gen.generation}
+        if cmd == "canary":
+            gen = ctl.start_canary_version(
+                str(meta["version"]), self._fresh_workflow(),
+                fraction=meta.get("fraction"),
+                shadow=meta.get("shadow"),
+            )
+            self._ship_soon()
+            return {"ok": True, "version": gen.version,
+                    "generation": gen.generation}
+        if cmd == "promote_canary":
+            gen = ctl.promote_canary()
+            self._ship_soon()
+            return {"ok": True, "version": gen.version,
+                    "generation": gen.generation}
+        if cmd == "rollback":
+            event = ctl.rollback_canary(
+                reason=str(meta.get("reason", "fleet")))
+            self._ship_soon()
+            return {"ok": True, "rolled_back": event is not None,
+                    "event": event}
+        if cmd == "check_canary":
+            decision = ctl.check_canary()
+            return {"ok": True,
+                    "decision": decision.to_json() if decision else None}
+        if cmd == "stop":
+            self._stopping = True
+            return {"ok": True, "stopping": True}
+        raise ValueError(f"unknown fleet control command {cmd!r}")
+
+    def _stable_telemetry(self) -> Optional[dict]:
+        gen = self.controller.stable_generation
+        if gen is None:
+            return None
+        snap = gen.endpoint.telemetry.snapshot()
+        return {
+            "rows_scored": snap["rows_scored"],
+            "rows_failed": snap["rows_failed"],
+            "latency_ms": snap["latency_ms"],
+            "breaker": snap["breaker"],
+        }
+
+    def _ship_soon(self) -> None:
+        """Ship the plane right after a lifecycle change so the
+        aggregation dir reflects the new generation within one beat."""
+        if self._shipper is not None:
+            self._shipper._ship_once()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="transmogrifai_tpu fleet replica worker")
+    p.add_argument("--registry-root", required=True)
+    p.add_argument("--workflow", required=True,
+                   help="module:function workflow factory")
+    p.add_argument("--socket", required=True,
+                   help="AF_UNIX socket path to serve on")
+    p.add_argument("--instance", required=True,
+                   help="replica instance name (obs shard + labels)")
+    p.add_argument("--version", default=None,
+                   help="registry version to serve (default: stable)")
+    p.add_argument("--fleet-dir", default=None,
+                   help="obs aggregation dir to ship shards into")
+    p.add_argument("--heartbeat", default=None,
+                   help="supervision heartbeat file to beat")
+    p.add_argument("--fleet-status-path", default=None,
+                   help="controller-published fleet_status.json (the "
+                        "deploy summary's one-document fleet view)")
+    p.add_argument("--ship-interval-s", type=float, default=0.5)
+    p.add_argument("--accept-timeout-s", type=float,
+                   default=DEFAULT_ACCEPT_TIMEOUT_S)
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated serving shape buckets")
+    p.add_argument("--drift-policy", default="warn",
+                   choices=("raise", "warn", "shed"))
+    p.add_argument("--fused-backend", default=None,
+                   choices=("auto", "numpy", "xla"))
+    p.add_argument("--canary-fraction", type=float, default=0.05)
+    args = p.parse_args(argv)
+    endpoint_kw: dict = {
+        "drift_policy": args.drift_policy,
+        "canary_fraction": args.canary_fraction,
+    }
+    if args.buckets:
+        endpoint_kw["batch_buckets"] = tuple(
+            int(b) for b in args.buckets.split(","))
+    if args.fused_backend:
+        endpoint_kw["fused_backend"] = args.fused_backend
+    worker = ReplicaWorker(
+        registry_root=args.registry_root,
+        workflow_spec=args.workflow,
+        socket_path=args.socket,
+        instance=args.instance,
+        version=args.version,
+        fleet_dir=args.fleet_dir,
+        heartbeat_path=args.heartbeat,
+        fleet_status_path=args.fleet_status_path,
+        ship_interval_s=args.ship_interval_s,
+        accept_timeout_s=args.accept_timeout_s,
+        **endpoint_kw,
+    )
+    worker.start()
+    worker.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,6 +65,7 @@ __all__ = [
     "child_env",
     "read_json_torn_safe",
     "read_jsonl_tolerant",
+    "serving_views",
     "ship_now",
 ]
 
@@ -147,6 +148,18 @@ def read_jsonl_tolerant(path: str) -> tuple[list[dict], int]:
     return records, skipped
 
 
+def serving_views(metrics_doc: dict):
+    """``(view_key, snapshot)`` pairs for every ServingTelemetry view
+    registered in one metrics document - THE shared filter for every
+    fleet consumer that walks shard serving state (router dispatch
+    weights, fleet canary snapshots, ``tx fleet status``,
+    ``tx autotune report`` over an aggregation dir), so the view-key
+    scheme has one reader, not four copies."""
+    for key, snap in (metrics_doc.get("views") or {}).items():
+        if key.partition("/")[0] == "serving" and isinstance(snap, dict):
+            yield key, snap
+
+
 # ---------------------------------------------------------------------------
 # shipping (per-process -> aggregation dir)
 # ---------------------------------------------------------------------------
@@ -203,18 +216,30 @@ class ObsShipper:
     discipline - a shipper must never be the thing that wedges exit)."""
 
     def __init__(self, agg_dir: str, interval_s: float = 1.0,
-                 instance: Optional[str] = None) -> None:
+                 instance: Optional[str] = None,
+                 extra_fn=None) -> None:
         self.agg_dir = agg_dir
         self.interval_s = max(0.01, float(interval_s))
         self.instance = instance or process_instance()
+        #: zero-arg callable whose dict is merged into every shipped
+        #: shard (ISSUE 14: a fleet replica stamps its per-replica
+        #: ``fleet`` info - generation, rows scored, in-flight - so the
+        #: aggregation dir carries replica state, not just metrics)
+        self.extra_fn = extra_fn
         self.ships_ok = 0
         self.ships_failed = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def _ship_once(self) -> None:
+        extra = None
+        if self.extra_fn is not None:
+            try:
+                extra = dict(self.extra_fn())
+            except Exception as e:  # noqa: BLE001 - shipping stays up
+                log.warning("obs shipper: extra_fn failed: %s", e)
         try:
-            ship_now(self.agg_dir, instance=self.instance)
+            ship_now(self.agg_dir, instance=self.instance, extra=extra)
             self.ships_ok += 1
         except OSError as e:
             self.ships_failed += 1

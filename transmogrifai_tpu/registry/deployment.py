@@ -141,6 +141,13 @@ class DeploymentController:
             "rows": 0, "rows_differed": 0,
             "max_abs_delta": 0.0, "sum_abs_delta": 0.0,
         }
+        #: fleet view source (ISSUE 14 satellite): a zero-arg callable
+        #: returning the fleet status document, or a path to the fleet
+        #: controller's atomically-published ``fleet_status.json`` -
+        #: ``summary_json()`` then carries per-replica generation /
+        #: heartbeat age / in-flight in ONE consistent document instead
+        #: of every consumer re-reading N obs shards
+        self.fleet_status_source: Optional[Any] = None
 
     # -- lifecycle ----------------------------------------------------------
     def _event(self, event: str, **kw: Any) -> dict:
@@ -594,6 +601,20 @@ class DeploymentController:
                 out["slo"] = eng.report()
             except Exception as e:  # noqa: BLE001 - summary only
                 log.warning("deploy summary: SLO report failed: %s", e)
+        src = self.fleet_status_source
+        if src is not None:
+            # the fleet view (ISSUE 14): per-replica generation, last
+            # heartbeat age, in-flight - one consistent document, read
+            # torn-safe (the publisher may be replacing it right now)
+            try:
+                if callable(src):
+                    out["fleet"] = src()
+                else:
+                    from ..obs.fleet import read_json_torn_safe
+
+                    out["fleet"] = read_json_torn_safe(str(src))
+            except Exception as e:  # noqa: BLE001 - summary only
+                log.warning("deploy summary: fleet view failed: %s", e)
         return out
 
     def export(self, path: str, extra: Optional[dict] = None) -> dict:

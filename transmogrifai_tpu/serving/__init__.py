@@ -26,6 +26,7 @@ from .admission import (
     DeadlineExceededError,
     QueueFullError,
     RequestTimeoutError,
+    TenantQuotaError,
 )
 from .endpoint import (
     CompiledEndpoint,
@@ -48,6 +49,7 @@ __all__ = [
     "RowScoringError",
     "SchemaDriftError",
     "ServingTelemetry",
+    "TenantQuotaError",
     "compile_endpoint",
     "records_from_dataset",
 ]

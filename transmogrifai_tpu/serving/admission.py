@@ -25,6 +25,7 @@ deadline-aware shedding at dequeue time).
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -34,6 +35,25 @@ from typing import Any, Mapping, Optional
 
 class QueueFullError(RuntimeError):
     """Serving queue at capacity - request rejected at submission."""
+
+
+class TenantQuotaError(QueueFullError):
+    """One tenant's share of the bounded queue is exhausted - ITS
+    request is rejected while other tenants keep admitting (ISSUE 14:
+    a single chatty tenant must not be able to convert the shared
+    bounded queue into a private one and starve the rest of the
+    fleet's traffic).  Subclasses QueueFullError so existing
+    shed-at-the-front-door handling still catches it; callers that
+    care about the distinction catch this first."""
+
+    def __init__(self, tenant: str, held: int, limit: int) -> None:
+        super().__init__(
+            f"tenant {tenant!r} holds {held}/{limit} queue slots "
+            f"(per-tenant quota)"
+        )
+        self.tenant = tenant
+        self.held = held
+        self.limit = limit
 
 
 class DeadlineExceededError(TimeoutError):
@@ -211,6 +231,9 @@ class _Request:
     record: Mapping[str, Any]
     enqueued_at: float
     deadline: Optional[float] = None  # absolute monotonic time, or None
+    #: tenant attribution for per-tenant quota accounting (None = the
+    #: anonymous shared pool); released back at take()/drain() time
+    tenant: Optional[str] = None
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
@@ -261,17 +284,34 @@ class AdmissionController:
     ``admit`` is the producer side (request threads); ``take`` the consumer
     side (the scheduler's batch loop).  Expired requests are resolved with
     DeadlineExceededError at take() time and never reach the endpoint.
+
+    ``tenant_quota`` (ISSUE 14) bounds any single tenant's share of the
+    queue: a tenant may hold at most ``ceil(tenant_quota * max_queue)``
+    queued slots, beyond which ITS submissions raise
+    :class:`TenantQuotaError` while other tenants keep admitting.
+    Requests with no tenant share one anonymous pool under the same
+    rule.  ``None`` (the default) disables quota accounting entirely -
+    the single-tenant hot path pays nothing.
     """
 
     def __init__(self, max_queue: int = 1024,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic,
+                 tenant_quota: Optional[float] = None) -> None:
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if tenant_quota is not None and not (0.0 < tenant_quota <= 1.0):
+            raise ValueError("tenant_quota must be in (0, 1]")
         self.max_queue = int(max_queue)
+        self.tenant_quota = tenant_quota
+        self.tenant_limit = (
+            None if tenant_quota is None
+            else max(1, math.ceil(tenant_quota * self.max_queue))
+        )
         self.clock = clock
         self._lock = threading.Lock()
         self.not_empty = threading.Condition(self._lock)
         self._queue: deque[_Request] = deque()
+        self._tenant_held: dict[Optional[str], int] = {}
         self._closed = False
 
     def __len__(self) -> int:
@@ -279,13 +319,17 @@ class AdmissionController:
             return len(self._queue)
 
     def admit(self, record: Mapping[str, Any],
-              deadline_s: Optional[float] = None) -> _Request:
-        """Enqueue or raise QueueFullError.  ``deadline_s`` is relative to
-        now; the request is shed (not scored) if still queued past it."""
+              deadline_s: Optional[float] = None,
+              tenant: Optional[str] = None) -> _Request:
+        """Enqueue or raise QueueFullError (TenantQuotaError when the
+        per-tenant share is the bound that tripped).  ``deadline_s`` is
+        relative to now; the request is shed (not scored) if still
+        queued past it."""
         now = self.clock()
         req = _Request(
             record=record, enqueued_at=now,
             deadline=None if deadline_s is None else now + deadline_s,
+            tenant=tenant,
         )
         with self.not_empty:
             if self._closed:
@@ -297,9 +341,27 @@ class AdmissionController:
                 raise QueueFullError(
                     f"serving queue full ({self.max_queue} pending)"
                 )
+            if self.tenant_limit is not None:
+                held = self._tenant_held.get(tenant, 0)
+                if held >= self.tenant_limit:
+                    raise TenantQuotaError(
+                        str(tenant), held, self.tenant_limit)
+                self._tenant_held[tenant] = held + 1
             self._queue.append(req)
             self.not_empty.notify()
         return req
+
+    def _release_tenant(self, req: _Request) -> None:
+        """Lock held: give the request's queue slot back to its
+        tenant's quota (dequeue time - quotas bound QUEUED work, the
+        in-flight share belongs to the consumer's own bounds)."""
+        if self.tenant_limit is None:
+            return
+        held = self._tenant_held.get(req.tenant, 0)
+        if held <= 1:
+            self._tenant_held.pop(req.tenant, None)
+        else:
+            self._tenant_held[req.tenant] = held - 1
 
     def take(self, max_n: int) -> tuple[list[_Request], list[_Request]]:
         """Dequeue up to ``max_n`` live requests -> (live, shed).  Shed
@@ -310,6 +372,7 @@ class AdmissionController:
         with self._lock:
             while self._queue and len(live) < max_n:
                 req = self._queue.popleft()
+                self._release_tenant(req)
                 if req.deadline is not None and now > req.deadline:
                     shed.append(req)
                 else:
@@ -347,4 +410,11 @@ class AdmissionController:
         """Remove and return everything pending (shutdown path)."""
         with self._lock:
             out, self._queue = list(self._queue), deque()
+            self._tenant_held.clear()
         return out
+
+    def tenants_held(self) -> dict:
+        """Per-tenant queued-slot counts (observability; the quota
+        evidence ``tx fleet status`` surfaces)."""
+        with self._lock:
+            return dict(self._tenant_held)

@@ -32,6 +32,7 @@ from .admission import (
     BreakerOpenError,
     QueueFullError,
     RequestTimeoutError,
+    TenantQuotaError,
     _Request,
 )
 from .endpoint import CompiledEndpoint, RowScoringError
@@ -51,6 +52,7 @@ class MicroBatchScheduler:
         telemetry: Optional[ServingTelemetry] = None,
         clock=time.monotonic,
         start: bool = True,
+        tenant_quota: Optional[float] = None,
     ) -> None:
         self.endpoint = endpoint
         self.max_batch_size = int(
@@ -66,7 +68,8 @@ class MicroBatchScheduler:
             telemetry if telemetry is not None else endpoint.telemetry
         )
         self.clock = clock
-        self.admission = AdmissionController(max_queue=max_queue, clock=clock)
+        self.admission = AdmissionController(max_queue=max_queue, clock=clock,
+                                             tenant_quota=tenant_quota)
         self._closed = False
         self._worker: Optional[threading.Thread] = None
         if start:
@@ -106,12 +109,15 @@ class MicroBatchScheduler:
     # -- request side -------------------------------------------------------
     def submit(self, record: Mapping[str, Any],
                deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
                _count_shed: bool = True) -> _Request:
         """Enqueue one score request; returns a future-like handle
         (``.wait(timeout)``).  Raises QueueFullError when the bounded
-        queue sheds at the front door.  ``_count_shed=False`` lets the
-        backpressuring stream retry without inflating the shed counter
-        for rows that are ultimately admitted."""
+        queue sheds at the front door (TenantQuotaError - counted as
+        ``shed_quota`` - when ``tenant``'s own share is what tripped).
+        ``_count_shed=False`` lets the backpressuring stream retry
+        without inflating the shed counter for rows that are ultimately
+        admitted."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
         if deadline_ms is None:
@@ -120,7 +126,15 @@ class MicroBatchScheduler:
             return self.admission.admit(
                 record,
                 None if deadline_ms is None else deadline_ms / 1e3,
+                tenant=tenant,
             )
+        except TenantQuotaError:
+            # a quota trip is ALWAYS counted (even on the stream's
+            # retry path): the whole-queue-full retry is expected to
+            # eventually admit, but a tenant at its own cap retrying is
+            # exactly the starvation signal the counter exists for
+            self.telemetry.record_request(0.0, "shed_quota")
+            raise
         except QueueFullError:
             if _count_shed:
                 self.telemetry.record_request(0.0, "shed_queue_full")
@@ -128,9 +142,10 @@ class MicroBatchScheduler:
 
     def score(self, record: Mapping[str, Any],
               timeout_s: Optional[float] = 30.0,
-              deadline_ms: Optional[float] = None) -> Any:
+              deadline_ms: Optional[float] = None,
+              tenant: Optional[str] = None) -> Any:
         """Synchronous request/response call through the batcher."""
-        req = self.submit(record, deadline_ms=deadline_ms)
+        req = self.submit(record, deadline_ms=deadline_ms, tenant=tenant)
         try:
             return req.wait(timeout_s)
         except RequestTimeoutError:

@@ -86,6 +86,7 @@ class ServingTelemetry:
         }
         self.shed_deadline = 0
         self.shed_queue_full = 0
+        self.shed_quota = 0
         self.request_timeouts = 0
         self.batches = 0
         self.batch_wall_s = 0.0
@@ -122,7 +123,7 @@ class ServingTelemetry:
 
     def record_request(self, latency_s: float, outcome: str = "ok") -> None:
         """Outcomes: ok | failed | shed_deadline | shed_queue_full |
-        shed_breaker | shed_schema | timeout."""
+        shed_quota | shed_breaker | shed_schema | timeout."""
         with self._lock:
             if outcome in ("ok", "failed"):
                 self._sample(self._latencies_s, float(latency_s))
@@ -134,6 +135,8 @@ class ServingTelemetry:
                 self.shed_deadline += 1
             elif outcome == "shed_queue_full":
                 self.shed_queue_full += 1
+            elif outcome == "shed_quota":
+                self.shed_quota += 1
             elif outcome == "shed_breaker":
                 self.shed_breaker += 1
             elif outcome == "shed_schema":
@@ -334,6 +337,7 @@ class ServingTelemetry:
                 "rows_fallback": self.rows_fallback,
                 "shed_deadline": self.shed_deadline,
                 "shed_queue_full": self.shed_queue_full,
+                "shed_quota": self.shed_quota,
                 "shed_breaker": self.shed_breaker,
                 "request_timeouts": self.request_timeouts,
                 "breaker": {
@@ -416,7 +420,7 @@ class ServingTelemetry:
             "p95_ms": lat["p95"],
             "p99_ms": lat["p99"],
             "shed": (snap["shed_deadline"] + snap["shed_queue_full"]
-                     + snap["shed_breaker"]),
+                     + snap["shed_quota"] + snap["shed_breaker"]),
             "fallback": snap["rows_fallback"],
             "breaker_opens": snap["breaker"]["opens"],
         }

@@ -65,6 +65,54 @@ def corrupted_csv_drill(dirpath: str, n_rows: int = 500,
     return path, [y, a, c], truth
 
 
+def serving_fleet_workflow(n: int = 891, seed: int = 7):
+    """-> (workflow, records): the serving-bench synthetic mixed-type
+    pipeline (picklists + reals + integrals through transmogrify ->
+    sanity check -> LR) - the fleet workload.  IMPORTABLE as
+    ``transmogrifai_tpu.testkit.drills:serving_fleet_workflow`` so
+    replica worker processes can rebuild the workflow a registry
+    artifact was trained under (``bench.py --fleet`` + tests/
+    test_fleet.py share it; deterministic for a fixed seed)."""
+    import numpy as np
+
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from .. import FeatureBuilder, OpWorkflow
+    from ..models.logistic_regression import OpLogisticRegression
+    from ..ops.transmogrifier import transmogrify
+    from ..types import feature_types as ft
+
+    rng = np.random.RandomState(seed)
+    cabins = ["A1", "B2", "C3", "D4", None]
+    data = {
+        "label": (rng.rand(n) > 0.6).astype(float).tolist(),
+        "klass": [str(rng.randint(1, 4)) for _ in range(n)],
+        "sex": [("male", "female")[rng.randint(2)] for _ in range(n)],
+        "age": [float(a) if rng.rand() > 0.2 else None
+                for a in rng.uniform(1, 80, n)],
+        "fare": rng.uniform(5, 500, n).round(2).tolist(),
+        "sibs": rng.randint(0, 5, n).astype(float).tolist(),
+        "cabin": [cabins[rng.randint(len(cabins))] for _ in range(n)],
+    }
+    label = FeatureBuilder(ft.RealNN, "label").as_response()
+    klass = FeatureBuilder(ft.PickList, "klass").as_predictor()
+    sex = FeatureBuilder(ft.PickList, "sex").as_predictor()
+    age = FeatureBuilder(ft.Real, "age").as_predictor()
+    fare = FeatureBuilder(ft.Real, "fare").as_predictor()
+    sibs = FeatureBuilder(ft.Integral, "sibs").as_predictor()
+    cabin = FeatureBuilder(ft.PickList, "cabin").as_predictor()
+    vec = transmogrify(
+        [klass, sex, age.fill_missing_with_mean().z_normalize(), fare,
+         sibs, cabin]
+    )
+    checked = label.sanity_check(vec, remove_bad_features=True)
+    pred = OpLogisticRegression(reg_param=0.01).set_input(
+        label, checked).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    feature_names = ("klass", "sex", "age", "fare", "sibs", "cabin")
+    records = [{k: data[k][i] for k in feature_names} for i in range(n)]
+    return wf, records
+
+
 def drill_env() -> dict:
     """Child-process env for supervision/crash drills: CPU backend, no
     inherited fault plan (TX_FAULTS would re-arm in the child), no axon

@@ -22,6 +22,13 @@ OpWorkflowRunner.scala:296-365, OpApp.scala:49-209): run types
                    signal-driven automatic rollback, and export the
                    deployment summary (generations, lifecycle events,
                    rollback evidence) as JSON
+* fleet          - scale-out serving (fleet/; ISSUE 14): bring up N
+                   supervised replica worker processes over the
+                   registry behind the least-loaded FleetRouter, pump
+                   the reader's rows through the fleet as concurrent
+                   batches, optionally rolling-hot-swap to a second
+                   version mid-traffic, and export the fleet status +
+                   router counters as JSON
 
 plus a CLI (``python -m transmogrifai_tpu.workflow.runner --run-type ...``)
 standing in for OpApp.main's scopt parsing.
@@ -115,6 +122,8 @@ class OpWorkflowRunner:
                     result = self._serve(params)
                 elif run_type == "deploy":
                     result = self._deploy(params)
+                elif run_type == "fleet":
+                    result = self._fleet(params)
                 else:
                     raise ValueError(f"unknown run type {run_type!r}")
         finally:
@@ -574,6 +583,155 @@ class OpWorkflowRunner:
                 json.dump(rows, f, default=str)
         return OpWorkflowRunnerResult(run_type="deploy", metrics=metrics)
 
+    def _fleet(self, params: OpParams) -> OpWorkflowRunnerResult:
+        """Scale-out fleet serving run (ISSUE 14).  Knobs ride
+        OpParams.custom_params: ``registry_root`` (required),
+        ``fleet_workflow`` (required ``module:function`` factory the
+        replica workers rebuild the workflow from - the same spec the
+        runner CLI's ``--workflow`` takes), ``fleet_replicas``
+        (default 2), ``fleet_dir`` (obs aggregation dir; default under
+        the fleet work dir), ``fleet_work_dir``, ``registry_publish``
+        (publish model_location as a new version; default: only when
+        the registry has no stable), ``fleet_deploy_version`` (rolling
+        hot-swap to this version mid-traffic), ``fleet_batch_rows``
+        (rows per routed batch, default 512), ``fleet_concurrency``
+        (client pump threads, default 4), ``fleet_tenant_quota``,
+        ``fleet_max_in_flight``, plus the worker serve knobs
+        ``serving_buckets`` / ``serving_drift_policy`` /
+        ``serving_fused_backend``.  Exports the one-document fleet
+        status + router counters to
+        ``<metrics_location>/fleet_metrics.json``."""
+        from ..fleet import FleetController
+        from ..registry import ModelRegistry
+        from ..serving import records_from_dataset
+
+        cp = params.custom_params
+        root = cp.get("registry_root")
+        spec = cp.get("fleet_workflow")
+        if not root or not spec:
+            raise ValueError(
+                "fleet run requires custom_params['registry_root'] and "
+                "['fleet_workflow'] (module:function)"
+            )
+        registry = ModelRegistry(root)
+        published = None
+        if params.model_location and cp.get(
+                "registry_publish", registry.stable is None):
+            model = self._load_model(params)
+            published = registry.publish(model)
+            if registry.stable is None:
+                registry.promote(published.version, to="stable")
+        worker_args = []
+        if cp.get("serving_buckets"):
+            worker_args += ["--buckets", ",".join(
+                str(b) for b in cp["serving_buckets"])]
+        if cp.get("serving_drift_policy"):
+            worker_args += ["--drift-policy",
+                            str(cp["serving_drift_policy"])]
+        if cp.get("serving_fused_backend"):
+            worker_args += ["--fused-backend",
+                            str(cp["serving_fused_backend"])]
+        router_kw = {
+            "max_in_flight_per_replica": int(
+                cp.get("fleet_max_in_flight", 4)),
+            "max_queue": int(cp.get("fleet_max_queue", 256)),
+        }
+        if cp.get("fleet_tenant_quota") is not None:
+            router_kw["tenant_quota"] = float(cp["fleet_tenant_quota"])
+        reader = self._reader("score")
+        if reader is not None:
+            raw = reader.generate_dataset(self.workflow.raw_features,
+                                          params.reader_params)
+        else:
+            raw = self.workflow.generate_raw_data()
+        records = records_from_dataset(
+            raw, [f for f in self.workflow.raw_features
+                  if not f.is_response])
+        step = max(int(cp.get("fleet_batch_rows", 512)), 1)
+        batches = [records[lo:lo + step]
+                   for lo in range(0, len(records), step)]
+        controller = FleetController(
+            root, str(spec),
+            n_replicas=int(cp.get("fleet_replicas", 2)),
+            work_dir=cp.get("fleet_work_dir"),
+            fleet_dir=cp.get("fleet_dir") or os.environ.get(
+                "TX_OBS_FLEET_DIR"),
+            router_kw=router_kw,
+            worker_args=worker_args,
+        )
+        rows_ok = rows_failed = 0
+        rolling_report = None
+        with controller:
+            import threading
+
+            n_threads = max(int(cp.get("fleet_concurrency", 4)), 1)
+            lock = threading.Lock()
+            idx = {"i": 0}
+            counts = {"ok": 0, "failed": 0}
+            errors: list[str] = []
+
+            def pump() -> None:
+                while True:
+                    with lock:
+                        i = idx["i"]
+                        if i >= len(batches):
+                            return
+                        idx["i"] = i + 1
+                    try:
+                        res = controller.router.score_batch(
+                            batches[i], timeout_s=120.0)
+                        with lock:
+                            counts["ok"] += len(res)
+                    except Exception as e:  # noqa: BLE001 - batch isolation
+                        with lock:
+                            counts["failed"] += len(batches[i])
+                            errors.append(f"{type(e).__name__}: {e}")
+
+            # half the traffic lands before the rolling deploy, half
+            # after, when one is requested - the deploy runs mid-load
+            threads = [threading.Thread(target=pump, daemon=True)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            if cp.get("fleet_deploy_version"):
+                rolling_report = controller.rolling_deploy(
+                    str(cp["fleet_deploy_version"]))
+            deadline = time.monotonic() + float(
+                cp.get("fleet_pump_timeout_s", 3600.0))
+            for t in threads:
+                t.join(timeout=max(deadline - time.monotonic(), 0.05))
+            still_running = [t for t in threads if t.is_alive()]
+            if still_running:
+                # counts harvested below would silently under-report;
+                # say so loudly in the exported metrics instead
+                errors.append(
+                    f"{len(still_running)} pump thread(s) still "
+                    f"running at fleet_pump_timeout_s - row counts "
+                    f"are partial")
+            rows_ok, rows_failed = counts["ok"], counts["failed"]
+            status = controller.status()
+        metrics = {
+            "run_type": "fleet",
+            "registry_root": root,
+            "replicas": int(cp.get("fleet_replicas", 2)),
+            "rows_submitted": len(records),
+            "rows_ok": rows_ok,
+            "rows_failed": rows_failed,
+            "errors": errors[:16],
+            "published_version":
+                published.version if published else None,
+            "rolling_deploy": rolling_report,
+            "status": status,
+        }
+        if params.metrics_location:
+            from ..obs import write_json_artifact
+
+            os.makedirs(params.metrics_location, exist_ok=True)
+            write_json_artifact(
+                os.path.join(params.metrics_location,
+                             "fleet_metrics.json"), metrics)
+        return OpWorkflowRunnerResult(run_type="fleet", metrics=metrics)
+
     # ------------------------------------------------------------------
     def streaming_score(
         self,
@@ -631,7 +789,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="transmogrifai_tpu workflow runner")
     p.add_argument("--run-type", required=True,
                    choices=["train", "score", "features", "evaluate",
-                            "serve", "deploy"])
+                            "serve", "deploy", "fleet"])
     p.add_argument("--params", help="path to OpParams JSON")
     p.add_argument("--workflow", required=True,
                    help="module:function returning (workflow, evaluator, readers...)")
