@@ -1,0 +1,516 @@
+"""Fused training programs (ISSUE 15): parity, donation safety, the AOT
+executable cache, and the tier-1 CPU floor.
+
+The contract under test (local/fused_train.py): each family's fold x
+grid dispatch runs as a donate-buffers fit program + device scoring +
+the exact metric program, and under the 'parity' runtime the selection
+it produces is indistinguishable from the kernel-at-a-time dispatch -
+same winner, metrics within 1e-9 (in practice: bit-level), betas
+bit-equal - in EVERY configuration (one runtime): a warm refit
+rehydrating executables from the ``train_xla_cache/`` compile cache
+returns bit-identical metrics to a cold one.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.binary import (
+    OpBinaryClassificationEvaluator,
+)
+from transmogrifai_tpu.evaluators.regression import OpRegressionEvaluator
+from transmogrifai_tpu.examples.synthetic import synthetic_design_matrix
+from transmogrifai_tpu.local import fused_train
+from transmogrifai_tpu.models.linear_regression import OpLinearRegression
+from transmogrifai_tpu.models.linear_svc import OpLinearSVC
+from transmogrifai_tpu.models.logistic_regression import (
+    OpLogisticRegression,
+)
+from transmogrifai_tpu.models.trees import (
+    OpGBTClassifier,
+    OpRandomForestClassifier,
+    OpRandomForestRegressor,
+)
+from transmogrifai_tpu.selector.factories import lr_grid
+from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+
+@pytest.fixture(autouse=True)
+def _single_process_mesh(monkeypatch):
+    """Fused dispatches engage only without a CV mesh (the PR-3 guarded
+    mesh route owns multi-device degradation); tier-1 forces 8 virtual
+    CPU devices, so pin the product mesh off for these drills."""
+    monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+
+
+def _binary_data(n=12_000, seed=0):
+    X, y, _ = synthetic_design_matrix(n, text_dims=32, seed=seed)
+    return np.asarray(X, np.float64), np.asarray(y)
+
+
+def _regression_target(X, seed=0):
+    rng = np.random.RandomState(seed)
+    return X[:, 3] * 2.0 - X[:, 7] + 0.1 * rng.randn(X.shape[0])
+
+
+def _validate(est, grid, X, y, ev, fused, stratify=True, cache_dir=None):
+    cv = OpCrossValidation(num_folds=3, evaluator=ev, stratify=stratify)
+    cv.train_fused = fused
+    cv.train_cache_dir = cache_dir
+    return cv.validate([(est, grid)], X, y)
+
+
+def _metric_diffs(r0, r1):
+    pairs = {
+        json.dumps(r["params"], sort_keys=True): r["metric"]
+        for r in r0.all_results
+    }
+    return [
+        abs(pairs[json.dumps(r["params"], sort_keys=True)] - r["metric"])
+        for r in r1.all_results
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Per-family parity: fused == existing dispatch
+# ---------------------------------------------------------------------------
+def test_lr_fused_parity_and_beta_bit_equality():
+    X, y = _binary_data()
+    est = OpLogisticRegression(max_iter=12)
+    ev = OpBinaryClassificationEvaluator()
+    grid = lr_grid()
+    r0 = _validate(est, grid, X, y, ev, fused=False)
+    r1 = _validate(est, grid, X, y, ev, fused=True)
+    assert r1.train_fused["families"][est.model_type]["backend"] == "fused"
+    assert r0.best_params == r1.best_params
+    assert max(_metric_diffs(r0, r1)) <= 1e-9
+    # betas: the fixed-point fit must be BIT-identical to the scan fit
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.selector.validator import (
+        lr_grid_scalars,
+        stratified_kfold_masks,
+    )
+
+    masks = stratified_kfold_masks(y, 3, 42, True)
+    regs_g, ens_g = lr_grid_scalars(est, grid)
+    regs, ens = np.tile(regs_g, 3), np.tile(ens_g, 3)
+    Xd = jnp.asarray(X, jnp.float32)
+    res = fused_train.run_linear(
+        est, Xd, y, masks, np.ones(len(y)), False, regs, ens,
+        len(grid), ev, "exact")
+    W = jnp.repeat(jnp.asarray(masks).astype(jnp.float32), len(grid),
+                   axis=0)
+    betas_e, b0s_e = est.fit_arrays_batched(
+        Xd, jnp.asarray(y, jnp.float32), W, regs, ens)
+    assert np.array_equal(np.asarray(betas_e), res.betas)
+    assert np.array_equal(np.asarray(b0s_e), res.b0s)
+
+
+def test_svc_fused_parity():
+    X, y = _binary_data()
+    est = OpLinearSVC(max_iter=8)
+    ev = OpBinaryClassificationEvaluator()
+    grid = [{"reg_param": r} for r in (0.01, 0.1, 0.5)]
+    r0 = _validate(est, grid, X, y, ev, fused=False)
+    r1 = _validate(est, grid, X, y, ev, fused=True)
+    assert r1.train_fused["families"][est.model_type]["backend"] == "fused"
+    assert r0.best_params == r1.best_params
+    assert max(_metric_diffs(r0, r1)) <= 1e-9
+
+
+def test_linreg_fused_parity():
+    X, y = _binary_data()
+    yr = _regression_target(X)
+    est = OpLinearRegression()
+    ev = OpRegressionEvaluator()
+    r0 = _validate(est, lr_grid(), X, yr, ev, fused=False, stratify=False)
+    r1 = _validate(est, lr_grid(), X, yr, ev, fused=True, stratify=False)
+    assert r1.train_fused["families"][est.model_type]["backend"] == "fused"
+    assert r0.best_params == r1.best_params
+    assert max(_metric_diffs(r0, r1)) <= 1e-9
+
+
+@pytest.mark.parametrize("family", ["rf", "gbt", "rf_reg"])
+def test_tree_fused_parity(monkeypatch, family):
+    monkeypatch.setenv("TX_TREE_BACKEND", "jax")
+    X, y = _binary_data(3_000)
+    if family == "rf":
+        est = OpRandomForestClassifier(num_trees=8, max_depth=4)
+        ev, yy, strat = OpBinaryClassificationEvaluator(), y, True
+        grid = [{"max_depth": 4, "min_info_gain": m} for m in (0.0, 0.01)]
+    elif family == "gbt":
+        est = OpGBTClassifier(num_trees=6, max_depth=3)
+        ev, yy, strat = OpBinaryClassificationEvaluator(), y, True
+        grid = [{"step_size": s} for s in (0.1, 0.3)]
+    else:
+        est = OpRandomForestRegressor(num_trees=8, max_depth=4)
+        ev, yy, strat = OpRegressionEvaluator(), _regression_target(X), \
+            False
+        grid = [{"max_depth": 4, "min_info_gain": m} for m in (0.0, 0.01)]
+    r0 = _validate(est, grid, X, yy, ev, fused=False, stratify=strat)
+    r1 = _validate(est, grid, X, yy, ev, fused=True, stratify=strat)
+    assert r1.train_fused["families"][est.model_type]["backend"] == "fused"
+    assert r0.best_params == r1.best_params
+    assert max(_metric_diffs(r0, r1)) <= 1e-9
+
+
+def test_approx_mode_fused_parity(monkeypatch):
+    """The 1024-bin device-metric arm (TPU's mode, forced on CPU via the
+    TX_CV_RANK_METRICS knob): the fused path reuses the exact kernels of
+    the existing approx arm on bit-identical betas, so the metrics are
+    bit-equal, not merely close."""
+    monkeypatch.setenv("TX_CV_RANK_METRICS", "approx")
+    X, y = _binary_data()
+    est = OpLogisticRegression(max_iter=12)
+    ev = OpBinaryClassificationEvaluator()
+    r0 = _validate(est, lr_grid(), X, y, ev, fused=False)
+    r1 = _validate(est, lr_grid(), X, y, ev, fused=True)
+    fam = r1.train_fused["families"][est.model_type]
+    assert fam["backend"] == "fused" and fam["mode"] == "approx"
+    assert r0.best_params == r1.best_params
+    assert max(_metric_diffs(r0, r1)) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Exact device rank metrics == host evaluator
+# ---------------------------------------------------------------------------
+def test_exact_rank_metrics_match_host_evaluator():
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.evaluators.binary import _roc_pr_areas
+
+    rng = np.random.RandomState(7)
+    n = 5000
+    y = (rng.rand(n) > 0.6).astype(np.float64)
+    # scores with heavy EXACT ties (saturated sigmoid analog) plus a
+    # continuous region - the tie-grouping is the part worth pinning
+    scores = np.where(rng.rand(n) < 0.2, 1.0,
+                      rng.rand(n)).astype(np.float32)
+    ok = rng.rand(n) > 0.1
+    with jax.experimental.enable_x64():
+        auroc, aupr = fused_train.exact_rank_metrics(
+            jnp.asarray(scores[None, :]),
+            jnp.asarray(y[None, :]),
+            jnp.asarray(ok[None, :]),
+        )
+        auroc, aupr = float(auroc[0]), float(aupr[0])
+    a_h, p_h = _roc_pr_areas(y[ok], scores[ok])
+    assert abs(auroc - a_h) <= 1e-12
+    assert abs(aupr - p_h) <= 1e-12
+
+
+# ---------------------------------------------------------------------------
+# Donation safety
+# ---------------------------------------------------------------------------
+def test_donation_safety_shared_buffers_survive_dispatch():
+    """The fit program donates the per-call fold-weight block; the
+    SHARED buffers (the hoisted design matrix) must never be donated -
+    they are read again by the scoring stage, by later families, and by
+    the caller.  Two dispatches over the same device X must succeed and
+    agree exactly, and X must remain readable afterwards."""
+    import jax.numpy as jnp
+
+    X, y = _binary_data(6_000)
+    est = OpLogisticRegression(max_iter=8)
+    ev = OpBinaryClassificationEvaluator()
+    from transmogrifai_tpu.selector.validator import (
+        lr_grid_scalars,
+        stratified_kfold_masks,
+    )
+
+    grid = lr_grid()
+    masks = stratified_kfold_masks(y, 3, 42, True)
+    regs_g, ens_g = lr_grid_scalars(est, grid)
+    regs, ens = np.tile(regs_g, 3), np.tile(ens_g, 3)
+    Xd = jnp.asarray(X, jnp.float32)
+    r1 = fused_train.run_linear(
+        est, Xd, y, masks, np.ones(len(y)), False, regs, ens,
+        len(grid), ev, "exact")
+    # the shared buffer is intact and reusable
+    assert np.isfinite(np.asarray(Xd)).all()
+    r2 = fused_train.run_linear(
+        est, Xd, y, masks, np.ones(len(y)), False, regs, ens,
+        len(grid), ev, "exact")
+    assert np.array_equal(r1.metrics, r2.metrics)
+    assert np.array_equal(r1.betas, r2.betas)
+
+
+# ---------------------------------------------------------------------------
+# AOT executable cache
+# ---------------------------------------------------------------------------
+_WARM_CHILD = r"""
+import json, sys
+import numpy as np
+from transmogrifai_tpu.evaluators.binary import (
+    OpBinaryClassificationEvaluator,
+)
+from transmogrifai_tpu.examples.synthetic import synthetic_design_matrix
+from transmogrifai_tpu.models.logistic_regression import (
+    OpLogisticRegression,
+)
+from transmogrifai_tpu.selector.factories import lr_grid
+from transmogrifai_tpu.selector.validator import OpCrossValidation
+
+X, y, _ = synthetic_design_matrix(8000, text_dims=32, seed=0)
+X = np.asarray(X, np.float64)
+cv = OpCrossValidation(
+    num_folds=3, evaluator=OpBinaryClassificationEvaluator(),
+    stratify=True)
+cv.train_fused = True
+cv.train_cache_dir = sys.argv[1]
+r = cv.validate([(OpLogisticRegression(max_iter=8), lr_grid())], X,
+                np.asarray(y))
+print(json.dumps({
+    "fam": r.train_fused["families"]["OpLogisticRegression"],
+    "metrics": [x["metric"] for x in r.all_results],
+    "best": r.best_params,
+}))
+"""
+
+
+def test_aot_cache_warm_refit_loads_instead_of_retracing(tmp_path):
+    """The warm-refit acceptance flow is the PR-12 pinned
+    trainer-process -> cache -> fresh-process shape: a brand-new
+    process (replica restart, rung worker) deserializes the cached
+    executable instead of retracing.  (A SAME-process reload can hit
+    jaxlib's process-uniquified entry-symbol collision - a counted
+    retrace, never wrong results - so the deterministic cross-process
+    flow is what gets pinned.)"""
+    import subprocess
+    import sys
+
+    X, y = _binary_data(8_000)
+    est = OpLogisticRegression(max_iter=8)
+    ev = OpBinaryClassificationEvaluator()
+    cache = str(tmp_path / "train_xla_cache")
+    fused_train.reset_program_registry()
+    r_cold = _validate(est, lr_grid(), X, y, ev, fused=True,
+                       cache_dir=cache)
+    fam_c = r_cold.train_fused["families"][est.model_type]
+    assert fam_c["cache"] == "miss"
+    assert fam_c["compile_ms"] > 0
+    assert os.listdir(cache), "no executables cached"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TX_PRODUCT_MESH="0")
+    out = subprocess.run(
+        [sys.executable, "-c", _WARM_CHILD, cache],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    child = json.loads(out.stdout.strip().splitlines()[-1])
+    fam_w = child["fam"]
+    assert fam_w["cache"] == "hit"
+    assert fam_w["load_ms"] > 0 and fam_w["compile_ms"] == 0
+    assert fam_w["load_ms"] < (fam_c["trace_ms"] + fam_c["compile_ms"])
+    # warm metrics are bit-identical to cold (same executable bytes)
+    assert child["metrics"] == [x["metric"] for x in r_cold.all_results]
+    assert child["best"] == r_cold.best_params
+
+
+def test_fingerprint_mismatch_is_counted_retrace_and_recache(
+        tmp_path, monkeypatch):
+    X, y = _binary_data(6_000)
+    est = OpLogisticRegression(max_iter=8)
+    ev = OpBinaryClassificationEvaluator()
+    cache = str(tmp_path / "train_xla_cache")
+    fused_train.reset_program_registry()
+    _validate(est, lr_grid(), X, y, ev, fused=True, cache_dir=cache)
+    n_before = len([n for n in os.listdir(cache)
+                    if n.endswith(".txmeta.json")])
+    # a new jaxlib/backend: every fingerprint changes, the logical key
+    # does not - the reload must be a counted STALE retrace-and-recache,
+    # never a foreign executable
+    real = fused_train.runtime_fingerprint
+
+    def fake_runtime():
+        rt = dict(real())
+        rt["jaxlib"] = "0.0.0-upgraded"
+        return rt
+
+    monkeypatch.setattr(fused_train, "runtime_fingerprint", fake_runtime)
+    fused_train.reset_program_registry()
+    r = _validate(est, lr_grid(), X, y, ev, fused=True, cache_dir=cache)
+    fam = r.train_fused["families"][est.model_type]
+    assert fam["cache"] == "stale"
+    assert fam["compile_ms"] > 0  # really retraced
+    assert r.train_fused["cache"]["stale"] >= 1
+    # recached under the new fingerprint, superseded records reaped
+    n_after = len([n for n in os.listdir(cache)
+                   if n.endswith(".txmeta.json")])
+    assert n_after == n_before
+
+
+def test_corrupt_cache_entry_degrades_to_retrace(tmp_path):
+    X, y = _binary_data(6_000)
+    est = OpLogisticRegression(max_iter=8)
+    ev = OpBinaryClassificationEvaluator()
+    cache = str(tmp_path / "train_xla_cache")
+    fused_train.reset_program_registry()
+    r0 = _validate(est, lr_grid(), X, y, ev, fused=True, cache_dir=cache)
+    for name in os.listdir(cache):
+        if name.endswith(".txmeta.json"):
+            continue
+        p = os.path.join(cache, name)
+        try:
+            with open(p, "r+b") as f:
+                f.seek(10)
+                f.write(b"\xde\xad\xbe\xef")
+        except OSError:
+            continue
+    fused_train.reset_program_registry()
+    r1 = _validate(est, lr_grid(), X, y, ev, fused=True, cache_dir=cache)
+    fam = r1.train_fused["families"][est.model_type]
+    # the contract: corruption degrades to a working fresh compile (jax
+    # warns on the unreadable entry and recompiles), never an error or
+    # a wrong executable - selection identical to the cold run
+    assert fam["backend"] == "fused"
+    assert r0.best_params == r1.best_params
+    assert all(
+        a["metric"] == b["metric"]
+        for a, b in zip(r0.all_results, r1.all_results)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fallback reasons + trail shape
+# ---------------------------------------------------------------------------
+def test_unsupported_evaluator_falls_back_with_reason():
+    from transmogrifai_tpu.evaluators.binary import OpBinScoreEvaluator
+
+    X, y = _binary_data(4_000)
+    est = OpLogisticRegression(max_iter=6)
+    r = _validate(est, lr_grid()[:2], X, y, OpBinScoreEvaluator(),
+                  fused=True)
+    fam = r.train_fused["families"][est.model_type]
+    assert fam["backend"] == "existing"
+    assert fam["reason"] == "evaluator_unsupported"
+
+
+def test_auto_gate_keeps_small_fits_on_existing_path():
+    X, y = _binary_data(4_000)
+    est = OpLogisticRegression(max_iter=6)
+    ev = OpBinaryClassificationEvaluator()
+    r = _validate(est, lr_grid()[:2], X, y, ev, fused=None)  # auto
+    fam = r.train_fused["families"][est.model_type]
+    assert fam["backend"] == "existing"
+    assert fam["reason"] == "below_min_rows"
+
+
+def test_trail_shape_mirrors_serving_telemetry():
+    X, y = _binary_data(6_000)
+    est = OpLogisticRegression(max_iter=6)
+    ev = OpBinaryClassificationEvaluator()
+    r = _validate(est, lr_grid()[:2], X, y, ev, fused=True)
+    tf = r.train_fused
+    assert tf["backend"] == "fused"
+    assert set(tf["cache"]) == {"hits", "misses", "stale"}
+    fam = tf["families"][est.model_type]
+    for key in ("backend", "cache", "trace_ms", "compile_ms", "load_ms",
+                "bucket", "mode"):
+        assert key in fam, key
+
+
+# ---------------------------------------------------------------------------
+# Runner + report wiring (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+def test_runner_train_fused_summary_cache_and_report(tmp_path):
+    """The ``train_fused`` run knob end to end: the run summary and the
+    saved summary.json carry the per-family dispatch trail
+    (backend/cache mirroring the PR-12 serving telemetry shape), the
+    AOT cache lands in ``train_xla_cache/`` NEXT TO the model, and
+    ``tx autotune report`` renders the trail."""
+    from transmogrifai_tpu import FeatureBuilder, OpWorkflow
+    from transmogrifai_tpu.autotune import report_from_path
+    from transmogrifai_tpu.models.logistic_regression import (
+        OpLogisticRegression as LR,
+    )
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.selector.factories import (
+        BinaryClassificationModelSelector,
+    )
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    rng = np.random.RandomState(0)
+    n = 600
+    a_v, b_v = rng.randn(n), rng.randn(n)
+    data = {
+        "y": ((a_v - b_v + 0.3 * rng.randn(n)) > 0)
+        .astype(float).tolist(),
+        "a": a_v.tolist(),
+        "b": b_v.tolist(),
+    }
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    b = FeatureBuilder(ft.Real, "b").as_predictor()
+    vec = transmogrify([a, b])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models_and_parameters=[
+            (LR(max_iter=6),
+             [{"reg_param": r, "elastic_net_param": 0.1}
+              for r in (0.01, 0.1)]),
+        ],
+        splitter=None,
+    )
+    pred = selector.set_input(y, vec).get_output()
+    wf = OpWorkflow().set_result_features(pred).set_input_dataset(data)
+    loc = str(tmp_path / "model")
+    fused_train.reset_program_registry()
+    r = OpWorkflowRunner(wf).run(
+        "train",
+        OpParams(model_location=loc,
+                 custom_params={"train_fused": True}),
+    )
+    tf = r.summary["train_fused"]
+    assert tf["backend"] == "fused"
+    fam = tf["families"]["OpLogisticRegression"]
+    # runner default: cache dir next to the model
+    assert fam["cache"] == "miss"
+    cache_dir = os.path.join(loc, "train_xla_cache")
+    assert os.path.isdir(cache_dir) and os.listdir(cache_dir)
+    with open(os.path.join(loc, "summary.json")) as f:
+        assert json.load(f)["train_fused"]["backend"] == "fused"
+    report = report_from_path(loc)
+    assert report["train_fused"]["backend"] == "fused"
+    assert (report["selection"][0]["train_fused"]["families"]
+            ["OpLogisticRegression"]["bucket"])
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 CPU floor
+# ---------------------------------------------------------------------------
+def test_fused_fold_grid_cpu_floor():
+    """Fused fold x grid dispatch must not cost more CPU than the
+    kernel-at-a-time path at a size where both are warm - proven
+    COMPILED-FIRST (the first fused call pays trace+compile and is
+    excluded), then best-of-2 process_time windows."""
+    X, y = _binary_data(60_000, seed=3)
+    est = OpLogisticRegression(max_iter=10)
+    ev = OpBinaryClassificationEvaluator()
+    grid = lr_grid()
+    # warm both paths (compile + trace)
+    r_f = _validate(est, grid, X, y, ev, fused=True)
+    assert (r_f.train_fused["families"][est.model_type]["backend"]
+            == "fused"), "floor would be vacuous: fused did not engage"
+    _validate(est, grid, X, y, ev, fused=False)
+
+    def cpu_of(fused):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.process_time()
+            _validate(est, grid, X, y, ev, fused=fused)
+            best = min(best, time.process_time() - t0)
+        return best
+
+    c_fused = cpu_of(True)
+    c_exist = cpu_of(False)
+    # best-of-3 + small tolerance for scheduler noise (idle margin is
+    # ~0.88x CPU / ~0.64x wall; process_time counts ALL XLA worker
+    # threads, so shared-host contention can inflate the parallel
+    # fused metric stage more than the host-side existing path)
+    assert c_fused <= c_exist * 1.10, (c_fused, c_exist)

@@ -337,13 +337,16 @@ def _is_time_time_call(node: ast.AST) -> bool:
 
 def test_no_epoch_clock_durations():
     """No ``time.time()`` call may appear inside a subtraction anywhere
-    in the package (ISSUE 7): ``time.time() - t0`` is a duration
-    measured on a clock that steps under NTP.  Span/metric timing code
-    must use ``time.perf_counter`` / ``perf_counter_ns`` /
-    ``time.monotonic``; epoch stamps are fine as plain timestamps."""
-    offenders = []
-    for p in MODULES:
-        rel = _rel(p)
+    in the package - or in ``bench.py`` (ISSUE 15 satellite extended the
+    ISSUE-7 gate: the boston/iris train walls were still epoch-clock
+    subtractions): ``time.time() - t0`` is a duration measured on a
+    clock that steps under NTP.  Span/metric timing code must use
+    ``time.perf_counter`` / ``perf_counter_ns`` / ``time.monotonic``;
+    epoch stamps are fine as plain timestamps."""
+    bench = ROOT.parent / "bench.py"
+    for p in list(MODULES) + [bench]:
+        rel = _rel(p) if p != bench else ("bench.py",)
+        offenders = []
         tree = ast.parse(p.read_text(encoding="utf-8"))
         for node in ast.walk(tree):
             if not (isinstance(node, ast.BinOp)
@@ -355,6 +358,64 @@ def test_no_epoch_clock_durations():
                 continue
             offenders.append(f"{p}:{node.lineno} time.time() in a "
                              "subtraction")
+        assert not offenders, offenders
+
+
+def _validate_fold_loops(tree: ast.Module):
+    """The fold loops (``for f in ...``) of OpValidator.validate, with
+    every nested node - the fold x grid hot path."""
+    validate_fn = None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ClassDef) and node.name == "OpValidator"):
+            for sub in node.body:
+                if (isinstance(sub, ast.FunctionDef)
+                        and sub.name == "validate"):
+                    validate_fn = sub
+    assert validate_fn is not None, "OpValidator.validate not found"
+    for node in ast.walk(validate_fn):
+        if (isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == "f"):
+            yield node
+
+
+def test_validator_hot_loop_has_no_device_host_syncs():
+    """The fold x grid hot loops in selector/validator.py (the
+    ``for f`` fold loops of OpValidator.validate and everything nested
+    in them) must not force mid-loop device->host syncs: no ``.item()``
+    (anywhere in the file), and no ``float(...)`` / ``np.asarray(...)``
+    calls inside the fold loops outside Lambda bodies (ISSUE 15
+    satellite) - the post-selection boundary (result building after the
+    metric matrix is complete) is where host conversion belongs.  The
+    degraded-mode recompute closures (lambdas handed to the collective
+    watchdog) are the sanctioned exception."""
+    p = ROOT / "selector" / "validator.py"
+    tree = ast.parse(p.read_text(encoding="utf-8"))
+    offenders = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute) and node.attr == "item"):
+            offenders.append(f"{p}:{node.lineno} .item")
+
+    lambda_nodes: set = set()
+    for loop in _validate_fold_loops(tree):
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Lambda):
+                for inner in ast.walk(sub):
+                    lambda_nodes.add(id(inner))
+        for sub in ast.walk(loop):
+            if id(sub) in lambda_nodes or not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Name) and fn.id == "float":
+                offenders.append(f"{p}:{sub.lineno} float() in fold loop")
+            elif (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "asarray"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("np", "numpy")
+            ):
+                offenders.append(
+                    f"{p}:{sub.lineno} np.asarray in fold loop")
     assert not offenders, offenders
 
 
@@ -449,6 +510,7 @@ _FUSED_PATH_MODULES = (
     ("local", "__init__.py"),
     ("local", "fused.py"),
     ("local", "fused_xla.py"),
+    ("local", "fused_train.py"),
     ("local", "scorer.py"),
 )
 

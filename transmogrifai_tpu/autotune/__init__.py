@@ -106,16 +106,22 @@ def report_from_path(path: str) -> dict:
         for st in summary.get("stages", []):
             md = (st.get("metadata") or {}).get(
                 "model_selector_summary") or {}
-            if md.get("autotune") is not None:
+            if (md.get("autotune") is not None
+                    or md.get("train_fused") is not None):
                 selections.append({
                     "stage_uid": st.get("uid"),
                     "best_model_type": md.get("best_model_type"),
                     "best_params": md.get("best_params"),
-                    "autotune": md["autotune"],
+                    "autotune": md.get("autotune"),
+                    # ISSUE 15 satellite: whether each family dispatch
+                    # ran fused / AOT-loaded / retraced
+                    "train_fused": md.get("train_fused"),
                 })
         out["selection"] = selections
         if summary.get("autotune") is not None:
             out["run"] = summary["autotune"]
+        if summary.get("train_fused") is not None:
+            out["train_fused"] = summary["train_fused"]
         found = True
     if os.path.exists(model_p):
         out["cost_model"] = CostModel.load(model_p).snapshot()

@@ -1,0 +1,880 @@
+"""Fused training programs: one donate-buffers jit per fold x grid
+dispatch, with AOT-cached training executables (ISSUE 15; ROADMAP item 3,
+training half).
+
+The serving half of the Flare-style fusion story (PRs 6/12) compiled the
+FITTED pipeline; this module compiles the SELECTION hot path.  The
+kernel-at-a-time dispatch in ``selector/validator.py`` runs, per family:
+a ``jnp.asarray`` upload, one ``fit_arrays_batched``/grid-core dispatch
+whose betas (or heaps) return to host, then k x g per-candidate predict
+dispatches each shipping an [n_val, d] host slice to the device and the
+scores back for host-side metrics - every drift-triggered refit pays
+those round trips again.  Here each family's dispatch becomes a fused,
+x64-windowed pipeline that keeps EVERY intermediate on device:
+
+* the FIT PROGRAM - the tentpole jit: the family's whole fold x grid fit
+  (batched Newton via the bitwise-fixed-point early-exit loop, or the
+  grid x fold tree cores) traced as ONE program with ``donate_argnums``
+  on the per-call fold-weight / stat / bootstrap buffers, so the Newton
+  and tree-scan iterations reuse that device memory instead of doubling
+  the working set.  This is the executable the AOT cache persists.
+* per-candidate SCORE dispatches - each family's predict math over the
+  eagerly-gathered per-fold validation rows (device buffer to device
+  buffer); betas/heaps arrive as device buffers straight from the fit
+  program.
+* the METRIC PROGRAM - one jit computing the whole [k, g] metric matrix:
+  exact rank metrics (one uint64 bit-pattern sort per candidate, tie-
+  grouped trapezoid AuROC / step-area AuPR accumulated in f64 where
+  every term is a half-integer < 2^53, so the sums are EXACT and match
+  the host evaluator to final-division rounding ~1e-15) or the f64
+  regression metrics.  Scores are donated into it.
+
+Only the metric matrix and the family's betas return to host.
+
+Why three executables and not literally one: on XLA:CPU the dot emitter
+is sensitive to operand provenance - the SAME f32 matvec lowers
+differently when its operand is an in-program value instead of a program
+parameter, and unrolled per-candidate dots sharing one design matrix get
+merged into a single matmul with a different accumulation order (both
+measured here: up to ~8e-6 score drift, enough to move AUROC past the
+1e-9 parity bar through rank flips).  Splitting at the betas/scores
+boundaries keeps every dot's operands parameters, which is bit-equal to
+the kernel-at-a-time dispatch - while the buffers still never leave the
+device.  The metric program is provenance-proof (sort + exact integer
+f64 sums), so it fuses freely.
+
+Approx mode (the validator's 1024-bin TPU path) reuses the SAME
+``_margins_kernel`` + ``masked_rank_metrics`` kernels the existing arm
+dispatches, fed the fit program's device betas - bit-equal by
+construction.
+
+AOT executable cache (``train_xla_cache/`` next to ``autotune.json``):
+warm refits - the successive-halving rungs of PR 13, item 2's future
+drift-triggered refits, restarted trainers - must not pay retrace +
+recompile per shape bucket.  Two tiers serve them:
+
+* the in-process program registry: a long-lived refit loop re-dispatching
+  the same (family, shape bucket, grid signature) skips trace AND
+  compile entirely (``cache: memory``);
+* the on-disk cache: jax's persistent compilation cache scoped to the
+  ``train_xla_cache/`` directory (enabled only for the fused-program
+  compile window, under the PR-12 process-wide config lock) - a fresh
+  process re-traces but its ``compile()`` REHYDRATES the cached
+  executable (``cache: hit``, the compile wall recorded as ``load_ms``)
+  instead of re-optimizing.  A sidecar meta file per program -
+  fingerprint = sha256(jax/jaxlib/backend + family + shape bucket +
+  grid signature) - keeps the PR-12-style stale accounting: a runtime
+  upgrade is a counted STALE retrace-and-recache, never a foreign
+  executable (jax's own cache key enforces the never-foreign half).
+
+Why not the literal PR-12 ``serialize_executable`` seam: measured on
+jaxlib 0.4.36 CPU, a serialized executable containing LAPACK custom
+calls (the Newton kernels' Cholesky solves) deserializes into a fresh
+process and then SEGFAULTS at execution - from a clean producer process,
+under both CPU runtimes - and the legacy runtime that PR 12 needed for
+sound serving serialization both compiles ~20x slower and computes f32
+matmuls with a different tiling (~2e-3 abs drift on a [20k, 39] Gram),
+which would break the 1e-9 parity bar.  The persistent compilation
+cache is the rehydration path jax actually supports for these programs:
+one (default) runtime everywhere, so fused == existing stays bit-exact
+in every configuration, warm included.
+
+Shape buckets are EXACT shapes: zero-padding rows would change the
+fit's f32 reductions and break the bit-parity contract, and refit loops
+re-see the same shapes anyway.
+
+Like the rest of local/, this module defers every jax import: importing
+it (the validator does so lazily) must never initialize a backend.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from .fused_xla import runtime_fingerprint
+
+log = logging.getLogger("transmogrifai_tpu.local.fused_train")
+
+TRAIN_CACHE_FORMAT_VERSION = 1
+
+#: directory name of the on-disk executable cache, created next to
+#: ``autotune.json`` (workflow/runner.py wires it)
+TRAIN_CACHE_DIRNAME = "train_xla_cache"
+
+
+class FusedTrainError(Exception):
+    """A family's fold x grid dispatch cannot ride the fused programs;
+    ``reason`` is the short machine-readable fallback reason the
+    validator records (mirroring PR-6's ``fused_reason`` discipline)."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _x64():
+    return _jax().experimental.enable_x64()
+
+
+# ---------------------------------------------------------------------------
+# Exact device rank / regression metrics
+# ---------------------------------------------------------------------------
+_ORD32_FLIP = 0x80000000
+_ORD64_FLIP = 0x8000000000000000
+
+
+def _ord62(scores):
+    """Order-preserving 62-bit integer keys for a [B, m] score block.
+
+    f32 scores map losslessly (32 ordered bits << 30).  f64 scores keep
+    their top 62 ordered pattern bits: only values within 4 consecutive
+    f64 patterns collide, which exact ties (the case that matters -
+    saturated sigmoids, binary predictions) never are."""
+    jnp = _jax().numpy
+    lax = _jax().lax
+    if scores.dtype == jnp.float64:
+        bits = lax.bitcast_convert_type(scores, jnp.uint64)
+        ordered = jnp.where(
+            (bits >> 63) == 0, bits | jnp.uint64(_ORD64_FLIP), ~bits
+        )
+        return ordered >> 2
+    bits = lax.bitcast_convert_type(scores.astype(jnp.float32), jnp.uint32)
+    ordered = jnp.where(
+        (bits >> 31) == 0, bits | jnp.uint32(_ORD32_FLIP), ~bits
+    )
+    return ordered.astype(jnp.uint64) << 30
+
+
+def exact_rank_metrics(scores, yb, okb):
+    """Exact AuROC + AuPR per candidate row, entirely on device.
+
+    scores [B, m] (f32 or f64, higher = more positive), yb [B, m] f64
+    labels in {0, 1}, okb [B, m] bool validity (False = gather padding).
+    One uint64 sort per row: key = (valid << 63) | (ordered score bits
+    << 1) | label, so invalid rows sink below every valid row and a
+    single pass of cumulative sums over the descending order yields the
+    tie-grouped trapezoid AuROC and the step-area AuPR - the same
+    group-end formulas the host evaluator's ``_roc_pr_areas`` computes,
+    term-for-term in f64 (each term is a half-integer < 2^53: the sums
+    are exact)."""
+    jnp = _jax().numpy
+    lax = _jax().lax
+    B, m = scores.shape
+    key = (
+        (okb.astype(jnp.uint64) << 63)
+        | (_ord62(scores) << 1)
+        | yb.astype(jnp.uint64)
+    )
+    skey = jnp.flip(lax.sort(key, dimension=1), axis=1)  # descending
+    valid = ((skey >> 63) & jnp.uint64(1)).astype(jnp.float64)
+    yy = (skey & jnp.uint64(1)).astype(jnp.float64)
+    gkey = skey >> 1  # score bits + validity: tie groups
+    tp = jnp.cumsum(yy * valid, axis=1)
+    fp = jnp.cumsum((1.0 - yy) * valid, axis=1)
+    iota = jnp.arange(m, dtype=jnp.int32)
+    neq_prev = gkey[:, 1:] != gkey[:, :-1]
+    is_start = jnp.concatenate(
+        [jnp.ones((B, 1), bool), neq_prev], axis=1)
+    is_end = jnp.concatenate(
+        [neq_prev, jnp.ones((B, 1), bool)], axis=1)
+    start_idx = lax.cummax(
+        jnp.where(is_start, iota[None, :], 0), axis=1)
+    prev = jnp.maximum(start_idx - 1, 0)
+    tp_prev = jnp.where(
+        start_idx > 0, jnp.take_along_axis(tp, prev, axis=1), 0.0)
+    hp = tp - tp_prev
+    fp_prev = jnp.where(
+        start_idx > 0, jnp.take_along_axis(fp, prev, axis=1), 0.0)
+    hn = fp - fp_prev
+    P = tp[:, -1:]
+    N = fp[:, -1:]
+    endw = (is_end & (valid > 0)).astype(jnp.float64)
+    auroc = (endw * hn * (tp_prev + 0.5 * hp)).sum(axis=1) / jnp.maximum(
+        P * N, 1e-12
+    )[:, 0]
+    prec = tp / jnp.maximum(tp + fp, 1e-12)
+    aupr = (endw * hp * prec).sum(axis=1) / jnp.maximum(P, 1e-12)[:, 0]
+    has_both = ((P > 0) & (N > 0))[:, 0]
+    return (
+        jnp.where(has_both, auroc, 0.0),
+        jnp.where(has_both, aupr, 0.0),
+    )
+
+
+def regression_metrics(pred, yb, okb, metric_name: str):
+    """Per-candidate regression metric over gathered validation rows:
+    the f64 mirror of evaluators/regression.OpRegressionEvaluator on
+    (pred [B, m], yb [B, m]), padding masked by ``okb``."""
+    jnp = _jax().numpy
+    okd = okb.astype(jnp.float64)
+    cnt = jnp.maximum(okd.sum(axis=1), 1.0)
+    err = (yb - pred.astype(jnp.float64)) * okd
+    sse = (err * err).sum(axis=1)
+    if metric_name == "MeanSquaredError":
+        return sse / cnt
+    if metric_name == "RootMeanSquaredError":
+        return jnp.sqrt(sse / cnt)
+    if metric_name == "MeanAbsoluteError":
+        return jnp.abs(err).sum(axis=1) / cnt
+    if metric_name == "R2":
+        ymean = (yb * okd).sum(axis=1, keepdims=True) / cnt[:, None]
+        ss_tot = (((yb - ymean) ** 2) * okd).sum(axis=1)
+        return jnp.where(ss_tot > 0, 1.0 - sse / ss_tot, 0.0)
+    raise FusedTrainError("metric_unsupported", metric_name)
+
+
+SUPPORTED_RANK_METRICS = ("AuROC", "AuPR")
+SUPPORTED_REGRESSION_METRICS = (
+    "RootMeanSquaredError", "MeanSquaredError", "MeanAbsoluteError", "R2",
+)
+
+
+def metric_kind(evaluator) -> Optional[tuple]:
+    """(kind, metric_name) when the evaluator's default metric has an
+    exact in-program implementation, else None.  Exact TYPE match: a
+    subclass may override evaluate_arrays, and the fused metrics must
+    mirror the implementation they claim parity with."""
+    from ..evaluators.binary import OpBinaryClassificationEvaluator
+    from ..evaluators.regression import OpRegressionEvaluator
+
+    name = getattr(evaluator, "metric_name", None)
+    if (type(evaluator) is OpBinaryClassificationEvaluator
+            and name in SUPPORTED_RANK_METRICS):
+        return ("rank", name)
+    if (type(evaluator) is OpRegressionEvaluator
+            and name in SUPPORTED_REGRESSION_METRICS):
+        return ("regression", name)
+    return None
+
+
+def val_gather_plan(masks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-fold validation-row index arrays from [k, n] train masks,
+    padded to the widest fold: (val_idx [k, m] int32, val_ok [k, m]
+    bool).  Padding indexes row 0 with ok=False - gathered but masked."""
+    k = masks.shape[0]
+    idxs = [np.nonzero(~masks[f])[0] for f in range(k)]
+    m = max((len(i) for i in idxs), default=0)
+    if m == 0:
+        raise FusedTrainError("no_validation_rows")
+    val_idx = np.zeros((k, m), np.int32)
+    val_ok = np.zeros((k, m), bool)
+    for f, i in enumerate(idxs):
+        val_idx[f, : len(i)] = i
+        val_ok[f, : len(i)] = True
+    return val_idx, val_ok
+
+
+# ---------------------------------------------------------------------------
+# On-disk cache: jax persistent compilation cache + sidecar meta
+# ---------------------------------------------------------------------------
+#: sidecar meta filename suffix (distinguishes our records from jax's
+#: own cache entries in the shared train_xla_cache/ directory)
+_META_SUFFIX = ".txmeta.json"
+
+
+class TrainExecutableCache:
+    """The sidecar bookkeeping over a ``train_xla_cache/`` directory
+    shared with jax's persistent compilation cache: one
+    ``<fingerprint>.txmeta.json`` per fused program, written via the
+    crash-consistent atomic byte writer in serialization/model_io.py.
+    ``logical_key`` (the fingerprint minus runtime) lets a
+    jax/jaxlib/backend upgrade be counted as STALE - the retrace
+    replaces the record - while a never-seen program is a plain MISS.
+    The executables themselves live in jax's cache entries (its key
+    covers jax version/backend/flags, so a foreign executable can never
+    rehydrate)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = str(root)
+
+    def _meta_path(self, fp: str) -> str:
+        return os.path.join(self.root, fp + _META_SUFFIX)
+
+    def has(self, fingerprint: str) -> bool:
+        try:
+            with open(self._meta_path(fingerprint)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return False
+        return meta.get("format_version") == TRAIN_CACHE_FORMAT_VERSION
+
+    def has_stale_sibling(self, fingerprint: str, logical_key: str) -> bool:
+        """A record exists for this program under a DIFFERENT
+        fingerprint (new jax/jaxlib/backend): the retrace that follows
+        is a counted 'stale', not a cold 'miss'."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return False
+        for name in names:
+            if (not name.endswith(_META_SUFFIX)
+                    or name == fingerprint + _META_SUFFIX):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if meta.get("logical_key") == logical_key:
+                return True
+        return False
+
+    def store(self, fingerprint: str, logical_key: str,
+              extra: dict) -> None:
+        """Best-effort atomic record; superseded same-logical-key
+        records are reaped so a long-lived cache dir holds one record
+        per live program."""
+        from ..serialization.model_io import write_bytes_atomic
+
+        try:
+            names = [n for n in os.listdir(self.root)
+                     if n.endswith(_META_SUFFIX)]
+        except OSError:
+            names = []
+        meta = {
+            "format_version": TRAIN_CACHE_FORMAT_VERSION,
+            "fingerprint": fingerprint,
+            "logical_key": logical_key,
+            "runtime": runtime_fingerprint(),
+        }
+        meta.update(extra)
+        try:
+            write_bytes_atomic(
+                self._meta_path(fingerprint),
+                json.dumps(meta, sort_keys=True).encode("utf-8"),
+            )
+        except OSError as e:
+            log.warning("could not store train cache record %s: %s",
+                        fingerprint, e)
+            return
+        for name in names:
+            if name == fingerprint + _META_SUFFIX:
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                with open(p) as f:
+                    if json.load(f).get("logical_key") != logical_key:
+                        continue
+                os.remove(p)
+            except (OSError, ValueError):
+                continue
+
+
+def _compile_program(lowered, cache_dir: Optional[str]):
+    """Compile a lowered fused program, through jax's persistent
+    compilation cache when a cache dir is configured: the config toggle
+    window is process-wide state, so it runs under the SAME lock the
+    PR-12 serving compiles use (fused_xla._COMPILE_CACHE_LOCK) - the
+    serving AOT path needs the cache OFF for its window, this path
+    needs it ON, and interleaving would corrupt both.  Returns
+    (executable, compile_ms, disk_hit: Optional[bool]) where disk_hit
+    is None without a cache dir, else whether the compile rehydrated an
+    existing entry (no cache files appeared or changed).  The hit
+    heuristic is directory-level: a CONCURRENT writer landing its own
+    cold entry in a shared cache dir during this window under-counts a
+    genuine rehydration as a miss - the hit/miss counters are
+    observability, never a correctness input, so an under-count costs
+    one report line, not an executable."""
+    jax = _jax()
+    import time as _time
+
+    if cache_dir is None:
+        t0 = _time.perf_counter()
+        exe = lowered.compile()
+        return exe, (_time.perf_counter() - t0) * 1e3, None
+    from jax.experimental.compilation_cache import (
+        compilation_cache as _jax_cc,
+    )
+
+    from .fused_xla import _COMPILE_CACHE_LOCK
+
+    os.makedirs(cache_dir, exist_ok=True)
+    with _COMPILE_CACHE_LOCK:
+        cfg = jax.config
+        old = (
+            cfg.jax_enable_compilation_cache,
+            cfg.jax_compilation_cache_dir,
+            cfg.jax_persistent_cache_min_compile_time_secs,
+            cfg.jax_persistent_cache_min_entry_size_bytes,
+        )
+        def _entries():
+            # (name, size, mtime_ns) so a corrupt entry jax silently
+            # rewrites in place reads as a MISS, not a hit; the -atime
+            # marker files are touched on every cache READ, so they
+            # must not count as writes
+            out = set()
+            for n in os.listdir(cache_dir):
+                if n.endswith(_META_SUFFIX) or n.endswith("-atime"):
+                    continue
+                try:
+                    st = os.stat(os.path.join(cache_dir, n))
+                except OSError:
+                    continue
+                out.add((n, st.st_size, st.st_mtime_ns))
+            return out
+
+        before = _entries()
+        try:
+            jax.config.update("jax_enable_compilation_cache", True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # the fused-program compiles are sub-second: jax's default
+            # 1s floor would silently skip caching exactly the
+            # executables this cache exists for
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
+            # the cache backend memoizes the directory it was first
+            # initialized with (usually None): drop it so this window's
+            # dir takes effect, and again on exit so later compiles
+            # don't keep writing here
+            _jax_cc.reset_cache()
+            t0 = _time.perf_counter()
+            try:
+                exe = lowered.compile()
+            except Exception as e:  # noqa: BLE001 - a damaged cache
+                # entry must degrade to a plain compile, never kill
+                # the dispatch
+                log.warning(
+                    "cached-compile failed (%s: %s); recompiling "
+                    "without the cache", type(e).__name__, e,
+                )
+                jax.config.update("jax_enable_compilation_cache", False)
+                t0 = _time.perf_counter()
+                exe = lowered.compile()
+            compile_ms = (_time.perf_counter() - t0) * 1e3
+        finally:
+            jax.config.update("jax_enable_compilation_cache", old[0])
+            jax.config.update("jax_compilation_cache_dir", old[1])
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", old[2])
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", old[3])
+            _jax_cc.reset_cache()
+        after = _entries()
+    return exe, compile_ms, after == before and bool(before)
+
+
+# ---------------------------------------------------------------------------
+# Program registry: trace/compile once per (family, shape bucket)
+# ---------------------------------------------------------------------------
+@dataclass
+class _Program:
+    exe: Any
+    n_outputs: int
+    stats: dict = field(default_factory=dict)
+
+
+_PROGRAMS: dict[str, _Program] = {}
+_PROGRAMS_LOCK = threading.Lock()
+_MAX_PROGRAMS = 32
+
+
+@dataclass
+class FusedDispatchResult:
+    """What one fused family dispatch hands back to the validator."""
+
+    metrics: np.ndarray  # [k, g] float64, metric per (fold, candidate)
+    betas: Optional[np.ndarray]
+    b0s: Optional[np.ndarray]
+    report: dict
+
+
+def fingerprint_for(sig: Sequence) -> tuple[str, str]:
+    """(fingerprint, logical_key): sha256 over runtime + program
+    signature, and the runtime-free logical identity used for stale
+    accounting."""
+    logical = json.dumps(
+        {"format": TRAIN_CACHE_FORMAT_VERSION, "sig": list(sig)},
+        sort_keys=True, default=str,
+    )
+    doc = json.dumps(
+        {"logical": logical, "runtime": runtime_fingerprint()},
+        sort_keys=True,
+    )
+    return (
+        hashlib.sha256(doc.encode("utf-8")).hexdigest(),
+        hashlib.sha256(logical.encode("utf-8")).hexdigest(),
+    )
+
+
+def _counters():
+    from ..obs.metrics import metrics_registry
+
+    return metrics_registry()
+
+
+def _get_program(sig: Sequence, build_fn: Callable[[], Any],
+                 arg_specs: Sequence, donate: Sequence[int],
+                 n_outputs: int,
+                 cache_dir: Optional[str]) -> tuple[_Program, dict]:
+    """The compiled executable for ``sig``, via (in order): the
+    in-process registry (``memory`` - trace and compile both skipped),
+    or trace + compile, where a configured cache dir routes the compile
+    through jax's persistent compilation cache: a rehydrated entry is a
+    counted HIT (compile wall recorded as load_ms), a never-seen
+    program a MISS, and a known program whose runtime fingerprint
+    changed a counted STALE retrace-and-recache."""
+    jax = _jax()
+    fp, logical = fingerprint_for(sig)
+    # the in-process registry is keyed per cache dir: a program first
+    # compiled WITHOUT a cache dir must not be served as a memory hit
+    # once the operator configures train_xla_cache/ - the recompile is
+    # what persists the executable for the next process
+    reg_key = f"{fp}|{cache_dir or ''}"
+    with _PROGRAMS_LOCK:
+        prog = _PROGRAMS.get(reg_key)
+    if prog is not None:
+        return prog, {"cache": "memory", "fingerprint": fp}
+    reg = _counters()
+    event = {"fingerprint": fp}
+    cache = TrainExecutableCache(cache_dir) if cache_dir else None
+    stats = {"trace_ms": 0.0, "compile_ms": 0.0, "load_ms": 0.0,
+             "cache_hit": 0}
+    known = cache is not None and cache.has(fp)
+    program = build_fn()
+    with _x64():
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            # CPU XLA has no output buffer shaped like the donated
+            # fold-weight block to alias, so it warns the donation is
+            # unusable there; the donation is deliberate (it pays on
+            # backends with aliasable layouts) and the warning would
+            # otherwise fire once per compile
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not "
+                "usable",
+            )
+            lowered = jax.jit(
+                program, donate_argnums=tuple(donate)
+            ).lower(*arg_specs)
+        t1 = time.perf_counter()
+        exe, compile_ms, disk_hit = _compile_program(lowered, cache_dir)
+    stats["trace_ms"] = round((t1 - t0) * 1e3, 3)
+    if known and disk_hit:
+        # the compile call rehydrated the cached executable: that wall
+        # IS the load
+        stats["load_ms"] = round(compile_ms, 3)
+        stats["cache_hit"] = 1
+        event["cache"] = "hit"
+        reg.counter(
+            "train_fused.cache_hits",
+            help="fused training executables rehydrated from the AOT "
+                 "compile cache instead of re-optimized",
+        ).inc()
+    else:
+        stats["compile_ms"] = round(compile_ms, 3)
+        stale = (cache is not None
+                 and cache.has_stale_sibling(fp, logical))
+        event["cache"] = "stale" if stale else "miss"
+        reg.counter(
+            "train_fused.cache_stale" if stale
+            else "train_fused.cache_misses",
+            help="fused training programs re-optimized because the "
+                 "cached record's fingerprint no longer matches"
+            if stale else
+            "fused training programs compiled cold (no cache entry)",
+        ).inc()
+        if cache is not None:
+            cache.store(fp, logical, {"sig": list(sig)})
+    prog = _Program(exe=exe, n_outputs=n_outputs, stats=stats)
+    with _PROGRAMS_LOCK:
+        if len(_PROGRAMS) >= _MAX_PROGRAMS:
+            _PROGRAMS.pop(next(iter(_PROGRAMS)))
+        _PROGRAMS[reg_key] = prog
+    event.update(stats)
+    return prog, event
+
+
+def reset_program_registry() -> None:
+    """Drop every in-process compiled program (tests / cache drills):
+    the next dispatch goes back through the on-disk AOT cache."""
+    with _PROGRAMS_LOCK:
+        _PROGRAMS.clear()
+
+
+def _merge_events(*events: dict) -> dict:
+    """One report entry from the fit/metric program events: cache state
+    keyed by the FIT program (the expensive executable), timing summed."""
+    out = dict(events[0])
+    for e in events[1:]:
+        for key in ("trace_ms", "compile_ms", "load_ms", "exec_ms"):
+            if key in e:
+                out[key] = round(out.get(key, 0.0) + e[key], 3)
+    return out
+
+
+def _run_metric_program(scores, y_folds, val_ok, g: int, mkind: str,
+                        mname: str,
+                        cache_dir: Optional[str]) -> tuple:
+    """The [k, g] metric matrix from fold-major stacked scores
+    [k*g, m]: builds/loads the shared metric program (family-agnostic -
+    one per (metric, shapes, dtype) bucket) and donates the score block
+    into it."""
+    jax = _jax()
+    jnp = jax.numpy
+    B, m = int(scores.shape[0]), int(scores.shape[1])
+    k = B // g
+    sig = ("metric", mkind, mname, str(scores.dtype), B, m, g)
+
+    def build():
+        def program(sc, yf, ok):
+            yb = jnp.repeat(yf, g, axis=0)       # [k*g, m]
+            okb = jnp.repeat(ok, g, axis=0)
+            if mkind == "rank":
+                auroc, aupr = exact_rank_metrics(sc, yb, okb)
+                vals = auroc if mname == "AuROC" else aupr
+            else:
+                vals = regression_metrics(sc, yb, okb, mname)
+            return (vals.reshape(k, g).astype(jnp.float64),)
+
+        return program
+
+    args = (scores, y_folds, val_ok)
+    specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+    prog, event = _get_program(
+        sig, build, specs, donate=(0,), n_outputs=1,
+        cache_dir=cache_dir)
+    with _x64():
+        (metrics,) = prog.exe(*args)
+        metrics = np.asarray(metrics)
+    return metrics, event
+
+
+# ---------------------------------------------------------------------------
+# Linear families
+# ---------------------------------------------------------------------------
+def run_linear(
+    est,
+    X,
+    y: np.ndarray,
+    masks: np.ndarray,
+    w: np.ndarray,
+    weights_given: bool,
+    regs: np.ndarray,
+    ens: np.ndarray,
+    g: int,
+    evaluator,
+    mode: str,
+    cache_dir: Optional[str] = None,
+) -> FusedDispatchResult:
+    """One fused dispatch for a batched linear family (LR / linear SVC /
+    linear regression): returns the [k, g] metric matrix + betas, or
+    raises :class:`FusedTrainError` with the fallback reason.
+
+    ``X`` may be the validator's hoisted device buffer (shared across
+    families - it is NOT donated); the [B, n] fold-weight block this
+    call builds IS donated into the fit program and never touched
+    again."""
+    jax = _jax()
+    jnp = jax.numpy
+    kind = metric_kind(evaluator)
+    if kind is None:
+        raise FusedTrainError(
+            "evaluator_unsupported", type(evaluator).__name__)
+    mkind, mname = kind
+    if mode == "approx" and mkind != "rank":
+        raise FusedTrainError("approx_needs_rank_metric")
+    if not hasattr(est, "fused_train_core"):
+        raise FusedTrainError("family_unsupported", est.model_type)
+    from ..models.packed_newton import use_packed
+
+    k, n = masks.shape
+    packed = bool(use_packed(X))
+    core = est.fused_train_core(packed)
+    d = int(X.shape[1])
+    sig = (
+        "linear-fit", est.model_type, tuple(core.get("sig", ())),
+        int(n), int(d), int(k), int(g), bool(weights_given),
+    )
+
+    def build():
+        fit_fn = core["fit"]
+
+        def program(Xd, y32, W, regs_d, ens_d):
+            return fit_fn(Xd, y32, W, regs_d, ens_d)
+
+        return program
+
+    # per-call device buffers; W is DONATED (arg index 2) and must never
+    # be read after the dispatch - the donation-safety test pins this
+    Xd = jnp.asarray(X, jnp.float32)
+    y32 = jnp.asarray(np.asarray(y), jnp.float32)
+    trainj = jnp.asarray(masks).astype(jnp.float32)
+    if not weights_given:
+        W = jnp.repeat(trainj, g, axis=0)
+    else:
+        wj = jnp.asarray(w, jnp.float32)
+        W = jnp.repeat(trainj * wj[None, :], g, axis=0)
+    regs_d = jnp.asarray(np.asarray(regs, np.float32))
+    ens_d = jnp.asarray(np.asarray(ens, np.float32))
+    args = (Xd, y32, W, regs_d, ens_d)
+    specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+    prog, fit_event = _get_program(
+        sig, build, specs, donate=(2,), n_outputs=2,
+        cache_dir=cache_dir)
+    t0 = time.perf_counter()
+    with _x64():
+        betas_d, b0s_d = prog.exe(*args)
+    if mode == "approx":
+        # the existing approx arm's own kernels, fed the fit program's
+        # device betas: bit-equal to that arm by construction
+        from ..evaluators.binary import masked_rank_metrics
+        from ..selector.validator import _margins_kernel
+
+        scores = _margins_kernel(
+            Xd, jnp.asarray(betas_d, jnp.float32),
+            jnp.asarray(b0s_d, jnp.float32),
+        ).T
+        vmask = jnp.repeat(1.0 - trainj, g, axis=0)
+        auroc_b, aupr_b = masked_rank_metrics(scores, y32, vmask)
+        vals = auroc_b if mname == "AuROC" else aupr_b
+        metrics = np.asarray(vals, np.float64).reshape(k, g)
+        met_event: dict = {}
+    else:
+        val_idx, val_ok = val_gather_plan(masks)
+        score_fn = core["score"]
+        with _x64():
+            # one jitted score kernel per family, reused across the
+            # k x g candidates.  The fold's validation rows are gathered
+            # EAGERLY (device buffer -> device buffer, a pure copy), so
+            # the kernel sees exactly the [m, d] operand shape and
+            # buffer contents the per-candidate dispatch jits - the same
+            # jaxpr on the same buffers is bitwise-deterministic, where
+            # a fused in-program gather or a full-matrix matvec picks a
+            # different dot emitter (module docstring); betas stay
+            # device-resident slices
+            score_jit = jax.jit(score_fn)
+            vidx_d = jnp.asarray(val_idx)
+            rows = []
+            for f in range(k):
+                Xv = Xd[vidx_d[f]]
+                for j in range(g):
+                    b = f * g + j
+                    rows.append(score_jit(Xv, betas_d[b], b0s_d[b]))
+            scores = jnp.stack(rows)  # [k*g, m] fold-major
+            y_folds = jnp.asarray(np.asarray(y, np.float64))[vidx_d]
+        metrics, met_event = _run_metric_program(
+            scores, y_folds, jnp.asarray(val_ok), g, mkind, mname,
+            cache_dir)
+    out_betas = np.asarray(betas_d)
+    out_b0s = np.asarray(b0s_d)
+    event = _merge_events(fit_event, met_event) if met_event else fit_event
+    event["exec_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+    _counters().counter(
+        "train_fused.dispatches",
+        help="family fold x grid dispatches that ran as fused "
+             "programs",
+    ).inc()
+    return FusedDispatchResult(
+        metrics=metrics, betas=out_betas, b0s=out_b0s,
+        report=dict(event, backend="fused", mode=mode,
+                    bucket=f"n={n},d={d},k={k},g={g}"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tree families
+# ---------------------------------------------------------------------------
+def run_tree(
+    est,
+    X: np.ndarray,
+    y: np.ndarray,
+    masks: np.ndarray,
+    W: np.ndarray,
+    grid: Sequence[dict],
+    evaluator,
+    cache_dir: Optional[str] = None,
+) -> FusedDispatchResult:
+    """One fused dispatch for a tree family (random forest / GBT): the
+    whole grid x fold fit as ONE donated-buffers program (heaps stay on
+    device), per-candidate traversal scoring over the once-gathered
+    validation bins, and the shared metric program.  Raises
+    :class:`FusedTrainError` with the fallback reason (native backend,
+    chunked dispatch, multiple shape groups...)."""
+    jax = _jax()
+    jnp = jax.numpy
+    kind = metric_kind(evaluator)
+    if kind is None:
+        raise FusedTrainError(
+            "evaluator_unsupported", type(evaluator).__name__)
+    mkind, mname = kind
+    if not hasattr(est, "fused_tree_plan"):
+        raise FusedTrainError("family_unsupported", est.model_type)
+    try:
+        plan = est.fused_tree_plan(X, y, W, list(grid))
+    except ValueError as e:
+        raise FusedTrainError(str(e) or "tree_plan_rejected") from e
+    k, n = masks.shape
+    G = len(grid)
+    val_idx, val_ok = val_gather_plan(masks)
+    names = list(plan["arrays"])
+    donate_idx = tuple(
+        names.index(nm) for nm in plan.get("donate", ()) if nm in names
+    )
+    sig = (
+        "tree-fit", est.model_type, tuple(plan["sig"]),
+        int(n), int(X.shape[1]), int(k), int(G),
+    )
+    n_state = int(plan["n_state"])
+
+    def build():
+        fit_fn = plan["fit"]
+
+        def program(*flat):
+            return tuple(fit_fn(dict(zip(names, flat))))
+
+        return program
+
+    args = tuple(jnp.asarray(plan["arrays"][nm]) for nm in names)
+    specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+    prog, fit_event = _get_program(
+        sig, build, specs, donate=donate_idx, n_outputs=n_state,
+        cache_dir=cache_dir)
+    bins_all = args[names.index(plan["bins_key"])]
+    t0 = time.perf_counter()
+    with _x64():
+        state = prog.exe(*args)
+        vidx_d = jnp.asarray(val_idx)
+        rows = []
+        for f in range(k):
+            # ONE validation-bins gather per fold (pure integer
+            # movement - exactly the bin values the per-candidate
+            # re-binning of the existing path produces), then the
+            # family's predict mirror per candidate with every operand
+            # a device buffer
+            bins_v = bins_all[vidx_d[f]]
+            for gi in range(G):
+                rows.append(plan["score"](state, bins_v, f, gi))
+        scores = jnp.stack([jnp.asarray(r) for r in rows])
+        y_folds = jnp.asarray(np.asarray(y, np.float64))[vidx_d]
+    metrics, met_event = _run_metric_program(
+        scores, y_folds, jnp.asarray(val_ok), G, mkind, mname,
+        cache_dir)
+    event = _merge_events(fit_event, met_event)
+    event["exec_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+    _counters().counter(
+        "train_fused.dispatches",
+        help="family fold x grid dispatches that ran as fused "
+             "programs",
+    ).inc()
+    return FusedDispatchResult(
+        metrics=metrics, betas=None, b0s=None,
+        report=dict(event, backend="fused", mode="exact",
+                    bucket=f"n={n},d={int(X.shape[1])},k={k},g={G}"),
+    )

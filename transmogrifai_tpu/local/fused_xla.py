@@ -81,6 +81,41 @@ def _jax():
     return jax
 
 
+def aot_compile(lowered):
+    """Serialization-sound AOT compile of a lowered jax program - THE
+    shared seam for every executable that will ride
+    ``jax.experimental.serialize_executable`` into a cache (the PR-12
+    serving buckets and the ISSUE-15 training programs):
+
+    * jax's persistent compilation cache is OFF for the duration of the
+      compile - serialize() of an executable REHYDRATED from that cache
+      yields a payload missing its compiled symbol definitions
+      (XlaRuntimeError 'Symbols not found' at deserialize; reproduced on
+      jaxlib 0.4.36 CPU under the tier-1 8-device config);
+    * on CPU the compile uses the legacy runtime
+      (xla_cpu_use_thunk_runtime=False): the thunk runtime dedupes JIT
+      fusion symbols against process state, so its serialized
+      executables fail to load in any process where a same-named fusion
+      is already resident - exactly a long-lived replica or trainer.
+
+    The toggle window is serialized process-wide (_COMPILE_CACHE_LOCK):
+    jax.config.update mutates global state, and two concurrent compiles
+    interleaving save/restore could leave the cache disabled for the
+    whole process."""
+    jax = _jax()
+    opts = (
+        {"xla_cpu_use_thunk_runtime": False}
+        if jax.default_backend() == "cpu" else None
+    )
+    with _COMPILE_CACHE_LOCK:
+        cc_old = jax.config.jax_enable_compilation_cache
+        try:
+            jax.config.update("jax_enable_compilation_cache", False)
+            return lowered.compile(compiler_options=opts)
+        finally:
+            jax.config.update("jax_enable_compilation_cache", cc_old)
+
+
 @contextlib.contextmanager
 def _x64():
     """x64 tracing/execution window: the fused env contract is float64
@@ -399,29 +434,7 @@ class XlaFusedPipeline:
                 t0 = time.perf_counter()
                 lowered = jax.jit(program).lower(spec)
                 t1 = time.perf_counter()
-                # serialization-sound compile (jaxlib 0.4.36 CPU):
-                # (a) the persistent compilation cache is OFF for this
-                # compile - serialize() of an executable REHYDRATED
-                # from it yields a payload missing its compiled symbol
-                # definitions; (b) the CPU thunk runtime dedupes JIT
-                # symbols against process state, so its serialized
-                # executables fail with "Symbols not found" whenever a
-                # same-named fusion was already resident - the legacy
-                # runtime embeds everything and round-trips cleanly
-                # (both reproduced under the tier-1 8-device config)
-                opts = (
-                    {"xla_cpu_use_thunk_runtime": False}
-                    if jax.default_backend() == "cpu" else None
-                )
-                with _COMPILE_CACHE_LOCK:
-                    cc_old = jax.config.jax_enable_compilation_cache
-                    try:
-                        jax.config.update(
-                            "jax_enable_compilation_cache", False)
-                        exe = lowered.compile(compiler_options=opts)
-                    finally:
-                        jax.config.update(
-                            "jax_enable_compilation_cache", cc_old)
+                exe = aot_compile(lowered)
                 t2 = time.perf_counter()
             stats["trace_ms"] = (t1 - t0) * 1e3
             stats["compile_ms"] = (t2 - t1) * 1e3

@@ -18,8 +18,12 @@ import numpy as np
 from .base import PredictorEstimator
 
 
-@partial(jax.jit, static_argnames=("l1_iters",))
-def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
+def linreg_core(X, y, w, reg, elastic_net, l1_iters: int = 8,
+                fixed_point: bool = False):
+    """Un-jitted, dtype-pinned closed-form ridge / reweighted-L1 core
+    (see logistic_regression.lr_newton_core for the seam contract):
+    ``_linreg_fit_kernel`` wraps it for dispatch, fused training
+    programs trace it inline."""
     n, d = X.shape
     wsum = w.sum()
     # global pre-centering + inactive-column exclusion: same f32
@@ -52,25 +56,39 @@ def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
 
     ridge = pd_jitter(jnp.trace(G) / d, d, hess_bf16=False)
 
-    def step(beta, _):
+    def step(beta):
         l1_diag = lam_l1 / (jnp.abs(beta) + 1e-3)
         H = G + jnp.diag(
-            lam_l2 + l1_diag + ridge + (1.0 - active)
+            lam_l2 + l1_diag + ridge + (1.0 - active).astype(X.dtype)
         )
         new = jax.scipy.linalg.solve(H, c, assume_a="pos")
-        return jnp.where(jnp.isfinite(new), new, beta), None
+        return jnp.where(jnp.isfinite(new), new, beta)
 
-    beta_s, _ = jax.lax.scan(step, jnp.zeros((d,)), None, length=l1_iters)
+    from .packed_newton import run_newton
+
+    beta_s = run_newton(step, jnp.zeros((d,), X.dtype), l1_iters,
+                        fixed_point)
     beta = beta_s / sd
     intercept = ybar - ((mu + m0) * beta).sum()
     return beta, intercept
 
 
+@partial(jax.jit, static_argnames=("l1_iters",))
+def _linreg_fit_kernel(X, y, w, reg, elastic_net, l1_iters: int = 8):
+    """Jitted kernel-at-a-time wrapper over :func:`linreg_core`."""
+    return linreg_core(X, y, w, reg, elastic_net, l1_iters)
+
+
+def linreg_fit_batched_core(X, y, W, regs, ens, fixed_point: bool = False):
+    """Un-jitted vmapped fold x grid batch (fused-program seam)."""
+    return jax.vmap(
+        lambda w, reg, en: linreg_core(X, y, w, reg, en,
+                                       fixed_point=fixed_point),
+    )(W, regs, ens)
+
+
 _linreg_fit_batched = jax.jit(
-    jax.vmap(
-        lambda X, y, w, reg, en: _linreg_fit_kernel(X, y, w, reg, en),
-        in_axes=(None, None, 0, 0, 0),
-    )
+    lambda X, y, W, regs, ens: linreg_fit_batched_core(X, y, W, regs, ens)
 )
 
 
@@ -145,6 +163,29 @@ class OpLinearRegression(PredictorEstimator):
                 jnp.asarray(regs), jnp.asarray(ens),
             )
         return np.asarray(beta), np.asarray(b0)
+
+    def fused_train_core(self, packed: bool):
+        """Fused-training seam (local/fused_train.py): same contract as
+        OpLogisticRegression.fused_train_core.  The 'score' is the raw
+        prediction (regression evaluators consume it directly), computed
+        as the same f32 matvec ``_linreg_predict_kernel`` runs."""
+        if packed:
+            from .packed_newton import linreg_fit_batched_packed_core
+
+            def fit(X, y, W, regs, ens):
+                return linreg_fit_batched_packed_core(
+                    X, y, W, regs, ens, fixed_point=True
+                )
+        else:
+            def fit(X, y, W, regs, ens):
+                return linreg_fit_batched_core(
+                    X, y, W, regs, ens, fixed_point=True
+                )
+
+        def score(X, beta, b0):
+            return X @ beta + b0
+
+        return {"fit": fit, "score": score, "sig": ("linreg", packed)}
 
     # -- streamed sufficient-statistics fit (readers/pipeline.py) ----------
     @staticmethod

@@ -18,13 +18,15 @@ import numpy as np
 from .base import PredictorEstimator
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
+def svc_newton_core(X, y, w, reg, iters: int = 20,
+                    fixed_point: bool = False):
     """Standardization is folded into the algebra (the identities in
     logistic_regression._lr_fit_kernel) so the kernel never materializes a
     standardized copy of X - under vmap over CV fold/grid weight vectors
     every replica reads the SHARED design matrix and adds only O(d^2)
-    state."""
+    state.  Un-jitted, dtype-pinned core (see
+    logistic_regression.lr_newton_core): ``_svc_fit_kernel`` wraps it
+    for dispatch, fused training programs trace it inline."""
     n, d = X.shape
     ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
     wsum = jnp.maximum(w.sum(), 1e-12)
@@ -45,7 +47,7 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
     hess_bf16 = _hessian_bf16()
     Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
 
-    def step(carry, _):
+    def step(carry):
         beta, b0 = carry  # beta in standardized space
         gamma = beta / sd
         margin = ypm * (X @ gamma + (b0 - mu @ gamma))
@@ -73,28 +75,44 @@ def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
 
         jitter = pd_jitter(jnp.trace(Hs) / d, d, hess_bf16, base=1e-8)
         H = (
-            Hs + jnp.diag(jnp.full((d,), 2.0 * reg)) + jitter * jnp.eye(d)
-            + jnp.diag(1.0 - active)
+            Hs + jnp.diag(jnp.full((d,), 2.0 * reg))
+            + jitter * jnp.eye(d, dtype=X.dtype)
+            + jnp.diag((1.0 - active).astype(X.dtype))
         )
         g0 = sr / wsum
         h0 = s / wsum + 1e-8
         delta = guarded_step(
             jax.scipy.linalg.solve(H, g, assume_a="pos"), g
         )
-        return (beta - delta, b0 - g0 / h0), None
+        return beta - delta, b0 - g0 / h0
 
-    (beta_s, b0), _ = jax.lax.scan(
-        step, (jnp.zeros((d,)), jnp.asarray(0.0)), None, length=iters
+    from .packed_newton import run_newton
+
+    beta_s, b0 = run_newton(
+        step, (jnp.zeros((d,), X.dtype), jnp.zeros((), X.dtype)),
+        iters, fixed_point,
     )
     beta = beta_s / sd
     return beta, b0 - ((mu + m0) * beta).sum()
 
 
 @partial(jax.jit, static_argnames=("iters",))
-def _svc_fit_batched(X, y, W, regs, iters: int):
+def _svc_fit_kernel(X, y, w, reg, iters: int = 20):
+    """Jitted kernel-at-a-time wrapper over :func:`svc_newton_core`."""
+    return svc_newton_core(X, y, w, reg, iters)
+
+
+def svc_fit_batched_core(X, y, W, regs, iters: int,
+                         fixed_point: bool = False):
+    """Un-jitted vmapped fold x grid batch (fused-program seam)."""
     return jax.vmap(
-        lambda w, r: _svc_fit_kernel(X, y, w, r, iters)
+        lambda w, r: svc_newton_core(X, y, w, r, iters, fixed_point)
     )(W, regs)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _svc_fit_batched(X, y, W, regs, iters: int):
+    return svc_fit_batched_core(X, y, W, regs, iters)
 
 
 class OpLinearSVC(PredictorEstimator):
@@ -159,6 +177,42 @@ class OpLinearSVC(PredictorEstimator):
                 jnp.asarray(regs), iters=iters,
             )
         return np.asarray(beta), np.asarray(b0)
+
+    def fused_train_core(self, packed: bool):
+        """Fused-training seam (local/fused_train.py): same contract as
+        OpLogisticRegression.fused_train_core.  The ranking score mirrors
+        ``predict_arrays`` - SVC exposes no probability, so the evaluator
+        ranks the 0/1 prediction; the margin sign is computed in f64 like
+        the numpy head (only the f64->f32 design-matrix cast differs)."""
+        from .logistic_regression import _hessian_bf16
+
+        iters = int(self.params.get("max_iter", 20))
+        # trace-time Hessian dtype is part of the program identity
+        # (see OpLogisticRegression.fused_train_core)
+        hess_bf16 = _hessian_bf16()
+        if packed:
+            from .packed_newton import svc_fit_batched_packed_core
+
+            def fit(X, y, W, regs, ens):
+                return svc_fit_batched_packed_core(
+                    X, y, W, regs, iters=iters, hess_bf16=hess_bf16,
+                    fixed_point=True,
+                )
+        else:
+            def fit(X, y, W, regs, ens):
+                return svc_fit_batched_core(
+                    X, y, W, regs, iters, fixed_point=True
+                )
+
+        def score(X, beta, b0):
+            z = (
+                X.astype(jnp.float64) @ beta.astype(jnp.float64)
+                + b0.astype(jnp.float64)
+            )
+            return (z > 0).astype(jnp.float64)
+
+        return {"fit": fit, "score": score,
+                "sig": ("svc", iters, packed, hess_bf16)}
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         z = X @ params["beta"] + params["intercept"]

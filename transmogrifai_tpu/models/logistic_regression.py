@@ -41,20 +41,28 @@ def _hessian_bf16() -> bool:
         return False
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def _lr_fit_kernel(
+def lr_newton_core(
     X: jnp.ndarray,
     y: jnp.ndarray,
     w: jnp.ndarray,
     reg: jnp.ndarray,
     elastic_net: jnp.ndarray,
     iters: int = 25,
+    fixed_point: bool = False,
 ):
     """Weighted L2(+approx L1) logistic regression via Newton/IRLS.
 
     X: [n, d] WITHOUT intercept column; y: [n] in {0,1}; w: [n] sample
     weights; reg: scalar regParam; elastic_net: scalar alpha in [0,1].
     Returns (beta [d], intercept scalar) on the raw feature scale.
+
+    Un-jitted core: ``_lr_fit_kernel`` wraps it for the kernel-at-a-time
+    dispatch; the fused training program (local/fused_train.py) traces it
+    inside ONE fit->score->metrics jit.  Dtypes are pinned to ``X.dtype``
+    so tracing under an enable_x64 window emits exactly the f32 graph the
+    standalone jit emits; ``fixed_point=True`` swaps the fixed-length
+    Newton scan for the bitwise-fixed-point early-exit loop
+    (packed_newton.run_newton - output identical by construction).
     """
     n, d = X.shape
     wsum = w.sum()
@@ -96,7 +104,7 @@ def _lr_fit_kernel(
     hess_bf16 = _hessian_bf16()
     Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
 
-    def step(carry, _):
+    def step(carry):
         beta, b0 = carry  # beta in standardized space
         gamma = beta / sd
         z = X @ gamma + (b0 - mu @ gamma)
@@ -130,18 +138,22 @@ def _lr_fit_kernel(
         amask = jnp.outer(active, active)
         Hs_m = Hs * amask
         H = (
-            Hs_m + jnp.diag(lam_l2 + l1_diag) + jitter * jnp.eye(d)
-            + jnp.diag(1.0 - active)
+            Hs_m + jnp.diag(lam_l2 + l1_diag)
+            + jitter * jnp.eye(d, dtype=X.dtype)
+            + jnp.diag((1.0 - active).astype(X.dtype))
         )
         g0 = sr / wsum
         h0 = s / wsum
         delta = guarded_step(
             jax.scipy.linalg.solve(H, g, assume_a="pos"), g
         )
-        return (beta - delta, b0 - g0 / h0), None
+        return beta - delta, b0 - g0 / h0
 
-    (beta_s, b0), _ = jax.lax.scan(
-        step, (jnp.zeros((d,)), jnp.asarray(0.0)), None, length=iters
+    from .packed_newton import run_newton
+
+    beta_s, b0 = run_newton(
+        step, (jnp.zeros((d,), X.dtype), jnp.zeros((), X.dtype)),
+        iters, fixed_point,
     )
     beta = beta_s / sd
     intercept = b0 - ((mu + m0) * beta).sum()  # un-center the intercept
@@ -149,10 +161,25 @@ def _lr_fit_kernel(
 
 
 @partial(jax.jit, static_argnames=("iters",))
-def _lr_fit_batched(X, y, W, regs, ens, iters: int = 25):
+def _lr_fit_kernel(X, y, w, reg, elastic_net, iters: int = 25):
+    """Jitted kernel-at-a-time wrapper over :func:`lr_newton_core`."""
+    return lr_newton_core(X, y, w, reg, elastic_net, iters)
+
+
+def lr_fit_batched_core(X, y, W, regs, ens, iters: int = 25,
+                        fixed_point: bool = False):
+    """The vmapped fold x grid batch over the shared design matrix: ONE
+    computation = the whole CV fan-out (un-jitted so fused training
+    programs can trace it; ``_lr_fit_batched`` is the dispatch wrapper)."""
     return jax.vmap(
-        lambda w, reg, en: _lr_fit_kernel(X, y, w, reg, en, iters)
+        lambda w, reg, en: lr_newton_core(X, y, w, reg, en, iters,
+                                          fixed_point)
     )(W, regs, ens)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _lr_fit_batched(X, y, W, regs, ens, iters: int = 25):
+    return lr_fit_batched_core(X, y, W, regs, ens, iters)
 
 
 @partial(jax.jit, static_argnames=("iters",))
@@ -463,6 +490,45 @@ class OpLogisticRegression(PredictorEstimator):
                 jnp.asarray(regs), jnp.asarray(ens), iters=iters,
             )
         return np.asarray(beta), np.asarray(b0)
+
+    def fused_train_core(self, packed: bool):
+        """Traceable (fit, score) pair for the fused training program
+        (local/fused_train.py, ISSUE 15): ``fit`` is the SAME batched
+        Newton math the kernel-at-a-time dispatch runs (vmap or packed
+        route picked by the caller with the same ``use_packed`` rule),
+        with the bitwise-fixed-point early exit; ``score`` mirrors
+        ``_lr_predict_kernel``'s ranking score (prob of class 1) op for
+        op over the FULL design matrix - the caller gathers validation
+        rows from the [n] score vector, because per-row dots over the
+        parameter X are bit-equal to the per-candidate dispatch while a
+        dot over a gathered operand picks a different CPU emitter.
+        Binary labels only - the validator's ``_labels_ok`` gate owns
+        that."""
+        iters = int(self.params.get("max_iter", 25))
+        # the Hessian dtype is baked in at TRACE time (vmap route reads
+        # it inside the core, packed route here), so it must be part of
+        # the program signature: a TX_LR_HESSIAN_BF16 flip mid-process
+        # must retrace, not silently reuse the old-precision program
+        hess_bf16 = _hessian_bf16()
+        if packed:
+            from .packed_newton import lr_fit_batched_packed_core
+
+            def fit(X, y, W, regs, ens):
+                return lr_fit_batched_packed_core(
+                    X, y, W, regs, ens, iters=iters,
+                    hess_bf16=hess_bf16, fixed_point=True,
+                )
+        else:
+            def fit(X, y, W, regs, ens):
+                return lr_fit_batched_core(
+                    X, y, W, regs, ens, iters, fixed_point=True
+                )
+
+        def score(X, beta, b0):
+            return jax.nn.sigmoid(X @ beta + b0)
+
+        return {"fit": fit, "score": score,
+                "sig": ("lr", iters, packed, hess_bf16)}
 
     def fit_arrays_folds(self, X, y, W):
         """One config, k folds in one vmapped dispatch: W [k, n] per-fold

@@ -222,6 +222,61 @@ def _batched_diag(v):
     return v[:, :, None] * jnp.eye(d, dtype=v.dtype)
 
 
+def newton_fixed_point(step, init, length: int):
+    """Run ``carry = step(carry)`` until the carry reaches a BITWISE fixed
+    point, or ``length`` iterations - whichever comes first.
+
+    The output is IDENTICAL to ``lax.scan`` of the same step for
+    ``length`` iterations: every Newton step here is a deterministic pure
+    function of its carry, so once ``step(c) == c`` bit-for-bit, every
+    further iteration reproduces ``c`` exactly and running them is pure
+    waste.  (The guarded steps make this reachable: ``guarded_step``
+    zeroes the beta delta once the gradient is at f32 noise, and the
+    intercept update ``b0 - g0/h0`` stops changing ``b0`` once ``g0/h0``
+    falls below half a ULP of ``b0``.)  A NaN anywhere in the carry can
+    never spuriously terminate the loop (NaN != NaN), so a diverging fit
+    runs the full budget exactly like the scan would.
+
+    This is the fused-training-program fit loop (local/fused_train.py,
+    ISSUE 15): the whole-fit ``while_loop`` is only expressible when fit
+    -> score -> metrics compile as ONE program; the kernel-at-a-time
+    dispatch keeps its fixed-length scan as the bit-identical baseline.
+    """
+
+    def body(state):
+        carry, i, _ = state
+        new = step(carry)
+        done = jnp.bool_(True)
+        for old_leaf, new_leaf in zip(
+            jax.tree_util.tree_leaves(carry),
+            jax.tree_util.tree_leaves(new),
+        ):
+            done = done & jnp.all(old_leaf == new_leaf)
+        return new, i + jnp.int32(1), done
+
+    def cond(state):
+        _, i, done = state
+        return (~done) & (i < length)
+
+    carry, _i, _done = jax.lax.while_loop(
+        cond, body, (init, jnp.int32(0), jnp.bool_(False))
+    )
+    return carry
+
+
+def run_newton(step, init, length: int, fixed_point: bool = False):
+    """The one point of truth for the Newton iteration loop: the scan
+    form (kernel-at-a-time dispatch, exactly the pre-fused graph) or the
+    bitwise fixed-point while loop (fused training programs).  Both
+    produce identical carries; only the wasted tail iterations differ."""
+    if fixed_point:
+        return newton_fixed_point(step, init, length)
+    carry, _ = jax.lax.scan(
+        lambda c, _: (step(c), None), init, None, length=length
+    )
+    return carry
+
+
 _psolve = jax.vmap(partial(jax.scipy.linalg.solve, assume_a="pos"))
 
 
@@ -254,15 +309,21 @@ def guarded_step(delta, g, axis=None):
     return _jnp.where(ok & _jnp.isfinite(delta), delta, 0.0)
 
 
-@partial(jax.jit, static_argnames=("iters", "hess_bf16", "mesh"))
-def lr_fit_batched_packed(
-    X, y, W, regs, ens, iters: int, hess_bf16: bool, mesh=None
+def lr_fit_batched_packed_core(
+    X, y, W, regs, ens, iters: int, hess_bf16: bool, mesh=None,
+    fixed_point: bool = False,
 ):
     """Explicitly-batched weighted logistic IRLS: X [n, d], y [n],
     W [B, n] per-replica sample weights, regs/ens [B].  Same per-row math
     as logistic_regression._lr_fit_kernel under vmap; the Gram is packed
     (shard_map over ``mesh`` when the caller's arrays are mesh-sharded).
-    Returns (beta [B, d] raw-scale, intercept [B])."""
+    Returns (beta [B, d] raw-scale, intercept [B]).
+
+    Un-jitted core so the fused training program (local/fused_train.py)
+    can trace it INSIDE one fit->score->metrics jit; dtypes are pinned to
+    ``X.dtype`` so tracing under an enable_x64 window emits exactly the
+    f32 graph the standalone jit emits (``fixed_point=True`` swaps the
+    fixed-length scan for the bit-identical early-exit while loop)."""
     n, d = X.shape
     B = W.shape[0]
     wsum = W.sum(axis=1)  # [B]
@@ -283,9 +344,9 @@ def lr_fit_batched_packed(
     eps = 1e-8
     Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
     Wn = W.T  # [n, B]
-    eye = jnp.eye(d)
+    eye = jnp.eye(d, dtype=X.dtype)
 
-    def step(carry, _):
+    def step(carry):
         beta, b0 = carry  # [B, d], [B]
         gamma = beta / sd  # [B, d]
         z = X @ gamma.T + (b0 - (mu * gamma).sum(axis=1))[None, :]  # [n, B]
@@ -316,16 +377,19 @@ def lr_fit_batched_packed(
         )
         H = (
             Hs
-            + _batched_diag(lam_l2[:, None] + l1_diag + (1.0 - active))
+            + _batched_diag(
+                lam_l2[:, None] + l1_diag + (1.0 - active).astype(X.dtype)
+            )
             + jitter[:, None, None] * eye
         )
         g0 = sr / wsum
         h0 = s / wsum
         delta = guarded_step(_psolve(H, g), g, axis=1)
-        return (beta - delta, b0 - g0 / h0), None
+        return beta - delta, b0 - g0 / h0
 
-    (beta_s, b0), _ = jax.lax.scan(
-        step, (jnp.zeros((B, d)), jnp.zeros((B,))), None, length=iters
+    beta_s, b0 = run_newton(
+        step, (jnp.zeros((B, d), X.dtype), jnp.zeros((B,), X.dtype)),
+        iters, fixed_point,
     )
     beta = beta_s / sd
     intercept = b0 - ((mu + m0[None, :]) * beta).sum(axis=1)
@@ -333,11 +397,23 @@ def lr_fit_batched_packed(
 
 
 @partial(jax.jit, static_argnames=("iters", "hess_bf16", "mesh"))
-def svc_fit_batched_packed(
-    X, y, W, regs, iters: int, hess_bf16: bool, mesh=None
+def lr_fit_batched_packed(
+    X, y, W, regs, ens, iters: int, hess_bf16: bool, mesh=None
+):
+    """Jitted kernel-at-a-time wrapper over the core (the pre-fused
+    dispatch; reference semantics documented on the core)."""
+    return lr_fit_batched_packed_core(
+        X, y, W, regs, ens, iters, hess_bf16, mesh
+    )
+
+
+def svc_fit_batched_packed_core(
+    X, y, W, regs, iters: int, hess_bf16: bool, mesh=None,
+    fixed_point: bool = False,
 ):
     """Explicitly-batched squared-hinge Newton (linear_svc._svc_fit_kernel
-    under vmap, Gram packed).  Returns (beta [B, d], intercept [B])."""
+    under vmap, Gram packed).  Returns (beta [B, d], intercept [B]).
+    Un-jitted, dtype-pinned core (see lr_fit_batched_packed_core)."""
     n, d = X.shape
     B = W.shape[0]
     ypm = 2.0 * y - 1.0
@@ -352,9 +428,9 @@ def svc_fit_batched_packed(
     sd = jnp.where(active > 0, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
     Xh = X.astype(jnp.bfloat16) if hess_bf16 else X
     Wn = W.T  # [n, B]
-    eye = jnp.eye(d)
+    eye = jnp.eye(d, dtype=X.dtype)
 
-    def step(carry, _):
+    def step(carry):
         beta, b0 = carry
         gamma = beta / sd
         margin = ypm[:, None] * (
@@ -384,29 +460,42 @@ def svc_fit_batched_packed(
             Hs
             + _batched_diag(
                 jnp.broadcast_to(2.0 * regs[:, None], (B, d))
-                + (1.0 - active)
+                + (1.0 - active).astype(X.dtype)
             )
             + jitter
         )
         g0 = sr / wsum
         h0 = s / wsum + 1e-8
         delta = guarded_step(_psolve(H, g), g, axis=1)
-        return (beta - delta, b0 - g0 / h0), None
+        return beta - delta, b0 - g0 / h0
 
-    (beta_s, b0), _ = jax.lax.scan(
-        step, (jnp.zeros((B, d)), jnp.zeros((B,))), None, length=iters
+    beta_s, b0 = run_newton(
+        step, (jnp.zeros((B, d), X.dtype), jnp.zeros((B,), X.dtype)),
+        iters, fixed_point,
     )
     beta = beta_s / sd
     return beta, b0 - ((mu + m0[None, :]) * beta).sum(axis=1)
 
 
-@partial(jax.jit, static_argnames=("l1_iters", "mesh"))
-def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8, mesh=None):
+@partial(jax.jit, static_argnames=("iters", "hess_bf16", "mesh"))
+def svc_fit_batched_packed(
+    X, y, W, regs, iters: int, hess_bf16: bool, mesh=None
+):
+    """Jitted kernel-at-a-time wrapper over the core (the pre-fused
+    dispatch; reference semantics documented on the core)."""
+    return svc_fit_batched_packed_core(X, y, W, regs, iters, hess_bf16, mesh)
+
+
+def linreg_fit_batched_packed_core(
+    X, y, W, regs, ens, l1_iters: int = 8, mesh=None,
+    fixed_point: bool = False,
+):
     """Explicitly-batched weighted ridge / elastic-net (normal equations).
     The Gram weights are the FIXED fold masks, so the packed Gram runs
     ONCE - the l1 reweighting scan is [B, d, d] solves only.  The Gram
     stays f32: unlike the Newton kernels it defines the answer, not just
-    the step direction.  Returns (beta [B, d], intercept [B])."""
+    the step direction.  Returns (beta [B, d], intercept [B]).
+    Un-jitted, dtype-pinned core (see lr_fit_batched_packed_core)."""
     n, d = X.shape
     B = W.shape[0]
     wsum = W.sum(axis=1)
@@ -440,15 +529,24 @@ def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8, mesh=None):
         jnp.trace(G, axis1=1, axis2=2) / d, d, hess_bf16=False
     )[:, None]
 
-    def step(beta, _):
+    def step(beta):
         l1_diag = lam_l1[:, None] / (jnp.abs(beta) + 1e-3)
         H = G + _batched_diag(
-            lam_l2[:, None] + l1_diag + ridge + (1.0 - active)
+            lam_l2[:, None] + l1_diag + ridge + (1.0 - active).astype(X.dtype)
         )
         new = _psolve(H, c)
-        return jnp.where(jnp.isfinite(new), new, beta), None
+        return jnp.where(jnp.isfinite(new), new, beta)
 
-    beta_s, _ = jax.lax.scan(step, jnp.zeros((B, d)), None, length=l1_iters)
+    beta_s = run_newton(
+        step, jnp.zeros((B, d), X.dtype), l1_iters, fixed_point
+    )
     beta = beta_s / sd
     intercept = ybar - ((mu + m0[None, :]) * beta).sum(axis=1)
     return beta, intercept
+
+
+@partial(jax.jit, static_argnames=("l1_iters", "mesh"))
+def linreg_fit_batched_packed(X, y, W, regs, ens, l1_iters: int = 8, mesh=None):
+    """Jitted kernel-at-a-time wrapper over the core (the pre-fused
+    dispatch; reference semantics documented on the core)."""
+    return linreg_fit_batched_packed_core(X, y, W, regs, ens, l1_iters, mesh)

@@ -255,8 +255,12 @@ def fit_tree(
             # per-NODE random feature subsets (Spark RF selects a subset per
             # node; Bernoulli(k/d) approximates choose-k-without-replacement)
             lk = jax.random.fold_in(rng_key, level)
+            # p pinned to f32: bernoulli draws its uniforms in p's
+            # canonical dtype, so a python-float p under an enable_x64
+            # trace (the fused training programs) would sample DIFFERENT
+            # f64 uniforms and grow different trees than the plain trace
             level_mask = level_mask & jax.random.bernoulli(
-                lk, feature_subset_p, (L, d)
+                lk, jnp.float32(feature_subset_p), (L, d)
             )
         valid = (
             level_mask[:, :, None]
@@ -661,6 +665,19 @@ def fit_gbt_folds_grid(
     heaps = _concat_heaps(g_parts, axis=0)
     f0_gf = jnp.broadcast_to(f0s[None, :], (G, F))
     return f0_gf, heaps
+
+
+#: fused-training seams (local/fused_train.py, ISSUE 15): the un-jitted
+#: grid x fold fit cores, traceable INSIDE one fit->score->metrics
+#: program so the [2^l, d, bins, C] histogram working set - the
+#: memory-bound hot spot the _level_hist comments size - lives and dies
+#: within a single jit whose per-call buffers (fold weights, bootstrap
+#: weights, stat channels) arrive donated.  Bodies are shared with the
+#: kernel-at-a-time jit wrappers above, so fused == chunked bit-for-bit
+#: whenever one dispatch covers the whole G x F x T product.
+fit_forest_folds_grid_core = _fit_forest_folds_grid_core
+gbt_grid_scan_core = _gbt_grid_scan_core
+gbt_f0 = _gbt_f0
 
 
 def effective_max_depth(

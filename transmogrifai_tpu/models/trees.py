@@ -532,6 +532,96 @@ class _RandomForest(_TreeEnsembleBase):
                 ]
         return results
 
+    def fused_tree_plan(self, X, y, W, grid):
+        """Fused-training seam (local/fused_train.py, ISSUE 15): host
+        prep (binning, bootstrap, rng keys - identical to
+        fit_arrays_folds_grid's) plus traceable fit/predict closures the
+        one-program jit composes with the shared metric stage.  Raises
+        ``ValueError`` naming the reason when this grid cannot ride one
+        fused dispatch (native backend, multiple static-shape groups,
+        watchdog-chunked dispatch) - the validator falls back to the
+        existing path and records the reason."""
+        from .tree_kernel import (
+            fit_forest_folds_grid_core,
+            fits_per_dispatch,
+        )
+
+        n, d = X.shape
+        if _resolve_backend(str(self.params.get("backend", "auto")),
+                            n) == "native":
+            raise ValueError("native_backend")
+        cands = [self.with_params(**pmap) for pmap in grid]
+        n_stats = (len(np.unique(y)) + 1) if self.is_classification else 3
+        keys_seen = set()
+        for cand in cands:
+            p = cand.params
+            depth = effective_max_depth(
+                int(p["max_depth"]), n, float(p["min_instances_per_node"]),
+                d, int(p["max_bins"]), n_stats,
+                cap=str(p.get("depth_cap", "auto")),
+            )
+            keys_seen.add((
+                depth, int(p["max_bins"]), int(p["num_trees"]),
+                str(p["feature_subset_strategy"]), int(p["seed"]),
+                float(p["subsampling_rate"]),
+            ))
+        if len(keys_seen) > 1:
+            raise ValueError("grid_shape_groups")
+        (edges, bins, stats, C, imp, classes, boot, feat_masks,
+         seed_ints, subset_p, depth) = cands[0]._forest_inputs(X, y)
+        G, F, T = len(grid), len(W), boot.shape[0]
+        cap = fits_per_dispatch(depth, n, d, int(cands[0].params["max_bins"]),
+                                C)
+        if G * F * T > cap:
+            raise ValueError("dispatch_chunked")
+        if self.is_classification and len(classes) < 2:
+            raise ValueError("single_class")
+        max_bins = int(cands[0].params["max_bins"])
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seed_ints))
+        arrays = {
+            "bins": np.asarray(bins),
+            "stats": stats,
+            "w_rows": np.asarray(W, np.float32),
+            "boot": boot,
+            "feat_masks": feat_masks,
+            "keys": np.asarray(keys),
+            "minipn_g": np.asarray(
+                [float(c.params["min_instances_per_node"]) for c in cands],
+                np.float32),
+            "minig_g": np.asarray(
+                [float(c.params["min_info_gain"]) for c in cands],
+                np.float32),
+        }
+        is_classification = self.is_classification
+
+        def fit(a):
+            return fit_forest_folds_grid_core(
+                a["bins"], a["stats"], a["w_rows"], a["boot"],
+                a["feat_masks"], a["keys"], a["minipn_g"], a["minig_g"],
+                max_depth=depth, max_bins=max_bins, impurity_kind=imp,
+                n_stats=C, feature_subset_p=float(subset_p),
+            )
+
+        def score(state, bins_v, f, gi):
+            # mirrors predict_arrays' jax route per (g, f): the SAME
+            # predict_forest kernel over the (gi, f) heap slice - every
+            # operand a device buffer, so the f32 scores are bit-equal
+            # to the per-candidate dispatches
+            out = predict_forest(
+                bins_v, tuple(h[gi, f] for h in state), max_depth=depth)
+            return out[:, 1] if is_classification else out[:, 0]
+
+        return {
+            "arrays": arrays,
+            "donate": ("stats", "w_rows", "boot"),
+            "bins_key": "bins",
+            "fit": fit,
+            "n_state": 4,
+            "score": score,
+            "sig": ("forest", depth, max_bins, G, F, T, C, imp,
+                    float(subset_p), is_classification),
+        }
+
     def predict_arrays(self, params: Any, X: np.ndarray):
         out = None
         if _resolve_backend(str(self.params.get("backend", "auto")),
@@ -865,6 +955,97 @@ class _GBT(_TreeEnsembleBase):
                     for f in range(len(W))
                 ]
         return results
+
+    def fused_tree_plan(self, X, y, W, grid):
+        """Fused-training seam for boosted trees (see
+        _RandomForest.fused_tree_plan for the contract): one grid x fold
+        boosting scan plus the predict mirror of the jax
+        ``predict_arrays`` route, traceable inside the one-program jit.
+        Raises ``ValueError`` naming the fallback reason."""
+        self._check_labels(y)
+        from .tree_kernel import (
+            fits_per_dispatch,
+            gbt_f0,
+            gbt_grid_scan_core,
+        )
+
+        n, d = X.shape
+        if _resolve_backend(str(self.params.get("backend", "auto")),
+                            n) == "native":
+            raise ValueError("native_backend")
+        cands = [self.with_params(**pmap) for pmap in grid]
+        keys_seen = set()
+        for cand in cands:
+            p = cand.params
+            keys_seen.add((cand._gbt_depth(n, d), int(p["max_bins"]),
+                           int(p["num_trees"]), int(p["seed"])))
+        if len(keys_seen) > 1:
+            raise ValueError("grid_shape_groups")
+        depth, max_bins, num_trees, seed = next(iter(keys_seen))
+        G, F = len(grid), len(W)
+        if G * F * num_trees > fits_per_dispatch(depth, n, d, max_bins, 4):
+            raise ValueError("dispatch_chunked")
+        edges = _sampled_bin_edges(X, max_bins, seed)
+        bins = _bins_cast(_bin_for_backend(X, edges), max_bins)
+        arrays = {
+            "bins": np.asarray(bins),
+            "y32": np.asarray(y, np.float32),
+            "w_rows": np.asarray(W, np.float32),
+            "step_g": np.asarray(
+                [float(c.params["step_size"]) for c in cands], np.float32),
+            "minipn_g": np.asarray(
+                [float(c.params["min_instances_per_node"]) for c in cands],
+                np.float32),
+            "minig_g": np.asarray(
+                [float(c.params["min_info_gain"]) for c in cands],
+                np.float32),
+        }
+        is_classification = self.is_classification
+
+        step_host = arrays["step_g"]
+
+        def fit(a):
+            f0s = gbt_f0(a["y32"], a["w_rows"], is_classification)
+            margins = jnp.broadcast_to(f0s[None, :, None], (G, F, n))
+            _margins, heaps = gbt_grid_scan_core(
+                a["bins"], a["y32"], a["w_rows"], margins,
+                a["step_g"], a["minipn_g"], a["minig_g"],
+                num_trees=num_trees, max_depth=depth, max_bins=max_bins,
+                is_classification=is_classification,
+            )
+            return (f0s,) + tuple(heaps)
+
+        def score(state, bins_v, f, gi):
+            # the EXACT op sequence of predict_arrays' jax route per
+            # (g, f): vmapped per-tree traversal + eager f32
+            # contribution sum on device, then the f64 head on host
+            # (numpy sigmoid, like predict_arrays) - bit-equal to the
+            # per-candidate dispatches
+            f0s, hf, ht, hl, hv = state
+
+            def one_tree(ff, tt, ll, vv):
+                out = predict_tree(bins_v, ff, tt, ll, vv, depth)
+                return out[:, 1] / jnp.maximum(out[:, 3], 1e-12)
+
+            contribs = jax.vmap(one_tree)(
+                hf[gi, f], ht[gi, f], hl[gi, f], hv[gi, f])
+            Fm = float(f0s[f]) + float(step_host[gi]) * contribs.sum(
+                axis=0)
+            Fm = np.asarray(Fm, dtype=np.float64)
+            if is_classification:
+                return 1.0 / (1.0 + np.exp(-Fm))
+            return Fm
+
+        return {
+            "arrays": arrays,
+            "donate": ("w_rows",),
+            "bins_key": "bins",
+            "fit": fit,
+            "n_state": 5,
+            "score": score,
+            "sig": ("gbt", depth, max_bins, G, F, num_trees,
+                    is_classification),
+        }
 
     def predict_arrays(self, params: Any, X: np.ndarray):
         bins = jnp.asarray(

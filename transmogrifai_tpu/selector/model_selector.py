@@ -295,5 +295,14 @@ class ModelSelector(Estimator):
             model.metadata["model_selector_summary"]["autotune"] = (
                 result.autotune
             )
+        if result.train_fused is not None:
+            # the fused-training dispatch trail (ISSUE 15): which family
+            # dispatches ran fused / AOT-loaded / retraced, mirroring
+            # the PR-12 serving fused.cache telemetry shape - the
+            # continuous-refit loop asserts warm refits skip retrace on
+            # exactly this record
+            model.metadata["model_selector_summary"]["train_fused"] = (
+                result.train_fused
+            )
         self.metadata = model.metadata
         return model

@@ -47,6 +47,10 @@ class ValidationResult:
     #: successive-halving decision trail (ISSUE 13): rungs, prunes,
     #: predicted-vs-actual times; None when autotune was off
     autotune: Optional[dict] = None
+    #: fused-training dispatch trail (ISSUE 15): per-family backend
+    #: (fused / existing + reason), AOT-cache hits/misses/stale - the
+    #: warm-refit observability the continuous-training loop asserts on
+    train_fused: Optional[dict] = None
 
 
 def _numeric_params(pmap: dict) -> dict:
@@ -197,6 +201,14 @@ class OpValidator:
         #: decision trail of the LAST validate() call (also carried on
         #: ValidationResult.autotune); None when autotune was off
         self.last_autotune_report: Optional[dict] = None
+        #: fused-training knobs (ISSUE 15): None = auto (TX_TRAIN_FUSED
+        #: env + row floor), True/False force; cache dir holds the AOT
+        #: train executables (train_xla_cache/ next to autotune.json)
+        self.train_fused: Optional[bool] = None
+        self.train_cache_dir: Optional[str] = None
+        #: per-family dispatch trail of the LAST validate() call (also
+        #: carried on ValidationResult.train_fused)
+        self.last_train_fused: Optional[dict] = None
 
     # -- CV checkpoint ------------------------------------------------------
     def _ckpt_load(self) -> dict:
@@ -287,8 +299,115 @@ class OpValidator:
         )
         return self.evaluator.default_metric(m)
 
+    # -- fused training programs (ISSUE 15) ---------------------------------
+    def _train_fused_gate(self, n: int, mesh_present: bool) -> Optional[str]:
+        """None when the fused fold x grid program may engage for this
+        family, else the recorded fallback reason.  Auto mode engages
+        only at scale (TX_TRAIN_FUSED_MIN_ROWS, default 200k): below it
+        a one-shot validate pays more in trace+compile than the fused
+        dispatch saves, and the proven kernel-at-a-time path stays
+        bit-for-bit what it always was.  ``train_fused=True`` (or
+        TX_TRAIN_FUSED=1) forces the path at any size - warm-refit
+        loops and tests; a CV mesh always falls back (the PR-3 guarded
+        mesh route owns multi-device degradation unchanged)."""
+        import os
+
+        if self.train_fused is False:
+            return "disabled"
+        env = os.environ.get("TX_TRAIN_FUSED", "").strip().lower()
+        if env in ("0", "false", "off"):
+            return "disabled_env"
+        forced = self.train_fused is True or env in ("1", "true", "on")
+        if not forced:
+            min_rows = int(os.environ.get(
+                "TX_TRAIN_FUSED_MIN_ROWS", 200_000))
+            if n < min_rows:
+                return "below_min_rows"
+        if mesh_present:
+            return "mesh"
+        return None
+
+    def _record_train_fused(self, family: str, entry: dict) -> None:
+        rep = self.last_train_fused
+        if rep is None:
+            rep = self.last_train_fused = {
+                "backend": "existing",
+                "families": {},
+                "cache": {"hits": 0, "misses": 0, "stale": 0},
+            }
+        rep["families"][family] = entry
+        backends = {e.get("backend") for e in rep["families"].values()}
+        rep["backend"] = (
+            "fused" if backends == {"fused"}
+            else "existing" if backends == {"existing"} else "mixed"
+        )
+        c = entry.get("cache")
+        if c in ("hit", "memory"):
+            rep["cache"]["hits"] += 1
+        elif c == "miss":
+            rep["cache"]["misses"] += 1
+        elif c == "stale":
+            rep["cache"]["stale"] += 1
+
+    def _try_train_fused(self, kind: str, est, mode: str, **kw):
+        """Attempt the one-program dispatch for this family; None (with
+        the reason recorded in the trail) routes the caller to the
+        existing kernel-at-a-time path.  Any failure here must degrade,
+        never abort a selection."""
+        from ..local import fused_train as _ft
+
+        reason = self._train_fused_gate(
+            kw.pop("n"), kw.pop("mesh_present"))
+        if reason is not None:
+            self._record_train_fused(
+                est.model_type,
+                {"backend": "existing", "reason": reason})
+            return None
+        try:
+            with _obs_trace.span(
+                "cv.fit_batch", family=est.model_type,
+                candidates=int(kw["candidates"]), folds=int(kw["folds"]),
+                n_rows=int(kw["n_rows"]), n_features=int(kw["n_features"]),
+                fused=1,
+            ):
+                if kind == "linear":
+                    res = _ft.run_linear(
+                        est, kw["xdev"](), kw["y"], kw["masks"], kw["w"],
+                        kw["weights_given"], kw["regs"], kw["ens"],
+                        kw["g"], self.evaluator, mode,
+                        cache_dir=self.train_cache_dir,
+                    )
+                else:
+                    res = _ft.run_tree(
+                        est, kw["X"], kw["y"], kw["masks"], kw["W"],
+                        kw["grid"], self.evaluator,
+                        cache_dir=self.train_cache_dir,
+                    )
+        except _ft.FusedTrainError as e:
+            self._record_train_fused(
+                est.model_type,
+                {"backend": "existing", "reason": e.reason})
+            return None
+        except Exception as e:  # noqa: BLE001 - a fused-path bug must
+            # degrade to the proven dispatch, loudly, never kill the
+            # whole selection
+            import logging
+
+            logging.getLogger("transmogrifai_tpu.selector").warning(
+                "fused training dispatch for %s failed (%s: %s); "
+                "falling back to the kernel-at-a-time path",
+                est.model_type, type(e).__name__, e,
+            )
+            self._record_train_fused(
+                est.model_type,
+                {"backend": "existing",
+                 "reason": f"error:{type(e).__name__}"})
+            return None
+        self._record_train_fused(est.model_type, res.report)
+        return res
+
     # -- successive-halving pre-pass (ISSUE 13) -----------------------------
-    def _autotune_prune(self, models, X, y, w, masks, larger):
+    def _autotune_prune(self, models, X, y, w, masks, larger, xdev=None):
         """Budget-ladder rung 0: every candidate fits ONCE on a
         deterministic row subsample, the cost model plus interim eval
         scores pick survivors, and only survivors proceed to the full
@@ -334,7 +453,15 @@ class OpValidator:
         # ladder is reproducible run to run
         rng = np.random.RandomState(self.seed)
         sub = np.sort(rng.permutation(n)[: plan.rung_rows])
-        Xs, ys, ws = X[sub], y[sub], w[sub]
+        if xdev is not None:
+            # rung 0 shares the validate-wide device buffer (ISSUE 15
+            # satellite): one [rung_rows, d] gather off the already-
+            # converted f32 matrix instead of a second host fancy-index
+            # whose rows every rung fit re-converts f64->f32
+            Xs = np.asarray(xdev()[jnp.asarray(sub)])
+        else:
+            Xs = X[sub]
+        ys, ws = y[sub], w[sub]
         rtr = _rung_train_mask(ys, cfg.rung_train_fraction, self.seed)
         n_rtr = int(rtr.sum())
         cm = cfg.cost_model
@@ -455,11 +582,25 @@ class OpValidator:
             masks = self.train_masks(y)  # [k, n] True=train
         k = masks.shape[0]
         larger = self.evaluator.larger_better
+
+        # ONE f32 device upload of the design matrix per validate call
+        # (ISSUE 15 satellite): shared by every batched family dispatch,
+        # the fused training programs, and the successive-halving rung -
+        # lazy, so validators whose families all take host paths never
+        # pay the [n, d] conversion at all
+        _xdev_box: list = []
+
+        def _xdev():
+            if not _xdev_box:
+                _xdev_box.append(jnp.asarray(X, jnp.float32))
+            return _xdev_box[0]
+
+        self.last_train_fused = None
         at_report = None
         pruned_results: list = []
         if self.autotune is not None:
             models, at_report, pruned_results = self._autotune_prune(
-                models, X, y, w, masks, larger
+                models, X, y, w, masks, larger, xdev=_xdev
             )
         self.last_autotune_report = at_report
         all_results = []
@@ -472,9 +613,18 @@ class OpValidator:
         # per-fold validation slices: on an accelerator with enough rows.
         # On CPU hosts - or small data, where near-tied candidates could
         # flip on quantization - use the exact host metrics.
+        # TX_CV_RANK_METRICS=approx|exact overrides the auto rule (the
+        # fused-training parity drills exercise the approx arm on CPU).
+        import os as _os
+
         approx_rank = (
             jax.default_backend() == "tpu" and n >= 100_000
         )
+        _rank_env = _os.environ.get("TX_CV_RANK_METRICS", "").strip().lower()
+        if _rank_env == "approx":
+            approx_rank = True
+        elif _rank_env == "exact":
+            approx_rank = False
 
         ckpt = self._ckpt_load()
         self._beat()  # validation started: open the liveness window
@@ -523,7 +673,7 @@ class OpValidator:
             ]
             for j, pmap in enumerate(grid):
                 if done_mask[j]:
-                    metrics[j] = np.asarray(ckpt[_key(est, pmap, mode)])
+                    metrics[j] = ckpt[_key(est, pmap, mode)]
             if all(done_mask):
                 pass  # everything restored from checkpoint
             elif (
@@ -540,119 +690,140 @@ class OpValidator:
                 regs_g, ens_g = lr_grid_scalars(est, grid)
                 regs = np.tile(regs_g, k)  # fold-major [k*g] replicas
                 ens = np.tile(ens_g, k)
-                Xj = jnp.asarray(X, jnp.float32)
-                trainj = jnp.asarray(masks).astype(jnp.float32)  # [k, n]
-                if weights is None:
-                    Wj = jnp.repeat(trainj, g, axis=0)  # [B, n]
-                else:
-                    wj = jnp.asarray(w, jnp.float32)
-                    Wj = jnp.repeat(trainj * wj[None, :], g, axis=0)
-                # >1 device: the fold x grid batch shards over 'replica'
-                # and rows over 'data' - XLA inserts the psum collectives
-                # where each replica's Newton reductions cross row shards
-                # (the treeAggregate / Future-pool analog on the mesh).
-                # Rows pad to the data-shard multiple with zero weight in
-                # BOTH the train masks (W=0) and the validation masks
-                # (trainj=1 -> vmask=0), so pads touch no statistic.
-                y_fit = jnp.asarray(y, jnp.float32)
                 mesh = cv_mesh_or_none(k * g)
-                host_fit_args = None
-                if mesh is not None:
-                    from jax.sharding import NamedSharding, PartitionSpec as P
-
-                    # host-route copies BEFORE padding/placement: the
-                    # shrink-to-survivors recompute (parallel/resilience)
-                    # reruns the SAME fit from these host-local inputs on
-                    # the single-host route - zero-weight padding touches
-                    # no statistic, so parity holds to f32 tolerance
-                    host_fit_args = (Xj, y_fit, Wj, regs, ens)
-                    nd_data = mesh.shape["data"]
-                    pad = (-Xj.shape[0]) % nd_data
-                    if pad:
-                        Xj = jnp.concatenate(
-                            [Xj, jnp.zeros((pad, Xj.shape[1]), Xj.dtype)]
-                        )
-                        Wj = jnp.concatenate(
-                            [Wj, jnp.zeros((Wj.shape[0], pad), Wj.dtype)],
-                            axis=1,
-                        )
-                        trainj = jnp.concatenate(
-                            [trainj, jnp.ones((k, pad), trainj.dtype)], axis=1
-                        )
-                        y_fit = jnp.concatenate(
-                            [y_fit, jnp.zeros((pad,), y_fit.dtype)]
-                        )
-                    Xj = jax.device_put(Xj, NamedSharding(mesh, P("data", None)))
-                    y_fit = jax.device_put(
-                        y_fit, NamedSharding(mesh, P("data"))
+                # fused training program (ISSUE 15): fit -> score ->
+                # rank metrics as ONE donate-buffers jit; falls back to
+                # the dispatch below with the reason recorded
+                fused_res = None
+                if not any(done_mask):
+                    fused_res = self._try_train_fused(
+                        "linear", est, mode,
+                        n=n, mesh_present=mesh is not None,
+                        xdev=_xdev, y=y, masks=masks, w=w,
+                        weights_given=weights is not None,
+                        regs=regs, ens=ens, g=g,
+                        candidates=k * g, folds=k, n_rows=n,
+                        n_features=int(X.shape[1]),
                     )
-                    Wj = jax.device_put(
-                        Wj, NamedSharding(mesh, P("replica", "data"))
-                    )
-                    regs = jax.device_put(
-                        jnp.asarray(regs, jnp.float32),
-                        NamedSharding(mesh, P("replica")),
-                    )
-                    ens = jax.device_put(
-                        jnp.asarray(ens, jnp.float32),
-                        NamedSharding(mesh, P("replica")),
-                    )
-                # ONE span for the whole one-dispatch batch: per-
-                # candidate walls do not exist here, so the cost model
-                # amortizes the batch wall across `candidates`
-                # (satellite: fit spans identify the candidate set)
-                with _obs_trace.span(
-                    "cv.fit_batch", family=est.model_type,
-                    candidates=int(k * g), folds=int(k),
-                    n_rows=int(n), n_features=int(X.shape[1]),
-                ):
-                    if mesh is not None:
-                        # the fold x grid fit is THE mesh collective of
-                        # this path: run it under the collective
-                        # watchdog so a hung or dead peer degrades
-                        # (straggler retry, then a survivor/single-host
-                        # recompute) instead of wedging the whole
-                        # selection forever
-                        from ..parallel import resilience as _resilience
-
-                        betas, b0s = _resilience.guarded_collective(
-                            "validator.fit_arrays_batched",
-                            lambda: est.fit_arrays_batched(
-                                Xj, y_fit, Wj, regs, ens),
-                            shrink_fn=lambda: est.fit_arrays_batched(
-                                *(np.asarray(a) for a in host_fit_args)),
-                        )
-                    else:
-                        betas, b0s = est.fit_arrays_batched(
-                            Xj, y_fit, Wj, regs, ens)
-                if mode == "approx":
-                    # rank-based binary metrics computed ON DEVICE against
-                    # the already-resident X: no per-fold slices ever leave
-                    # HBM (the host loop below ships [n_val, d] k*g times)
-                    from ..evaluators.binary import masked_rank_metrics
-
-                    scores = _margins_kernel(
-                        Xj, jnp.asarray(betas, jnp.float32),
-                        jnp.asarray(b0s, jnp.float32),
-                    ).T  # [B, n(+pad)]
-                    vmask = jnp.repeat(1.0 - trainj, g, axis=0)
-                    auroc_b, aupr_b = masked_rank_metrics(scores, y_fit, vmask)
-                    vals = auroc_b if metric_name == "AuROC" else aupr_b
-                    for f in range(k):
-                        for j in range(g):
-                            metrics[j, f] = vals[f * g + j]
+                if fused_res is not None:
+                    # metrics filled by the one-program dispatch;
+                    # the shared tail below checkpoints rows and
+                    # builds the per-candidate results exactly as
+                    # for the kernel-at-a-time dispatch
+                    metrics[:, :] = fused_res.metrics.T
                 else:
-                    Xh = np.asarray(X)
-                    for f in range(k):
-                        val = ~masks[f]
-                        yv = y[val]
-                        for j in range(g):
-                            b = f * g + j
-                            pred, raw, prob = est.predict_arrays(
-                                {"beta": betas[b], "intercept": float(b0s[b])},
-                                Xh[val],
+                    Xj = _xdev()
+                    trainj = jnp.asarray(masks).astype(jnp.float32)  # [k, n]
+                    if weights is None:
+                        Wj = jnp.repeat(trainj, g, axis=0)  # [B, n]
+                    else:
+                        wj = jnp.asarray(w, jnp.float32)
+                        Wj = jnp.repeat(trainj * wj[None, :], g, axis=0)
+                    # >1 device: the fold x grid batch shards over 'replica'
+                    # and rows over 'data' - XLA inserts the psum collectives
+                    # where each replica's Newton reductions cross row shards
+                    # (the treeAggregate / Future-pool analog on the mesh).
+                    # Rows pad to the data-shard multiple with zero weight in
+                    # BOTH the train masks (W=0) and the validation masks
+                    # (trainj=1 -> vmask=0), so pads touch no statistic.
+                    y_fit = jnp.asarray(y, jnp.float32)
+                    host_fit_args = None
+                    if mesh is not None:
+                        from jax.sharding import NamedSharding, PartitionSpec as P
+
+                        # host-route copies BEFORE padding/placement: the
+                        # shrink-to-survivors recompute (parallel/resilience)
+                        # reruns the SAME fit from these host-local inputs on
+                        # the single-host route - zero-weight padding touches
+                        # no statistic, so parity holds to f32 tolerance
+                        host_fit_args = (Xj, y_fit, Wj, regs, ens)
+                        nd_data = mesh.shape["data"]
+                        pad = (-Xj.shape[0]) % nd_data
+                        if pad:
+                            Xj = jnp.concatenate(
+                                [Xj, jnp.zeros((pad, Xj.shape[1]), Xj.dtype)]
                             )
-                            metrics[j, f] = self._metric_of(yv, pred, raw, prob)
+                            Wj = jnp.concatenate(
+                                [Wj, jnp.zeros((Wj.shape[0], pad), Wj.dtype)],
+                                axis=1,
+                            )
+                            trainj = jnp.concatenate(
+                                [trainj, jnp.ones((k, pad), trainj.dtype)], axis=1
+                            )
+                            y_fit = jnp.concatenate(
+                                [y_fit, jnp.zeros((pad,), y_fit.dtype)]
+                            )
+                        Xj = jax.device_put(Xj, NamedSharding(mesh, P("data", None)))
+                        y_fit = jax.device_put(
+                            y_fit, NamedSharding(mesh, P("data"))
+                        )
+                        Wj = jax.device_put(
+                            Wj, NamedSharding(mesh, P("replica", "data"))
+                        )
+                        regs = jax.device_put(
+                            jnp.asarray(regs, jnp.float32),
+                            NamedSharding(mesh, P("replica")),
+                        )
+                        ens = jax.device_put(
+                            jnp.asarray(ens, jnp.float32),
+                            NamedSharding(mesh, P("replica")),
+                        )
+                    # ONE span for the whole one-dispatch batch: per-
+                    # candidate walls do not exist here, so the cost model
+                    # amortizes the batch wall across `candidates`
+                    # (satellite: fit spans identify the candidate set)
+                    with _obs_trace.span(
+                        "cv.fit_batch", family=est.model_type,
+                        candidates=int(k * g), folds=int(k),
+                        n_rows=int(n), n_features=int(X.shape[1]),
+                    ):
+                        if mesh is not None:
+                            # the fold x grid fit is THE mesh collective of
+                            # this path: run it under the collective
+                            # watchdog so a hung or dead peer degrades
+                            # (straggler retry, then a survivor/single-host
+                            # recompute) instead of wedging the whole
+                            # selection forever
+                            from ..parallel import resilience as _resilience
+
+                            betas, b0s = _resilience.guarded_collective(
+                                "validator.fit_arrays_batched",
+                                lambda: est.fit_arrays_batched(
+                                    Xj, y_fit, Wj, regs, ens),
+                                shrink_fn=lambda: est.fit_arrays_batched(
+                                    *(np.asarray(a) for a in host_fit_args)),
+                            )
+                        else:
+                            betas, b0s = est.fit_arrays_batched(
+                                Xj, y_fit, Wj, regs, ens)
+                    if mode == "approx":
+                        # rank-based binary metrics computed ON DEVICE against
+                        # the already-resident X: no per-fold slices ever leave
+                        # HBM (the host loop below ships [n_val, d] k*g times)
+                        from ..evaluators.binary import masked_rank_metrics
+
+                        scores = _margins_kernel(
+                            Xj, jnp.asarray(betas, jnp.float32),
+                            jnp.asarray(b0s, jnp.float32),
+                        ).T  # [B, n(+pad)]
+                        vmask = jnp.repeat(1.0 - trainj, g, axis=0)
+                        auroc_b, aupr_b = masked_rank_metrics(scores, y_fit, vmask)
+                        vals = auroc_b if metric_name == "AuROC" else aupr_b
+                        for f in range(k):
+                            for j in range(g):
+                                metrics[j, f] = vals[f * g + j]
+                    else:
+                        Xh = np.asarray(X)
+                        for f in range(k):
+                            val = ~masks[f]
+                            yv = y[val]
+                            for j in range(g):
+                                b = f * g + j
+                                pred, raw, prob = est.predict_arrays(
+                                    {"beta": betas[b], "intercept": b0s[b]},
+                                    Xh[val],
+                                )
+                                metrics[j, f] = self._metric_of(yv, pred, raw, prob)
             elif hasattr(est, "fit_arrays_folds"):
                 # fold-batched path (trees): grid x folds in one-or-few
                 # device dispatches when the estimator supports whole-grid
@@ -660,6 +831,23 @@ class OpValidator:
                 Xh = np.asarray(X)
                 W = masks.astype(np.float64) * w[None, :]
                 todo = [j for j in range(g) if not done_mask[j]]
+                # fused training program (ISSUE 15): the whole grid x
+                # fold fit PLUS per-fold traversal scoring and metrics
+                # as one donated-buffers jit - heaps never come to host
+                fused_res = None
+                if len(todo) == g and hasattr(est, "fused_tree_plan"):
+                    from ..parallel.mesh import data_mesh_or_none
+
+                    fused_res = self._try_train_fused(
+                        "tree", est, mode,
+                        n=n, mesh_present=data_mesh_or_none() is not None,
+                        X=Xh, y=y, masks=masks, W=W, grid=grid,
+                        candidates=int(g * k), folds=k, n_rows=n,
+                        n_features=int(X.shape[1]),
+                    )
+                if fused_res is not None:
+                    metrics[:, :] = fused_res.metrics.T
+                    todo = []
                 grid_fold_params = None
                 if todo and hasattr(est, "fit_arrays_folds_grid"):
                     with _obs_trace.span(
@@ -762,6 +950,7 @@ class OpValidator:
             larger_better=larger,
             all_results=all_results,
             autotune=at_report,
+            train_fused=self.last_train_fused,
         )
 
 

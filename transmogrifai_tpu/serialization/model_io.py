@@ -166,6 +166,21 @@ def _write_fsync(path: str, data: bytes) -> None:
         os.fsync(f.fileno())
 
 
+def write_bytes_atomic(path: str, data: bytes) -> None:
+    """Crash-consistent single-file byte write: fsync'd temp file in the
+    target directory, atomic ``os.replace``, directory fsync.  The
+    sidecar artifacts that ride NEXT TO the model artifact - the
+    ISSUE-15 ``train_xla_cache/`` executable entries - reuse this
+    instead of re-inventing the discipline; a reader never observes a
+    torn file, only the old bytes or the new ones."""
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    _write_fsync(tmp, data)
+    os.replace(tmp, path)
+    _fsync_dir(parent)
+
+
 def _fsync_dir(path: str) -> None:
     """fsync a directory so renames within it are durable (best-effort:
     some filesystems refuse directory fds)."""

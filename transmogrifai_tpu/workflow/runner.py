@@ -195,10 +195,14 @@ class OpWorkflowRunner:
                     "ingest_errors", "coerce")),
             ))
         at_cfg = self._setup_autotune(params)
+        tf_validators = self._setup_train_fused(params, at_cfg)
         model = self.workflow.train()
         summary = model.summary_json()
         if at_cfg is not None:
             summary["autotune"] = self._autotune_summary(at_cfg, params)
+        tf_summary = self._train_fused_summary(tf_validators)
+        if tf_summary is not None:
+            summary["train_fused"] = tf_summary
         if params.model_location:
             model.save(params.model_location)
             with open(
@@ -249,6 +253,76 @@ class OpWorkflowRunner:
                 if getattr(stage, "is_model_selector", False):
                     stage.validator.autotune = cfg
         return cfg
+
+    def _setup_train_fused(self, params: OpParams, at_cfg) -> list:
+        """Fused-training knobs (ISSUE 15): ``train_fused`` custom param
+        (None = auto-by-scale, True/False force) and the AOT executable
+        cache directory - ``train_xla_cache_dir`` custom param, default
+        ``train_xla_cache/`` NEXT TO ``autotune.json`` (the cost-model
+        artifact's directory, or the model location) so warm refits of
+        the same shape bucket deserialize executables instead of
+        retracing.  Returns the validators it configured, for the
+        post-train summary rollup."""
+        from .dag import compute_dag
+
+        cp = params.custom_params
+        cache_dir = cp.get("train_xla_cache_dir")
+        if cache_dir is None:
+            base = None
+            if at_cfg is not None and at_cfg.model_path:
+                base = os.path.dirname(str(at_cfg.model_path))
+            elif params.model_location:
+                base = str(params.model_location)
+            if base:
+                cache_dir = os.path.join(base, "train_xla_cache")
+        train_fused = cp.get("train_fused")
+        validators = []
+        for layer in compute_dag(self.workflow.result_features):
+            for stage in layer:
+                if getattr(stage, "is_model_selector", False):
+                    v = stage.validator
+                    if train_fused is not None:
+                        v.train_fused = bool(train_fused)
+                    if cache_dir:
+                        v.train_cache_dir = str(cache_dir)
+                    validators.append(v)
+        return validators
+
+    @staticmethod
+    def _train_fused_summary(validators: list):
+        """Run-level rollup of the per-selector fused-training trails
+        (ISSUE 15 satellite): ``train_fused.backend`` +
+        ``cache{hits,misses,stale}`` mirroring the PR-12 serving
+        telemetry shape, so `tx autotune report` and the continuous
+        loop can assert warm refits skipped retrace.  The backend
+        tri-state folds the per-selector verdicts (each computed by
+        OpValidator._record_train_fused) rather than re-deriving from
+        families, and a family selected by TWO selectors keeps both
+        entries under suffixed keys instead of last-one-wins."""
+        trails = [v.last_train_fused for v in validators
+                  if v.last_train_fused is not None]
+        if not trails:
+            return None
+        cache = {"hits": 0, "misses": 0, "stale": 0}
+        families: dict = {}
+        backends: set = set()
+        for t in trails:
+            backends.add(t.get("backend"))
+            for key in cache:
+                cache[key] += int(t.get("cache", {}).get(key, 0))
+            for fam, entry in t.get("families", {}).items():
+                key, i = fam, 2
+                while key in families:
+                    key, i = f"{fam}#{i}", i + 1
+                families[key] = entry
+        return {
+            "backend": (
+                "fused" if backends == {"fused"}
+                else "existing" if backends == {"existing"} else "mixed"
+            ),
+            "families": families,
+            "cache": cache,
+        }
 
     def _autotune_summary(self, at_cfg, params: OpParams) -> dict:
         """Post-train autotune bookkeeping: fold this run's tagged fit
