@@ -1,11 +1,13 @@
-"""Chaos-composition drill (ISSUE 4 satellite, extended by ISSUE 5):
-ONE seeded, randomized schedule arming faults from five different
-subsystems — ``reader.*`` (data plane), ``serving.batch`` (serving),
-``io.save_model.crash`` (serialization), ``supervisor.child_kill``
-(supervision), ``registry.publish_crash`` + ``canary.regression``
-(model lifecycle) — across a single end-to-end workflow run
-(corrupted-CSV quarantine ingest → train → save/load → serve →
-supervise → registry publish/canary), asserting the GLOBAL invariants:
+"""Chaos-composition drill (ISSUE 4 satellite, extended by ISSUEs 5
+and 16): ONE seeded, randomized schedule arming faults from six
+different subsystems — ``reader.*`` (data plane), ``serving.batch``
+(serving), ``io.save_model.crash`` (serialization),
+``supervisor.child_kill`` (supervision), ``registry.publish_crash`` +
+``canary.regression`` (model lifecycle), ``continuous.refit_crash`` +
+``drift.false_positive`` (continuous training) — across a single
+end-to-end workflow run (corrupted-CSV quarantine ingest → train →
+save/load → serve → supervise → registry publish/canary →
+drift-triggered refit), asserting the GLOBAL invariants:
 
 * no corrupt artifact is ever loadable (checksums verify at each step,
   including the registry index after a crashed publish);
@@ -97,6 +99,7 @@ def test_chaos_composition_end_to_end(tmp_path):
         "reader.malformed_row", "reader.type_flip", "serving.batch",
         "io.save_model.crash", "supervisor.child_kill",
         "registry.publish_crash", "canary.regression",
+        "continuous.refit_crash", "drift.false_positive",
     ]}
 
     # ---- phase 1: quarantine ingest (real corruption + injected) → train
@@ -253,6 +256,72 @@ def test_chaos_composition_end_to_end(tmp_path):
                for r in rollbacks[0]["reasons"])
     assert any(e["event"] == "rollback" for e in registry.lineage())
     events["canary_rolled_back_after_batches"] = rolled_back_after
+
+    # ---- phase 6: continuous loop under injected faults ----------------
+    # (ISSUE 16 satellite) the drift-triggered refit controller takes
+    # over the SAME registry the lifecycle drill just exercised: a refit
+    # crashed between train and publish leaves the fleet on the old
+    # stable and the next cycle recovers organically; then a forced
+    # drift false-positive on a healthy window promotes a healthy refit
+    # instead of wedging the loop
+    from transmogrifai_tpu.continuous import ContinuousTrainer
+    from transmogrifai_tpu.testkit.drills import (
+        CONTINUOUS_REFIT_CRASH_TEMPLATE,
+        continuous_shard_rows,
+        write_shard_csv,
+    )
+
+    tiny_factory = (
+        "transmogrifai_tpu.testkit.drills:continuous_tiny_factory")
+    watch = str(tmp_path / "continuous_watch")
+    os.makedirs(watch)
+    stable_before = registry.stable
+    write_shard_csv(os.path.join(watch, "s0000.csv"),
+                    continuous_shard_rows(64, seed=seed, shift=3.0))
+    crash_script = tmp_path / "refit_crasher.py"
+    crash_script.write_text(CONTINUOUS_REFIT_CRASH_TEMPLATE.format(
+        repo=REPO, watch=watch, root=reg_root,
+        fault="continuous.refit_crash:on=1"))
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, str(crash_script)],
+                          env=drill_env(), timeout=CRASH_SAVE_DEADLINE_S)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really died
+    events["refit_crash_exit"] = proc.returncode
+    # invariant: the registry never saw the crashed refit
+    registry = ModelRegistry(reg_root, create=False)
+    assert registry.stable == stable_before
+    assert registry.verify()["ok"]
+    # next cycle (fresh daemon, same watch dir) recovers end to end:
+    # the follower re-offers the shard, detect → refit → promote
+    trainer = ContinuousTrainer(
+        watch, reg_root, tiny_factory,
+        drift_threshold=0.3, consecutive_windows=1, cooldown_windows=0,
+        min_window_rows=8, refit_rows=256, train_fused=False)
+    cyc = trainer.run_cycle()
+    t_cont = time.monotonic() - t0
+    assert t_cont < INGEST_TRAIN_DEADLINE_S, "continuous recovery hang"
+    assert cyc["verdict"] == "trigger" and cyc["outcome"] == "promote"
+    assert registry.stable == cyc["published"] != stable_before
+    events["continuous_recovered_version"] = cyc["published"]
+    # forced false positive: hysteresis tuned so an organic trigger is
+    # impossible (threshold 0.9, three consecutive windows) — only the
+    # injected flag fires, and the healthy refit is judged on merit
+    forced_trainer = ContinuousTrainer(
+        watch, reg_root, tiny_factory,
+        drift_threshold=0.9, consecutive_windows=3, cooldown_windows=1,
+        min_window_rows=8, refit_rows=256, train_fused=False)
+    write_shard_csv(os.path.join(watch, "s0001.csv"),
+                    continuous_shard_rows(64, seed=seed + 1, shift=3.0))
+    faults.configure("drift.false_positive:on=1")
+    cyc2 = forced_trainer.run_cycle()
+    faults.reset()
+    # invariant: the window itself was healthy — only the forced flag
+    # triggered, and it is accounted on the trainer
+    assert cyc2["forced"] is True and cyc2["max_js"] < 0.9
+    assert cyc2["verdict"] == "trigger" and cyc2["outcome"] == "promote"
+    assert forced_trainer.forced_triggers == 1
+    assert registry.stable == cyc2["published"] != cyc["published"]
+    events["forced_trigger_promoted"] = cyc2["published"]
 
     # ---- global: nothing leaked, everything accounted ------------------
     assert not faults.active()

@@ -1,0 +1,598 @@
+"""Continuous-training drills (ISSUE 16).
+
+The drift-triggered refit controller that closes the
+data→drift→refit→canary→promote loop: the RefitGovernor hysteresis/
+cooldown machine, the ShardDirectoryFollower tail mode on the PR-8
+pipeline, the DriftMonitor windowed-reset seam (with the cumulative-
+merge dilution bias pinned), the direct-promote loop + status file +
+``tx continuous status`` + ``continuous`` run type + ``tx_continuous_*``
+scrape, the ``continuous.refit_crash`` / ``drift.false_positive`` fault
+drills, and the e2e acceptance drill: a mid-stream distribution shift
+on a live 2-replica fleet is detected, refit WARM from the PR-15
+``train_xla_cache/`` seeded by a different process, canaried and
+auto-promoted — old model serving throughout, zero dropped rows, the
+whole cycle under ONE trace id.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.continuous import (
+    STATUS_FILENAME,
+    ContinuousError,
+    ContinuousTrainer,
+    RefitGovernor,
+)
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.readers.pipeline import (
+    ShardDirectoryFollower,
+    pipelined_columns,
+)
+from transmogrifai_tpu.registry import ModelRegistry
+from transmogrifai_tpu.schema.drift import DriftMonitor
+from transmogrifai_tpu.testkit.drills import (
+    CONTINUOUS_REFIT_CRASH_TEMPLATE,
+    CONTINUOUS_SEED_TRAINER_TEMPLATE,
+    continuous_shard_rows,
+    continuous_tiny_factory,
+    drill_env,
+    tiny_drill_pipeline,
+    write_shard_csv,
+)
+from transmogrifai_tpu.testkit.random_data import shift_records
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TINY_FACTORY = "transmogrifai_tpu.testkit.drills:continuous_tiny_factory"
+DRILL_FACTORY = (
+    "transmogrifai_tpu.testkit.drills:continuous_drill_workflow")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _tiny_trainer(tmp_path, **kw):
+    """A bootstrapped direct-mode trainer over the tiny (no-selector)
+    pipeline - the fast fixture for loop-policy drills."""
+    watch = str(tmp_path / "watch")
+    os.makedirs(watch, exist_ok=True)
+    kw.setdefault("drift_threshold", 0.35)
+    kw.setdefault("consecutive_windows", 2)
+    kw.setdefault("cooldown_windows", 1)
+    kw.setdefault("min_window_rows", 32)
+    kw.setdefault("refit_rows", 256)
+    kw.setdefault("train_fused", False)
+    kw.setdefault("bootstrap", True)
+    return ContinuousTrainer(
+        watch, str(tmp_path / "registry"), TINY_FACTORY,
+        status_dir=str(tmp_path), **kw)
+
+
+# ---------------------------------------------------------------------------
+# RefitGovernor: hysteresis + cooldown + forced semantics
+# ---------------------------------------------------------------------------
+def test_governor_hysteresis_needs_consecutive_over_windows():
+    gov = RefitGovernor(threshold=0.5, consecutive=3, cooldown=2)
+    # a broken streak never triggers
+    assert gov.observe_window(0.6) == "over"
+    assert gov.observe_window(0.6) == "over"
+    assert gov.observe_window(0.4) == "clear"
+    assert gov.over_streak == 0 and gov.triggers == 0
+    # three in a row does
+    assert gov.observe_window(0.6) == "over"
+    assert gov.observe_window(0.9) == "over"
+    assert gov.observe_window(0.7) == "trigger"
+    assert gov.triggers == 1 and gov.cooldown_left == 2
+
+
+def test_governor_cooldown_suppresses_and_surfaces():
+    gov = RefitGovernor(threshold=0.5, consecutive=1, cooldown=2)
+    assert gov.observe_window(0.9) == "trigger"
+    # the two cooldown windows cannot re-trigger, however hot
+    assert gov.observe_window(0.99) == "suppressed"
+    assert gov.observe_window(0.2) == "clear"  # burns cooldown quietly
+    assert gov.suppressed == 1 and gov.triggers == 1
+    # cooldown over: the next hot window triggers again
+    assert gov.observe_window(0.9) == "trigger"
+    snap = gov.snapshot()
+    assert snap["triggers"] == 2 and snap["windows"] == 4
+
+
+def test_governor_forced_bypasses_hysteresis_not_cooldown():
+    gov = RefitGovernor(threshold=0.5, consecutive=3, cooldown=2)
+    # forced trigger on a stone-cold window, streak irrelevant
+    assert gov.observe_window(0.0, forced=True) == "trigger"
+    # forced during cooldown is suppressed like any other window
+    assert gov.observe_window(0.0, forced=True) == "suppressed"
+    assert gov.suppressed == 1
+
+
+def test_governor_rejects_nonsense_knobs():
+    with pytest.raises(ValueError):
+        RefitGovernor(consecutive=0)
+    with pytest.raises(ValueError):
+        RefitGovernor(cooldown=-1)
+
+
+# ---------------------------------------------------------------------------
+# ShardDirectoryFollower: the tail mode on the PR-8 pipeline
+# ---------------------------------------------------------------------------
+def test_follower_monotonic_ids_and_exactly_once(tmp_path):
+    watch = tmp_path / "watch"
+    follower = ShardDirectoryFollower(str(watch))
+    # missing dir = nothing yet, not an error
+    assert follower.poll() == []
+    watch.mkdir()
+    assert follower.poll() == []
+    write_shard_csv(str(watch / "s0001.csv"),
+                    continuous_shard_rows(4, seed=1))
+    (watch / "notes.txt").write_text("not a shard")
+    (watch / "subdir").mkdir()
+    specs = follower.poll()
+    assert [s.shard_id for s in specs] == [0]
+    assert specs[0].path.endswith("s0001.csv") and specs[0].fmt == "csv"
+    # consumed exactly once; an in-place overwrite is NOT re-read
+    write_shard_csv(str(watch / "s0001.csv"),
+                    continuous_shard_rows(4, seed=2))
+    assert follower.poll() == []
+    # new names keep the ids growing monotonically, in name order
+    write_shard_csv(str(watch / "s0003.csv"),
+                    continuous_shard_rows(4, seed=3))
+    write_shard_csv(str(watch / "s0002.csv"),
+                    continuous_shard_rows(4, seed=4))
+    specs = follower.poll()
+    assert [(s.shard_id, os.path.basename(s.path)) for s in specs] == [
+        (1, "s0002.csv"), (2, "s0003.csv")]
+    assert follower.shards_seen == 3
+
+
+def test_follower_pinned_fmt_accepts_any_extension(tmp_path):
+    follower = ShardDirectoryFollower(str(tmp_path), fmt="csv")
+    write_shard_csv(str(tmp_path / "rows.dat"),
+                    continuous_shard_rows(4, seed=1))
+    specs = follower.poll()
+    assert len(specs) == 1 and specs[0].fmt == "csv"
+
+
+def test_follower_rides_the_pipeline_round_trip(tmp_path):
+    """One poll's shards read through the real interleave/prefetch
+    pipeline land as the exact rows the producer published."""
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from transmogrifai_tpu.types import feature_types as ft
+
+    rows = continuous_shard_rows(12, seed=5)
+    write_shard_csv(str(tmp_path / "a.csv"), rows[:6])
+    write_shard_csv(str(tmp_path / "b.csv"), rows[6:])
+    follower = ShardDirectoryFollower(str(tmp_path))
+    schema = {"y": ft.RealNN, "a": ft.Real, "c": ft.PickList}
+    pipe = follower.pipeline(follower.poll(), schema, workers=2)
+    cols = {n: c.to_list() for n, c in pipelined_columns(pipe).items()}
+    assert len(cols["a"]) == 12
+    assert cols["c"] == [r["c"] for r in rows]
+    assert cols["a"] == pytest.approx([r["a"] for r in rows])
+    # an empty poll yields no pipeline, not an empty-shard crash
+    assert follower.pipeline([], schema) is None
+
+
+# ---------------------------------------------------------------------------
+# the windowed-merge seam: cumulative dilution bias, pinned
+# ---------------------------------------------------------------------------
+def test_cumulative_merge_dilutes_late_shift_windowed_reset_catches_it():
+    """The satellite pin behind DriftMonitor.reset(): after enough
+    baseline traffic the cumulative monoid merge waters a full
+    distribution shift down below the warn threshold, while the same
+    monitor reset() at the window boundary scores it at saturation -
+    the bias that forces the continuous loop to be windowed."""
+    wf, _data, records, _name = tiny_drill_pipeline(n=120, seed=0)
+    model = wf.train()
+    mon = DriftMonitor(model.schema_contract)
+    base = records[:64]
+    shifted = shift_records(base, "a", delta=30.0)
+    for _ in range(19):
+        mon.observe(base)
+    mon.observe(shifted)  # a FULLY disjoint window, 5% of the mass
+    diluted = mon.scores()["a"]
+    assert diluted < mon.warn_threshold, (
+        "the drill premise broke: the cumulative score noticed")
+    # the windowed view of the exact same monitor: reset + one window
+    mon.reset()
+    assert mon.rows_observed("a") == 0 and mon.batches_observed == 0
+    mon.observe(shifted)
+    windowed = mon.scores()["a"]
+    assert windowed > 0.9  # disjoint support: JS ~ 1.0
+    assert windowed > 5 * diluted
+    # reset clears the warned-once latch too: a fresh window re-alarms
+    assert mon.reset() is mon
+
+
+# ---------------------------------------------------------------------------
+# direct-mode loop: detect -> refit -> publish -> stable pointer flip
+# ---------------------------------------------------------------------------
+def test_direct_mode_detects_shift_refits_and_promotes(tmp_path, capsys):
+    trainer = _tiny_trainer(tmp_path)
+    v1 = trainer.version
+    assert v1 is not None and trainer.registry.stable == v1
+    watch = trainer.watch_dir
+
+    # idle poll: no shards, no governor window consumed
+    c = trainer.run_cycle()
+    assert c["verdict"] == "idle" and trainer.governor.windows == 0
+    # a thin window judges nothing either way
+    write_shard_csv(os.path.join(watch, "s0000.csv"),
+                    continuous_shard_rows(8, seed=20))
+    c = trainer.run_cycle()
+    assert c["verdict"] == "thin" and trainer.governor.windows == 0
+    # healthy window: clear
+    write_shard_csv(os.path.join(watch, "s0001.csv"),
+                    continuous_shard_rows(64, seed=21))
+    c = trainer.run_cycle()
+    assert c["verdict"] == "clear" and c["max_js"] < 0.35
+    # two consecutive shifted windows: over, then trigger -> promote
+    write_shard_csv(os.path.join(watch, "s0002.csv"),
+                    continuous_shard_rows(64, seed=22, shift=3.0))
+    assert trainer.run_cycle()["verdict"] == "over"
+    write_shard_csv(os.path.join(watch, "s0003.csv"),
+                    continuous_shard_rows(64, seed=23, shift=3.0))
+    c = trainer.run_cycle()
+    assert c["verdict"] == "trigger" and c["outcome"] == "promote"
+    v2 = c["published"]
+    assert v2 != v1
+    assert trainer.registry.stable == v2 == trainer.version
+    assert trainer.refits == 1 and trainer.promotes == 1
+    # the refit became the drift baseline: its contract watches now
+    assert trainer.model.schema_contract is not None
+    assert trainer.monitor.contract is trainer.model.schema_contract
+
+    # the whole trigger cycle rode ONE trace id
+    from transmogrifai_tpu.obs import tracer
+
+    names = {s["name"] for s in tracer().spans()
+             if s.get("trace") == c["trace"]
+             and str(s["name"]).startswith("continuous.")}
+    assert {"continuous.cycle", "continuous.detect",
+            "continuous.refit", "continuous.publish",
+            "continuous.verdict"} <= names
+
+    # the continuous view rides the obs scrape
+    from transmogrifai_tpu.obs import (
+        metrics_registry,
+        prometheus_text_from_json,
+    )
+
+    text = prometheus_text_from_json(metrics_registry().to_json())
+    assert "tx_continuous_cycles" in text
+    assert "tx_continuous_refit_cache_hits" in text
+
+    # the status file is the one consistent loop document ...
+    doc = json.load(open(os.path.join(str(tmp_path), STATUS_FILENAME)))
+    assert doc["mode"] == "direct"
+    assert doc["stable_version"] == v2
+    assert doc["counters"]["refits"] == 1
+    assert doc["counters"]["promotes"] == 1
+    assert doc["governor"]["triggers"] == 1
+    assert doc["last_cycle"]["verdict"] == "trigger"
+    assert doc["last_trace"] == c["trace"]
+    # ... and `tx continuous status` renders it (dir or file path)
+    from transmogrifai_tpu.cli import main as cli_main
+
+    assert cli_main(["continuous", "status",
+                     "--path", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"]["counters"]["refits"] == 1
+    assert out["source"].endswith(STATUS_FILENAME)
+    assert cli_main(["continuous", "status", "--path",
+                     os.path.join(str(tmp_path), STATUS_FILENAME)]) == 0
+
+
+def test_trainer_without_stable_requires_bootstrap(tmp_path):
+    os.makedirs(tmp_path / "watch")
+    with pytest.raises(ContinuousError, match="no stable"):
+        ContinuousTrainer(str(tmp_path / "watch"),
+                          str(tmp_path / "registry"), TINY_FACTORY)
+
+
+def test_run_loop_exits_on_idle_and_max_cycles(tmp_path):
+    trainer = _tiny_trainer(tmp_path)
+    cycles = trainer.run(max_cycles=5, idle_exit=2, poll_interval_s=0.01)
+    assert len(cycles) == 2  # two consecutive empty polls
+    assert all(c["verdict"] == "idle" for c in cycles)
+    write_shard_csv(os.path.join(trainer.watch_dir, "s0.csv"),
+                    continuous_shard_rows(64, seed=30))
+    cycles = trainer.run(max_cycles=1, poll_interval_s=0.01)
+    assert len(cycles) == 1 and cycles[0]["rows"] == 64
+
+
+# ---------------------------------------------------------------------------
+# drift.false_positive: a forced trigger on a healthy stream
+# ---------------------------------------------------------------------------
+def test_false_positive_trigger_promotes_healthy_refit(tmp_path):
+    """A spurious detection (operator page, broken alert) must not
+    wedge or degrade anything: the forced refit is judged on its own
+    merit - here (direct mode, healthy data) it simply promotes."""
+    trainer = _tiny_trainer(tmp_path, drift_threshold=0.9,
+                            consecutive_windows=3)
+    v1 = trainer.version
+    write_shard_csv(os.path.join(trainer.watch_dir, "s0.csv"),
+                    continuous_shard_rows(64, seed=40))
+    assert trainer.run_cycle()["verdict"] == "clear"
+    faults.configure("drift.false_positive:on=1")
+    write_shard_csv(os.path.join(trainer.watch_dir, "s1.csv"),
+                    continuous_shard_rows(64, seed=41))
+    c = trainer.run_cycle()
+    faults.reset()
+    # the window itself was healthy - only the forced flag triggered
+    assert c["forced"] is True and c["max_js"] < 0.9
+    assert c["verdict"] == "trigger" and c["outcome"] == "promote"
+    assert trainer.forced_triggers == 1
+    assert trainer.registry.stable == c["published"] != v1
+    # burned: the next window is judged normally again
+    write_shard_csv(os.path.join(trainer.watch_dir, "s2.csv"),
+                    continuous_shard_rows(64, seed=42))
+    c = trainer.run_cycle()
+    assert c["forced"] is False and c["verdict"] in (
+        "clear", "suppressed")
+
+
+# ---------------------------------------------------------------------------
+# continuous.refit_crash: kill between refit and publish
+# ---------------------------------------------------------------------------
+def test_refit_crash_leaves_old_stable_and_next_cycle_recovers(
+        tmp_path):
+    reg_root = str(tmp_path / "registry")
+    watch = str(tmp_path / "watch")
+    os.makedirs(watch)
+    model = continuous_tiny_factory().train()
+    v1 = ModelRegistry(reg_root).publish(model, stage="stable").version
+    write_shard_csv(os.path.join(watch, "s0.csv"),
+                    continuous_shard_rows(64, seed=50, shift=3.0))
+    script = tmp_path / "crasher.py"
+    script.write_text(CONTINUOUS_REFIT_CRASH_TEMPLATE.format(
+        repo=REPO, watch=watch, root=reg_root,
+        fault="continuous.refit_crash:on=1"))
+    proc = subprocess.run([sys.executable, str(script)],
+                          env=drill_env(), timeout=300)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really died
+    # the refit died BEFORE publish: the registry never saw it
+    reg = ModelRegistry(reg_root, create=False)
+    assert reg.stable == v1
+    assert reg.verify()["ok"]
+    # next cycle (fresh daemon, same watch dir) recovers end to end:
+    # the follower re-offers the shard, the refit completes, promotes
+    trainer = ContinuousTrainer(
+        watch, reg_root, TINY_FACTORY,
+        drift_threshold=0.3, consecutive_windows=1, cooldown_windows=0,
+        min_window_rows=8, refit_rows=256, train_fused=False)
+    c = trainer.run_cycle()
+    assert c["verdict"] == "trigger" and c["outcome"] == "promote"
+    assert trainer.registry.stable == c["published"] != v1
+
+
+# ---------------------------------------------------------------------------
+# the `continuous` run type on the workflow runner
+# ---------------------------------------------------------------------------
+def test_runner_continuous_run_type_bootstraps_and_reports(tmp_path):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    watch = str(tmp_path / "watch")
+    os.makedirs(watch)
+    write_shard_csv(os.path.join(watch, "s0.csv"),
+                    continuous_shard_rows(64, seed=60, shift=3.0))
+    wf = continuous_tiny_factory()
+    r = OpWorkflowRunner(wf).run("continuous", OpParams(
+        model_location=str(tmp_path / "model"),
+        metrics_location=str(tmp_path / "metrics"),
+        custom_params={
+            "watch_dir": watch,
+            "drift_threshold": 0.3,
+            "drift_consecutive": 1,
+            "drift_cooldown": 0,
+            "continuous_window_rows": 32,
+            "continuous_refit_rows": 256,
+            "continuous_max_cycles": 3,
+            "continuous_idle_exit": 1,
+            "continuous_poll_s": 0.01,
+            "train_fused": False,
+        }))
+    assert r.run_type == "continuous"
+    m = r.metrics
+    assert m["run_type"] == "continuous" and m["mode"] == "direct"
+    # bootstrap published v1 from the runner's workflow, then the
+    # shifted shard refit-promoted on top of it
+    assert m["counters"]["refits"] >= 1
+    assert m["counters"]["promotes"] >= 1
+    reg = ModelRegistry(os.path.join(str(tmp_path / "model"),
+                                     "registry"), create=False)
+    assert reg.stable is not None and reg.verify()["ok"]
+    saved = json.load(open(os.path.join(
+        str(tmp_path / "metrics"), "continuous_metrics.json")))
+    assert saved["counters"]["cycles"] == m["counters"]["cycles"]
+    # the status file landed in metrics_location (the runner default)
+    assert os.path.exists(os.path.join(str(tmp_path / "metrics"),
+                                       STATUS_FILENAME))
+
+
+def test_runner_continuous_requires_watch_dir():
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    with pytest.raises(ValueError, match="watch_dir"):
+        OpWorkflowRunner(continuous_tiny_factory()).run(
+            "continuous", OpParams(model_location="/tmp/x"))
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: shift -> detect -> WARM refit -> canary -> promote on
+# a live fleet, old model serving throughout, zero dropped rows
+# ---------------------------------------------------------------------------
+def test_continuous_e2e_fleet_shift_warm_refit_canary_promote(
+        tmp_path, monkeypatch):
+    from transmogrifai_tpu.fleet import FleetController
+    from transmogrifai_tpu.obs.slo import SLObjective
+
+    # the conftest provisions an 8-device CPU mesh; with the CV product
+    # mesh live the fused-train gate defers to it (reason "mesh"), so
+    # pin the single-process fused path the same way test_fused_train
+    # does - the drill is about the WARM cache, not mesh scheduling
+    monkeypatch.setenv("TX_PRODUCT_MESH", "0")
+
+    reg_root = str(tmp_path / "registry")
+    cache = str(tmp_path / "train_xla_cache")
+    watch = str(tmp_path / "watch")
+    os.makedirs(watch)
+    n_train = 256
+
+    # seed v1 COLD in a child process: this process's in-process fused
+    # program registry stays empty, so the daemon's refit below can
+    # only be warm via DISK rehydration from train_xla_cache/
+    seed_src = CONTINUOUS_SEED_TRAINER_TEMPLATE.format(
+        repo=REPO, n=n_train, seed=0, cache_dir=cache, root=reg_root)
+    proc = subprocess.run(
+        [sys.executable, "-c", seed_src], env=drill_env(),
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    seeded = [ln for ln in proc.stdout.splitlines()
+              if ln.startswith("SEEDED")][0].split(" ", 2)
+    v1, seed_trail = seeded[1], json.loads(seeded[2])
+    seed_fam = seed_trail["families"]["OpLogisticRegression"]
+    assert seed_fam["cache"] == "miss" and seed_fam["compile_ms"] > 0
+    assert os.listdir(cache), "the seed left no AOT cache to rehydrate"
+
+    batch_base = [{k: r[k] for k in ("a", "c")}
+                  for r in continuous_shard_rows(40, seed=99)]
+    batch_shifted = [{k: r[k] for k in ("a", "c")}
+                     for r in continuous_shard_rows(40, seed=98,
+                                                    shift=3.0)]
+    # the pump serves whatever the stream currently looks like: the
+    # mid-stream shift moves the LIVE traffic too (that is the drill -
+    # the canary is judged on the shifted traffic it will actually see)
+    current = {"batch": batch_base}
+    results: list = []
+    errors: list = []
+    stop = threading.Event()
+    # the fleet SLO wired into the rollback policy is a HEALTH signal
+    # (NaN-guard refusals), not the default drift SLO: during a genuine
+    # distribution shift the fleet-wide drift objective fires BECAUSE
+    # the stable arm is drowning in the new traffic - the very signal
+    # that triggered the refit - and would veto its own correction
+    # (docs/continuous.md documents the scoping rule)
+    health_slo = SLObjective(
+        name="fleet-nonfinite", kind="threshold",
+        metric="serving.breaker.rows_nonfinite", objective=0.5,
+        windows_s=(30.0, 5.0))
+    with FleetController(
+        reg_root, DRILL_FACTORY, n_replicas=2,
+        work_dir=str(tmp_path / "fleet"), ship_interval_s=0.15,
+        slo_objectives=[health_slo],
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64},
+    ) as fc:
+        fc.router.score_batch(batch_base, timeout_s=120.0)  # warm
+
+        def pump() -> None:
+            while not stop.is_set():
+                try:
+                    results.append(fc.router.submit(
+                        records=current["batch"]).wait(120.0))
+                except Exception as e:  # noqa: BLE001 - the drill counts
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=pump) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            trainer = ContinuousTrainer(
+                watch, reg_root, DRILL_FACTORY, fleet=fc,
+                status_dir=str(tmp_path),
+                drift_threshold=0.4, consecutive_windows=4,
+                cooldown_windows=2, min_window_rows=64,
+                refit_rows=n_train, train_fused=True,
+                train_cache_dir=cache, canary_fraction=0.5,
+                canary_min_rows=48, canary_timeout_s=120.0)
+            assert trainer.version == v1
+            # window 1: the stream looks like training - clear
+            write_shard_csv(os.path.join(watch, "s0000.csv"),
+                            continuous_shard_rows(64, seed=10))
+            c = trainer.run_cycle()
+            assert c["verdict"] == "clear", c
+            # the distribution SHIFTS mid-stream - shards AND live
+            # traffic; the hysteresis holds for three over-threshold
+            # windows, then trips on the fourth.  By then the bounded
+            # buffer holds the last n_train rows = ALL shifted, the
+            # seed's exact shape bucket, so the refit both rehydrates
+            # the seeded executables and models the traffic its canary
+            # is about to be judged on.
+            current["batch"] = batch_shifted
+            for i in range(1, 4):
+                write_shard_csv(
+                    os.path.join(watch, f"s{i:04d}.csv"),
+                    continuous_shard_rows(64, seed=10 + i, shift=3.0))
+                c = trainer.run_cycle()
+                assert c["verdict"] == "over", c
+            write_shard_csv(os.path.join(watch, "s0004.csv"),
+                            continuous_shard_rows(64, seed=14,
+                                                  shift=3.0))
+            c = trainer.run_cycle()
+            assert c["verdict"] == "trigger", c
+            assert c["outcome"] == "promote", c
+            time.sleep(0.4)  # let the promoted arm serve some batches
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=120.0)
+
+        v2 = c["published"]
+        assert v2 != v1 and fc.registry.stable == v2
+        assert trainer.version == v2
+
+        # WARM refit: executables rehydrated from the child-seeded
+        # disk cache - zero compile, nonzero load, same shape bucket
+        fam = c["refit"]["train_fused"]["families"][
+            "OpLogisticRegression"]
+        assert fam["cache"] == "hit", fam
+        assert fam["load_ms"] > 0 and fam["compile_ms"] == 0, fam
+        assert fam["bucket"] == seed_fam["bucket"]
+        assert trainer.refit_cache["hits"] >= 1
+        assert c["refit"]["rows"] == n_train
+
+        # zero dropped rows; exact conservation, double-entry
+        assert errors == []
+        assert all(res.n_rows == len(batch_base) for res in results)
+        snap = fc.router.snapshot()
+        assert snap["rows_ok"] == (sum(r.n_rows for r in results)
+                                   + len(batch_base))  # + warm batch
+
+        # the superseded model served THROUGHOUT: every response names
+        # a version, v1 until the pointer flip, v2 after
+        versions = {res.version for res in results}
+        assert None not in versions
+        assert versions <= {v1, v2}
+        assert v1 in versions
+        # the canary actually scored shadow traffic before the verdict
+        assert c["canary_rows"] >= 48
+
+    # ONE trace id spans the whole promoted cycle: detect, refit,
+    # publish, canary, verdict
+    from transmogrifai_tpu.obs import tracer
+
+    names = {s["name"] for s in tracer().spans()
+             if s.get("trace") == c["trace"]
+             and str(s["name"]).startswith("continuous.")}
+    assert {"continuous.cycle", "continuous.detect",
+            "continuous.refit", "continuous.publish",
+            "continuous.canary", "continuous.verdict"} <= names
+    # and the status file carries the same story
+    doc = json.load(open(os.path.join(str(tmp_path), STATUS_FILENAME)))
+    assert doc["mode"] == "fleet"
+    assert doc["counters"]["promotes"] == 1
+    assert doc["counters"]["refit_cache_hits"] == 1
+    assert doc["last_trace"] == c["trace"]

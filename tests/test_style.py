@@ -595,3 +595,30 @@ def test_autotune_reads_telemetry_via_public_apis_only():
                 continue
             offenders.append(f"{p}:{node.lineno} .{attr}")
     assert not offenders, offenders
+
+
+def test_continuous_drives_subsystems_via_public_seams_only():
+    """continuous/ composes five earlier subsystems (reader follow
+    mode, drift monitor, fused-train cache, registry, fleet) and may
+    drive them ONLY through their public seams (ISSUE 16 satellite):
+    no single-underscore attribute of ANY foreign object is touched
+    anywhere in the package (``self._x``/``cls._x`` own-state access is
+    the only exception).  The controller must survive each subsystem
+    refactoring its internals - a private reach would weld the refit
+    loop to implementation details five packages away."""
+    offenders = []
+    for p in sorted((ROOT / "continuous").rglob("*.py")):
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            attr = node.attr
+            if not attr.startswith("_") or attr.startswith("__"):
+                continue
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")
+            ):
+                continue
+            offenders.append(f"{p}:{node.lineno} .{attr}")
+    assert not offenders, offenders

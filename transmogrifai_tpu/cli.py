@@ -951,6 +951,59 @@ def _add_fleet_parser(sub) -> None:
 
 
 # ---------------------------------------------------------------------------
+# continuous commands (continuous/: drift-triggered refit controller)
+# ---------------------------------------------------------------------------
+def _continuous_status_doc(path: str) -> dict:
+    """The continuous trainer's atomically-published status document:
+    ``path`` may be the ``continuous_status.json`` file itself or a
+    directory holding one (the trainer's status dir / watch dir)."""
+    from .continuous import STATUS_FILENAME
+    from .obs.fleet import read_json_torn_safe
+
+    candidates = [path] if path.endswith(".json") else [
+        os.path.join(path, STATUS_FILENAME),
+    ]
+    for cand in candidates:
+        if os.path.exists(cand):
+            doc = read_json_torn_safe(cand)
+            if doc is not None:
+                return {"source": cand, "status": doc}
+            raise ValueError(f"{cand}: torn/unreadable status document")
+    raise ValueError(
+        f"{path!r} holds no continuous status document "
+        f"({STATUS_FILENAME})")
+
+
+def _continuous_main(args) -> int:
+    if args.continuous_cmd == "status":
+        try:
+            doc = _continuous_status_doc(args.path)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        return 0
+    raise AssertionError(
+        f"unhandled continuous command {args.continuous_cmd}")
+
+
+def _add_continuous_parser(sub) -> None:
+    c = sub.add_parser(
+        "continuous",
+        help="drift-triggered continuous training loop (cycle "
+             "counters, governor state, last cycle verdict)")
+    csub = c.add_subparsers(dest="continuous_cmd", required=True)
+    s = csub.add_parser(
+        "status",
+        help="the trainer's atomically-published status document: "
+             "cycles/refits/promotes/rollbacks, hysteresis + cooldown "
+             "state, last cycle trace id")
+    s.add_argument("--path", required=True,
+                   help="continuous status dir (continuous_status.json)"
+                        " or the file itself")
+
+
+# ---------------------------------------------------------------------------
 # registry commands (registry/: versioned store + lifecycle)
 # ---------------------------------------------------------------------------
 def _registry_main(args) -> int:
@@ -1022,6 +1075,7 @@ def main(argv=None) -> int:
     _add_obs_parser(sub)
     _add_autotune_parser(sub)
     _add_fleet_parser(sub)
+    _add_continuous_parser(sub)
     g = sub.add_parser("gen", help="generate a project from data")
     g.add_argument("--input", required=True, help="CSV or .avsc path")
     g.add_argument("--response", required=True)
@@ -1050,6 +1104,8 @@ def main(argv=None) -> int:
         return _autotune_main(args)
     if args.cmd == "fleet":
         return _fleet_main(args)
+    if args.cmd == "continuous":
+        return _continuous_main(args)
     answers = load_answers(args.answers) if args.answers else None
     path = generate(
         args.input, args.response, args.name, args.output, args.kind,

@@ -1,0 +1,25 @@
+"""transmogrifai_tpu.continuous: the self-operating training loop.
+
+ISSUE 16 — a drift-triggered refit controller that closes the
+data→drift→refit→canary→promote loop the earlier PRs built piecewise:
+the PR-8 pipelined reader grows a follow/tail mode, the PR-4 drift
+monitor a windowed reset seam, the PR-15 fused-train cache keeps refits
+warm, and the PR-14 fleet plus PR-9 SLO engine judge the canary — with
+no human anywhere in the cycle.  See :mod:`.trainer` for the state
+machine and ``docs/continuous.md`` for the operator story.
+"""
+from __future__ import annotations
+
+from .governor import RefitGovernor
+from .trainer import (
+    STATUS_FILENAME,
+    ContinuousError,
+    ContinuousTrainer,
+)
+
+__all__ = [
+    "STATUS_FILENAME",
+    "ContinuousError",
+    "ContinuousTrainer",
+    "RefitGovernor",
+]

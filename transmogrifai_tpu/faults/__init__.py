@@ -39,6 +39,13 @@ canary.regression          live canary outputs poisoned to NaN through
                            drill)
 canary.latency             the canary arm sleeps ``delay`` seconds
                            inside its timed window (latency-SLO drill)
+continuous.refit_crash     hard kill in the continuous trainer between
+                           refit completion and registry publish (the
+                           fleet must keep serving the old stable; the
+                           next cycle recovers)
+drift.false_positive       the continuous detect phase reports a forced
+                           drift trigger on a healthy window (the
+                           canary judges the spurious refit on merit)
 ========================== ==================================================
 
 The ``serving.*``/``io.*``/``supervisor.*``/``native.*`` points drill the
@@ -49,7 +56,10 @@ parallel/resilience.py watchdog (tests/test_mesh_resilience.py,
 drift guards (schema/, tests/test_data_plane.py,
 ``python bench.py --data-faults``); the ``registry.*`` + ``canary.*``
 points drill the model-lifecycle control loop (registry/,
-tests/test_registry.py, ``python bench.py --registry``).
+tests/test_registry.py, ``python bench.py --registry``); the
+``continuous.*`` + ``drift.*`` points drill the drift-triggered refit
+loop (continuous/, tests/test_continuous.py,
+``python bench.py --continuous``).
 """
 from .injection import (
     DEFAULT_KILL_EXIT,

@@ -510,6 +510,18 @@ class FleetController:
                     stable_snaps.append(snap)
         return stable_snaps, canary_snaps
 
+    def canary_telemetry(self) -> dict:
+        """The merged (stable, canary) serving telemetry split — the
+        PUBLIC read seam for automated canary verdicts (ISSUE 16: the
+        continuous trainer polls this for canary row counts instead of
+        reaching into the aggregator's internals).  Same merge
+        :meth:`check_canary` evaluates the rollback policy against."""
+        stable_snaps, canary_snaps = self._arm_snapshots()
+        return {
+            "stable": merge_serving_snapshots(stable_snaps),
+            "canary": merge_serving_snapshots(canary_snaps),
+        }
+
     def check_canary(self) -> Optional[RollbackDecision]:
         """Evaluate the rollback policy (and the fleet SLO engine)
         against the MERGED per-replica telemetry; a breach rolls the
@@ -545,6 +557,23 @@ class FleetController:
         self._write_status()
         log.warning("%s fleet canary %s ROLLED BACK across %d "
                     "replicas", LOG_PREFIX, version, len(out))
+        return out
+
+    def release_canary(self, reason: str = "undecided") -> dict:
+        """Release the canary slot on EVERY replica without a verdict
+        (each worker's release is a pointer flip back to 100% stable;
+        the first one also records the registry ``release_canary``, the
+        rest observe the slot already freed) — the fleet-wide
+        counterpart of ``DeploymentController.release_canary`` for a
+        canary whose evaluation window expired undecided."""
+        out = self.router.broadcast("release_canary",
+                                    {"reason": reason})
+        version, self.canary_version = self.canary_version, None
+        self._event("fleet_canary_release", version=version,
+                    reason=reason, replicas=sorted(out))
+        self._write_status()
+        log.info("%s fleet canary %s released undecided across %d "
+                 "replicas: %s", LOG_PREFIX, version, len(out), reason)
         return out
 
     def promote_canary(self) -> dict:

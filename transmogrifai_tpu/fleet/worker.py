@@ -358,6 +358,12 @@ class ReplicaWorker:
             self._ship_soon()
             return {"ok": True, "rolled_back": event is not None,
                     "event": event}
+        if cmd == "release_canary":
+            event = ctl.release_canary(
+                reason=str(meta.get("reason", "fleet")))
+            self._ship_soon()
+            return {"ok": True, "released": event is not None,
+                    "event": event}
         if cmd == "check_canary":
             decision = ctl.check_canary()
             return {"ok": True,

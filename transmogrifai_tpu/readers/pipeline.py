@@ -122,6 +122,84 @@ def shard(paths: Sequence[str], fmt: Optional[str] = None) -> list[ShardSpec]:
     return [ShardSpec(i, p, fmt) for i, p in enumerate(paths)]
 
 
+class ShardDirectoryFollower:
+    """Follow/tail mode for the sharded pipeline (ISSUE 16): watch a
+    directory and hand out shard files that arrive AFTER start, as
+    :class:`ShardSpec`\\ s whose ids keep growing monotonically across
+    polls — so global row indices, quarantine attribution and ordered
+    reassembly stay stable over the whole lifetime of a long-lived
+    consumer (the continuous trainer), exactly as if the shards had all
+    been declared up front via :func:`shard`.
+
+    Pick-up contract: a file is eligible the first poll it exists with
+    a recognized shard extension (``_FMT_BY_EXT``, or any extension
+    when ``fmt=`` pins the format).  Producers must therefore publish
+    shards ATOMICALLY — write to a temp name and ``os.replace`` into
+    the watched directory (``testkit.drills.write_shard_csv`` is the
+    reference writer) — or set ``settle_s`` so a file is only taken
+    once its mtime is at least that old.  Files arriving within one
+    poll are ordered lexicographically by name; each file is consumed
+    exactly once, keyed by name (a shard overwritten in place is NOT
+    re-read — publish a new name instead)."""
+
+    def __init__(self, directory: str, fmt: Optional[str] = None,
+                 settle_s: float = 0.0) -> None:
+        self.directory = str(directory)
+        self.fmt = fmt
+        self.settle_s = float(settle_s)
+        self._seen: set = set()
+        self._next_id = 0
+
+    @property
+    def shards_seen(self) -> int:
+        """How many shards have been handed out so far."""
+        return self._next_id
+
+    def poll(self) -> list[ShardSpec]:
+        """New shards since the last poll (possibly empty; never
+        blocks).  A missing watch directory is 'nothing new yet', not
+        an error — the producer may not have created it."""
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return []
+        specs: list[ShardSpec] = []
+        now = time.time()
+        for name in names:
+            if name in self._seen:
+                continue
+            if self.fmt is None:
+                ext = os.path.splitext(name)[1].lower()
+                if ext not in _FMT_BY_EXT:
+                    continue
+            path = os.path.join(self.directory, name)
+            if not os.path.isfile(path):
+                continue
+            if self.settle_s > 0:
+                try:
+                    settling = (now - os.stat(path).st_mtime
+                                < self.settle_s)
+                except OSError:
+                    settling = True  # vanished mid-poll: re-decide later
+                if settling:
+                    continue  # still settling: next poll's problem
+            self._seen.add(name)
+            specs.append(ShardSpec(self._next_id, path, self.fmt))
+            self._next_id += 1
+        return specs
+
+    def pipeline(self, specs: Sequence[ShardSpec],
+                 schema: Mapping[str, Type[FeatureType]],
+                 **kw: Any) -> Optional["InputPipeline"]:
+        """One single-use :class:`InputPipeline` over one poll's shards
+        (None when the poll was empty) — the tail consumer's per-window
+        ingest rides the exact same interleave/prefetch machinery as a
+        batch read."""
+        if not specs:
+            return None
+        return InputPipeline(list(specs), schema, **kw)
+
+
 class ShardIngestError(RuntimeError):
     """A worker failed parsing one shard; names the shard and file so
     the operator knows exactly which input to look at."""

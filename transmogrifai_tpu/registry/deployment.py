@@ -349,6 +349,39 @@ class DeploymentController:
                             "registry: %s", canary.version, e)
         return event
 
+    def release_canary(self, reason: str = "undecided") -> Optional[dict]:
+        """Drop the canary arm WITHOUT a judgement: the pointer flips
+        back to 100% stable like a rollback, but the registry records a
+        ``release_canary`` (version back to candidate, slot freed,
+        verdict undecided) instead of a rollback — the path for a
+        canary whose evaluation window expired before either verdict
+        (ISSUE 16: the continuous trainer's verdict timeout).  Returns
+        None when there is no canary to release."""
+        with self._route_lock:
+            canary = self._canary
+            if canary is None:
+                return None
+            self._canary = None
+            stable = self._stable
+        event = self._event(
+            "canary_release", version=canary.version,
+            generation=canary.generation, reason=reason,
+        )
+        canary.endpoint.telemetry.record_lifecycle(event)
+        if stable is not None:
+            stable.endpoint.telemetry.record_lifecycle(event)
+        log.info(
+            "%s canary generation %d (version %s) released undecided: "
+            "%s", LOG_PREFIX, canary.generation, canary.version, reason,
+        )
+        if self.registry is not None:
+            try:
+                self.registry.release_canary(reason=reason)
+            except RegistryError as e:
+                log.warning("released canary %s not tracked in the "
+                            "registry: %s", canary.version, e)
+        return event
+
     # -- routing + scoring --------------------------------------------------
     @property
     def stable_generation(self) -> Optional[Generation]:

@@ -151,3 +151,23 @@ class DriftMonitor:
         with self._lock:
             d = self._accum.get(name)
             return 0 if d is None else d.count
+
+    def reset(self) -> "DriftMonitor":
+        """Drop the accumulated serve distributions: the windowed-merge
+        seam (ISSUE 16).  The cumulative monoid merge above is the right
+        default for a serving endpoint (one long-lived score-vs-train
+        comparison), but it DILUTES late shifts: after a million
+        baseline rows, a thousand drifted rows move the accumulated
+        histogram - and therefore the JS score - almost nothing, so a
+        continuous trainer watching the cumulative score would detect a
+        mid-stream distribution change hours late or never
+        (tests/test_continuous.py pins the bias).  A drift-triggered
+        refit loop instead calls ``reset()`` at each window boundary and
+        scores every window against the training contract on its own
+        rows.  The warned-once latch clears too: a fresh window is a
+        fresh alarm."""
+        with self._lock:
+            self._accum.clear()
+            self._warned.clear()
+            self.batches_observed = 0
+        return self

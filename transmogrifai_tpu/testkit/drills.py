@@ -129,6 +129,149 @@ def drill_env() -> dict:
     return env
 
 
+# -- continuous-training drill surface (ISSUE 16) ---------------------------
+def continuous_shard_rows(n: int = 64, seed: int = 0,
+                          shift: float = 0.0) -> list:
+    """-> n row dicts (y, a, c) matching the tiny drill schema.  ``a``
+    is N(shift, 1) and ``y`` thresholds on the CENTERED value, so the
+    label balance (and therefore trainability) survives any shift while
+    the marginal of ``a`` - what the drift monitor watches - moves with
+    it.  Deterministic per (n, seed, shift) so the continuous e2e test,
+    the chaos drill and ``bench.py --continuous`` stream byte-identical
+    data."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n) + float(shift)
+    y = ((a - float(shift) + 0.3 * rng.randn(n)) > 0).astype(float)
+    return [
+        {"y": float(y[i]), "a": float(a[i]),
+         "c": ("u", "v", "w")[i % 3]}
+        for i in range(n)
+    ]
+
+
+def write_shard_csv(path: str, rows: list) -> str:
+    """Atomically publish one y,a,c shard CSV (tmp + os.replace): the
+    producer contract :class:`~..readers.pipeline.ShardDirectoryFollower`
+    documents - the follower must never see a half-written file."""
+    import csv
+    import os
+    import tempfile
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    with os.fdopen(fd, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["y", "a", "c"])
+        w.writeheader()
+        w.writerows(rows)
+    os.replace(tmp, path)
+    return path
+
+
+def continuous_drill_workflow(n: int = 256, seed: int = 0):
+    """-> a selector-backed workflow over the y/a/c drill schema, input
+    dataset attached (``continuous_shard_rows(n, seed)``).  IMPORTABLE
+    as ``transmogrifai_tpu.testkit.drills:continuous_drill_workflow``,
+    the daemon/worker/seed-trainer factory convention.  The selector
+    (2 folds x 2-point LR grid) is what makes refits exercise the PR-15
+    fused-train cache; the shape bucket is exact, so a refit hits the
+    seed's cached executable ONLY when it trains on the same
+    (rows, width, folds, grid) - stream exactly ``n`` rows before
+    triggering."""
+    import transmogrifai_tpu.dsl  # noqa: F401 - feature operators
+    from .. import FeatureBuilder, OpWorkflow
+    from ..models.logistic_regression import OpLogisticRegression
+    from ..ops.transmogrifier import transmogrify
+    from ..selector.factories import BinaryClassificationModelSelector
+    from ..types import feature_types as ft
+
+    rows = continuous_shard_rows(n, seed)
+    data = {k: [r[k] for r in rows] for k in ("y", "a", "c")}
+    y = FeatureBuilder(ft.RealNN, "y").as_response()
+    a = FeatureBuilder(ft.Real, "a").as_predictor()
+    c = FeatureBuilder(ft.PickList, "c").as_predictor()
+    vec = transmogrify([a, c])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models_and_parameters=[
+            (OpLogisticRegression(max_iter=6),
+             [{"reg_param": r, "elastic_net_param": 0.1}
+              for r in (0.01, 0.1)]),
+        ],
+        splitter=None,
+    )
+    pred = selector.set_input(y, vec).get_output()
+    return OpWorkflow().set_result_features(pred).set_input_dataset(data)
+
+
+def continuous_tiny_factory():
+    """-> the plain-LR tiny drill workflow (no selector): the FAST
+    factory for continuous drills that exercise crash/recovery paths
+    rather than the fused-train cache."""
+    return tiny_drill_pipeline()[0]
+
+
+#: child for the continuous warm-refit drills (tests/test_continuous.py
+#: + ``bench.py --continuous``): cold-train the selector drill workflow
+#: of exactly ``n`` rows with the fused-train AOT cache at ``cache_dir``,
+#: publish the model as stable v1 into the registry at ``root``.  Runs
+#: in a CHILD so the parent's in-process program registry stays empty -
+#: the daemon's first refit then proves disk REHYDRATION (cache "hit",
+#: load_ms > 0, compile_ms == 0), not a same-process memory hit.
+CONTINUOUS_SEED_TRAINER_TEMPLATE = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TX_PRODUCT_MESH", "0")
+from transmogrifai_tpu.testkit.drills import continuous_drill_workflow
+from transmogrifai_tpu.registry import ModelRegistry
+from transmogrifai_tpu.workflow.dag import compute_dag
+from transmogrifai_tpu.workflow.runner import train_fused_summary
+wf = continuous_drill_workflow(n={n}, seed={seed})
+validators = []
+for layer in compute_dag(wf.result_features):
+    for stage in layer:
+        if getattr(stage, "is_model_selector", False):
+            stage.validator.train_fused = True
+            stage.validator.train_cache_dir = {cache_dir!r}
+            validators.append(stage.validator)
+model = wf.train()
+trail = train_fused_summary(validators)
+reg = ModelRegistry({root!r})
+entry = reg.publish(model, stage="stable")
+print("SEEDED", entry.version, json.dumps(trail), flush=True)
+os._exit(0)
+"""
+
+
+#: child for the ``continuous.refit_crash`` drills: run one trainer
+#: cycle over a pre-seeded registry + a pre-written drifted shard with
+#: the kill armed - the refit completes, then the process dies in the
+#: window BEFORE the registry publish (exit DEFAULT_KILL_EXIT).  The
+#: parent asserts the registry still points at the old stable and a
+#: fresh (unarmed) trainer's next cycle recovers end-to-end.  Tiny
+#: factory + consecutive=1/cooldown=0 + train_fused off: the drill pins
+#: crash containment, not cache warmth.
+CONTINUOUS_REFIT_CRASH_TEMPLATE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.continuous import ContinuousTrainer
+from transmogrifai_tpu.faults import injection
+trainer = ContinuousTrainer(
+    {watch!r}, {root!r},
+    "transmogrifai_tpu.testkit.drills:continuous_tiny_factory",
+    drift_threshold=0.05, consecutive_windows=1, cooldown_windows=0,
+    min_window_rows=8, refit_rows=256, train_fused=False,
+)
+injection.configure({fault!r})            # arm the crash
+trainer.run_cycle()                       # dies at continuous.refit_crash
+os._exit(0)                               # unreachable when armed
+"""
+
+
 #: child script for supervision drills: exits ``first_exit`` on the run
 #: that creates ``marker``, ``then_exit`` on every run after (die-once
 #: recovery when then_exit=0, differing-exit-codes when both non-zero).

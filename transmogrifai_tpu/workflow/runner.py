@@ -57,6 +57,44 @@ class OpWorkflowRunnerResult:
     wall_s: float = 0.0
 
 
+def train_fused_summary(validators: list) -> Optional[dict]:
+    """Run-level rollup of the per-selector fused-training trails
+    (ISSUE 15 satellite): ``train_fused.backend`` +
+    ``cache{hits,misses,stale}`` mirroring the PR-12 serving
+    telemetry shape, so `tx autotune report` and the continuous
+    loop can assert warm refits skipped retrace.  The backend
+    tri-state folds the per-selector verdicts (each computed by
+    OpValidator._record_train_fused) rather than re-deriving from
+    families, and a family selected by TWO selectors keeps both
+    entries under suffixed keys instead of last-one-wins.  Module-
+    level and public: the ISSUE 16 continuous trainer folds its own
+    refit validators through the exact same rollup."""
+    trails = [v.last_train_fused for v in validators
+              if v.last_train_fused is not None]
+    if not trails:
+        return None
+    cache = {"hits": 0, "misses": 0, "stale": 0}
+    families: dict = {}
+    backends: set = set()
+    for t in trails:
+        backends.add(t.get("backend"))
+        for key in cache:
+            cache[key] += int(t.get("cache", {}).get(key, 0))
+        for fam, entry in t.get("families", {}).items():
+            key, i = fam, 2
+            while key in families:
+                key, i = f"{fam}#{i}", i + 1
+            families[key] = entry
+    return {
+        "backend": (
+            "fused" if backends == {"fused"}
+            else "existing" if backends == {"existing"} else "mixed"
+        ),
+        "families": families,
+        "cache": cache,
+    }
+
+
 class OpWorkflowRunner:
     def __init__(
         self,
@@ -124,6 +162,8 @@ class OpWorkflowRunner:
                     result = self._deploy(params)
                 elif run_type == "fleet":
                     result = self._fleet(params)
+                elif run_type == "continuous":
+                    result = self._continuous(params)
                 else:
                     raise ValueError(f"unknown run type {run_type!r}")
         finally:
@@ -290,39 +330,7 @@ class OpWorkflowRunner:
 
     @staticmethod
     def _train_fused_summary(validators: list):
-        """Run-level rollup of the per-selector fused-training trails
-        (ISSUE 15 satellite): ``train_fused.backend`` +
-        ``cache{hits,misses,stale}`` mirroring the PR-12 serving
-        telemetry shape, so `tx autotune report` and the continuous
-        loop can assert warm refits skipped retrace.  The backend
-        tri-state folds the per-selector verdicts (each computed by
-        OpValidator._record_train_fused) rather than re-deriving from
-        families, and a family selected by TWO selectors keeps both
-        entries under suffixed keys instead of last-one-wins."""
-        trails = [v.last_train_fused for v in validators
-                  if v.last_train_fused is not None]
-        if not trails:
-            return None
-        cache = {"hits": 0, "misses": 0, "stale": 0}
-        families: dict = {}
-        backends: set = set()
-        for t in trails:
-            backends.add(t.get("backend"))
-            for key in cache:
-                cache[key] += int(t.get("cache", {}).get(key, 0))
-            for fam, entry in t.get("families", {}).items():
-                key, i = fam, 2
-                while key in families:
-                    key, i = f"{fam}#{i}", i + 1
-                families[key] = entry
-        return {
-            "backend": (
-                "fused" if backends == {"fused"}
-                else "existing" if backends == {"existing"} else "mixed"
-            ),
-            "families": families,
-            "cache": cache,
-        }
+        return train_fused_summary(validators)
 
     def _autotune_summary(self, at_cfg, params: OpParams) -> dict:
         """Post-train autotune bookkeeping: fold this run's tagged fit
@@ -806,6 +814,71 @@ class OpWorkflowRunner:
                              "fleet_metrics.json"), metrics)
         return OpWorkflowRunnerResult(run_type="fleet", metrics=metrics)
 
+    def _continuous(self, params: OpParams) -> OpWorkflowRunnerResult:
+        """The ``continuous`` run type (ISSUE 16): a BOUNDED run of the
+        drift-triggered refit controller — tail ``watch_dir`` for
+        shards, score each window's drift against the stable model's
+        training contract, refit + publish + promote when the hysteresis
+        trips, then exit after ``continuous_max_cycles`` cycles or
+        ``continuous_idle_exit`` consecutive empty polls.  The batch
+        entrypoint runs in DIRECT promote mode (no fleet: publish →
+        stable pointer flip); a fleet-attached daemon is constructed
+        programmatically with ``ContinuousTrainer(fleet=...)``.  Knobs
+        (custom_params): ``watch_dir`` (required), ``registry_root``
+        (default <model_location>/registry), ``drift_threshold`` /
+        ``drift_consecutive`` / ``drift_cooldown``,
+        ``continuous_window_rows``, ``continuous_refit_rows``,
+        ``continuous_max_cycles`` / ``continuous_idle_exit`` /
+        ``continuous_poll_s``, plus the train-fused pair
+        (``train_fused``, ``train_xla_cache_dir``) the refit reuses."""
+        from ..continuous import ContinuousTrainer
+        from ..registry import ModelRegistry
+
+        cp = params.custom_params
+        watch = cp.get("watch_dir")
+        if not watch:
+            raise ValueError("continuous run needs custom_params "
+                             "{'watch_dir': DIR} to tail")
+        root = cp.get("registry_root") or (
+            os.path.join(params.model_location, "registry")
+            if params.model_location else None)
+        if not root:
+            raise ValueError("continuous run needs custom_params "
+                             "{'registry_root': DIR} or model_location")
+        status_dir = str(cp.get("continuous_status_dir")
+                         or params.metrics_location or watch)
+        cache_dir = cp.get("train_xla_cache_dir")
+        if cache_dir is None and params.model_location:
+            cache_dir = os.path.join(params.model_location,
+                                     "train_xla_cache")
+        trainer = ContinuousTrainer(
+            str(watch), ModelRegistry(str(root)), self._fresh_workflow,
+            status_dir=status_dir,
+            drift_threshold=float(cp.get("drift_threshold", 0.1)),
+            consecutive_windows=int(cp.get("drift_consecutive", 3)),
+            cooldown_windows=int(cp.get("drift_cooldown", 2)),
+            min_window_rows=int(cp.get("continuous_window_rows", 64)),
+            refit_rows=int(cp.get("continuous_refit_rows", 4096)),
+            train_fused=cp.get("train_fused"),
+            train_cache_dir=str(cache_dir) if cache_dir else None,
+            bootstrap=True,
+        )
+        trainer.run(
+            max_cycles=int(cp.get("continuous_max_cycles", 4)),
+            idle_exit=int(cp.get("continuous_idle_exit", 2)),
+            poll_interval_s=float(cp.get("continuous_poll_s", 0.2)),
+        )
+        metrics = dict(trainer.status(), run_type="continuous")
+        if params.metrics_location:
+            from ..obs import write_json_artifact
+
+            os.makedirs(params.metrics_location, exist_ok=True)
+            write_json_artifact(
+                os.path.join(params.metrics_location,
+                             "continuous_metrics.json"), metrics)
+        return OpWorkflowRunnerResult(run_type="continuous",
+                                      metrics=metrics)
+
     # ------------------------------------------------------------------
     def streaming_score(
         self,
@@ -863,7 +936,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="transmogrifai_tpu workflow runner")
     p.add_argument("--run-type", required=True,
                    choices=["train", "score", "features", "evaluate",
-                            "serve", "deploy", "fleet"])
+                            "serve", "deploy", "fleet", "continuous"])
     p.add_argument("--params", help="path to OpParams JSON")
     p.add_argument("--workflow", required=True,
                    help="module:function returning (workflow, evaluator, readers...)")
