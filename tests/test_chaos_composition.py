@@ -1,20 +1,25 @@
-"""Chaos-composition drill (ISSUE 4 satellite, extended by ISSUEs 5
-and 16): ONE seeded, randomized schedule arming faults from six
+"""Chaos-composition drill (ISSUE 4 satellite, extended by ISSUEs 5,
+16 and 17): ONE seeded, randomized schedule arming faults from seven
 different subsystems — ``reader.*`` (data plane), ``serving.batch``
 (serving), ``io.save_model.crash`` (serialization),
 ``supervisor.child_kill`` (supervision), ``registry.publish_crash`` +
 ``canary.regression`` (model lifecycle), ``continuous.refit_crash`` +
-``drift.false_positive`` (continuous training) — across a single
-end-to-end workflow run (corrupted-CSV quarantine ingest → train →
-save/load → serve → supervise → registry publish/canary →
-drift-triggered refit), asserting the GLOBAL invariants:
+``drift.false_positive`` (continuous training), and
+``fleet.partition`` + ``channel.corrupt_frame`` +
+``fleet.reconnect_storm`` (fleet transport, over a live loopback-TCP
+fleet) — across a single end-to-end workflow run (corrupted-CSV
+quarantine ingest → train → save/load → serve → supervise → registry
+publish/canary → drift-triggered refit → fleet serve under network
+faults), asserting the GLOBAL invariants:
 
 * no corrupt artifact is ever loadable (checksums verify at each step,
   including the registry index after a crashed publish);
 * no phase hangs past its deadline;
 * every injected event is accounted for in telemetry — quarantine
   counts, fallback rows, breaker transitions, supervisor restarts,
-  canary NaN-guard refusals and the rollback decision they trigger.
+  canary NaN-guard refusals and the rollback decision they trigger,
+  partition windows and corrupt frames in the fleet wire ledgers with
+  the fleet's row ledger EXACT (nothing lost, nothing duplicated).
 
 The schedule is randomized per TX_CHAOS_SEED but deterministic for a
 given seed, so a failing composition replays exactly.
@@ -22,6 +27,7 @@ given seed, so a failing composition replays exactly.
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -63,6 +69,7 @@ INGEST_TRAIN_DEADLINE_S = 120.0
 CRASH_SAVE_DEADLINE_S = 300.0
 SERVE_DEADLINE_S = 60.0
 SUPERVISE_DEADLINE_S = 60.0
+FLEET_DEADLINE_S = 180.0
 
 
 @pytest.fixture(autouse=True)
@@ -100,6 +107,8 @@ def test_chaos_composition_end_to_end(tmp_path):
         "io.save_model.crash", "supervisor.child_kill",
         "registry.publish_crash", "canary.regression",
         "continuous.refit_crash", "drift.false_positive",
+        "fleet.partition", "channel.corrupt_frame",
+        "fleet.reconnect_storm",
     ]}
 
     # ---- phase 1: quarantine ingest (real corruption + injected) → train
@@ -322,6 +331,113 @@ def test_chaos_composition_end_to_end(tmp_path):
     assert forced_trainer.forced_triggers == 1
     assert registry.stable == cyc2["published"] != cyc["published"]
     events["forced_trigger_promoted"] = cyc2["published"]
+
+    # ---- phase 7: fleet transport under network faults -----------------
+    # (ISSUE 17) a live loopback-TCP fleet rides out a partition on one
+    # replica (silence-detection ejection → failover → probe
+    # readmission), then a router-side corrupt frame kills the other
+    # replica's channel and the readmission probe rides out a reconnect
+    # storm — traffic pumped throughout, the row ledger EXACT
+    from transmogrifai_tpu.fleet import FleetController
+    from transmogrifai_tpu.registry import ModelRegistry as _Reg
+
+    fleet_reg_root = str(tmp_path / "fleet_registry")
+    _Reg(fleet_reg_root).publish(recovered, stage="stable")
+    t0 = time.monotonic()
+    batch = records[:16]
+    with FleetController(
+        fleet_reg_root,
+        "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline",
+        n_replicas=2, transport="tcp", max_restarts=0,
+        work_dir=str(tmp_path / "fleet"), ship_interval_s=0.2,
+        worker_env_overrides={"replica-1": {
+            "TX_FAULTS": "fleet.partition:every=4:times=1:delay=2.5"}},
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64,
+                   "response_timeout_s": 1.5, "eject_after": 1,
+                   "probe_interval_s": 0.4, "probe_timeout_s": 0.8},
+    ) as fc:
+        def _fleet_wait(pred, timeout_s, what):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() <= deadline:
+                if pred():
+                    return
+                time.sleep(0.05)
+            raise AssertionError(f"fleet phase hang: {what}")
+
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        delivered, fleet_errors, submitted = [], [], [0]
+        stop_pump = threading.Event()
+
+        def _pump():
+            while not stop_pump.is_set():
+                submitted[0] += 1
+                try:
+                    res = fc.router.submit(records=batch).wait(60.0)
+                    delivered.append(res.n_rows)
+                except Exception as e:  # noqa: BLE001 - the ledger counts
+                    fleet_errors.append(repr(e))
+
+        pumps = [threading.Thread(target=_pump) for _ in range(3)]
+        for t in pumps:
+            t.start()
+        try:
+            # replica-1's 4th data send opens the partition window: the
+            # router must eject it on response silence while replica-0
+            # absorbs the failovers
+            _fleet_wait(lambda: fc.router.snapshot()["ejections"] >= 1,
+                        30.0, "partition ejection")
+        finally:
+            stop_pump.set()
+            for t in pumps:
+                t.join(timeout=120.0)
+        _fleet_wait(
+            lambda: fc.router.snapshot()["readmissions"] >= 1
+            and fc.router.handle("replica-1").health.state == "healthy",
+            30.0, "partition readmission")
+
+        # router-side: the NEXT outbound frame goes out corrupt (the
+        # worker's CRC check kills the channel), and the readmission
+        # probe's first reconnect is storm-dropped
+        faults.configure("channel.corrupt_frame:on=1 "
+                         "fleet.reconnect_storm:every=1:times=1")
+        res = fc.router.submit(records=batch).wait(60.0)
+        assert res.n_rows == len(batch)  # failed over, delivered ONCE
+        _fleet_wait(lambda: fc.router.snapshot()["readmissions"] >= 2,
+                    30.0, "post-storm readmission")
+        faults.reset()
+
+        post = fc.router.score_batch(batch, timeout_s=60.0)
+        assert len(post) == len(batch)
+        snap = fc.router.snapshot()
+
+        # row ledger EXACT: every accepted request answered exactly once
+        assert fleet_errors == []
+        assert len(delivered) == submitted[0]
+        assert sum(delivered) == submitted[0] * len(batch)
+        assert snap["rows_ok"] == (submitted[0] + 3) * len(batch)
+        assert snap["requests_failed"] == 0
+
+        # every injection accounted in the wire/health ledgers
+        corrupted = [h for h in fc.router.replicas()
+                     if h.wire_stats()["corrupt_injected"] >= 1]
+        assert len(corrupted) == 1  # exactly one frame went out corrupt
+        victim = corrupted[0]
+        assert victim.health.state == "healthy"  # readmitted post-storm
+        vdoc = fc.router.control(victim.instance, "status",
+                                 timeout_s=30.0)
+        assert vdoc["wire"]["protocol_errors"] >= 1  # worker CAUGHT it
+        w1 = fc.router.control("replica-1", "status", timeout_s=30.0)
+        assert w1["wire"]["partitions"] >= 1
+        assert w1["wire"]["frames_dropped"] >= 1
+        assert snap["response_timeouts"] >= 1  # partition detection
+        assert snap["ejections"] >= 2 and snap["readmissions"] >= 2
+        assert snap["replica_deaths"] >= 1     # corrupt-frame channel kill
+        assert snap["probes_failed"] >= 1      # the storm's dropped dial
+        events["fleet_ejections"] = snap["ejections"]
+        events["fleet_readmissions"] = snap["readmissions"]
+        events["fleet_rows_ok"] = snap["rows_ok"]
+    t_fleet = time.monotonic() - t0
+    assert t_fleet < FLEET_DEADLINE_S, "fleet transport hang"
 
     # ---- global: nothing leaked, everything accounted ------------------
     assert not faults.active()

@@ -1,0 +1,610 @@
+"""Network-fault envelope drills for the fleet transport (ISSUE 17).
+
+The acceptance drills - a loopback-TCP fleet partitioned mid-serve
+(ejection -> survivors absorb with an exact double-entry ledger ->
+half-open probe readmission, all under one trace id), the half-open
+(accept-but-never-respond) variant, and a reconnect-storm recovery -
+plus the unit surface: TCP/unix address parsing, per-frame CRC32
+integrity, OP_HELLO handshake failure modes, the ReplicaHealth state
+machine, quorum brownout, remote deadline drops, and the
+decode-error attribution satellite.
+
+All drills are seeded: the fault specs (``on=``/``every=`` triggers,
+``delay=`` impairment windows) pin every run to the same schedule, and
+fault consumption only happens on data sends (see faults/injection.py)
+so trigger counts are a deterministic function of traffic.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.faults import injection as _faults
+from transmogrifai_tpu.fleet import (
+    BrownoutShedError,
+    FleetController,
+    FleetDecodeError,
+    FleetRouter,
+    ReplicaHealth,
+)
+from transmogrifai_tpu.fleet.channel import (
+    OP_HELLO,
+    OP_SCORE,
+    WIRE_MAGIC,
+    ChannelClosedError,
+    ChannelProtocolError,
+    ChannelTimeoutError,
+    accept,
+    connect,
+    listen,
+    parse_address,
+)
+from transmogrifai_tpu.fleet.router import FleetResult
+from transmogrifai_tpu.obs.trace import tracer
+from transmogrifai_tpu.registry import ModelRegistry
+from transmogrifai_tpu.serving import QueueFullError
+from transmogrifai_tpu.serving.admission import DeadlineExceededError
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+WORKFLOW_SPEC = "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every drill leaves the process fault-free (channel unit tests
+    arm in-process; a leaked plan would corrupt later tests)."""
+    yield
+    _faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# unit surface: addressing
+# ---------------------------------------------------------------------------
+def test_parse_address_tcp_vs_unix():
+    assert parse_address("tcp://10.0.0.7:7001") == \
+        ("tcp", ("10.0.0.7", 7001))
+    assert parse_address("tcp://:7001") == ("tcp", ("127.0.0.1", 7001))
+    assert parse_address("127.0.0.1:9000") == \
+        ("tcp", ("127.0.0.1", 9000))
+    # a path separator or a non-numeric port means unix, not TCP
+    assert parse_address("/tmp/replica-0.sock") == \
+        ("unix", "/tmp/replica-0.sock")
+    assert parse_address("/tmp/odd:name.sock") == \
+        ("unix", "/tmp/odd:name.sock")
+    assert parse_address("replica:zero") == ("unix", "replica:zero")
+
+
+# ---------------------------------------------------------------------------
+# unit surface: TCP channel - roundtrip, CRC integrity, handshake
+# ---------------------------------------------------------------------------
+def _tcp_listener():
+    lsock = listen("127.0.0.1:0")
+    host, port = lsock.getsockname()[:2]
+    return lsock, f"{host}:{port}"
+
+
+def _recv_message(chan, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() <= deadline:
+        msg = chan.recv()
+        if msg is not None:
+            return msg
+    raise AssertionError("no message within the deadline")
+
+
+def _handshake_server(lsock, accepts=1, magic=None, respond=True,
+                      errors=None):
+    """Accept ``accepts`` connections and answer each OP_HELLO (with an
+    optionally-wrong magic, or silence) - the worker side of the
+    handshake, small enough to drive every client failure mode."""
+
+    def run():
+        for _ in range(accepts):
+            try:
+                chan = accept(lsock, 10.0)
+            except ChannelClosedError:
+                return  # the test closed the listener: done
+            if chan is None:
+                return
+            try:
+                msg = _recv_message(chan)
+                if respond:
+                    meta = chan.hello_reply_meta()
+                    if magic is not None:
+                        meta["magic"] = magic
+                    chan.send(OP_HELLO, msg[1], meta)
+                # hold the channel open (silently when not responding -
+                # the client must TIME OUT, not see a close) until the
+                # peer hangs up or a bounded wait passes
+                try:
+                    _recv_message(chan, timeout_s=5.0)
+                except AssertionError:
+                    pass
+            except (ChannelClosedError, ChannelProtocolError) as e:
+                if errors is not None:
+                    errors.append(e)
+            finally:
+                chan.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_tcp_roundtrip_and_crc_corruption_detected():
+    lsock, address = _tcp_listener()
+    server_chan = {}
+    ready = threading.Event()
+
+    def server():
+        server_chan["c"] = accept(lsock, 10.0)
+        ready.set()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    client = connect(address, timeout_s=10.0, handshake=False)
+    assert ready.wait(10.0)
+    srv = server_chan["c"]
+    try:
+        # clean frame: meta + payload survive the wire byte-exactly
+        payload = b"\x00\x01" * 4096
+        client.send(OP_SCORE, 7, {"n_rows": 3}, payload)
+        op, rid, meta, got = _recv_message(srv)
+        assert (op, rid, meta["n_rows"]) == (OP_SCORE, 7, 3)
+        assert bytes(got) == payload
+
+        # corrupt frame: flipped CRC -> ChannelProtocolError, counted,
+        # never decoded into a batch; the stream is unsyncable -> closed
+        _faults.configure("channel.corrupt_frame:on=1")
+        client.send(OP_SCORE, 8, {"n_rows": 3}, payload)
+        assert client.corrupt_injected == 1
+        with pytest.raises(ChannelProtocolError, match="CRC"):
+            _recv_message(srv)
+        assert srv.protocol_errors == 1
+        assert srv.closed
+        assert srv.stats()["protocol_errors"] == 1
+    finally:
+        client.close()
+        srv.close()
+        lsock.close()
+
+
+def test_handshake_rejects_cross_wired_magic():
+    lsock, address = _tcp_listener()
+    _handshake_server(lsock, magic="not-txfleet")
+    try:
+        with pytest.raises(ChannelProtocolError, match="cross-wired"):
+            connect(address, timeout_s=5.0)
+    finally:
+        lsock.close()
+
+
+def test_handshake_silence_times_out_bounded():
+    lsock, address = _tcp_listener()
+    _handshake_server(lsock, accepts=8, respond=False)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ChannelTimeoutError, match="handshake"):
+            connect(address, timeout_s=0.6, handshake_timeout_s=0.2)
+    finally:
+        lsock.close()
+    assert time.monotonic() - t0 < 5.0  # bounded, not the 30s default
+
+
+def test_handshake_completes_and_records_peer():
+    lsock, address = _tcp_listener()
+    _handshake_server(lsock)
+    try:
+        chan = connect(address, timeout_s=5.0)
+        assert chan.peer["magic"] == WIRE_MAGIC
+        assert chan.peer["pid"] > 0
+        chan.close()
+    finally:
+        lsock.close()
+
+
+def test_reconnect_storm_drops_connections_then_recovers():
+    lsock, address = _tcp_listener()
+    errors: list = []
+    _handshake_server(lsock, accepts=4, errors=errors)
+    _faults.configure("fleet.reconnect_storm:every=1:times=2")
+    try:
+        for _ in range(2):
+            with pytest.raises(ChannelProtocolError,
+                               match="reconnect storm"):
+                connect(address, timeout_s=5.0)
+        # the storm budget (times=2) is spent: the next connect lands
+        chan = connect(address, timeout_s=5.0)
+        assert chan.peer["magic"] == WIRE_MAGIC
+        chan.close()
+    finally:
+        lsock.close()
+
+
+# ---------------------------------------------------------------------------
+# unit surface: ReplicaHealth state machine
+# ---------------------------------------------------------------------------
+def test_replica_health_state_machine():
+    h = ReplicaHealth(eject_after=2)
+    assert h.state == "healthy" and h.snapshot()["state_code"] == 0
+
+    # consecutive failures below the threshold do not eject
+    assert h.record_failure("response timeout", 1.0) is False
+    assert h.state == "healthy" and h.consecutive_failures == 1
+    # a response of any kind resets the count while healthy
+    h.record_success(2.5, 1.5)
+    assert h.consecutive_failures == 0 and h.last_rtt_ms == 2.5
+    # the threshold ejects exactly once
+    assert h.record_failure("response timeout", 2.0) is False
+    assert h.record_failure("response timeout", 2.1) is True
+    assert h.state == "ejected" and h.ejections == 1
+    assert h.ejected_at == 2.1
+    # force_eject while already ejected does not double-count
+    h.force_eject("channel dead", 2.2)
+    assert h.ejections == 1
+
+    # a straggler success while ejected is NOT readmission
+    h.record_success(1.0, 2.3)
+    assert h.state == "ejected"
+
+    # probe -> probing; unanswered probe -> back to ejected
+    h.begin_probe(3.0)
+    assert h.state == "probing" and h.probes_sent == 1
+    h.probe_failed("probe unanswered", 3.5)
+    assert h.state == "ejected" and h.probes_failed == 1
+    assert h.snapshot()["state_code"] == 2
+
+    # probe pong readmits (exactly once) and clears the counters
+    h.begin_probe(4.0)
+    assert h.readmit(4.2) is True
+    assert h.state == "healthy" and h.readmissions == 1
+    assert h.consecutive_failures == 0 and h.readmitted_at == 4.2
+    assert h.readmit(4.3) is False  # already healthy: no double-count
+    assert h.readmissions == 1
+
+    transitions = [t["to"] for t in h.transitions]
+    assert transitions == ["ejected", "probing", "ejected", "probing",
+                           "healthy"]
+    with pytest.raises(ValueError):
+        ReplicaHealth(eject_after=0)
+
+
+# ---------------------------------------------------------------------------
+# unit surface: quorum brownout sheds at the front door
+# ---------------------------------------------------------------------------
+def test_brownout_sheds_low_priority_below_quorum():
+    router = FleetRouter(start=False, quorum=2,
+                         tenant_priority={"vip": 5},
+                         brownout_min_priority=1)
+    try:
+        # zero healthy replicas < quorum 2: anonymous + low-priority
+        # tenants shed with the dedicated (QueueFullError) subclass
+        with pytest.raises(BrownoutShedError, match="brownout"):
+            router.submit(records=[{"r": 1}])
+        with pytest.raises(QueueFullError):
+            router.submit(records=[{"r": 1}], tenant="batch-job")
+        # a tenant at/above the priority floor still admits
+        req = router.submit(records=[{"r": 1}], tenant="vip")
+        assert req is not None
+        snap = router.snapshot()
+        assert snap["shed_brownout"] == 2
+        assert snap["healthy_replicas"] == 0 and snap["quorum"] == 2
+        health = router.health_snapshot()
+        assert health["shed_brownout"] == 2
+    finally:
+        router.close(timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: decode failures are counted and attributed
+# ---------------------------------------------------------------------------
+def test_fleet_result_decode_error_names_request_and_replica():
+    counted = []
+    res = FleetResult(
+        {"request_id": 41, "instance": "replica-3", "n_rows": 2},
+        b"\x80\x05not-a-pickle",
+        on_decode_error=lambda: counted.append(1))
+    with pytest.raises(FleetDecodeError) as ei:
+        _ = res.results
+    msg = str(ei.value)
+    assert "request 41" in msg and "replica-3" in msg
+    assert counted == [1]
+    # a decodable payload still round-trips
+    from transmogrifai_tpu.fleet import encode_results
+
+    ok = FleetResult({"n_rows": 1}, encode_results([{"p": 0.5}]))
+    assert ok.results == [{"p": 0.5}]
+
+
+def test_router_counts_decode_errors():
+    router = FleetRouter(start=False)
+    try:
+        router._count_decode_error()
+        assert router.snapshot()["decode_errors"] == 1
+        assert router.health_snapshot()["decode_errors"] == 1
+    finally:
+        router.close(timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the fleet_health metrics view rides the obs plane
+# ---------------------------------------------------------------------------
+def test_health_views_extracts_fleet_health_from_metrics_doc():
+    from transmogrifai_tpu.obs.fleet import health_views
+
+    doc = {"views": {
+        "fleet_router/1": {"rows_ok": 5},
+        "fleet_health/1": {"ejections": 2,
+                           "replicas": {"replica-0": {"state": "healthy"}}},
+        "fleet_health": {"ejections": 0, "replicas": {}},
+    }}
+    got = dict(health_views(doc))
+    assert set(got) == {"fleet_health/1", "fleet_health"}
+    assert got["fleet_health/1"]["ejections"] == 2
+    assert dict(health_views({"views": {"serving/1": {}}})) == {}
+    assert dict(health_views({})) == {}
+
+
+# ---------------------------------------------------------------------------
+# shared registry for the integration drills (one tiny trained model)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fleet_registry(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fleet-faults-registry"))
+    wf, _data, records, pred_name = tiny_drill_pipeline()
+    model = wf.train()
+    reg = ModelRegistry(root)
+    v1 = reg.publish(model, stage="stable")
+    return {"root": root, "records": records, "pred_name": pred_name,
+            "v1": v1.version}
+
+
+def _tcp_controller(fleet_registry, tmp_path, n_replicas, **kw):
+    kw.setdefault("router_kw", {})
+    kw["router_kw"].setdefault("max_in_flight_per_replica", 2)
+    kw["router_kw"].setdefault("max_queue", 64)
+    kw.setdefault("transport", "tcp")
+    kw.setdefault("max_restarts", 0)
+    return FleetController(
+        fleet_registry["root"], WORKFLOW_SPEC,
+        n_replicas=n_replicas, work_dir=str(tmp_path / "fleet"),
+        ship_interval_s=0.15, **kw,
+    )
+
+
+def _wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() <= deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _run_impairment_drill(fleet_registry, tmp_path, fault_spec):
+    """The shared body of the partition and half-open acceptance
+    drills: a two-replica loopback-TCP fleet, replica-1 impaired
+    mid-serve by ``fault_spec``, pumped traffic throughout.  Asserts
+    ejection -> survivors absorb with an exact double-entry ledger ->
+    probe readmission, all under ONE trace id; returns the final router
+    snapshot + replica-1's worker-side status doc for drill-specific
+    asserts."""
+    records = fleet_registry["records"]
+    batch = records[:24]
+    with tracer().span("fleet.fault_drill") as root:
+        with _tcp_controller(
+            fleet_registry, tmp_path, 2,
+            worker_env_overrides={"replica-1": {"TX_FAULTS": fault_spec}},
+            router_kw={
+                "response_timeout_s": 1.5,
+                "eject_after": 1,
+                "probe_interval_s": 0.4,
+                "probe_timeout_s": 0.8,
+            },
+        ) as fc:
+            assert all(h.transport == "tcp"
+                       for h in fc.router.replicas())
+            fc.router.score_batch(batch, timeout_s=60.0)  # warm
+            delivered: list = []
+            errors: list = []
+            submitted = [0]
+            stop_pump = threading.Event()
+
+            def pump() -> None:
+                while not stop_pump.is_set():
+                    submitted[0] += 1
+                    try:
+                        res = fc.router.submit(records=batch).wait(60.0)
+                        delivered.append(res.n_rows)
+                    except Exception as e:  # noqa: BLE001 - the drill counts
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=pump) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                # the impairment window opens on replica-1's Nth data
+                # send; the router must EJECT it within the silence
+                # ceiling while survivors keep serving
+                _wait_for(
+                    lambda: fc.router.snapshot()["ejections"] >= 1,
+                    timeout_s=20.0, what="ejection")
+                assert fc.router.handle("replica-1").health.state \
+                    != "healthy"
+                assert fc.router.score_batch(batch, timeout_s=60.0) \
+                    and True  # survivors serve DURING the outage
+            finally:
+                stop_pump.set()
+                for t in threads:
+                    t.join(timeout=120.0)
+
+            # heal: the window expires, a probe pong readmits
+            _wait_for(
+                lambda: fc.router.snapshot()["readmissions"] >= 1
+                and fc.router.handle("replica-1").health.state
+                == "healthy",
+                timeout_s=20.0, what="readmission")
+
+            # EXACT double-entry ledger: every accepted request was
+            # answered exactly once - nothing lost, nothing duplicated
+            assert errors == []
+            assert len(delivered) == submitted[0]
+            assert sum(delivered) == submitted[0] * len(batch)
+            snap = fc.router.snapshot()
+            # +2: the warm batch and the mid-outage survivor batch
+            assert snap["rows_ok"] == (submitted[0] + 2) * len(batch)
+            assert snap["response_timeouts"] >= 1
+            assert snap["ejections"] >= 1
+            assert snap["readmissions"] >= 1
+            assert snap["probes_sent"] >= 1
+            assert snap["requests_failed"] == 0
+
+            # the survivor carried load the whole way through
+            assert snap["replicas"]["replica-0"]["rows_ok"] > 0
+            assert snap["replicas"]["replica-0"]["health"]["state"] \
+                == "healthy"
+
+            # the readmitted replica serves again (post-heal traffic
+            # reaches it once its health is green)
+            post = fc.router.score_batch(batch, timeout_s=60.0)
+            assert len(post) == len(batch)
+
+            # the controller's status doc carries the health columns
+            status = fc.status()
+            rep1 = status["replicas"]["replica-1"]
+            assert rep1["transport"] == "tcp"
+            assert rep1["health"] == "healthy"
+            assert rep1["ejections"] >= 1 and rep1["readmissions"] >= 1
+
+            # worker-side wire ledger (the impairment happened in the
+            # replica's channel): read it over the control plane
+            worker_doc = fc.router.control("replica-1", "status",
+                                           timeout_s=30.0)
+            health_snap = fc.router.health_snapshot()
+    # ONE trace id: ejection and readmission events from the router's
+    # health/receive threads ride the drill's ambient trace
+    events = [r for r in tracer().spans(root.trace_id)
+              if r["name"] in ("fleet.ejection", "fleet.readmission")]
+    names = {r["name"] for r in events}
+    assert names == {"fleet.ejection", "fleet.readmission"}
+    assert all(r["trace"] == root.trace_id for r in events)
+    return snap, worker_doc, health_snap
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill: partition -> ejection -> heal -> readmission
+# ---------------------------------------------------------------------------
+def test_tcp_partition_ejects_heals_and_readmits(fleet_registry,
+                                                 tmp_path):
+    snap, worker_doc, health_snap = _run_impairment_drill(
+        fleet_registry, tmp_path,
+        "fleet.partition:every=6:times=1:delay=4.0")
+    wire = worker_doc["wire"]
+    assert wire["partitions"] >= 1
+    assert wire["frames_dropped"] >= 1  # frames vanished into the dark
+    assert health_snap["replicas"]["replica-1"]["state"] == "healthy"
+    assert health_snap["replicas"]["replica-1"]["ejections"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill: half-open (accepts work, never responds)
+# ---------------------------------------------------------------------------
+def test_tcp_half_open_peer_ejects_heals_and_readmits(fleet_registry,
+                                                      tmp_path):
+    snap, worker_doc, _health = _run_impairment_drill(
+        fleet_registry, tmp_path,
+        "fleet.half_open:every=6:times=1:delay=4.0")
+    wire = worker_doc["wire"]
+    assert wire["half_opens"] >= 1
+    assert wire["frames_dropped"] >= 1
+    # half-open keeps READING: probes reached the worker but the pongs
+    # were eaten, so at least one probe went unanswered before the heal
+    assert snap["probes_failed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# drill: corrupt frame kills the channel; the readmission probe rides
+# out a reconnect storm (rate-bounded) and recovers the replica
+# ---------------------------------------------------------------------------
+def test_corrupt_frame_then_reconnect_storm_recovers(fleet_registry,
+                                                     tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:24]
+    with _tcp_controller(
+        fleet_registry, tmp_path, 1,
+        # the worker corrupts its 3rd data send: the router's receiver
+        # raises ChannelProtocolError and force-ejects the replica
+        worker_env_overrides={
+            "replica-0": {"TX_FAULTS": "channel.corrupt_frame:on=3"}},
+        router_kw={
+            "response_timeout_s": 5.0,
+            "probe_interval_s": 0.4,
+            "probe_timeout_s": 2.0,
+        },
+    ) as fc:
+        # sends 1-2 deliver cleanly; the 3rd comes back corrupt
+        for _ in range(2):
+            assert len(fc.router.score_batch(batch, timeout_s=60.0)) \
+                == len(batch)
+        # the router-side storm eats the probe's first two reconnects
+        _faults.configure("fleet.reconnect_storm:every=1:times=2")
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < 60.0 and not recovered:
+            try:
+                res = fc.router.submit(records=batch).wait(30.0)
+                recovered = len(res.results) == len(batch)
+            except Exception:  # noqa: BLE001 - outage window: retry
+                time.sleep(0.2)
+        assert recovered, ("fleet never recovered from the "
+                           "corrupt-frame + storm outage")
+        snap = fc.router.snapshot()
+        assert snap["protocol_errors"] >= 1   # the corrupt frame
+        assert snap["replica_deaths"] >= 1    # channel force-eject
+        assert snap["probes_failed"] >= 2     # the storm's two drops
+        assert snap["readmissions"] >= 1      # and the recovery
+        h = fc.router.handle("replica-0")
+        assert h.health.state == "healthy"
+        # reconnect probing is RATE-BOUNDED: two storm-dropped attempts
+        # plus the landing one cannot complete faster than the interval
+        assert time.monotonic() - t0 >= 2 * 0.4
+        # the replaced channel's wire counters were folded, not zeroed
+        assert h.wire_stats()["protocol_errors"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# drill: deadlines ride the wire; a slow peer drops abandoned work
+# ---------------------------------------------------------------------------
+def test_deadline_rides_wire_and_slow_peer_drops_late_work(
+        fleet_registry, tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:8]
+    with _tcp_controller(
+        fleet_registry, tmp_path, 1,
+        worker_env_overrides={
+            "replica-0": {"TX_FAULTS": "fleet.slow_peer:every=1:delay=0.5"}},
+    ) as fc:
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm (slow)
+        # r1 holds the (serial) worker for ~0.5s; r2's 200ms budget is
+        # spent in the socket before the worker ever reads it
+        r1 = fc.router.submit(records=batch)
+        r2 = fc.router.submit(records=batch, deadline_ms=200.0)
+        assert len(r1.wait(60.0).results) == len(batch)
+        with pytest.raises(DeadlineExceededError, match="replica-0"):
+            r2.wait(60.0)
+        snap = fc.router.snapshot()
+        assert snap["deadline_dropped_remote"] == 1
+        assert snap["shed_deadline"] >= 1
+        # a deadline drop is evidence of transport LIFE, not a failure:
+        # the replica stays healthy and serves on
+        h = fc.router.handle("replica-0")
+        assert h.health.state == "healthy"
+        assert h.health.consecutive_failures == 0
+        worker_doc = fc.router.control("replica-0", "status",
+                                       timeout_s=30.0)
+        assert worker_doc["deadline_dropped"] == 1
+        post = fc.router.score_batch(batch, timeout_s=60.0)
+        assert len(post) == len(batch)

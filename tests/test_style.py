@@ -131,13 +131,15 @@ def _handler_is_accounted(handler: ast.ExceptHandler) -> bool:
 
 
 def test_serving_and_workflow_broad_excepts_leave_a_trace():
-    """Under serving/ and workflow/ a broad ``except Exception`` must
-    re-raise, use the caught exception, or record telemetry/logging -
-    a swallowed batch failure is a silent full-fleet degradation."""
+    """Under serving/, workflow/ AND fleet/ a broad ``except
+    Exception`` must re-raise, use the caught exception, or record
+    telemetry/logging - a swallowed batch failure is a silent
+    full-fleet degradation, and on the ISSUE-17 TCP transport a
+    swallowed channel error is an invisible network fault."""
     offenders = []
     for p in MODULES:
         rel = _rel(p)
-        if rel[0] not in ("serving", "workflow"):
+        if rel[0] not in ("serving", "workflow", "fleet"):
             continue
         tree = ast.parse(p.read_text(encoding="utf-8"))
         for node in ast.walk(tree):

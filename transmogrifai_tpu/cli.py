@@ -832,6 +832,7 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
     from .obs.fleet import (
         SHARD_SUFFIX,
         FleetAggregator,
+        health_views,
         read_json_torn_safe,
         serving_views,
     )
@@ -850,9 +851,25 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
     for agg_path in (path, os.path.join(path, "obs")):
         if _is_agg_dir(agg_path):
             agg = FleetAggregator(agg_path, stale_after_s=stale_after_s)
+            shards = agg.shards()
+            # the router's own shard (ship_router_obs) carries the
+            # fleet_health view: per-replica transport-health columns
+            # (ejected/probing/healthy, consecutive failures, last RTT)
+            # from ONE consistent document, no shard re-reads
+            health_by_replica: dict = {}
+            fleet_health: dict = {}
+            for shard in shards:
+                for _key, snap in health_views(
+                        shard.get("metrics", {})):
+                    for inst, h in (snap.get("replicas") or {}).items():
+                        health_by_replica[str(inst)] = h
+                    fleet_health = {k: v for k, v in snap.items()
+                                    if k != "replicas"}
             replicas = {}
-            for shard in agg.shards():
+            for shard in shards:
                 inst = str(shard.get("instance"))
+                if inst == "router":
+                    continue  # its health view is folded in above
                 shard_file = os.path.join(agg_path,
                                           inst + SHARD_SUFFIX)
                 serving = {}
@@ -874,9 +891,14 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
                                         else round(age, 3)),
                     "fleet": shard.get("fleet"),
                     "serving": serving or None,
+                    "health": health_by_replica.get(inst),
                 }
-            return {"source": agg_path, "shards": dict(agg.last_report),
-                    "replicas": replicas}
+            out = {"source": agg_path,
+                   "shards": dict(agg.last_report),
+                   "replicas": replicas}
+            if fleet_health:
+                out["fleet_health"] = fleet_health
+            return out
     raise ValueError(
         f"{path!r} holds neither a fleet status document nor an obs "
         "aggregation dir")

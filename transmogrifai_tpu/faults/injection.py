@@ -27,8 +27,29 @@ Trigger fields (all optional; an armed point with none always fires):
 * ``prob=P``   - fire with probability P from a seeded per-point RNG
 * ``seed=S``   - RNG seed for ``prob`` (default 42: deterministic drills)
 * ``times=K``  - stop after K total fires
-* ``delay=S``  - sleep duration for :func:`inject_sleep` points
+* ``delay=S``  - sleep duration for :func:`inject_sleep` points (also
+  the impairment-window length for the fleet channel's timed faults)
 * ``exit=C``   - process exit code for :func:`inject_kill` points
+
+The ISSUE-17 network-fault envelope adds five seams at the fleet
+channel (``fleet/channel.py``; ``tests/test_fleet_faults.py`` and
+``bench.py --fleet-faults`` drill them):
+
+* ``fleet.partition``      - both directions dark for ``delay`` seconds
+  (sends dropped, reads idle) while the socket stays open - the
+  failure TCP cannot surface as EOF
+* ``fleet.half_open``      - outbound dead, inbound alive: the peer
+  that accepts and never responds
+* ``fleet.slow_peer``      - inject_sleep in the worker's scoring path
+* ``channel.corrupt_frame``- one frame's CRC flipped in flight; the
+  receiver must raise ``ChannelProtocolError``, never decode garbage
+* ``fleet.reconnect_storm``- a fresh connection dropped before its
+  handshake (drills the router's rate-bounded readmission probing)
+
+Determinism note: only DATA sends consume an armed spec's trigger
+counters - recv-side idle polls honor an open impairment window but
+never advance ``on=``/``every=`` counts, so drills fire on exactly the
+Nth batch regardless of poll timing.
 
 Injection is dormant by default: every helper returns immediately when
 no plan is configured, so production hot paths pay one attribute read.

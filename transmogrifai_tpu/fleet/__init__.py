@@ -1,63 +1,82 @@
 """Scale-out serving fleet: N replica workers behind a least-loaded
-router, with fleet-wide rolling hot-swap (ISSUE 14, ROADMAP item 1).
+router, with fleet-wide rolling hot-swap and a full network-fault
+envelope (ISSUE 14/17, ROADMAP items 1/3).
 
 The "millions of users" tier over everything the repo already has: the
 PR-1/6/12 compiled serving stack becomes N supervised worker processes
 (:mod:`.worker`), a least-loaded front router dispatches over bounded
-per-replica channels with at-least-once failover (:mod:`.router` /
-:mod:`.channel`), and the PR-5 registry drives fleet lifecycle -
-rolling zero-drop hot-swap, fleet-wide canary with rollback signals
-aggregated through the PR-9 obs plane and SLO engine
-(:mod:`.controller`).
+per-replica channels with at-least-once failover and health-gated
+ejection/readmission (:mod:`.router` / :mod:`.channel`), and the PR-5
+registry drives fleet lifecycle - rolling zero-drop hot-swap,
+fleet-wide canary with rollback signals aggregated through the PR-9
+obs plane and SLO engine (:mod:`.controller`).
 
     registry = ModelRegistry(root); registry.publish(model, stage="stable")
     with FleetController(root, "myapp:build_workflow", n_replicas=4) as fc:
         results = fc.router.score_batch(records)
         fc.rolling_deploy("v2")          # zero-drop, one replica at a time
 
+The channel speaks AF_UNIX on-host (the fast path) or TCP cross-host
+(``transport="tcp"`` / any ``host:port`` address), with per-frame
+CRC32 integrity and an OP_HELLO handshake either way.
+
 Fault points: ``fleet.replica_kill`` (a worker dies mid-serve like a
-SIGKILL), ``fleet.router_stall`` (the dispatcher wedges for a beat).
-``tx fleet status|drain`` is the operator surface; ``python bench.py
---fleet`` writes FLEET_BENCH.json.
+SIGKILL), ``fleet.router_stall`` (the dispatcher wedges for a beat),
+and the ISSUE-17 socket seams - ``fleet.partition`` (both directions
+dark), ``fleet.half_open`` (accepts, never responds),
+``fleet.slow_peer``, ``channel.corrupt_frame``,
+``fleet.reconnect_storm``.  ``tx fleet status|drain`` is the operator
+surface; ``python bench.py --fleet`` writes FLEET_BENCH.json and
+``--fleet-faults`` writes FLEET_FAULTS_BENCH.json.
 """
 from .channel import (
     ChannelClosedError,
+    ChannelProtocolError,
     ChannelTimeoutError,
     FleetChannel,
     decode_records,
     decode_results,
     encode_records,
     encode_results,
+    parse_address,
 )
 from .controller import (
     FleetController,
     merge_serving_snapshots,
 )
 from .router import (
+    BrownoutShedError,
     FleetBatch,
+    FleetDecodeError,
     FleetError,
     FleetResult,
     FleetRouter,
     FleetWorkerError,
     ReplicaHandle,
+    ReplicaHealth,
 )
 from .worker import ReplicaWorker
 
 __all__ = [
+    "BrownoutShedError",
     "ChannelClosedError",
+    "ChannelProtocolError",
     "ChannelTimeoutError",
     "FleetBatch",
     "FleetChannel",
     "FleetController",
+    "FleetDecodeError",
     "FleetError",
     "FleetResult",
     "FleetRouter",
     "FleetWorkerError",
     "ReplicaHandle",
+    "ReplicaHealth",
     "ReplicaWorker",
     "decode_records",
     "decode_results",
     "encode_records",
     "encode_results",
     "merge_serving_snapshots",
+    "parse_address",
 ]

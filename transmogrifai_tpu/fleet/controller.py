@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
 import subprocess
 import sys
 import tempfile
@@ -44,7 +45,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
-from ..obs.fleet import FleetAggregator, child_env, read_json_torn_safe
+from ..obs.fleet import (
+    FleetAggregator,
+    ObsShipper,
+    child_env,
+    read_json_torn_safe,
+)
 from ..obs.slo import SLOEngine, default_objectives
 from ..registry import ModelRegistry, RollbackDecision, RollbackPolicy
 from ..workflow.supervisor import backoff_delay_s, staleness
@@ -60,6 +66,17 @@ STATUS_FILENAME = "fleet_status.json"
 
 #: drain/undrain command files dropped by ``tx fleet drain``
 COMMANDS_DIR = "commands"
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port the OS just proved free on ``host`` (the
+    standard bind-0 probe; the worker re-binds it with SO_REUSEADDR, so
+    the close->rebind race is benign on loopback and the port stays
+    STABLE across replica restarts - the router's readmission probe
+    reconnects to the same address the fleet was built with)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return int(s.getsockname()[1])
 
 
 def merge_serving_snapshots(snaps: Sequence[dict]) -> dict:
@@ -136,6 +153,10 @@ class FleetController:
         router_kw: Optional[dict] = None,
         worker_args: Optional[Sequence[str]] = None,
         worker_env: Optional[dict] = None,
+        worker_env_overrides: Optional[dict] = None,
+        transport: str = "unix",
+        tcp_host: str = "127.0.0.1",
+        ship_router_obs: bool = False,
         max_restarts: int = 2,
         stale_after_s: float = 60.0,
         connect_timeout_s: float = 180.0,
@@ -155,6 +176,24 @@ class FleetController:
         self.version = version
         self.worker_args = list(worker_args or ())
         self.worker_env = dict(worker_env or {})
+        #: per-instance env on top of ``worker_env`` (e.g. arming
+        #: TX_FAULTS on exactly one replica for a partition drill)
+        self.worker_env_overrides = dict(worker_env_overrides or {})
+        if transport not in ("unix", "tcp"):
+            raise ValueError(
+                f"transport must be 'unix' or 'tcp', got {transport!r}")
+        #: "unix" keeps the on-host fast path; "tcp" binds each replica
+        #: to ``tcp_host:<ephemeral>`` - the cross-host wire, drillable
+        #: on loopback
+        self.transport = transport
+        self.tcp_host = tcp_host
+        #: ship the ROUTER process's obs (the fleet_router/fleet_health
+        #: views) as its own shard so one scrape of the aggregation dir
+        #: includes ejection/readmission gauges; off by default - a
+        #: controller embedded in a test/serving process would ship that
+        #: process's unrelated views too
+        self.ship_router_obs = bool(ship_router_obs)
+        self._router_shipper: Optional[ObsShipper] = None
         self.max_restarts = int(max_restarts)
         self.stale_after_s = float(stale_after_s)
         self.connect_timeout_s = float(connect_timeout_s)
@@ -198,13 +237,22 @@ class FleetController:
             else None
         self.router = FleetRouter(cost_model=cost_model,
                                   **self._router_kw)
+        if self.ship_router_obs:
+            self._router_shipper = ObsShipper(
+                self.fleet_dir, interval_s=self.ship_interval_s,
+                instance="router").start()
         try:
             for i in range(self.n_replicas):
+                if self.transport == "tcp":
+                    address = (f"{self.tcp_host}:"
+                               f"{_free_port(self.tcp_host)}")
+                else:
+                    address = os.path.join(self.work_dir,
+                                           f"replica-{i}.sock")
                 rep = _Replica(
                     index=i,
                     instance=f"replica-{i}",
-                    socket_path=os.path.join(self.work_dir,
-                                             f"replica-{i}.sock"),
+                    socket_path=address,
                     heartbeat_path=os.path.join(self.work_dir,
                                                 f"replica-{i}.hb"),
                 )
@@ -278,7 +326,9 @@ class FleetController:
         return cmd
 
     def _spawn(self, rep: _Replica) -> None:
-        env = child_env(dict(os.environ, **self.worker_env))
+        env = child_env(dict(
+            os.environ, **self.worker_env,
+            **self.worker_env_overrides.get(rep.instance, {})))
         env.setdefault("JAX_PLATFORMS", "cpu")
         # the package is not pip-installed: children import it from the
         # repo root, wherever the controller process found it
@@ -286,7 +336,10 @@ class FleetController:
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
             "PYTHONPATH", "")
-        for stale in (rep.socket_path, rep.heartbeat_path):
+        stale_files = [rep.heartbeat_path]
+        if self.transport != "tcp":
+            stale_files.append(rep.socket_path)
+        for stale in stale_files:
             # the DEAD incarnation's heartbeat file must go too: its
             # frozen mtime is by construction older than stale_after_s
             # by restart time, and judging the fresh warming process by
@@ -503,6 +556,11 @@ class FleetController:
         stable_snaps: list[dict] = []
         canary_snaps: list[dict] = []
         for doc in self.aggregator.shards():
+            if str(doc.get("instance")) == "router":
+                # the router's own shard (ship_router_obs) carries this
+                # process's views, not replica serving telemetry -
+                # folding it in would pollute the canary verdict pools
+                continue
             for _key, snap in serving_views(doc.get("metrics", {})):
                 if snap.get("model_version") == self.canary_version:
                     canary_snaps.append(snap)
@@ -605,6 +663,7 @@ class FleetController:
             hb = staleness(rep.heartbeat_path)
             handle_snap = (router_snap.get("replicas") or {}).get(
                 rep.instance, {})
+            health = handle_snap.get("health") or {}
             replicas[rep.instance] = {
                 "pid": rep.proc.pid if rep.proc else None,
                 "running": (rep.proc is not None
@@ -620,6 +679,14 @@ class FleetController:
                 "drained": handle_snap.get("drained"),
                 "alive": handle_snap.get("alive"),
                 "rows_ok": handle_snap.get("rows_ok"),
+                "transport": handle_snap.get("transport"),
+                "health": health.get("state"),
+                "consecutive_failures": health.get(
+                    "consecutive_failures"),
+                "last_rtt_ms": health.get("last_rtt_ms"),
+                "ejections": health.get("ejections"),
+                "readmissions": health.get("readmissions"),
+                "wire": handle_snap.get("wire"),
                 "worker": shard_fleet.get(rep.instance),
             }
         with self._events_lock:
@@ -656,6 +723,9 @@ class FleetController:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout_s)
+        if self._router_shipper is not None:
+            self._router_shipper.stop()
+            self._router_shipper = None
         if self.router is not None:
             try:
                 self.router.broadcast("stop", timeout_s=5.0)

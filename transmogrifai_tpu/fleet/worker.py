@@ -123,6 +123,12 @@ class ReplicaWorker:
         self._in_flight_rows = 0
         self.rows_scored = 0
         self.batches = 0
+        #: batches refused because the caller's wire deadline had
+        #: already passed on arrival (deadline propagation, ISSUE 17)
+        self.deadline_dropped = 0
+        #: wire-integrity counters folded across channel replacements
+        self._wire: dict = {}
+        self._chan: Optional[FleetChannel] = None
         self.started_at = time.monotonic()
         self.controller: Optional[DeploymentController] = None
         self.registry: Optional[ModelRegistry] = None
@@ -174,8 +180,16 @@ class ReplicaWorker:
             "rows_scored": self.rows_scored,
             "batches": self.batches,
             "in_flight_rows": self._in_flight_rows,
+            "deadline_dropped": self.deadline_dropped,
+            "wire": self._wire_stats(),
             "uptime_s": round(time.monotonic() - self.started_at, 3),
         }
+
+    def _wire_stats(self) -> dict:
+        chan = self._chan
+        live = chan.stats() if chan is not None else {}
+        return {k: self._wire.get(k, 0) + live.get(k, 0)
+                for k in set(self._wire) | set(live)}
 
     def snapshot(self) -> dict:
         """Metrics-view shape (kind ``fleet_replica``) so per-replica
@@ -208,33 +222,69 @@ class ReplicaWorker:
 
     # -- serving ------------------------------------------------------------
     def serve_forever(self) -> None:
-        """Accept the router, then serve until it disconnects or sends
-        ``stop``.  An orphaned worker (no router within
-        ``accept_timeout_s``) exits on its own."""
+        """Accept a router connection and serve it until EOF/protocol
+        death or ``stop``, then accept again: after a network fault
+        BOTH the router's readmission probe and the controller's
+        restart path may reconnect, so losing one channel must not end
+        the replica.  A worker nobody talks to within
+        ``accept_timeout_s`` concludes it is orphaned and exits."""
         lsock = _ch.listen(self.socket_path)
         try:
-            chan = _ch.accept(lsock, timeout_s=self.accept_timeout_s)
-            if chan is None:
-                log.warning("no router connected to %s within %.0fs; "
-                            "exiting", self.socket_path,
-                            self.accept_timeout_s)
-                return
-            self._serve_channel(chan)
+            while not self._stopping:
+                chan = self._accept_beating(lsock)
+                if chan is None:
+                    log.warning("no router connected to %s within "
+                                "%.0fs; exiting", self.socket_path,
+                                self.accept_timeout_s)
+                    return
+                self._chan = chan
+                try:
+                    self._serve_channel(chan, lsock)
+                finally:
+                    self._fold_wire(chan)
+                    self._chan = None
+                    chan.close()
         finally:
             try:
                 lsock.close()
                 os.unlink(self.socket_path)
             except OSError:
-                pass  # socket file already gone
+                pass  # socket file already gone (or TCP: never a file)
             if self._shipper is not None:
                 self._shipper.stop()
 
-    def _serve_channel(self, chan: FleetChannel) -> None:
+    def _accept_beating(self,
+                        lsock: "_ch.socket.socket"
+                        ) -> Optional[FleetChannel]:
+        """Bounded accept that keeps the supervision heartbeat alive:
+        waiting for a router to (re)connect is a legitimate state, not
+        staleness."""
+        last_beat = 0.0
+        deadline = time.monotonic() + self.accept_timeout_s
+        while not self._stopping and time.monotonic() <= deadline:
+            last_beat = self._beat(last_beat)
+            chan = _ch.accept(lsock, timeout_s=_ch.QUANTUM_S)
+            if chan is not None:
+                return chan
+        return None
+
+    def _fold_wire(self, chan: FleetChannel) -> None:
+        for k, v in chan.stats().items():
+            self._wire[k] = self._wire.get(k, 0) + v
+
+    def _serve_channel(self, chan: FleetChannel,
+                       lsock: "_ch.socket.socket") -> None:
         """Single-threaded serve loop: decode -> score -> encode in
         order on the one scoring lane.  (A three-stage threaded
         pipeline was tried and measured SLOWER - the codec stages are
         GIL-bound, so splitting them onto threads only added switch
-        overhead against the scoring thread's GIL hold.)"""
+        overhead against the scoring thread's GIL hold.)
+
+        On idle quanta the listener is polled: a NEWLY accepted
+        connection replaces this channel (newest wins).  That resolves
+        the probe-vs-restart reconnect race deterministically - the
+        replica always serves whoever dialed last, and the older
+        peer's next recv sees EOF and re-plans."""
         last_beat = 0.0
         while not self._stopping:
             last_beat = self._beat(last_beat)
@@ -243,19 +293,53 @@ class ReplicaWorker:
                 # control back so the loop can beat its heartbeat
                 msg = chan.recv(idle_return=True)
             except ChannelClosedError:
-                log.info("router disconnected; replica %s exiting",
+                log.info("router disconnected; replica %s re-listening",
                          self.instance)
                 return
+            except _ch.ChannelProtocolError as e:
+                log.warning("replica %s: protocol error on channel "
+                            "(%s); dropping connection", self.instance,
+                            e)
+                return
             if msg is None:
+                newer = _ch.accept(lsock, timeout_s=0.0)
+                if newer is not None:
+                    log.info("replica %s: newer connection accepted; "
+                             "replacing current channel",
+                             self.instance)
+                    self._fold_wire(chan)
+                    chan.close()
+                    chan = newer
+                    self._chan = newer
                 continue
             op, rid, meta, payload = msg
-            if op == OP_SCORE:
-                self._handle_score(chan, rid, payload)
+            if op == _ch.OP_HELLO:
+                self._send(chan, _ch.OP_HELLO, rid,
+                           dict(chan.hello_reply_meta(),
+                                instance=self.instance))
+            elif op == OP_SCORE:
+                self._handle_score(chan, rid, meta, payload)
             elif op == OP_CONTROL:
                 self._handle_control(chan, rid, meta)
 
-    def _handle_score(self, chan: FleetChannel, rid: int,
+    def _handle_score(self, chan: FleetChannel, rid: int, meta: dict,
                       payload) -> None:
+        # the slow-peer drill: scoring wall inflates exactly like a
+        # replica thrashing under memory pressure - the router's
+        # silence ceiling (response_timeout_s) is what must catch it
+        _faults.inject_sleep("fleet.slow_peer")
+        deadline_unix = meta.get("deadline_unix")
+        if deadline_unix is not None and time.time() > float(deadline_unix):
+            # the caller's deadline passed while this batch sat in a
+            # queue (or a partitioned socket's kernel buffer): the
+            # caller already gave up, so scoring it would be pure waste
+            # - drop it and say so (kind="deadline" is shed accounting
+            # on the router, not a worker failure)
+            self.deadline_dropped += 1
+            self._send(chan, OP_ERROR, rid,
+                       {"error": "deadline already passed on arrival",
+                        "kind": "deadline"})
+            return
         try:
             records = decode_records(payload)
         except Exception as e:  # noqa: BLE001 - poison payload isolation

@@ -458,3 +458,17 @@ class FleetAggregator:
                 for d in shards
             },
         }
+
+
+def health_views(metrics_doc: dict):
+    """``(view_key, snapshot)`` pairs for every ``fleet_health`` view in
+    one metrics document (the ISSUE-17 per-replica failure-detector
+    plane the router registers) - the shared filter for consumers that
+    surface ejection/readmission state from a router shard
+    (``tx fleet status`` over an aggregation dir, dashboards scraping
+    ``tx_fleet_health_*``).  Placed after :class:`FleetAggregator` so
+    the style-gate's epoch-subtraction allowlist line stays put."""
+    for key, snap in (metrics_doc.get("views") or {}).items():
+        if key.partition("/")[0] == "fleet_health" \
+                and isinstance(snap, dict):
+            yield key, snap

@@ -680,8 +680,15 @@ class OpWorkflowRunner:
         (client pump threads, default 4), ``fleet_tenant_quota``,
         ``fleet_max_in_flight``, plus the worker serve knobs
         ``serving_buckets`` / ``serving_drift_policy`` /
-        ``serving_fused_backend``.  Exports the one-document fleet
-        status + router counters to
+        ``serving_fused_backend``.  ISSUE-17 network knobs:
+        ``fleet_transport`` ("unix" on-host fast path, default; "tcp"
+        for the cross-host wire, loopback-drillable), ``fleet_quorum``
+        + ``fleet_tenant_priority`` (brownout: below quorum healthy
+        replicas, tenants under priority 1 shed loudly),
+        ``fleet_response_timeout_s`` (per-request silence ceiling
+        driving ejection), ``fleet_deadline_ms`` (per-batch deadline
+        that rides the wire so replicas drop abandoned work).  Exports
+        the one-document fleet status + router counters to
         ``<metrics_location>/fleet_metrics.json``."""
         from ..fleet import FleetController
         from ..registry import ModelRegistry
@@ -720,6 +727,14 @@ class OpWorkflowRunner:
         }
         if cp.get("fleet_tenant_quota") is not None:
             router_kw["tenant_quota"] = float(cp["fleet_tenant_quota"])
+        if cp.get("fleet_quorum") is not None:
+            router_kw["quorum"] = int(cp["fleet_quorum"])
+        if cp.get("fleet_tenant_priority") is not None:
+            router_kw["tenant_priority"] = dict(
+                cp["fleet_tenant_priority"])
+        if cp.get("fleet_response_timeout_s") is not None:
+            router_kw["response_timeout_s"] = float(
+                cp["fleet_response_timeout_s"])
         reader = self._reader("score")
         if reader is not None:
             raw = reader.generate_dataset(self.workflow.raw_features,
@@ -740,7 +755,10 @@ class OpWorkflowRunner:
                 "TX_OBS_FLEET_DIR"),
             router_kw=router_kw,
             worker_args=worker_args,
+            transport=str(cp.get("fleet_transport", "unix")),
         )
+        deadline_ms = cp.get("fleet_deadline_ms")
+        deadline_ms = None if deadline_ms is None else float(deadline_ms)
         rows_ok = rows_failed = 0
         rolling_report = None
         with controller:
@@ -761,7 +779,8 @@ class OpWorkflowRunner:
                         idx["i"] = i + 1
                     try:
                         res = controller.router.score_batch(
-                            batches[i], timeout_s=120.0)
+                            batches[i], timeout_s=120.0,
+                            deadline_ms=deadline_ms)
                         with lock:
                             counts["ok"] += len(res)
                     except Exception as e:  # noqa: BLE001 - batch isolation
