@@ -2174,6 +2174,222 @@ def input_pipeline_bench() -> dict:
     return out
 
 
+def bulk_bench() -> dict:
+    """Checkpointed bulk-scoring bench (``python bench.py --bulk``).
+
+    Two measurements in ONE run, same model and same generated shards:
+
+    - throughput: a large sharded CSV job (``TX_BULK_BENCH_ROWS`` rows,
+      default 2M, across 8 shards) scored by :class:`BulkScoringJob`
+      against TWO same-run, same-model serving-endpoint baselines: the
+      endpoint's per-record rows/s (what actually serving every row as
+      a request delivers - the >= 3x claim), and a hand-rolled batched
+      job (read the shard, 512-record ``score_batch`` calls, JSON-line
+      the results) as the tougher hybrid comparison;
+    - kill-survivability: a child process runs the SAME job armed with
+      ``bulk.output_crash`` mid-job (SIGKILL between a durable output
+      write and its journal receipt), the parent resumes the torn job
+      dir and we report resume wall seconds, the resume OVERHEAD
+      (resume wall minus what the rescored rows would have cost at the
+      clean-run rate), and byte-identity of the resumed output against
+      the clean run's.
+
+    The double-entry ledger (rows_in == rows_out + rows_quarantined,
+    with planted junk rows every 10k) is asserted on both jobs.
+    """
+    import shutil
+
+    import numpy as np
+
+    from transmogrifai_tpu.bulk import BulkScoringJob, concatenated_output
+    from transmogrifai_tpu.faults import injection as _faults
+    from transmogrifai_tpu.serving import compile_endpoint
+    from transmogrifai_tpu.testkit.drills import (
+        BULK_KILL_CHILD_TEMPLATE,
+        drill_env,
+        tiny_drill_pipeline,
+    )
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    out: dict = {}
+    n_target = int(os.environ.get("TX_BULK_BENCH_ROWS", 2_000_000))
+    n_shards = 8
+    block = max(n_target // n_shards, 1)
+    n = block * n_shards
+    chunk_rows = 200_000
+    poison_every = 10_000
+
+    # The kill drill compares output BYTES against a fresh child whose
+    # stage-uid counters start at zero, so reset ours before building
+    # the model (prediction column names embed stage uids).
+    reset_uids()
+    wf, _data, _records, _pred = tiny_drill_pipeline(n=120, seed=0)
+    model = wf.train()
+
+    # One shard block of y,a,c rows, reused for every shard; a junk
+    # 'a' cell every `poison_every` rows exercises quarantine
+    # accounting at scale.
+    rng = np.random.RandomState(7)
+    a_col = rng.randn(block)
+    y_col = (rng.rand(block) > 0.5).astype(float)
+    cats = ("u", "v", "w")
+    lines = ["y,a,c"]
+    for i in range(block):
+        a_cell = ("junk" if (i + 1) % poison_every == 0
+                  else "%.6f" % a_col[i])
+        lines.append("%.1f,%s,%s" % (y_col[i], a_cell, cats[i % 3]))
+    shard_bytes = ("\n".join(lines) + "\n").encode("utf-8")
+    del lines
+
+    tmp = tempfile.mkdtemp(prefix="tx_bulk_bench_")
+    try:
+        shards = []
+        for s in range(n_shards):
+            p = os.path.join(tmp, "shard-%d.csv" % s)
+            with open(p, "wb") as f:
+                f.write(shard_bytes)
+            shards.append(p)
+
+        # --- serving-endpoint baseline, same run, same model: the job
+        # a caller would hand-roll WITHOUT bulk/ - read a shard, batch
+        # records through the endpoint (its largest bucket), JSON-line
+        # the results to disk.  One shard is enough to rate it. -------
+        import csv
+
+        endpoint = compile_endpoint(model, batch_buckets=(1, 8, 32, 128, 512))
+        warm = [{"a": float(a_col[i]), "c": cats[i % 3]} for i in range(512)]
+        endpoint.score_batch(warm)  # absorb the compile
+        endpoint(warm[0])
+        single_n = 2_000
+        t0 = time.perf_counter()
+        for i in range(single_n):
+            endpoint(warm[i % 512])
+        t_single = max(time.perf_counter() - t0, 1e-9)
+        single_rows_per_s = single_n / t_single
+        out["serving_single_rows_per_s"] = round(single_rows_per_s, 1)
+        base_out = os.path.join(tmp, "baseline.jsonl")
+        base_rows = 0
+        t0 = time.perf_counter()
+        with open(shards[0], newline="") as fin, open(base_out, "wb") as fout:
+            batch = []
+            for row in csv.DictReader(fin):
+                try:
+                    a_val = float(row["a"])
+                except ValueError:
+                    a_val = None  # the endpoint caller's quarantine
+                batch.append({"a": a_val, "c": row["c"]})
+                if len(batch) == 512:
+                    for r in endpoint.score_batch(batch):
+                        fout.write(json.dumps(
+                            r, sort_keys=True, separators=(",", ":"),
+                            default=str).encode("utf-8") + b"\n")
+                    base_rows += len(batch)
+                    batch = []
+            if batch:
+                for r in endpoint.score_batch(batch):
+                    fout.write(json.dumps(
+                        r, sort_keys=True, separators=(",", ":"),
+                        default=str).encode("utf-8") + b"\n")
+                base_rows += len(batch)
+        t_serve = max(time.perf_counter() - t0, 1e-9)
+        serving_rows_per_s = base_rows / t_serve
+        out["serving_batched_job"] = {
+            "rows": base_rows,
+            "batch": 512,
+            "wall_s": round(t_serve, 3),
+            "rows_per_s": round(serving_rows_per_s, 1),
+        }
+
+        # --- the clean bulk job --------------------------------------
+        clean_dir = os.path.join(tmp, "job-clean")
+        t0 = time.perf_counter()
+        clean = BulkScoringJob(
+            model, clean_dir, shards, chunk_rows=chunk_rows).run()
+        t_clean = max(time.perf_counter() - t0, 1e-9)
+        led = clean["ledger"]
+        assert led["complete"] and led["balanced"], led
+        assert led["rows_in"] == n, (led["rows_in"], n)
+        clean_rate = n / t_clean
+        out["rows"] = n
+        out["shards"] = n_shards
+        out["chunk_rows"] = chunk_rows
+        out["rows_quarantined"] = led["rows_quarantined"]
+        out["ledger_balanced"] = bool(led["balanced"])
+        out["clean_wall_s"] = round(t_clean, 3)
+        out["bulk_rows_per_s"] = round(clean_rate, 1)
+        out["speedup_vs_serving"] = round(clean_rate / single_rows_per_s, 2)
+        out["speedup_vs_batched_endpoint"] = round(
+            clean_rate / serving_rows_per_s, 2)
+        out["scorer_backend"] = clean["scorer_backend"]
+
+        # --- mid-job SIGKILL + resume --------------------------------
+        kill_dir = os.path.join(tmp, "job-killed")
+        kill_shard = n_shards // 2 + 1  # fires in the (n/2)-th commit
+        fault = "bulk.output_crash:on=%d" % kill_shard
+        script = os.path.join(tmp, "killed_child.py")
+        with open(script, "w") as f:
+            f.write(BULK_KILL_CHILD_TEMPLATE.format(
+                repo=os.path.dirname(os.path.abspath(__file__)),
+                fault=fault, n=120, job_dir=kill_dir, shards=shards,
+                chunk=chunk_rows))
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, script], env=drill_env(),
+            capture_output=True, text=True, timeout=3600)
+        t_child = time.perf_counter() - t0
+        assert proc.returncode == _faults.DEFAULT_KILL_EXIT, (
+            proc.returncode, proc.stderr[-2000:])
+        t0 = time.perf_counter()
+        resumed = BulkScoringJob(model, kill_dir).run()
+        t_resume = max(time.perf_counter() - t0, 1e-9)
+        rled = resumed["ledger"]
+        assert resumed["resumed"] and rled["complete"] and rled["balanced"]
+        rescored_rows = resumed["shards_scored_this_run"] * block
+        byte_identical = (
+            concatenated_output(kill_dir) == concatenated_output(clean_dir))
+        out["kill"] = {
+            "fault": fault,
+            "child_exit": proc.returncode,
+            "child_wall_s": round(t_child, 3),
+            "shards_scored_on_resume": resumed["shards_scored_this_run"],
+            "rescored_shards": resumed["resumes"][-1]["rescored_shards"],
+            "resume_wall_s": round(t_resume, 3),
+            "resume_overhead_s": round(
+                t_resume - rescored_rows / clean_rate, 3),
+            "resume_byte_identical": bool(byte_identical),
+            "resume_ledger_balanced": bool(rled["balanced"]),
+        }
+        out["exactly_once"] = bool(
+            byte_identical and led["balanced"] and rled["balanced"]
+            and rled["rows_in"] == n)
+        assert byte_identical, "resumed output diverged from clean run"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _bulk_section(result: dict) -> None:
+    """Run the exactly-once bulk-scoring bench: artifact side-written
+    to BULK_BENCH.json, headline numbers folded into the main
+    result."""
+    bench = bulk_bench()
+    path = os.environ.get(
+        "TX_BULK_BENCH_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BULK_BENCH.json"),
+    )
+    bench["bench_commit"] = result.get("bench_commit", "unknown")
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["bulk_rows_per_s"] = bench["bulk_rows_per_s"]
+    result["bulk_speedup_vs_serving"] = bench["speedup_vs_serving"]
+    result["bulk_speedup_vs_batched_endpoint"] = bench[
+        "speedup_vs_batched_endpoint"]
+    result["bulk_resume_overhead_s"] = bench["kill"]["resume_overhead_s"]
+    result["bulk_exactly_once"] = bench["exactly_once"]
+
+
 def _input_pipeline_section(result: dict) -> None:
     """Run the sharded-input-pipeline bench: artifact side-written to
     INPUT_PIPELINE_BENCH.json, headline numbers folded into the main
@@ -3815,6 +4031,27 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _input_pipeline_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--bulk" in sys.argv:
+        # standalone exactly-once bulk-scoring bench: writes
+        # BULK_BENCH.json (2M-row sharded job vs the serving endpoint
+        # in one run, plus the mid-job SIGKILL + byte-identical
+        # resume drill) and prints it, without the multi-minute
+        # full-bench sections
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _bulk_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--data-faults" in sys.argv:

@@ -1,0 +1,483 @@
+"""Exactly-once bulk scoring drills (ISSUE 18; bulk/).
+
+The acceptance matrix for the checkpointed, kill-survivable
+batch-inference job: a SIGKILL parameterized across EVERY journal state
+boundary (all-pending, scored-not-committed, assigned, committed, and
+the output-durable-but-unreceipted window) must resume to output bytes
+identical to an uninterrupted run with the double-entry ledger exactly
+balanced; a torn journal primary recovers from ``.last-good``; a
+corrupted committed output shard is caught by its checksum and
+re-scored; one trace id spans plan -> score -> commit -> resume.  The
+satellites ride along: the ``tx bulk status`` CLI, the ``bulk``
+workflow run type, the ``tx_bulk_*`` metrics view, and the fleet-mode
+replica-death drill (at-least-once failover under an exactly-once
+journal).
+
+All drills are seeded: the drill pipeline's data seed and the fault
+specs (``on=``/``times=`` triggers) pin every run to the same schedule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from transmogrifai_tpu.bulk import (
+    OUTPUT_DIR,
+    STATE_COMMITTED,
+    BulkJournal,
+    BulkScoringJob,
+    TornJournalError,
+    concatenated_output,
+)
+from transmogrifai_tpu.bulk.journal import output_name
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.obs import trace as obs_trace
+from transmogrifai_tpu.serialization.model_io import LAST_GOOD_SUFFIX
+from transmogrifai_tpu.testkit.drills import (
+    BULK_KILL_CHILD_TEMPLATE,
+    drill_env,
+    tiny_drill_pipeline,
+    write_shard_csv,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ROWS = 120
+N_SHARDS = 3
+ROWS_PER_SHARD = 40
+POISON_INDEX = 45  # row 5 of shard 1: a non-numeric cell -> quarantine
+CHUNK_ROWS = 16
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every drill arms injection explicitly; none may leak."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _drill_rows():
+    """(workflow, rows): the tiny drill pipeline plus its 120 input
+    rows with ONE poisoned numeric cell (the quarantine the ledger
+    must account exactly).
+
+    Stage uids are reset first: the kill drills compare output BYTES
+    against a fresh child process (uid counters at zero), and the
+    scored rows' column names embed those uids."""
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    wf, data, _records, _pred = tiny_drill_pipeline(n=N_ROWS, seed=0)
+    rows = [{"y": data["y"][i], "a": data["a"][i], "c": data["c"][i]}
+            for i in range(N_ROWS)]
+    rows[POISON_INDEX] = dict(rows[POISON_INDEX], a="not-a-number")
+    return wf, rows
+
+
+def _write_shards(dirpath: str, rows) -> list:
+    shards = []
+    for k in range(N_SHARDS):
+        p = os.path.join(dirpath, f"in-{k}.csv")
+        write_shard_csv(p, rows[k * ROWS_PER_SHARD:(k + 1) * ROWS_PER_SHARD])
+        shards.append(p)
+    return shards
+
+
+@pytest.fixture(scope="module")
+def bulk_env(tmp_path_factory):
+    """One trained model, three 40-row input shards (one quarantined
+    cell), and an uninterrupted reference run's concatenated output -
+    the byte-identity oracle every resume drill compares against."""
+    base = str(tmp_path_factory.mktemp("bulk"))
+    wf, rows = _drill_rows()
+    model = wf.train()
+    shards = _write_shards(base, rows)
+    ref_dir = os.path.join(base, "ref")
+    summary = BulkScoringJob(model, ref_dir, shards,
+                             chunk_rows=CHUNK_ROWS).run()
+    assert summary["ledger"]["balanced"], "reference run must balance"
+    return {
+        "model": model, "rows": rows, "shards": shards,
+        "ref_dir": ref_dir, "ref": concatenated_output(ref_dir),
+        "ref_summary": summary,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the clean path: planning, scoring, the ledger, determinism
+# ---------------------------------------------------------------------------
+
+def test_fresh_job_scores_every_shard_and_balances(bulk_env):
+    s = bulk_env["ref_summary"]
+    assert s["resumed"] is False
+    assert s["shards"] == N_SHARDS
+    assert s["shards_scored_this_run"] == N_SHARDS
+    led = s["ledger"]
+    assert led["complete"] and led["balanced"]
+    assert led["rows_in"] == N_ROWS
+    assert led["rows_quarantined"] == 1
+    assert led["rows_out"] == N_ROWS - 1
+    # the poisoned cell landed in shard 1, and ONLY there
+    assert led["shards"]["1"]["rows_quarantined"] == 1
+    assert led["shards"]["0"]["rows_quarantined"] == 0
+    j = BulkJournal.load(bulk_env["ref_dir"])
+    assert j.states()[STATE_COMMITTED] == N_SHARDS
+    assert all(j.verify_output(sid) for sid in j.shard_ids())
+    # the output is real scored rows, one JSON object per line
+    lines = bulk_env["ref"].decode("utf-8").splitlines()
+    assert len(lines) == N_ROWS - 1
+    assert all(isinstance(json.loads(ln), dict) for ln in lines)
+
+
+def test_second_clean_run_is_byte_identical(bulk_env, tmp_path):
+    jd = str(tmp_path / "job")
+    s = BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"],
+                       chunk_rows=CHUNK_ROWS).run()
+    assert s["resumed"] is False
+    assert concatenated_output(jd) == bulk_env["ref"]
+
+
+def test_columnar_feed_matches_record_scoring(bulk_env):
+    """The direct chunk->env feed must produce the SAME rows as
+    scoring the per-record dicts through the scorer's batch path."""
+    from transmogrifai_tpu.local.scorer import LocalScorer
+
+    clean = [r for i, r in enumerate(bulk_env["rows"])
+             if i != POISON_INDEX]
+    records = [{"a": float(r["a"]), "c": r["c"]} for r in clean]
+    scorer = LocalScorer(bulk_env["model"], fused=True)
+    want = [json.dumps(r, sort_keys=True, separators=(",", ":"),
+                       default=str)
+            for r in scorer.score_batch(records)]
+    assert bulk_env["ref"].decode("utf-8").splitlines() == want
+
+
+# ---------------------------------------------------------------------------
+# the tentpole drill: SIGKILL at every journal state boundary
+# ---------------------------------------------------------------------------
+
+# the journal commit sequence for 3 shards is create(1), then per shard
+# assigned/scored/committed (2..10); on=N walks the kill across each
+# distinct boundary, and bulk.output_crash:on=2 lands in the canonical
+# "output durable, receipt lost" window of the SECOND shard
+KILL_FAULTS = (
+    "bulk.commit_crash:on=1",   # planned: every shard still pending
+    "bulk.commit_crash:on=3",   # shard 0 scored, not yet committed
+    "bulk.commit_crash:on=5",   # shard 1 assigned, scoring in flight
+    "bulk.commit_crash:on=7",   # shard 1 committed, shard 2 pending
+    "bulk.output_crash:on=2",   # shard 1 output written, unreceipted
+)
+
+@pytest.mark.parametrize("fault", KILL_FAULTS)
+def test_sigkill_at_state_boundary_resumes_byte_identical(
+        bulk_env, tmp_path, fault):
+    jd = str(tmp_path / "job")
+    script = tmp_path / "child.py"
+    script.write_text(BULK_KILL_CHILD_TEMPLATE.format(
+        repo=REPO, fault=fault, n=N_ROWS, job_dir=jd,
+        shards=bulk_env["shards"], chunk=CHUNK_ROWS))
+    proc = subprocess.run([sys.executable, str(script)],
+                          env=drill_env(), timeout=300)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really killed
+    # the kill left a loadable journal with unfinished work
+    j = BulkJournal.load(jd)
+    assert j.states()[STATE_COMMITTED] < N_SHARDS
+    # resume in THIS process with the same (deterministically trained)
+    # model: no inputs passed - the journal is the plan
+    s = BulkScoringJob(bulk_env["model"], jd).run()
+    assert s["resumed"] is True
+    assert concatenated_output(jd) == bulk_env["ref"]
+    led = s["ledger"]
+    assert led["complete"] and led["balanced"]
+    assert led["rows_in"] == N_ROWS
+    assert led["rows_out"] == N_ROWS - 1
+    assert led["rows_quarantined"] == 1
+    (resume,) = s["resumes"]
+    assert resume["pid"] == os.getpid()
+    assert resume["from_last_good"] is False
+    j2 = BulkJournal.load(jd)
+    assert j2.states()[STATE_COMMITTED] == N_SHARDS
+    assert all(j2.verify_output(sid) for sid in j2.shard_ids())
+
+
+def test_output_crash_resume_rescores_the_unreceipted_shard(
+        bulk_env, tmp_path):
+    """The exactly-once window in detail: the output shard is durable
+    but the journal still says ``assigned`` - the resume must treat
+    the untrusted bytes as garbage and re-score exactly that shard."""
+    jd = str(tmp_path / "job")
+    script = tmp_path / "child.py"
+    script.write_text(BULK_KILL_CHILD_TEMPLATE.format(
+        repo=REPO, fault="bulk.output_crash:on=1", n=N_ROWS, job_dir=jd,
+        shards=bulk_env["shards"], chunk=CHUNK_ROWS))
+    proc = subprocess.run([sys.executable, str(script)],
+                          env=drill_env(), timeout=300)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT
+    j = BulkJournal.load(jd)
+    assert j.shard(0)["state"] == "assigned"
+    assert os.path.exists(os.path.join(jd, OUTPUT_DIR, output_name(0)))
+    s = BulkScoringJob(bulk_env["model"], jd).run()
+    (resume,) = s["resumes"]
+    assert resume["recovered_states"]["0"] == "assigned"
+    assert 0 in resume["rescored_shards"]
+    assert concatenated_output(jd) == bulk_env["ref"]
+    assert s["ledger"]["balanced"]
+
+
+def test_rerun_of_a_completed_job_is_a_noop_resume(bulk_env, tmp_path):
+    jd = str(tmp_path / "job")
+    BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"],
+                   chunk_rows=CHUNK_ROWS).run()
+    s = BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"]).run()
+    assert s["resumed"] is True
+    assert s["shards_scored_this_run"] == 0
+    last = s["resumes"][-1]
+    assert last["recovered_states"] == {}
+    assert last["rescored_shards"] == []
+    assert concatenated_output(jd) == bulk_env["ref"]
+
+
+def test_job_dir_refuses_a_different_input_set(bulk_env, tmp_path):
+    other = str(tmp_path / "other.csv")
+    write_shard_csv(other, [{"y": 1.0, "a": 0.5, "c": "u"}])
+    with pytest.raises(ValueError, match="different input set"):
+        BulkScoringJob(bulk_env["model"], bulk_env["ref_dir"],
+                       [other]).run()
+
+
+# ---------------------------------------------------------------------------
+# journal durability: torn primary, torn both, corrupted outputs
+# ---------------------------------------------------------------------------
+
+def test_torn_primary_recovers_from_last_good(bulk_env, tmp_path):
+    jd = str(tmp_path / "job")
+    BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"],
+                   chunk_rows=CHUNK_ROWS).run()
+    faults.configure("bulk.journal_torn:times=1")
+    j = BulkJournal.load(jd)
+    assert j.recovered_from_last_good is True
+    # .last-good is exactly one commit behind the final state
+    assert j.states()[STATE_COMMITTED] == N_SHARDS - 1
+    # a full resume THROUGH the torn primary: the verified scored
+    # shard rolls forward to committed without re-scoring
+    faults.configure("bulk.journal_torn:times=1")
+    s = BulkScoringJob(bulk_env["model"], jd).run()
+    last = s["resumes"][-1]
+    assert last["from_last_good"] is True
+    assert last["rescored_shards"] == []
+    assert last["recovered_states"] == {"2": "scored"}
+    assert concatenated_output(jd) == bulk_env["ref"]
+    assert s["ledger"]["balanced"]
+
+
+def test_torn_primary_and_fallback_is_loud(bulk_env, tmp_path):
+    jd = str(tmp_path / "job")
+    BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"],
+                   chunk_rows=CHUNK_ROWS).run()
+    primary = os.path.join(jd, "journal.json")
+    for path in (primary, primary + LAST_GOOD_SUFFIX):
+        with open(path, "r+b") as f:
+            f.truncate(30)
+    assert BulkJournal.exists(jd)
+    with pytest.raises(TornJournalError):
+        BulkJournal.load(jd)
+
+
+def test_corrupted_committed_output_is_caught_and_rescored(
+        bulk_env, tmp_path):
+    jd = str(tmp_path / "job")
+    BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"],
+                   chunk_rows=CHUNK_ROWS).run()
+    # a partial write nobody journaled: truncate shard 1's output
+    with open(os.path.join(jd, OUTPUT_DIR, output_name(1)), "r+b") as f:
+        f.truncate(10)
+    j = BulkJournal.load(jd)
+    assert j.verify_output(0) and not j.verify_output(1)
+    s = BulkScoringJob(bulk_env["model"], jd).run()
+    last = s["resumes"][-1]
+    assert last["recovered_states"] == {"1": "committed"}
+    assert last["rescored_shards"] == [1]
+    assert concatenated_output(jd) == bulk_env["ref"]
+    assert s["ledger"]["balanced"]
+
+
+def test_empty_shard_commits_with_zero_rows(bulk_env, tmp_path):
+    import csv
+
+    empty = str(tmp_path / "empty.csv")
+    with open(empty, "w", newline="") as f:
+        csv.DictWriter(f, fieldnames=["y", "a", "c"]).writeheader()
+    jd = str(tmp_path / "job")
+    s = BulkScoringJob(bulk_env["model"], jd,
+                       [bulk_env["shards"][0], empty],
+                       chunk_rows=CHUNK_ROWS).run()
+    assert s["ledger"]["balanced"]
+    j = BulkJournal.load(jd)
+    rec = j.shard(1)
+    assert rec["state"] == STATE_COMMITTED
+    assert rec["rows_in"] == 0 and rec["rows_out"] == 0
+    assert os.path.getsize(j.output_path(1)) == 0
+    assert j.verify_output(1)
+
+
+# ---------------------------------------------------------------------------
+# one trace across plan -> score -> commit -> resume
+# ---------------------------------------------------------------------------
+
+def test_one_trace_spans_plan_to_resume(bulk_env, tmp_path):
+    from transmogrifai_tpu.obs.trace import reset_tracer, tracer
+
+    jd = str(tmp_path / "job")
+    reset_tracer()
+    try:
+        BulkScoringJob(bulk_env["model"], jd, bulk_env["shards"],
+                       chunk_rows=CHUNK_ROWS).run()
+        ctx = BulkJournal.load(jd).doc["trace_context"]
+        assert ctx, "planning must stamp its trace context"
+        trace_id = ctx.split(":")[0]
+        # a FRESH tracer (= a new process after the kill) must adopt
+        # the planning trace when it resumes
+        reset_tracer()
+        BulkScoringJob(bulk_env["model"], jd).run()
+        names = {r["name"] for r in tracer().spans(trace_id)}
+        assert "bulk.run" in names and "bulk.resume" in names
+        # the journal still carries the ORIGINAL planning context
+        assert BulkJournal.load(jd).doc["trace_context"] == ctx
+    finally:
+        reset_tracer()
+
+
+# ---------------------------------------------------------------------------
+# satellites: the metrics view, the CLI, the workflow run type
+# ---------------------------------------------------------------------------
+
+def test_bulk_metrics_view_rides_the_scrape(bulk_env, tmp_path):
+    from transmogrifai_tpu.obs.metrics import (
+        metrics_registry,
+        reset_metrics_registry,
+    )
+
+    reset_metrics_registry()
+    try:
+        job = BulkScoringJob(bulk_env["model"], str(tmp_path / "job"),
+                             bulk_env["shards"], chunk_rows=CHUNK_ROWS)
+        job.run()
+        text = metrics_registry().prometheus_text()
+        samples = {}
+        for line in text.splitlines():
+            if line.startswith("tx_bulk_"):
+                name = line.split("{", 1)[0]
+                samples[name] = float(line.rsplit(" ", 1)[1])
+        assert samples["tx_bulk_shards_total"] == N_SHARDS
+        assert samples["tx_bulk_shards_committed"] == N_SHARDS
+        assert samples["tx_bulk_shards_pending"] == 0
+        assert samples["tx_bulk_rows_out"] == N_ROWS - 1
+        assert samples["tx_bulk_rows_quarantined"] == 1
+        assert samples["tx_bulk_rows_per_s"] > 0
+    finally:
+        reset_metrics_registry()
+
+
+def test_cli_bulk_status_prints_the_journal(bulk_env, capsys):
+    from transmogrifai_tpu.cli import main
+
+    rc = main(["bulk", "status", bulk_env["ref_dir"]])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["n_shards"] == N_SHARDS
+    assert doc["states"][STATE_COMMITTED] == N_SHARDS
+    assert doc["ledger"]["balanced"] is True
+    assert doc["ledger"]["rows_quarantined"] == 1
+    assert doc["trace_context"]
+
+
+def test_cli_bulk_status_torn_journal_exits_1(tmp_path, capsys):
+    from transmogrifai_tpu.cli import main
+
+    jd = str(tmp_path / "job")
+    os.makedirs(jd)
+    with open(os.path.join(jd, "journal.json"), "w") as f:
+        f.write("{ torn")
+    rc = main(["bulk", "status", jd])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["error"].startswith("TornJournalError")
+
+
+def test_runner_bulk_run_type(tmp_path):
+    from transmogrifai_tpu.workflow.params import OpParams
+    from transmogrifai_tpu.workflow.runner import OpWorkflowRunner
+
+    wf, rows = _drill_rows()
+    mloc = str(tmp_path / "model")
+    OpWorkflowRunner(wf).run("train", OpParams(model_location=mloc))
+    shards = _write_shards(str(tmp_path), rows)
+    wf2, _ = _drill_rows()
+    params = OpParams(
+        model_location=mloc,
+        write_location=str(tmp_path / "out"),
+        metrics_location=str(tmp_path / "metrics"),
+        custom_params={"bulk_inputs": shards,
+                       "bulk_chunk_rows": CHUNK_ROWS},
+    )
+    r = OpWorkflowRunner(wf2).run("bulk", params)
+    assert r.run_type == "bulk"
+    assert r.metrics["ledger"]["balanced"] is True
+    assert r.metrics["ledger"]["rows_in"] == N_ROWS
+    jd = os.path.join(str(tmp_path / "out"), "bulk")
+    assert BulkJournal.load(jd).states()[STATE_COMMITTED] == N_SHARDS
+    with open(tmp_path / "metrics" / "bulk_metrics.json") as f:
+        saved = json.load(f)
+    assert saved["run_type"] == "bulk"
+    assert saved["ledger"]["balanced"] is True
+
+
+# ---------------------------------------------------------------------------
+# fleet mode: a replica dies mid-shard; the journal keeps exactly-once
+# ---------------------------------------------------------------------------
+
+def test_fleet_replica_death_midshard_keeps_output_exactly_once(tmp_path):
+    from transmogrifai_tpu.fleet import FleetController
+    from transmogrifai_tpu.registry import ModelRegistry
+
+    wf, rows = _drill_rows()
+    model = wf.train()
+    root = str(tmp_path / "registry")
+    ModelRegistry(root).publish(model, stage="stable")
+    shards = _write_shards(str(tmp_path), rows)
+    with FleetController(
+        root, "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline",
+        n_replicas=2, work_dir=str(tmp_path / "fleet"),
+        ship_interval_s=0.15, max_restarts=0,
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64},
+        # replica-1 dies on its FIRST bulk chunk; the router must
+        # reassign the in-flight batch to the survivor
+        worker_env_overrides={
+            "replica-1": {"TX_FAULTS": "bulk.replica_die_midshard:on=1"},
+        },
+    ) as fc:
+        jd = str(tmp_path / "job")
+        s = BulkScoringJob(model, jd, shards, router=fc.router,
+                           chunk_rows=CHUNK_ROWS, max_in_flight=4).run()
+        led = s["ledger"]
+        assert led["complete"] and led["balanced"]
+        assert led["rows_in"] == N_ROWS
+        assert led["rows_out"] == N_ROWS - 1
+        snap = fc.router.snapshot()
+        assert snap["replica_deaths"] == 1
+        assert snap["retries"] >= 1  # the victim died holding a chunk
+        got = concatenated_output(jd)
+        assert len(got.splitlines()) == N_ROWS - 1
+        # a clean run on the surviving fleet is byte-identical: the
+        # failover duplicated WORK (at-least-once), never OUTPUT
+        jd2 = str(tmp_path / "job2")
+        s2 = BulkScoringJob(model, jd2, shards, router=fc.router,
+                            chunk_rows=CHUNK_ROWS, max_in_flight=4).run()
+        assert s2["ledger"]["balanced"]
+        assert concatenated_output(jd2) == got

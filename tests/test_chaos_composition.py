@@ -1,16 +1,19 @@
 """Chaos-composition drill (ISSUE 4 satellite, extended by ISSUEs 5,
-16 and 17): ONE seeded, randomized schedule arming faults from seven
-different subsystems — ``reader.*`` (data plane), ``serving.batch``
-(serving), ``io.save_model.crash`` (serialization),
+16, 17 and 18): ONE seeded, randomized schedule arming faults from
+eight different subsystems — ``reader.*`` (data plane),
+``serving.batch`` (serving), ``io.save_model.crash`` (serialization),
 ``supervisor.child_kill`` (supervision), ``registry.publish_crash`` +
 ``canary.regression`` (model lifecycle), ``continuous.refit_crash`` +
-``drift.false_positive`` (continuous training), and
-``fleet.partition`` + ``channel.corrupt_frame`` +
-``fleet.reconnect_storm`` (fleet transport, over a live loopback-TCP
-fleet) — across a single end-to-end workflow run (corrupted-CSV
-quarantine ingest → train → save/load → serve → supervise → registry
-publish/canary → drift-triggered refit → fleet serve under network
-faults), asserting the GLOBAL invariants:
+``drift.false_positive`` (continuous training), ``fleet.partition`` +
+``channel.corrupt_frame`` + ``fleet.reconnect_storm`` (fleet
+transport, over a live loopback-TCP fleet), and ``bulk.output_crash``
++ ``bulk.replica_die_midshard`` (exactly-once bulk scoring) — across a
+single end-to-end workflow run (corrupted-CSV quarantine ingest →
+train → save/load → serve → supervise → registry publish/canary →
+drift-triggered refit → fleet serve under network faults → a bulk job
+killed between output write and journal commit, then resumed, then
+re-run over a fleet losing a replica mid-shard), asserting the GLOBAL
+invariants:
 
 * no corrupt artifact is ever loadable (checksums verify at each step,
   including the registry index after a crashed publish);
@@ -19,7 +22,10 @@ faults), asserting the GLOBAL invariants:
   counts, fallback rows, breaker transitions, supervisor restarts,
   canary NaN-guard refusals and the rollback decision they trigger,
   partition windows and corrupt frames in the fleet wire ledgers with
-  the fleet's row ledger EXACT (nothing lost, nothing duplicated).
+  the fleet's row ledger EXACT (nothing lost, nothing duplicated), and
+  the bulk job's double-entry ledger EXACT after a kill + resume
+  (``rows_in == rows_out + rows_quarantined``, output bytes identical
+  to an uninterrupted run).
 
 The schedule is randomized per TX_CHAOS_SEED but deterministic for a
 given seed, so a failing composition replays exactly.
@@ -70,6 +76,7 @@ CRASH_SAVE_DEADLINE_S = 300.0
 SERVE_DEADLINE_S = 60.0
 SUPERVISE_DEADLINE_S = 60.0
 FLEET_DEADLINE_S = 180.0
+BULK_DEADLINE_S = 180.0
 
 
 @pytest.fixture(autouse=True)
@@ -109,7 +116,9 @@ def test_chaos_composition_end_to_end(tmp_path):
         "continuous.refit_crash", "drift.false_positive",
         "fleet.partition", "channel.corrupt_frame",
         "fleet.reconnect_storm",
+        "bulk.output_crash", "bulk.replica_die_midshard",
     ]}
+    bulk_kill_shard = int(rng.randint(1, 4))    # which shard's window
 
     # ---- phase 1: quarantine ingest (real corruption + injected) → train
     csv_path = str(tmp_path / "chaos.csv")
@@ -438,6 +447,93 @@ def test_chaos_composition_end_to_end(tmp_path):
         events["fleet_rows_ok"] = snap["rows_ok"]
     t_fleet = time.monotonic() - t0
     assert t_fleet < FLEET_DEADLINE_S, "fleet transport hang"
+
+    # ---- phase 8: exactly-once bulk scoring under kills ----------------
+    # (ISSUE 18) a checkpointed bulk job over three shards of the tiny
+    # drill schema is SIGKILLed in the seeded shard's "output durable,
+    # receipt lost" window (between the output-shard write and its
+    # ``scored`` journal commit), resumed in THIS process, and must
+    # come out byte-identical to an uninterrupted run with the
+    # double-entry ledger exact; then the SAME shards run over a fresh
+    # fleet whose replica-1 dies mid-shard - at-least-once failover
+    # duplicates WORK, the journal keeps the OUTPUT exactly-once
+    from transmogrifai_tpu.bulk import (
+        BulkJournal,
+        BulkScoringJob,
+        concatenated_output,
+    )
+    from transmogrifai_tpu.testkit.drills import BULK_KILL_CHILD_TEMPLATE
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    # byte-identity with the killed CHILD requires matching stage uids
+    # (the scored rows' column names embed them): rewind the counters
+    # to where a fresh process starts before building the oracle
+    reset_uids()
+    wf8, data8, _rec8, _name8 = tiny_drill_pipeline()
+    bulk_rows = [{"y": data8["y"][i], "a": data8["a"][i],
+                  "c": data8["c"][i]} for i in range(len(data8["y"]))]
+    bulk_shards = []
+    for k in range(3):
+        p = str(tmp_path / f"bulk-in-{k}.csv")
+        write_shard_csv(p, bulk_rows[k * 40:(k + 1) * 40])
+        bulk_shards.append(p)
+    t0 = time.monotonic()
+    # train the oracle model EXACTLY as the killed child will (the
+    # save/load roundtrip `recovered` went through perturbs low-order
+    # weight bits, and byte-identity is the whole point here)
+    bulk_model = wf8.train()
+    bulk_ref_dir = str(tmp_path / "bulk_ref")
+    BulkScoringJob(bulk_model, bulk_ref_dir, bulk_shards,
+                   chunk_rows=16).run()
+    bulk_ref = concatenated_output(bulk_ref_dir)
+    # kill between write and commit on the seeded shard's window
+    bulk_dir = str(tmp_path / "bulk_job")
+    bulk_script = tmp_path / "bulk_killer.py"
+    bulk_script.write_text(BULK_KILL_CHILD_TEMPLATE.format(
+        repo=REPO, fault=f"bulk.output_crash:on={bulk_kill_shard}",
+        n=120, job_dir=bulk_dir, shards=bulk_shards, chunk=16))
+    proc = subprocess.run([sys.executable, str(bulk_script)],
+                          env=drill_env(), timeout=CRASH_SAVE_DEADLINE_S)
+    assert proc.returncode == faults.DEFAULT_KILL_EXIT  # really killed
+    events["bulk_kill_exit"] = proc.returncode
+    assert BulkJournal.load(bulk_dir).states()["committed"] < 3
+    bulk_summary = BulkScoringJob(bulk_model, bulk_dir).run()
+    # invariant: zero duplicated, zero lost rows - bytes identical,
+    # ledger balanced, the killed shard's re-score accounted
+    assert bulk_summary["resumed"] is True
+    assert concatenated_output(bulk_dir) == bulk_ref
+    bulk_led = bulk_summary["ledger"]
+    assert bulk_led["balanced"] and bulk_led["rows_in"] == 120
+    assert bulk_led["rows_in"] == (bulk_led["rows_out"]
+                                   + bulk_led["rows_quarantined"])
+    (bulk_resume,) = bulk_summary["resumes"]
+    assert bulk_kill_shard - 1 in bulk_resume["rescored_shards"]
+    events["bulk_rescored_shards"] = bulk_resume["rescored_shards"]
+    # replica death mid-shard: a fresh 2-replica fleet over the same
+    # registry; replica-1 dies on its first bulk chunk
+    with FleetController(
+        fleet_reg_root,
+        "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline",
+        n_replicas=2, transport="tcp", max_restarts=0,
+        work_dir=str(tmp_path / "bulk_fleet"), ship_interval_s=0.2,
+        worker_env_overrides={"replica-1": {
+            "TX_FAULTS": "bulk.replica_die_midshard:on=1"}},
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64},
+    ) as bfc:
+        fleet_bulk_dir = str(tmp_path / "bulk_fleet_job")
+        fleet_summary = BulkScoringJob(
+            bulk_model, fleet_bulk_dir, bulk_shards, router=bfc.router,
+            chunk_rows=16, max_in_flight=4).run()
+        fleet_led = fleet_summary["ledger"]
+        assert fleet_led["balanced"] and fleet_led["rows_in"] == 120
+        bsnap = bfc.router.snapshot()
+        assert bsnap["replica_deaths"] == 1
+        assert bsnap["retries"] >= 1  # the victim died holding a chunk
+        assert len(concatenated_output(fleet_bulk_dir).splitlines()) \
+            == fleet_led["rows_out"]
+        events["bulk_fleet_replica_deaths"] = bsnap["replica_deaths"]
+    t_bulk = time.monotonic() - t0
+    assert t_bulk < BULK_DEADLINE_S, "bulk scoring hang"
 
     # ---- global: nothing leaked, everything accounted ------------------
     assert not faults.active()

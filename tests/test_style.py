@@ -37,6 +37,9 @@ Enforced invariants over every module in transmogrifai_tpu/:
   before jax/numpy init: module-level imports are stdlib or intra-obs
   relative only (ISSUE 7) - the measurement plane must not depend on
   the accelerator stack it measures
+- bulk/ never writes a file with a bare ``open()``/``np.save*``: the
+  exactly-once journal and every output shard ride the atomic
+  tempfile+fsync+rename writer only (ISSUE 18)
 """
 import ast
 import pathlib
@@ -131,15 +134,16 @@ def _handler_is_accounted(handler: ast.ExceptHandler) -> bool:
 
 
 def test_serving_and_workflow_broad_excepts_leave_a_trace():
-    """Under serving/, workflow/ AND fleet/ a broad ``except
+    """Under serving/, workflow/, fleet/ AND bulk/ a broad ``except
     Exception`` must re-raise, use the caught exception, or record
     telemetry/logging - a swallowed batch failure is a silent
-    full-fleet degradation, and on the ISSUE-17 TCP transport a
-    swallowed channel error is an invisible network fault."""
+    full-fleet degradation, on the ISSUE-17 TCP transport a swallowed
+    channel error is an invisible network fault, and in an ISSUE-18
+    bulk job a swallowed shard failure silently breaks exactly-once."""
     offenders = []
     for p in MODULES:
         rel = _rel(p)
-        if rel[0] not in ("serving", "workflow", "fleet"):
+        if rel[0] not in ("serving", "workflow", "fleet", "bulk"):
             continue
         tree = ast.parse(p.read_text(encoding="utf-8"))
         for node in ast.walk(tree):
@@ -157,17 +161,18 @@ _BLOCKING_ALLOWLIST: set = set()
 
 
 def test_no_unbounded_blocking_waits_under_parallel_and_workflow():
-    """Under parallel/, workflow/ AND fleet/ every .join()/.wait()/
-    .get()/.recv() call must pass a timeout (ISSUE 3; extended to the
-    serving fleet by ISSUE 14 - a SIGKILLed replica or a wedged router
-    peer must never block dispatch, failover, or worker shutdown
+    """Under parallel/, workflow/, fleet/ AND bulk/ every .join()/
+    .wait()/.get()/.recv() call must pass a timeout (ISSUE 3; extended
+    to the serving fleet by ISSUE 14 and to bulk scoring by ISSUE 18 -
+    a SIGKILLed replica or a wedged router peer must never block
+    dispatch, failover, worker shutdown, or a bulk job's result drain
     forever; every fleet wait runs in 50 ms quanta).  The zero-argument
     forms are the unbounded-blocking ones - dict.get(k) /
     "sep".join(xs) / q.get(timeout=...) all carry arguments and pass."""
     offenders = []
     for p in MODULES:
         rel = _rel(p)
-        if rel[0] not in ("parallel", "workflow", "fleet"):
+        if rel[0] not in ("parallel", "workflow", "fleet", "bulk"):
             continue
         tree = ast.parse(p.read_text(encoding="utf-8"))
         for node in ast.walk(tree):
@@ -294,6 +299,47 @@ def test_model_artifacts_written_only_via_serialization_and_registry():
                 offenders.append(f"{p}:{node.lineno} np.{f.attr}")
             elif _call_writes_artifact(node):
                 offenders.append(f"{p}:{node.lineno} open(<artifact>, 'w')")
+    assert not offenders, offenders
+
+
+def test_bulk_writes_only_through_the_atomic_journal_writer():
+    """Under bulk/ NO file may be written with a bare ``open()`` or
+    ``np.save*`` at all (ISSUE 18): the exactly-once contract rests on
+    every journal and output-shard byte riding the tempfile + fsync +
+    os.replace path (serialization.write_bytes_atomic), so a single
+    buffered write-mode ``open()`` is a torn-file bug waiting for a
+    kill -9.  Read-mode ``open(p, "rb")`` passes; this gate is stricter
+    than the artifact gate above - it bans write modes regardless of
+    filename."""
+    offenders = []
+    for p in MODULES:
+        rel = _rel(p)
+        if rel[0] != "bulk":
+            continue
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _NP_SAVERS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy")
+            ):
+                offenders.append(f"{p}:{node.lineno} np.{f.attr}")
+                continue
+            if not (isinstance(f, ast.Name) and f.id == "open"):
+                continue
+            mode = "r"
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if any(c in mode for c in "wax+"):
+                offenders.append(f"{p}:{node.lineno} open(mode={mode!r})")
     assert not offenders, offenders
 
 

@@ -1,0 +1,38 @@
+"""Exactly-once bulk scoring (ISSUE 18; ROADMAP item 4).
+
+A checkpointed, kill-survivable batch-inference job joining the PR-8
+pipelined reader to the PR-12 fused programs (and, in fleet mode, the
+PR-17 TCP fleet): sharded inputs stream through
+:class:`readers.pipeline.InputPipeline` straight into
+``score_env`` - no admission controller, no micro-batcher - while an
+atomic, checksummed :class:`BulkJournal` walks every shard through
+``pending -> assigned -> scored -> committed`` so a SIGKILL at any
+instant resumes with zero duplicated and zero lost rows, and the
+double-entry ledger accounts every quarantined row exactly.
+"""
+from .job import BulkScoringJob, concatenated_output
+from .journal import (
+    JOURNAL_FILENAME,
+    OUTPUT_DIR,
+    STATE_ASSIGNED,
+    STATE_COMMITTED,
+    STATE_PENDING,
+    STATE_SCORED,
+    STATES,
+    BulkJournal,
+    TornJournalError,
+)
+
+__all__ = [
+    "BulkJournal",
+    "BulkScoringJob",
+    "JOURNAL_FILENAME",
+    "OUTPUT_DIR",
+    "STATES",
+    "STATE_ASSIGNED",
+    "STATE_COMMITTED",
+    "STATE_PENDING",
+    "STATE_SCORED",
+    "TornJournalError",
+    "concatenated_output",
+]

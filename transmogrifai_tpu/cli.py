@@ -1026,6 +1026,43 @@ def _add_continuous_parser(sub) -> None:
 
 
 # ---------------------------------------------------------------------------
+# bulk scoring commands (bulk/: exactly-once checkpointed batch inference)
+# ---------------------------------------------------------------------------
+def _bulk_main(args) -> int:
+    from .bulk import BulkJournal, TornJournalError
+
+    if args.bulk_cmd == "status":
+        try:
+            doc = BulkJournal.load(args.job_dir).status_doc()
+        except TornJournalError as e:
+            # exit 1 is the torn-journal verdict an operator scripts
+            # against (both the primary and .last-good failed their
+            # checksums) - everything else is a plain error (2)
+            print(json.dumps({"error": f"TornJournalError: {e}"}))
+            return 1
+        except (OSError, ValueError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        return 0
+    raise AssertionError(f"unhandled bulk command {args.bulk_cmd}")
+
+
+def _add_bulk_parser(sub) -> None:
+    b = sub.add_parser(
+        "bulk",
+        help="exactly-once bulk scoring jobs (checkpointed journal, "
+             "kill-survivable resume)")
+    bsub = b.add_subparsers(dest="bulk_cmd", required=True)
+    s = bsub.add_parser(
+        "status",
+        help="the job journal as JSON: per-shard states, the "
+             "double-entry row ledger, resume history; exit 1 when "
+             "the journal (and its .last-good fallback) is torn")
+    s.add_argument("job_dir", help="bulk job directory (holds journal.json)")
+
+
+# ---------------------------------------------------------------------------
 # registry commands (registry/: versioned store + lifecycle)
 # ---------------------------------------------------------------------------
 def _registry_main(args) -> int:
@@ -1098,6 +1135,7 @@ def main(argv=None) -> int:
     _add_autotune_parser(sub)
     _add_fleet_parser(sub)
     _add_continuous_parser(sub)
+    _add_bulk_parser(sub)
     g = sub.add_parser("gen", help="generate a project from data")
     g.add_argument("--input", required=True, help="CSV or .avsc path")
     g.add_argument("--response", required=True)
@@ -1128,6 +1166,8 @@ def main(argv=None) -> int:
         return _fleet_main(args)
     if args.cmd == "continuous":
         return _continuous_main(args)
+    if args.cmd == "bulk":
+        return _bulk_main(args)
     answers = load_answers(args.answers) if args.answers else None
     path = generate(
         args.input, args.response, args.name, args.output, args.kind,

@@ -46,6 +46,20 @@ continuous.refit_crash     hard kill in the continuous trainer between
 drift.false_positive       the continuous detect phase reports a forced
                            drift trigger on a healthy window (the
                            canary judges the spurious refit on merit)
+bulk.journal_torn          the bulk job journal's primary bytes read
+                           back truncated (the loader must fall back to
+                           ``.last-good``)
+bulk.commit_crash          hard kill immediately AFTER a journal commit
+                           lands - ``on=N`` walks the kill across every
+                           shard-state boundary (pending/assigned/
+                           scored/committed)
+bulk.output_crash          hard kill between a durable output-shard
+                           write and its ``scored`` journal commit (the
+                           resume must detect the unrecorded shard and
+                           re-score it)
+bulk.replica_die_midshard  a fleet replica dies while scoring a bulk
+                           chunk (at-least-once failover reassigns; the
+                           journal keeps output exactly-once)
 ========================== ==================================================
 
 The ``serving.*``/``io.*``/``supervisor.*``/``native.*`` points drill the
@@ -59,7 +73,9 @@ points drill the model-lifecycle control loop (registry/,
 tests/test_registry.py, ``python bench.py --registry``); the
 ``continuous.*`` + ``drift.*`` points drill the drift-triggered refit
 loop (continuous/, tests/test_continuous.py,
-``python bench.py --continuous``).
+``python bench.py --continuous``); the ``bulk.*`` points drill the
+exactly-once checkpointed bulk-scoring job (bulk/, tests/test_bulk.py,
+``python bench.py --bulk``).
 """
 from .injection import (
     DEFAULT_KILL_EXIT,

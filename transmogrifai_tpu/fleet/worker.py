@@ -351,6 +351,11 @@ class ReplicaWorker:
         # mid-serve - the request is accepted but unanswered, and the
         # router must retry it on survivors
         _faults.inject_kill("fleet.replica_kill")
+        # the bulk-job drill (ISSUE 18): a replica dying mid-shard while
+        # a BulkScoringJob fans chunk batches across the fleet - the
+        # router reassigns through ReplicaHealth, the job's journal
+        # keeps the output shard exactly-once
+        _faults.inject_kill("bulk.replica_die_midshard")
         self._in_flight_rows = len(records)
         try:
             results, info = self.controller.score_batch_with_info(records)

@@ -235,11 +235,12 @@ def _row_builder(name: str, keys: tuple):
     )
 
 
-def _prediction_stack(env: dict, name: str) -> tuple:
-    """Prediction env arrays -> (key layout, per-row value lists): the
+def _prediction_stack_arrays(env: dict, name: str) -> tuple:
+    """Prediction env arrays -> (key layout, [n, k] float array): the
     ONE place the prediction column order (prediction, raw_*, prob_*)
-    is stacked, shared by _assemble_prediction and the score_batch
-    single-result fast path so the two can never diverge."""
+    is stacked, shared by _assemble_prediction, the score_batch
+    single-result fast path and the bulk job's columnar line encoder
+    so the three can never diverge."""
     pred = env[name]
     raw = env.get(name + RAW_SUFFIX)
     prob = env.get(name + PROB_SUFFIX)
@@ -248,7 +249,13 @@ def _prediction_stack(env: dict, name: str) -> tuple:
         prob.shape[1] if prob is not None else 0,
     )
     parts = [pred[:, None]] + [a for a in (raw, prob) if a is not None]
-    return keys, np.concatenate(parts, axis=1).tolist()
+    return keys, np.concatenate(parts, axis=1)
+
+
+def _prediction_stack(env: dict, name: str) -> tuple:
+    """:func:`_prediction_stack_arrays` with per-row value lists."""
+    keys, stacked = _prediction_stack_arrays(env, name)
+    return keys, stacked.tolist()
 
 
 def _assemble_prediction(env: dict, name: str) -> list:
@@ -364,7 +371,21 @@ class FusedPipeline:
         cold = (n not in self.compile_ms
                 and len(self.compile_ms) < _MAX_SHAPE_PROGRAMS)
         t0 = time.perf_counter() if cold else 0.0
-        env = self._decoder.decode_env(records)
+        out = self.score_env(self._decoder.decode_env(records), n)
+        if cold:
+            self.compile_ms[n] = (time.perf_counter() - t0) * 1e3
+        return out
+
+    def score_env(self, env: dict, n: int) -> list[dict[str, Any]]:
+        """Columnar entry (ISSUE 18): run the fused steps + assembly
+        over a PRE-BUILT decode env - the bulk job feeds pipelined
+        chunk columns here directly, skipping per-record decode.  The
+        env must hold every decoder feature's keys (``name`` +
+        ``name@mask`` for numerics) with the decode_env missing-value
+        conventions; ``score_batch`` is exactly this after decode."""
+        if n == 0:
+            self.last_nonfinite_rows = ()
+            return []
         env = reduce(_apply_step, self._step_fns, env)
         if self._single_prediction is not None:
             # the dominant serving shape (one Prediction result): build
@@ -389,9 +410,25 @@ class FusedPipeline:
                 )
             ).tolist()
         )
-        if cold:
-            self.compile_ms[n] = (time.perf_counter() - t0) * 1e3
         return out
+
+    def score_env_prediction(self, env: dict, n: int):
+        """Columnar bulk fast path: run the fused steps over a
+        pre-built decode env and hand back the single-Prediction
+        result as raw arrays ``(name, keys, stacked [n, k] float64)``
+        instead of per-row dicts, so the bulk job can line-encode the
+        output without ever materialising n python dicts.  None when
+        the plan has any other result shape (or n == 0) - the caller
+        falls back to :meth:`score_env`.  ``last_nonfinite_rows`` is
+        set exactly as score_env would."""
+        if self._single_prediction is None or n == 0:
+            return None
+        env = reduce(_apply_step, self._step_fns, env)
+        name = self._single_prediction
+        keys, stacked = _prediction_stack_arrays(env, name)
+        self.last_nonfinite_rows = tuple(
+            np.flatnonzero(_nonfinite_mask(env, name, n)).tolist())
+        return name, keys, stacked
 
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
         return self.score_batch([record])[0]

@@ -57,6 +57,7 @@ from .fused import (
     _assemble_prediction,
     _nonfinite_mask,
     _prediction_stack,
+    _prediction_stack_arrays,
     _row_builder,
     FusionError,
     PipelineCompiler,
@@ -500,7 +501,17 @@ class XlaFusedPipeline:
         if n == 0:
             self.last_nonfinite_rows = ()
             return []
-        env = self._decoder.decode_env(records)
+        return self.score_env(self._decoder.decode_env(records), n)
+
+    def score_env(self, env: dict, n: int) -> list[dict[str, Any]]:
+        """Columnar entry (ISSUE 18): host pre-steps + the AOT device
+        program + assembly over a PRE-BUILT decode env, so the bulk
+        job's pipelined chunks feed the XLA program directly without
+        per-record dict round trips.  Same contract as the numpy
+        pipeline's ``score_env``."""
+        if n == 0:
+            self.last_nonfinite_rows = ()
+            return []
         for fn in self._host_steps:
             env.update(fn(env))
         m = _exec_bucket(n)
@@ -532,6 +543,34 @@ class XlaFusedPipeline:
             np.flatnonzero(reduce(np.logical_or, host_masks, nf)).tolist()
         )
         return result
+
+    def score_env_prediction(self, env: dict, n: int):
+        """Columnar bulk fast path: host pre-steps + the AOT device
+        program, returning the single-Prediction result as raw arrays
+        ``(name, keys, stacked [n, k] float64)`` - same contract as
+        the numpy pipeline's ``score_env_prediction`` (None when the
+        plan has any other result shape or n == 0)."""
+        if self._single_prediction is None or n == 0:
+            return None
+        for fn in self._host_steps:
+            env.update(fn(env))
+        m = _exec_bucket(n)
+        xenv = {
+            k: _pad0(np.asarray(env[k]), m) for k in self._device_inputs
+        }
+        out = self._execute(m, xenv)
+        nf = out.pop(NONFINITE_KEY)[:n]
+        env.update({k: v[:n] for k, v in out.items()})
+        name = self._single_prediction
+        keys, stacked = _prediction_stack_arrays(env, name)
+        host_masks = [
+            _nonfinite_mask(env, nm, n)
+            for nm, _ in self._result_plan if nm not in out
+        ]
+        self.last_nonfinite_rows = tuple(
+            np.flatnonzero(reduce(np.logical_or, host_masks, nf)).tolist()
+        )
+        return name, keys, stacked
 
     def __call__(self, record: Mapping[str, Any]) -> dict[str, Any]:
         return self.score_batch([record])[0]

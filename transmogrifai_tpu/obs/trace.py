@@ -292,6 +292,23 @@ class Tracer:
         self.profiler.observe(s.name, record["wall_ms"], tree)
 
     # -- cross-process context ----------------------------------------------
+    def adopt_context(self, value: Optional[str]) -> bool:
+        """Adopt a foreign trace context IN-PROCESS (the
+        :data:`TRACE_CONTEXT_ENV` seam only runs at construction): the
+        bulk job's resume path joins the PLANNING process's trace this
+        way, so plan -> score -> commit -> resume is one trace across
+        kills.  A no-op (False) on a malformed context, when this
+        tracer already adopted one, or when a span is open - joining a
+        foreign trace mid-span would orphan the open root."""
+        trace_id, parent = parse_context(value)
+        if trace_id is None or self._adopted_trace is not None:
+            return False
+        if self.current_context() is not None:
+            return False
+        self._adopted_trace, self._adopted_parent = trace_id, parent
+        self.contexts_adopted += 1
+        return True
+
     def current_context(self) -> Optional[str]:
         """The ambient span's ``<trace_id>:<span_id>`` context string
         (the :data:`TRACE_CONTEXT_ENV` payload), or - with no span open

@@ -150,112 +150,272 @@ def _decode_value(dec: _Decoder, schema: Any) -> Any:
     raise ValueError(f"unsupported avro type: {schema!r}")
 
 
+class _ByteWindow:
+    """Bounded read-ahead over a binary file.
+
+    Exposes FILE-ABSOLUTE offsets so the decoder and the damage-resync
+    scan can reason in the same coordinates the materializing reader
+    used, while only ever buffering from the current block head forward.
+    """
+
+    def __init__(self, f, read_bytes: int = 1 << 20) -> None:
+        self._f = f
+        self._read_bytes = read_bytes
+        self.buf = bytearray()
+        self.base = 0  # file offset of buf[0]
+        self.eof = False
+
+    def _fill(self) -> bool:
+        if self.eof:
+            return False
+        b = self._f.read(self._read_bytes)
+        if not b:
+            self.eof = True
+            return False
+        self.buf += b
+        return True
+
+    def ensure(self, end: int) -> bool:
+        """Buffer through file offset ``end`` (exclusive); False at EOF."""
+        while self.base + len(self.buf) < end:
+            if not self._fill():
+                return False
+        return True
+
+    def drop_to(self, pos: int) -> None:
+        cut = pos - self.base
+        if cut > 0:
+            del self.buf[:cut]
+            self.base = pos
+
+    def find(self, needle: bytes, start: int) -> int:
+        """File-absolute ``find`` from ``start``, discarding scanned
+        bytes as it goes (a len(needle)-1 overlap survives each read so
+        a marker straddling two reads still matches); -1 when absent —
+        at which point the window has reached EOF, so ``base + len(buf)``
+        is the total file size."""
+        self.drop_to(start)
+        while True:
+            i = self.buf.find(needle)
+            if i >= 0:
+                return self.base + i
+            keep = len(needle) - 1
+            if len(self.buf) > keep:
+                cut = len(self.buf) - keep
+                del self.buf[:cut]
+                self.base += cut
+            if not self._fill():
+                return -1
+
+
+class _WindowDecoder(_Decoder):
+    """The _Decoder API over a _ByteWindow; ``pos`` is file-absolute.
+    The inherited compound reads (read_bytes/string/float/double/
+    boolean) all route through the three primitives overridden here."""
+
+    def __init__(self, win: _ByteWindow, pos: int = 0) -> None:
+        self.win = win
+        self.pos = pos
+
+    def read(self, n: int) -> bytes:
+        if not self.win.ensure(self.pos + n):
+            raise EOFError("truncated avro data")
+        s = self.pos - self.win.base
+        out = bytes(self.win.buf[s : s + n])
+        self.pos += n
+        return out
+
+    def at_end(self) -> bool:
+        return not self.win.ensure(self.pos + 1)
+
+    def read_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            if not self.win.ensure(self.pos + 1):
+                raise EOFError("truncated avro data")
+            b = self.win.buf[self.pos - self.win.base]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+    read_int = read_long
+
+
+class AvroBlockStream:
+    """Incremental OCF block decoder — ONE implementation serving both
+    the materializing batch reader (:func:`read_avro_records`) and the
+    input pipeline's chunked avro ingest.
+
+    The header (magic, metadata map, schema, codec, sync marker) parses
+    eagerly in ``__init__``; :meth:`blocks` then yields each block's
+    decoded record list while holding only the current block (plus a
+    bounded read-ahead window) in memory, so an avro shard streams
+    exactly like a CSV shard instead of materializing the whole file.
+
+    Error policy matches the old whole-file reader byte for byte:
+    ``"coerce"`` raises raw, ``"strict"`` raises MalformedRowError
+    naming the clean-record index, ``"quarantine"`` rolls the suspect
+    block back, records the damage (same excerpt strings), and resyncs
+    forward past the next sync marker — scanned incrementally, never
+    by loading the tail.  ``records_decoded`` counts cleanly decoded
+    records and ``damaged`` counts quarantined block-level events so
+    callers can reconcile rows_seen without owning the buffer.
+    """
+
+    def __init__(self, path: str, errors: str = "coerce",
+                 quarantine: Optional[QuarantineBuffer] = None,
+                 read_bytes: int = 1 << 20) -> None:
+        check_errors_mode(errors)
+        self.path = path
+        self.errors = errors
+        self.quarantine = quarantine
+        self.records_decoded = 0
+        self.damaged = 0
+        self._f = open(path, "rb")
+        try:
+            self._win = _ByteWindow(self._f, read_bytes)
+            dec = _WindowDecoder(self._win)
+            if dec.read(4) != MAGIC:
+                raise ValueError(
+                    f"{path} is not an avro object container file")
+            meta: dict[str, bytes] = {}
+            while True:
+                n = dec.read_long()
+                if n == 0:
+                    break
+                if n < 0:
+                    dec.read_long()
+                    n = -n
+                for _ in range(n):
+                    key = dec.read_string()
+                    meta[key] = dec.read_bytes()
+            self._sync = dec.read(16)
+            self.schema = json.loads(meta["avro.schema"].decode("utf-8"))
+            self.codec = meta.get("avro.codec", b"null").decode("utf-8")
+            if self.codec not in ("null", "deflate"):
+                # configuration error, NOT block damage: checked once up
+                # front so quarantine mode can never misread a whole
+                # valid file in an unsupported codec as wall-to-wall
+                # corrupt blocks
+                raise ValueError(f"unsupported avro codec {self.codec!r}")
+            self._dec = dec
+        except BaseException:
+            self._f.close()
+            raise
+
+    def close(self) -> None:
+        self._f.close()
+
+    def blocks(self):
+        """Yield each block's decoded records (a list per block)."""
+        dec, win, sync = self._dec, self._win, self._sync
+        while True:
+            block_start = dec.pos
+            # nothing before the current block head is ever needed again
+            # (the resync scan searches FORWARD from it), so release it:
+            # this is what bounds memory to one block + read-ahead
+            win.drop_to(block_start)
+            if dec.at_end():
+                return
+            out: list = []
+            try:
+                count = dec.read_long()
+                size = dec.read_long()
+                block = dec.read(size)
+                if self.codec == "deflate":
+                    block = zlib.decompress(block, -15)
+                bdec = _Decoder(block)
+                for _ in range(count):
+                    out.append(_decode_value(bdec, self.schema))
+                if dec.read(16) != sync:
+                    raise ValueError("bad sync marker (corrupt avro file)")
+            except (EOFError, IndexError, ValueError, KeyError, zlib.error,
+                    struct.error, UnicodeDecodeError) as e:
+                if self.errors == "coerce":
+                    raise
+                truncated = isinstance(
+                    e, (EOFError, IndexError, struct.error))
+                reason = "truncated_block" if truncated else "corrupt_block"
+                if self.errors == "strict":
+                    data_telemetry().record_strict_error(self.path)
+                    # the old whole-file reader's index counted the
+                    # damaged block's partially decoded records too
+                    # (nothing rolled back before a strict raise) -
+                    # keep that contract exactly
+                    raise MalformedRowError(
+                        self.path, self.records_decoded + len(out),
+                        reason, None, excerpt_of(str(e)),
+                    ) from e
+                # quarantine: the whole damaged block is suspect - its
+                # records never left this frame, so dropping the block
+                # is just not yielding it.  Search for the next sync
+                # marker from the block HEAD, not the failure point:
+                # when damage hits early payload (or just the trailing
+                # marker) this finds THIS block's own boundary, so the
+                # next healthy block is never skipped.  A false match
+                # inside payload just fails the next decode and resyncs
+                # again - strictly forward progress either way.
+                self.damaged += 1
+                nxt = win.find(sync, block_start)
+                if nxt < 0:
+                    total = win.base + len(win.buf)  # find() hit EOF
+                    if self.quarantine is not None:
+                        self.quarantine.add(
+                            self.records_decoded, reason, None,
+                            excerpt_of(f"{e}; no later sync marker - "
+                                       f"{total - block_start} trailing "
+                                       "bytes undecodable"),
+                        )
+                    log.warning(
+                        "avro %s: %s at record %d; no sync marker after "
+                        "byte %d - keeping the %d-record clean prefix",
+                        self.path, reason, self.records_decoded,
+                        block_start, self.records_decoded,
+                    )
+                    return
+                if self.quarantine is not None:
+                    self.quarantine.add(
+                        self.records_decoded, reason, None,
+                        excerpt_of(f"{e}; block dropped, resynced past "
+                                   f"{nxt + 16 - block_start} bytes"),
+                    )
+                log.warning(
+                    "avro %s: %s at record %d; dropping the damaged "
+                    "block (%d bytes) and resyncing",
+                    self.path, reason, self.records_decoded,
+                    nxt + 16 - block_start,
+                )
+                dec.pos = nxt + 16  # just past the marker: next block
+                continue
+            self.records_decoded += len(out)
+            yield out
+
+
 def read_avro_records(
     path: str,
     errors: str = "coerce",
     quarantine: Optional[QuarantineBuffer] = None,
 ) -> tuple[dict, list[dict]]:
-    """Read all records + the parsed schema from an OCF file.
+    """Read all records + the parsed schema from an OCF file (a
+    materializing wrapper over :class:`AvroBlockStream`).
 
     A truncated or corrupt trailing block: raw EOFError/ValueError under
     ``"coerce"`` (legacy), :class:`MalformedRowError` naming the record
     index under ``"strict"``, or — under ``"quarantine"`` — the cleanly
     decoded prefix is returned and the damage recorded in the buffer.
     """
-    check_errors_mode(errors)
-    with open(path, "rb") as f:
-        data = f.read()
-    dec = _Decoder(data)
-    if dec.read(4) != MAGIC:
-        raise ValueError(f"{path} is not an avro object container file")
-    meta: dict[str, bytes] = {}
-    while True:
-        n = dec.read_long()
-        if n == 0:
-            break
-        if n < 0:
-            dec.read_long()
-            n = -n
-        for _ in range(n):
-            key = dec.read_string()
-            meta[key] = dec.read_bytes()
-    sync = dec.read(16)
-    schema = json.loads(meta["avro.schema"].decode("utf-8"))
-    codec = meta.get("avro.codec", b"null").decode("utf-8")
-    if codec not in ("null", "deflate"):
-        # configuration error, NOT block damage: checked once up front
-        # so quarantine mode can never misread a whole valid file in an
-        # unsupported codec as wall-to-wall corrupt blocks
-        raise ValueError(f"unsupported avro codec {codec!r}")
-    records: list[dict] = []
-    while not dec.at_end():
-        block_start = dec.pos
-        n_before = len(records)
-        try:
-            count = dec.read_long()
-            size = dec.read_long()
-            block = dec.read(size)
-            if codec == "deflate":
-                block = zlib.decompress(block, -15)
-            bdec = _Decoder(block)
-            for _ in range(count):
-                records.append(_decode_value(bdec, schema))
-            if dec.read(16) != sync:
-                raise ValueError("bad sync marker (corrupt avro file)")
-        except (EOFError, IndexError, ValueError, KeyError, zlib.error,
-                struct.error, UnicodeDecodeError) as e:
-            if errors == "coerce":
-                raise
-            truncated = isinstance(e, (EOFError, IndexError, struct.error))
-            reason = "truncated_block" if truncated else "corrupt_block"
-            if errors == "strict":
-                data_telemetry().record_strict_error(path)
-                raise MalformedRowError(
-                    path, len(records), reason, None, excerpt_of(str(e))
-                ) from e
-            # quarantine: the whole damaged block is suspect - records
-            # it already appended may be garbage decoded off misaligned
-            # bytes, so roll back to the block boundary before
-            # resyncing.  The sync marker exists precisely so one
-            # corrupt block does not cost every block after it; only
-            # with no further marker (a truncated tail) does the clean
-            # prefix stand alone.
-            del records[n_before:]
-            # search from the block HEAD, not the failure point: when
-            # damage hits early payload (or just the trailing marker)
-            # this finds THIS block's own boundary, so the next healthy
-            # block is never skipped.  A false match inside payload
-            # just fails the next decode and resyncs again - strictly
-            # forward progress either way.
-            nxt = data.find(sync, block_start)
-            if nxt < 0:
-                if quarantine is not None:
-                    quarantine.add(
-                        len(records), reason, None,
-                        excerpt_of(f"{e}; no later sync marker - "
-                                   f"{len(data) - block_start} trailing "
-                                   "bytes undecodable"),
-                    )
-                log.warning(
-                    "avro %s: %s at record %d; no sync marker after "
-                    "byte %d - keeping the %d-record clean prefix",
-                    path, reason, len(records), block_start,
-                    len(records),
-                )
-                break
-            if quarantine is not None:
-                quarantine.add(
-                    len(records), reason, None,
-                    excerpt_of(f"{e}; block dropped, resynced past "
-                               f"{nxt + 16 - block_start} bytes"),
-                )
-            log.warning(
-                "avro %s: %s at record %d; dropping the damaged block "
-                "(%d bytes) and resyncing",
-                path, reason, len(records), nxt + 16 - block_start,
-            )
-            dec.pos = nxt + 16  # just past the marker: next block head
-    return schema, records
+    stream = AvroBlockStream(path, errors=errors, quarantine=quarantine)
+    try:
+        records: list[dict] = []
+        for block in stream.blocks():
+            records.extend(block)
+        return stream.schema, records
+    finally:
+        stream.close()
 
 
 class AvroReader:

@@ -483,29 +483,23 @@ def _iter_avro_chunks(
     quarantine: Optional[QuarantineBuffer],
     telemetry,
 ):
-    """Avro shard -> CsvChunk stream: records decode through the
-    existing avro machinery (which owns corrupt-block/record
-    quarantine), then chunk into columnar slices.
-
-    Memory note: ``read_avro_records`` materializes the WHOLE shard's
-    record list before chunking, so for avro the prefetch buffer bounds
-    decoded-chunk memory but not the per-worker record list — size avro
-    shards accordingly (the OCF decoder is not yet incremental; CSV and
-    Parquet shards stream truly chunk-by-chunk)."""
+    """Avro shard -> CsvChunk stream: OCF blocks decode incrementally
+    through :class:`~.avro_reader.AvroBlockStream` (which owns
+    corrupt-block quarantine and sync-marker resync), then buffer into
+    columnar slices of ``chunk_rows`` — an avro shard now streams truly
+    chunk-by-chunk like CSV and Parquet, never materializing the whole
+    record list."""
     from ..faults import injection as _faults
-    from .avro_reader import read_avro_records
+    from .avro_reader import AvroBlockStream
 
     checked = errors != "coerce"
     if checked and quarantine is None:
         quarantine = QuarantineBuffer(source=path)
-    _avro_schema, records = read_avro_records(
-        path, errors=errors, quarantine=quarantine,
-    )
     num_names = [n for n in wanted if issubclass(schema[n], OPNumeric)]
-    rows_seen = len(records) + (quarantine.total if checked else 0)
     rows_kept = 0
-    for start in range(0, len(records), chunk_rows):
-        chunk = records[start:start + chunk_rows]
+
+    def _columnar(chunk: list, start: int) -> CsvChunk:
+        nonlocal rows_kept
         keep = np.ones(len(chunk), bool)
         if checked:
             # same per-record junk rule as AvroReader._checked_records:
@@ -564,10 +558,33 @@ def _iter_avro_chunks(
                     v = r.get(n)
                     out[i] = None if v in (None, "") else str(v)
                 text[n] = out
-        yield CsvChunk(len(chunk), num, text, start)
+        return CsvChunk(len(chunk), num, text, start)
+
+    stream = AvroBlockStream(path, errors=errors, quarantine=quarantine)
+    try:
+        # chunk boundaries, quarantine record indexes, and rows_seen
+        # must match the old materialize-then-slice path exactly:
+        # `start` counts positions in the cleanly decoded record stream
+        # (damaged blocks contribute nothing - the stream rolls them
+        # back), so every slice is bit-identical to records[start:
+        # start+chunk_rows] of a full decode
+        pending: list = []
+        start = 0
+        for block in stream.blocks():
+            pending.extend(block)
+            while len(pending) >= chunk_rows:
+                chunk, pending = (pending[:chunk_rows],
+                                  pending[chunk_rows:])
+                yield _columnar(chunk, start)
+                start += chunk_rows
+        if pending:
+            yield _columnar(pending, start)
+    finally:
+        stream.close()
     if checked:
         (telemetry or data_telemetry()).record_read(
-            path, rows_seen, rows_kept, quarantine)
+            path, stream.records_decoded + stream.damaged, rows_kept,
+            quarantine)
 
 
 def iter_shard_chunks(

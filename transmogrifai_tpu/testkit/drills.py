@@ -449,3 +449,25 @@ if not os.path.exists(marker):
     sys.exit({first_exit})
 sys.exit(0)
 """
+
+
+#: child for the exactly-once bulk-scoring kill drills (tests/
+#: test_bulk.py, the chaos composition's bulk phase, and
+#: ``bench.py --bulk``): arms one ``bulk.*`` fault, trains the tiny
+#: drill pipeline deterministically (the resuming parent trains the
+#: SAME weights from the same seed, so post-resume output bytes are
+#: comparable), then runs a BulkScoringJob that the armed fault must
+#: SIGKILL mid-flight - ``os._exit(3)`` is unreachable when armed.
+BULK_KILL_CHILD_TEMPLATE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from transmogrifai_tpu.faults import injection
+injection.configure({fault!r})
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+from transmogrifai_tpu.bulk import BulkScoringJob
+wf, _data, _records, _pred = tiny_drill_pipeline(n={n}, seed=0)
+model = wf.train()
+BulkScoringJob(model, {job_dir!r}, {shards!r}, chunk_rows={chunk}).run()
+os._exit(3)  # unreachable: the armed fault must kill first
+"""
