@@ -976,6 +976,151 @@ def _fleet_section(result: dict) -> None:
     }
 
 
+def autoscale_bench() -> dict:
+    """Elastic autoscaling proof -> AUTOSCALE_BENCH.json (ISSUE 19
+    acceptance): one traffic-ramp drill over a live loopback-TCP fleet
+    - sustained load pushes utilization past 1.0, the autoscaler grows
+    2 -> 4 replicas (probe-gated admission, cost-model sizing), the
+    load stops and the fleet drains back to 2 - measuring
+    time-to-scale-up (trigger to last admission), the drain wall
+    (idle to last retirement), exact row conservation across every
+    transition, and the count of trace-recorded decisions.  Worker
+    throughput is BOUNDED (an injected 20ms per-batch floor) so the
+    surge is deterministic, not a race against compile caches."""
+    import threading
+
+    import jax
+
+    from transmogrifai_tpu.fleet import FleetAutoscaler, FleetController
+    from transmogrifai_tpu.obs.trace import tracer
+    from transmogrifai_tpu.registry import ModelRegistry
+    from transmogrifai_tpu.testkit.drills import serving_fleet_workflow
+
+    spec = "transmogrifai_tpu.testkit.drills:serving_fleet_workflow"
+    out: dict = {"platform": jax.default_backend()}
+    wf, records = serving_fleet_workflow()
+    model = wf.train()
+    work_root = tempfile.mkdtemp(prefix="tx-autoscale-bench-")
+    root = os.path.join(work_root, "registry")
+    ModelRegistry(root).publish(model, stage="stable")
+    batch = (records * (64 // len(records) + 1))[:64]
+    out["config"] = {
+        "min_replicas": 2, "max_replicas": 4, "interval_s": 0.25,
+        "up_consecutive": 2, "down_consecutive": 3,
+        "cooldown_windows": 2, "pump_threads": 6,
+        "batch_rows": len(batch),
+        "worker_batch_floor_ms": 20.0,
+    }
+    delivered: list = []
+    errors: list = []
+    stop_pump = threading.Event()
+    with FleetController(
+        root, spec, n_replicas=2, transport="tcp", max_restarts=0,
+        work_dir=os.path.join(work_root, "fleet"),
+        worker_env={"TX_FAULTS":
+                    "serving.slow_batch:every=1:delay=0.02"},
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64},
+        worker_args=["--buckets", "1,8,32,64"],
+    ) as fc:
+        fc.router.score_batch(batch, timeout_s=120.0)  # warm
+
+        def pump() -> None:
+            while not stop_pump.is_set():
+                try:
+                    delivered.append(fc.router.submit(
+                        records=batch).wait(120.0).n_rows)
+                except Exception as e:  # noqa: BLE001 - counted
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=pump) for _ in range(6)]
+        with tracer().span("autoscale-bench-ramp") as ramp:
+            scaler = FleetAutoscaler(
+                fc, min_replicas=2, max_replicas=4, interval_s=0.25,
+                up_consecutive=2, down_consecutive=3,
+                cooldown_windows=2, retune_enabled=False,
+                probe_timeout_s=120.0, drain_timeout_s=60.0)
+            t_load = time.monotonic()
+            for t in threads:
+                t.start()
+            scaler.start()
+            grew = None
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                if len(fc.member_instances()) >= 4:
+                    grew = time.monotonic() - t_load
+                    break
+                time.sleep(0.05)
+            t_idle = time.monotonic()
+            stop_pump.set()
+            for t in threads:
+                t.join(timeout=120.0)
+            shrank = None
+            while time.monotonic() < deadline:
+                if len(fc.member_instances()) <= 2:
+                    shrank = time.monotonic() - t_idle
+                    break
+                time.sleep(0.05)
+            scaler.stop()
+            snap = fc.router.snapshot()
+            decisions = scaler.decisions()
+            decision_spans = [
+                s for s in tracer().spans(ramp.trace_id)
+                if s["name"] == "autoscaler.decision"]
+        ups = [d for d in decisions if d.action == "scale_up"]
+        downs = [d for d in decisions if d.action == "scale_down"]
+        out["ramp"] = {
+            "grew_to": max(d.members_after for d in decisions),
+            "time_to_scale_up_s": (round(grew, 3)
+                                   if grew is not None else None),
+            "drain_wall_s": (round(shrank, 3)
+                             if shrank is not None else None),
+            "retire_drain_s": [
+                r.get("drain_s") for d in downs
+                for r in d.evidence.get("retired", [])],
+            "scale_ups": len(ups),
+            "scale_downs": len(downs),
+            "capacity_source": (ups[0].evidence["capacity"]["source"]
+                                if ups else None),
+        }
+        rows_expected = (len(delivered) + 1) * len(batch)
+        out["conservation"] = {
+            "requests_delivered": len(delivered),
+            "rows_delivered": sum(delivered),
+            "router_rows_ok": snap["rows_ok"],
+            "requests_failed": snap["requests_failed"],
+            "dropped": len(errors),
+            "rows_conserved": bool(
+                not errors and snap["rows_ok"] == rows_expected
+                and snap["requests_failed"] == 0),
+        }
+        out["decisions_in_trace"] = len(decision_spans)
+        out["decisions_total"] = len(decisions)
+        out["autoscale_ok"] = bool(
+            grew is not None and shrank is not None
+            and out["conservation"]["rows_conserved"]
+            and len(decision_spans) == len(decisions))
+    return out
+
+
+def _autoscale_section(result: dict) -> None:
+    auto = autoscale_bench()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "AUTOSCALE_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(dict(auto,
+                       bench_commit=result.get("bench_commit",
+                                               "unknown")),
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["autoscale"] = {
+        "time_to_scale_up_s": auto["ramp"]["time_to_scale_up_s"],
+        "drain_wall_s": auto["ramp"]["drain_wall_s"],
+        "rows_conserved": auto["conservation"]["rows_conserved"],
+        "decisions_in_trace": auto["decisions_in_trace"],
+        "autoscale_ok": auto["autoscale_ok"],
+    }
+
+
 def fleet_faults_bench() -> dict:
     """Network-fault envelope proof -> FLEET_FAULTS_BENCH.json (ISSUE 17
     acceptance): the on-host TCP-vs-unix router CPU overhead ratio at
@@ -4108,6 +4253,26 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _fleet_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--autoscale" in sys.argv:
+        # elastic-capacity proof: writes AUTOSCALE_BENCH.json
+        # (time-to-scale-up on a sustained surge, drain wall back to
+        # min, exact row conservation across every transition, every
+        # decision trace-recorded) and prints it (ISSUE 19)
+        _ensure_working_backend()
+        _res: dict = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _autoscale_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--fleet-faults" in sys.argv:

@@ -1,0 +1,698 @@
+"""Elastic fleet autoscaling drills (ISSUE 19; fleet/autoscaler.py).
+
+The acceptance matrix for the capacity control loop: ScaleGovernor
+hysteresis units (streaks, cooldown suppression, a flap storm that
+never triggers), the deterministic ``step()`` decision function over a
+fake fleet (cost-model surge sizing - never "+1" - the at-max brownout
+hold, replica-death replacement-capacity accounting, the A/B knob
+retune riding the loop), and the live drills: a traffic ramp that
+grows a real TCP fleet 2 -> >= 4 under load and shrinks it back idle
+with ZERO dropped rows and exact double-entry row conservation, a
+SIGKILL of a draining scale-down victim mid-drain (failover owns the
+strands), the ``autoscaler.crash`` fault point (the control loop dies;
+the data plane keeps serving; a restarted autoscaler ADOPTS the live
+fleet), the worker ``retune`` verb + chunk cap, and the bulk job's
+router re-resolution at shard boundaries (grow-mid-job).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.fleet import (
+    AutoscaleDecision,
+    FleetAutoscaler,
+    FleetController,
+    ScaleGovernor,
+)
+from transmogrifai_tpu.registry import ModelRegistry
+from transmogrifai_tpu.testkit.drills import (
+    tiny_drill_pipeline,
+    write_shard_csv,
+)
+
+WORKFLOW_SPEC = "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline"
+
+RAMP_DEADLINE_S = 180.0
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def fleet_registry(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("autoscale-registry"))
+    wf, _data, records, pred_name = tiny_drill_pipeline()
+    model = wf.train()
+    reg = ModelRegistry(root)
+    v1 = reg.publish(model, stage="stable")
+    return {"root": root, "records": records, "pred_name": pred_name,
+            "v1": v1.version, "model": model}
+
+
+def _controller(fleet_registry, tmp_path, n_replicas, **kw):
+    kw.setdefault("router_kw", {})
+    kw["router_kw"].setdefault("max_in_flight_per_replica", 2)
+    kw["router_kw"].setdefault("max_queue", 64)
+    return FleetController(
+        fleet_registry["root"], WORKFLOW_SPEC,
+        n_replicas=n_replicas, work_dir=str(tmp_path / "fleet"),
+        ship_interval_s=0.15, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ScaleGovernor: hysteresis units
+# ---------------------------------------------------------------------------
+def test_governor_streak_then_cooldown_suppression():
+    g = ScaleGovernor(up_consecutive=2, down_consecutive=4, cooldown=2)
+    assert g.observe_window("up") == "over"       # streak building
+    assert g.observe_window("up") == "trigger"    # streak complete
+    assert g.cooldown_left == 2
+    assert g.observe_window("up") == "over"       # streaks reset
+    assert g.observe_window("up") == "suppressed"  # complete but cooling
+    assert g.observe_window("up") == "trigger"    # cooldown expired
+    assert g.triggers == 2 and g.suppressed == 1
+
+
+def test_governor_hold_resets_both_streaks():
+    g = ScaleGovernor(up_consecutive=2, down_consecutive=2, cooldown=0)
+    assert g.observe_window("up") == "over"
+    assert g.observe_window("hold") == "clear"
+    assert g.up_streak == 0 and g.down_streak == 0
+    assert g.observe_window("up") == "over"       # starts from scratch
+    assert g.observe_window("up") == "trigger"
+
+
+def test_governor_down_needs_its_own_longer_streak():
+    g = ScaleGovernor(up_consecutive=2, down_consecutive=4, cooldown=0)
+    for _ in range(3):
+        assert g.observe_window("down") == "over"
+    assert g.observe_window("down") == "trigger"
+
+
+def test_governor_flap_storm_never_triggers():
+    g = ScaleGovernor(up_consecutive=2, down_consecutive=2, cooldown=2)
+    for i in range(60):
+        out = g.observe_window("up" if i % 2 == 0 else "down")
+        assert out == "over"  # every flip resets the other streak
+    assert g.triggers == 0 and g.windows == 60
+
+
+def test_governor_rejects_unknown_direction():
+    with pytest.raises(ValueError):
+        ScaleGovernor().observe_window("sideways")
+
+
+# ---------------------------------------------------------------------------
+# step(): the deterministic decision function over a fake fleet
+# ---------------------------------------------------------------------------
+class _FakeHandle:
+    def __init__(self, instance, svc_s=None, obs=None):
+        self.instance = instance
+        self.svc_s_ewma = svc_s
+        self.obs = dict(obs or {})
+
+
+class _FakeRouter:
+    """Just the public seams ``step()`` reads: snapshot, live replicas,
+    cost model, the retune broadcast."""
+
+    def __init__(self, members, svc_s=0.01, snapshot=None):
+        self.cost_model = None
+        self._members = members  # shared list with the controller
+        self.svc_s = svc_s
+        self.snapshot_doc = dict(snapshot or {})
+        self.broadcasts: list = []
+
+    def snapshot(self):
+        doc = {"rows_ok": 0, "requests_ok": 0, "queue_depth": 0,
+               "healthy_replicas": len(self._members), "replicas": {}}
+        doc.update(self.snapshot_doc)
+        return doc
+
+    def live_replicas(self):
+        return [_FakeHandle(m, svc_s=self.svc_s) for m in self._members]
+
+    def broadcast(self, cmd, args=None, timeout_s=30.0):
+        self.broadcasts.append((cmd, dict(args or {})))
+        return {m: {"ok": True} for m in self._members}
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.firing: list = []
+
+    def observe(self):
+        return {"objectives": {}, "firing": [{"name": n}
+                                             for n in self.firing]}
+
+
+class _FakeController:
+    def __init__(self, n=2):
+        self.members = [f"replica-{i}" for i in range(n)]
+        self.router = _FakeRouter(self.members)
+        self.slo_engine = _FakeSLO()
+        self.gave_up: list = []
+        self.autoscaler = None
+        self.added: list = []
+        self.removed: list = []
+
+    def member_instances(self):
+        return list(self.members)
+
+    def gave_up_instances(self):
+        return list(self.gave_up)
+
+    def add_replica(self, probe_timeout_s=30.0):
+        name = f"replica-{len(self.members)}"
+        self.members.append(name)
+        self.added.append(name)
+        self.router._members = self.members
+        return name
+
+    def remove_replica(self, instance, drain_timeout_s=30.0):
+        self.members.remove(instance)
+        self.removed.append(instance)
+        self.router._members = self.members
+        return {"instance": instance, "drained": True, "drain_s": 0.0}
+
+
+def _scaler(fc, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("up_consecutive", 2)
+    kw.setdefault("down_consecutive", 2)
+    kw.setdefault("cooldown_windows", 2)
+    kw.setdefault("ref_batch_rows", 10)
+    kw.setdefault("retune_enabled", False)
+    return FleetAutoscaler(fc, **kw)
+
+
+def test_step_sizes_surge_from_demand_not_plus_one():
+    fc = _FakeController(n=2)
+    # per-replica capacity 100 rows/s (svc EWMA 10ms/row); a backlog of
+    # 100 in-flight rows + 30 queued requests x 10 rows/request over
+    # the 1s up-window = 400 rows/s demand -> utilization 2.0
+    fc.router.svc_s = 0.01
+    fc.router.snapshot_doc = {
+        "queue_depth": 30, "healthy_replicas": 2,
+        "replicas": {"replica-0": {"in_flight_rows": 50},
+                     "replica-1": {"in_flight_rows": 50}},
+    }
+    s = _scaler(fc, interval_s=0.5, target_utilization=0.7)
+    d1 = s.step()
+    assert d1.action == "hold" and d1.outcome == "over"
+    assert d1.reason.startswith("overload:")
+    d2 = s.step()
+    # sized from demand: ceil(400 / (100 * 0.7)) = 6, NOT 2 + 1
+    assert d2.action == "scale_up" and d2.target == 6
+    assert fc.added == ["replica-2", "replica-3", "replica-4",
+                        "replica-5"]
+    assert d2.members_after == 6
+    assert d2.evidence["capacity"]["source"] == "observed_ewma"
+    assert d2.evidence["utilization"] >= 2.0
+    assert d2.evidence["governor"]["triggers"] == 1
+
+
+def test_step_at_max_defers_to_brownout():
+    fc = _FakeController(n=2)
+    fc.router.svc_s = 0.01
+    fc.router.snapshot_doc = {
+        "queue_depth": 30, "healthy_replicas": 2,
+        "replicas": {"replica-0": {"in_flight_rows": 50}},
+    }
+    s = _scaler(fc, max_replicas=2)
+    s.step()
+    d = s.step()
+    assert d.action == "hold" and d.outcome == "at_max"
+    assert "brownout" in d.reason
+    assert fc.added == []  # the quorum rule stays the last line
+
+
+def test_step_replica_death_is_replacement_capacity():
+    # 4 members but 2 gave up their restart budget: the survivors'
+    # effective capacity halves, utilization crosses 1.0, and the
+    # trigger sizes from DEMAND - not a blind 1:1 restart of the dead
+    fc = _FakeController(n=4)
+    fc.gave_up = ["replica-2", "replica-3"]
+    fc.router.svc_s = 0.01
+    fc.router.snapshot_doc = {
+        "queue_depth": 0, "healthy_replicas": 2,
+        "replicas": {"replica-0": {"in_flight_rows": 120},
+                     "replica-1": {"in_flight_rows": 120}},
+    }
+    s = _scaler(fc, interval_s=0.5, target_utilization=0.7)
+    s.step()
+    d = s.step()
+    assert d.action == "scale_up"
+    # demand 240/1.0s = 240 rows/s over 200 effective -> util 1.2;
+    # sized: ceil(240 / 70) = 4 serving replicas wanted
+    assert d.target == 4
+    assert d.evidence["gave_up"] == ["replica-2", "replica-3"]
+    assert d.evidence["serving_n"] == 2
+
+
+def test_step_scales_down_idle_fleet_youngest_first():
+    fc = _FakeController(n=4)
+    fc.router.svc_s = 0.01
+    fc.router.snapshot_doc = {"queue_depth": 0, "healthy_replicas": 4,
+                              "replicas": {}}
+    s = _scaler(fc, min_replicas=1, idle_utilization=0.3)
+    d1 = s.step()
+    assert d1.action == "hold" and d1.reason.startswith("idle:")
+    d2 = s.step()
+    assert d2.action == "scale_down" and d2.target == 1
+    # the youngest members retire first; the longest-lived replica
+    # keeps its warm caches
+    assert fc.removed == ["replica-3", "replica-2", "replica-1"]
+    assert fc.members == ["replica-0"]
+    assert [r["instance"] for r in d2.evidence["retired"]] == fc.removed
+
+
+def test_step_flap_storm_never_scales():
+    fc = _FakeController(n=2)
+    fc.router.svc_s = 0.01
+    overload = {"queue_depth": 30, "healthy_replicas": 2,
+                "replicas": {"replica-0": {"in_flight_rows": 100}}}
+    idle = {"queue_depth": 0, "healthy_replicas": 2, "replicas": {}}
+    s = _scaler(fc, min_replicas=1)
+    for i in range(12):
+        fc.router.snapshot_doc = overload if i % 2 == 0 else idle
+        d = s.step()
+        assert d is None or d.action == "hold"
+    assert fc.added == [] and fc.removed == []
+    assert s.governor.triggers == 0
+
+
+def test_stale_burn_over_idle_fleet_still_scales_down():
+    # a LATCHED burn (e.g. serving-drift-js is a running max that never
+    # decays) over a fleet with no offered load is stale evidence: it
+    # must not pin the direction "up" and deadlock scale-down forever
+    fc = _FakeController(n=3)
+    fc.router.svc_s = 0.01
+    fc.router.snapshot_doc = {"queue_depth": 0, "healthy_replicas": 3,
+                              "replicas": {}}
+    fc.slo_engine.firing = ["serving-drift-js"]
+    s = _scaler(fc, min_replicas=1, down_consecutive=2)
+    decisions = [s.step() for _ in range(2)]
+    trigger = decisions[-1]
+    assert trigger is not None and trigger.action == "scale_down"
+    assert trigger.reason.startswith("idle:")
+    assert fc.removed and not fc.added
+    fc = _FakeController(n=3)
+    fc.router = None  # no data plane reads: adoption alone
+    s = _scaler(fc, interval_s=0.05)
+    s.start()
+    try:
+        time.sleep(0.2)
+    finally:
+        s.stop()
+    decisions = s.decisions()
+    assert decisions[0].action == "adopt"
+    assert decisions[0].members_before == 3
+    assert decisions[0].evidence["governor"]["up_streak"] == 0
+    # a restarted autoscaler cannot justify a scale event it cannot
+    # derive from fresh windows: nothing but the adoption is recorded
+    assert [d.action for d in decisions] == ["adopt"]
+    assert s.scale_ups == 0 and s.scale_downs == 0
+    assert fc.autoscaler is s
+    snap = s.snapshot()
+    assert snap["crashed"] is False and snap["members"] == 3
+
+
+def test_retune_rides_the_loop_and_never_regresses(fleet_registry):
+    # latency burns but the capacity trigger has not fired: the loop
+    # A/B-probes micro-batch knobs instead of scaling
+    fc = _FakeController(n=2)
+    fc.router.svc_s = 0.01
+    # queue_depth 1: a burn only counts with offered load behind it
+    # (a stale latched burn over an idle fleet must not pin "up")
+    fc.router.snapshot_doc = {"queue_depth": 1, "healthy_replicas": 2,
+                              "replicas": {}}
+    fc.slo_engine.firing = ["serving-p99-latency"]
+
+    def fast_big_batches(knobs):
+        return 100.0 + float(knobs["max_batch_size"])
+
+    s = _scaler(fc, retune_enabled=True, ref_batch_rows=16,
+                measure_fn=fast_big_batches, retune_margin=0.03)
+    d = s.step()  # window 1: direction up, streak building -> retune
+    assert d.action == "retune" and d.outcome == "tuned"
+    assert d.evidence["knob_decision"]["tuned"] is True
+    cmd, args = fc.router.broadcasts[-1]
+    assert cmd == "retune" and args["source"] == "autotune"
+    assert args["max_batch_size"] == 32  # the winning candidate
+    assert s.retunes == 1
+
+    # a baseline win RESTORES the hand-set default: tuned knobs never
+    # regress past it (ties and margins keep the baseline)
+    fc2 = _FakeController(n=2)
+    fc2.router.svc_s = 0.01
+    fc2.router.snapshot_doc = dict(fc.router.snapshot_doc)
+    fc2.slo_engine.firing = ["serving-p99-latency"]
+    s2 = _scaler(fc2, retune_enabled=True, ref_batch_rows=16,
+                 measure_fn=lambda k: 100.0, retune_margin=0.03)
+    d2 = s2.step()
+    assert d2.action == "retune" and d2.outcome == "baseline_held"
+    cmd2, args2 = fc2.router.broadcasts[-1]
+    assert cmd2 == "retune" and args2["source"] == "hand_set"
+    assert args2["max_batch_size"] == 0  # resets the worker cap
+
+    # the retune cooldown holds: the very next burning window must not
+    # probe again
+    assert s2._retune_cooldown_left > 0
+    d3 = s2.step()
+    assert d3.action in ("hold", "scale_up")
+
+
+def test_decision_to_json_round_trips():
+    d = AutoscaleDecision(action="scale_up", outcome="trigger",
+                          reason="r", members_before=2,
+                          members_after=4, target=4,
+                          evidence={"utilization": 2.0})
+    doc = d.to_json()
+    assert doc["action"] == "scale_up" and doc["target"] == 4
+    assert doc["evidence"] == {"utilization": 2.0}
+    assert doc["t"] <= time.time()
+
+
+def test_autoscaler_validates_bounds():
+    with pytest.raises(ValueError):
+        FleetAutoscaler(_FakeController(), min_replicas=0)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(_FakeController(), min_replicas=4,
+                        max_replicas=2)
+
+
+# ---------------------------------------------------------------------------
+# worker retune verb + chunk cap (live, 1 replica)
+# ---------------------------------------------------------------------------
+def test_worker_retune_verb_applies_chunk_cap(fleet_registry, tmp_path):
+    records = fleet_registry["records"]
+    with _controller(fleet_registry, tmp_path, 1) as fc:
+        out = fc.router.score_batch(records[:30], timeout_s=60.0)
+        assert len(out) == 30
+        doc = fc.router.control("replica-0", "retune",
+                                {"max_batch_size": 8}, timeout_s=30.0)
+        assert doc["ok"] and doc["applied"]["max_batch_size"] == 8
+        assert doc["knobs"]["source"] == "autotune"
+        # scoring still conserves rows: 30 rows through 8-row chunks
+        out = fc.router.score_batch(records[:30], timeout_s=60.0)
+        assert len(out) == 30
+        info = fc.router.control("replica-0", "status", timeout_s=30.0)
+        assert info["knobs"]["max_batch_size"] == 8
+        # <= 0 resets to the hand-set default
+        doc = fc.router.control(
+            "replica-0", "retune",
+            {"max_batch_size": 0, "source": "hand_set"}, timeout_s=30.0)
+        assert doc["knobs"] == {"max_batch_size": None,
+                                "max_wait_us": None,
+                                "source": "hand_set"}
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill 1: the traffic ramp - grow under load, shrink idle,
+# zero drops, exact conservation, every decision under ONE trace id
+# ---------------------------------------------------------------------------
+def test_traffic_ramp_grows_and_shrinks_without_dropping_rows(
+        fleet_registry, tmp_path):
+    from transmogrifai_tpu.obs.trace import tracer
+
+    records = fleet_registry["records"]
+    batch = records[:24]
+    t_start = time.monotonic()
+    with _controller(
+        fleet_registry, tmp_path, 2, transport="tcp", max_restarts=0,
+        worker_env={"TX_FAULTS": "serving.slow_batch:every=1:delay=0.03"},
+    ) as fc:
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        delivered: list = []
+        errors: list = []
+        stop_pump = threading.Event()
+
+        def pump() -> None:
+            while not stop_pump.is_set():
+                try:
+                    res = fc.router.submit(records=batch).wait(120.0)
+                    delivered.append(res.n_rows)
+                except Exception as e:  # noqa: BLE001 - ledger counts
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=pump) for _ in range(6)]
+        with tracer().span("autoscale-ramp-drill") as ramp_span:
+            scaler = FleetAutoscaler(
+                fc, min_replicas=2, max_replicas=4, interval_s=0.25,
+                up_consecutive=2, down_consecutive=3,
+                cooldown_windows=2, retune_enabled=False,
+                probe_timeout_s=120.0, drain_timeout_s=60.0)
+            scaler.start()
+            try:
+                for t in threads:
+                    t.start()
+                # surge: the backlog pushes utilization over 1.0, the
+                # governor streak completes, and the cost-model sizing
+                # grows the fleet 2 -> >= 4 (probe-gated admission)
+                deadline = time.monotonic() + RAMP_DEADLINE_S
+                while time.monotonic() < deadline:
+                    if len(fc.member_instances()) >= 4:
+                        break
+                    time.sleep(0.1)
+                grown = len(fc.member_instances())
+                stop_pump.set()
+                for t in threads:
+                    t.join(timeout=120.0)
+                assert grown >= 4, \
+                    f"fleet never grew under load: {grown} members"
+                # idle: served EWMA decays, the down streak completes,
+                # and the fleet drains back to min_replicas
+                while time.monotonic() < deadline:
+                    if len(fc.member_instances()) <= 2:
+                        break
+                    time.sleep(0.1)
+                assert len(fc.member_instances()) == 2, \
+                    "fleet never shrank back after load stopped"
+            finally:
+                stop_pump.set()
+                scaler.stop()
+
+        # ZERO dropped rows, exact double-entry conservation across
+        # every transition (grow, serve, drain, retire)
+        assert errors == []
+        snap = fc.router.snapshot()
+        assert snap["rows_ok"] == (len(delivered) + 1) * len(batch)
+        assert sum(delivered) == len(delivered) * len(batch)
+        assert snap["requests_failed"] == 0
+
+        # the decision trail: a recorded scale_up AND scale_down, each
+        # carrying its evidence, all under the ONE ramp trace id
+        actions = [d.action for d in scaler.decisions()]
+        assert "adopt" == actions[0]
+        assert "scale_up" in actions and "scale_down" in actions
+        up = next(d for d in scaler.decisions()
+                  if d.action == "scale_up")
+        assert up.evidence["capacity"]["per_replica_rows_s"] > 0
+        assert up.evidence["governor"]["triggers"] >= 1
+        assert up.members_after > up.members_before
+        down = next(d for d in scaler.decisions()
+                    if d.action == "scale_down")
+        assert down.members_after < down.members_before
+        assert all(r.get("drained") for r in down.evidence["retired"])
+        decision_spans = [
+            s for s in tracer().spans(ramp_span.trace_id)
+            if s["name"] == "autoscaler.decision"]
+        assert len(decision_spans) >= 3  # adopt + up + down at least
+        assert {s["trace"] for s in decision_spans} \
+            == {ramp_span.trace_id}
+
+        # the status document carries the autoscaler columns
+        status = fc.status()
+        assert status["autoscaler"]["scale_ups"] >= 1
+        assert status["autoscaler"]["scale_downs"] >= 1
+        assert status["autoscaler"]["replicas_added"] >= 2
+    assert time.monotonic() - t_start < RAMP_DEADLINE_S + 60.0
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill 2: SIGKILL of a draining scale-down victim - the
+# router's failover owns the strands, conservation holds
+# ---------------------------------------------------------------------------
+def test_scale_down_victim_sigkilled_mid_drain_conserves_rows(
+        fleet_registry, tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:24]
+    with _controller(
+        fleet_registry, tmp_path, 3, max_restarts=0,
+        worker_env={"TX_FAULTS": "serving.slow_batch:every=1:delay=0.15"},
+    ) as fc:
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        victim_pid = fc._replicas["replica-2"].proc.pid
+        delivered: list = []
+        errors: list = []
+        submitted = 36
+
+        def pump(k: int) -> None:
+            for _ in range(k):
+                try:
+                    res = fc.router.submit(records=batch).wait(120.0)
+                    delivered.append(res.n_rows)
+                except Exception as e:  # noqa: BLE001 - ledger counts
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=pump, args=(submitted // 4,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # saturate: the victim holds in-flight work
+        report: dict = {}
+
+        def retire() -> None:
+            report.update(fc.remove_replica("replica-2",
+                                            drain_timeout_s=60.0))
+
+        retirer = threading.Thread(target=retire)
+        retirer.start()
+        time.sleep(0.1)  # the drain is underway, batches in flight
+        os.kill(victim_pid, signal.SIGKILL)
+        retirer.join(timeout=120.0)
+        assert not retirer.is_alive(), "removal hung on a dead victim"
+        for t in threads:
+            t.join(timeout=120.0)
+
+        # EXACT conservation: everything the dead victim stranded was
+        # re-dispatched to survivors - nothing lost, nothing doubled
+        assert errors == []
+        assert len(delivered) == submitted
+        assert sum(delivered) == submitted * len(batch)
+        snap = fc.router.snapshot()
+        assert snap["rows_ok"] == (submitted + 1) * len(batch)
+        assert report["instance"] == "replica-2"
+        assert sorted(fc.member_instances()) \
+            == ["replica-0", "replica-1"]
+        live = {h.instance for h in fc.router.live_replicas()}
+        assert live == {"replica-0", "replica-1"}
+        post = fc.router.score_batch(batch, timeout_s=60.0)
+        assert len(post) == len(batch)
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill 3: autoscaler.crash - the control plane dies, the
+# data plane keeps serving, a restarted autoscaler adopts
+# ---------------------------------------------------------------------------
+def test_autoscaler_crash_leaves_the_data_plane_serving(
+        fleet_registry, tmp_path):
+    records = fleet_registry["records"]
+    batch = records[:16]
+    with _controller(fleet_registry, tmp_path, 2,
+                     max_restarts=0) as fc:
+        fc.router.score_batch(batch, timeout_s=60.0)  # warm
+        faults.configure("autoscaler.crash:on=2")
+        scaler = FleetAutoscaler(fc, min_replicas=2, max_replicas=4,
+                                 interval_s=0.1, retune_enabled=False)
+        scaler.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and scaler.alive():
+            time.sleep(0.05)
+        faults.reset()
+        assert not scaler.alive() and scaler.crashed
+        assert scaler.snapshot()["crashed"] is True
+
+        # the data plane never noticed: replicas, router, supervision
+        # all keep serving through the control-plane death
+        out = fc.router.score_batch(batch, timeout_s=60.0)
+        assert len(out) == len(batch)
+        assert sorted(fc.member_instances()) \
+            == ["replica-0", "replica-1"]
+
+        # a restarted autoscaler ADOPTS the live fleet: its first
+        # decision is the adoption, and with the fleet steady it
+        # cannot justify any scale event from fresh evidence
+        scaler2 = FleetAutoscaler(fc, min_replicas=2, max_replicas=4,
+                                  interval_s=0.1,
+                                  retune_enabled=False)
+        scaler2.start()
+        try:
+            time.sleep(0.6)
+        finally:
+            scaler2.stop()
+        decisions = scaler2.decisions()
+        assert decisions[0].action == "adopt"
+        assert scaler2.scale_ups == 0 and scaler2.scale_downs == 0
+        assert all(d.action in ("adopt", "hold") for d in decisions)
+        assert fc.status()["autoscaler"]["crashed"] is False
+
+
+# ---------------------------------------------------------------------------
+# satellite: the bulk job re-resolves its router at shard boundaries
+# ---------------------------------------------------------------------------
+def test_bulk_job_re_resolves_router_when_fleet_grows_mid_job(
+        fleet_registry, tmp_path):
+    from transmogrifai_tpu.bulk import BulkScoringJob
+
+    wf, data, _records, _pred = tiny_drill_pipeline(n=120, seed=0)
+    model = wf.train()
+    rows = [{"y": data["y"][i], "a": data["a"][i], "c": data["c"][i]}
+            for i in range(120)]
+    shards = []
+    for k in range(3):
+        p = str(tmp_path / f"in-{k}.csv")
+        write_shard_csv(p, rows[k * 40:(k + 1) * 40])
+        shards.append(p)
+    reg_root = str(tmp_path / "bulk-registry")
+    ModelRegistry(reg_root).publish(model, stage="stable")
+    with FleetController(
+        reg_root, WORKFLOW_SPEC, n_replicas=2,
+        work_dir=str(tmp_path / "fleet"), ship_interval_s=0.15,
+        max_restarts=0,
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64},
+    ) as fc:
+        resolutions = [0]
+
+        def live_router():
+            resolutions[0] += 1
+            if resolutions[0] == 2:
+                # the fleet grows AT the first shard boundary - the
+                # job must pick up the new replica set, not a pinned
+                # snapshot from planning time
+                fc.add_replica(probe_timeout_s=120.0)
+            return fc.router
+
+        jd = str(tmp_path / "job")
+        s = BulkScoringJob(model, jd, shards, router=live_router,
+                           chunk_rows=16, max_in_flight=4).run()
+        led = s["ledger"]
+        assert led["balanced"] and led["rows_in"] == 120
+        # one resolution at construction + one per shard boundary
+        assert resolutions[0] == 1 + 3
+        live = {h.instance for h in fc.router.live_replicas()}
+        assert "replica-2" in live
+        assert len(fc.member_instances()) == 3
+
+        # a CONTROLLER source re-resolves the same way (the live
+        # ``controller.router`` attribute each boundary)
+        jd2 = str(tmp_path / "job2")
+        s2 = BulkScoringJob(model, jd2, shards, router=fc,
+                            chunk_rows=16, max_in_flight=4).run()
+        assert s2["ledger"]["balanced"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: the health eject/readmit knobs ride the controller seam
+# ---------------------------------------------------------------------------
+def test_health_knobs_flow_through_controller(fleet_registry, tmp_path):
+    with _controller(fleet_registry, tmp_path, 1, eject_after=5,
+                     probe_interval_s=0.25,
+                     probe_timeout_s=1.25) as fc:
+        assert fc.router.eject_after == 5
+        assert fc.router.probe_interval_s == 0.25
+        assert fc.router.probe_timeout_s == 1.25
+        assert fc.router.handle("replica-0").health.eject_after == 5

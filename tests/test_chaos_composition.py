@@ -117,8 +117,10 @@ def test_chaos_composition_end_to_end(tmp_path):
         "fleet.partition", "channel.corrupt_frame",
         "fleet.reconnect_storm",
         "bulk.output_crash", "bulk.replica_die_midshard",
+        "autoscaler.crash",
     ]}
     bulk_kill_shard = int(rng.randint(1, 4))    # which shard's window
+    autoscaler_crash_on = int(rng.randint(2, 6))  # Nth control tick
 
     # ---- phase 1: quarantine ingest (real corruption + injected) → train
     csv_path = str(tmp_path / "chaos.csv")
@@ -534,6 +536,97 @@ def test_chaos_composition_end_to_end(tmp_path):
         events["bulk_fleet_replica_deaths"] = bsnap["replica_deaths"]
     t_bulk = time.monotonic() - t0
     assert t_bulk < BULK_DEADLINE_S, "bulk scoring hang"
+
+    # ---- phase 9: elastic capacity under chaos -------------------------
+    # (ISSUE 19) a fresh TCP fleet rides a load surge while the
+    # autoscaler's OWN control loop is killed on the seeded tick
+    # (``autoscaler.crash``): the data plane keeps serving through the
+    # control-plane death, a restarted autoscaler adopts the live
+    # fleet and grows it under the still-burning load, then the drain
+    # (load stops) shrinks it back - the row ledger EXACT throughout
+    from transmogrifai_tpu.fleet import FleetAutoscaler
+
+    t0 = time.monotonic()
+    with FleetController(
+        fleet_reg_root,
+        "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline",
+        n_replicas=2, transport="tcp", max_restarts=0,
+        work_dir=str(tmp_path / "autoscale_fleet"), ship_interval_s=0.2,
+        worker_env={"TX_FAULTS": "serving.slow_batch:every=1:delay=0.03"},
+        router_kw={"max_in_flight_per_replica": 2, "max_queue": 64},
+    ) as afc:
+        abatch = records[:24]
+        afc.router.score_batch(abatch, timeout_s=60.0)  # warm
+        adelivered, aerrors = [], []
+        stop_surge = threading.Event()
+
+        def _surge():
+            while not stop_surge.is_set():
+                try:
+                    res = afc.router.submit(records=abatch).wait(120.0)
+                    adelivered.append(res.n_rows)
+                except Exception as e:  # noqa: BLE001 - ledger counts
+                    aerrors.append(repr(e))
+
+        surges = [threading.Thread(target=_surge) for _ in range(6)]
+        for t in surges:
+            t.start()
+        try:
+            faults.configure(
+                f"autoscaler.crash:on={autoscaler_crash_on}")
+            doomed = FleetAutoscaler(
+                afc, min_replicas=2, max_replicas=3, interval_s=0.15,
+                up_consecutive=2, down_consecutive=2,
+                cooldown_windows=1, retune_enabled=False,
+                probe_timeout_s=120.0, drain_timeout_s=60.0)
+            doomed.start()
+            _fleet_wait(lambda: not doomed.alive(), 60.0,
+                        "autoscaler crash")
+            faults.reset()
+            assert doomed.crashed  # the fault, not a clean stop
+            # the data plane never noticed the control plane die
+            assert len(afc.router.score_batch(
+                abatch, timeout_s=60.0)) == len(abatch)
+            # a restarted autoscaler adopts the live fleet and grows
+            # it under the still-burning surge
+            scaler = FleetAutoscaler(
+                afc, min_replicas=2, max_replicas=3, interval_s=0.15,
+                up_consecutive=2, down_consecutive=2,
+                cooldown_windows=1, retune_enabled=False,
+                probe_timeout_s=120.0, drain_timeout_s=60.0)
+            scaler.start()
+            _fleet_wait(lambda: len(afc.member_instances()) >= 3,
+                        FLEET_DEADLINE_S, "surge scale-up")
+        finally:
+            stop_surge.set()
+            for t in surges:
+                t.join(timeout=120.0)
+        try:
+            # the drain: load gone, the fleet shrinks back to min
+            _fleet_wait(lambda: len(afc.member_instances()) <= 2,
+                        FLEET_DEADLINE_S, "idle scale-down")
+        finally:
+            scaler.stop()
+        assert scaler.decisions()[0].action == "adopt"
+        # the crash tick is randomized, so the surge scale-up may land
+        # on either side of the crash: assert it over the COMBINED
+        # decision history, and that the adopter never repeated it
+        # blindly (at most one scale-up total for one sustained surge)
+        actions = [d.action for d in doomed.decisions()] \
+            + [d.action for d in scaler.decisions()]
+        assert "scale_up" in actions and "scale_down" in actions
+        assert actions.count("scale_up") == 1
+        # row ledger EXACT across crash + grow + drain: every accepted
+        # request answered exactly once
+        assert aerrors == []
+        asnap = afc.router.snapshot()
+        assert asnap["rows_ok"] == (len(adelivered) + 2) * len(abatch)
+        assert asnap["requests_failed"] == 0
+        events["autoscaler_crash_tick"] = autoscaler_crash_on
+        events["autoscale_decisions"] = len(scaler.decisions())
+        events["autoscale_rows_ok"] = asnap["rows_ok"]
+    t_autoscale = time.monotonic() - t0
+    assert t_autoscale < FLEET_DEADLINE_S, "autoscale phase hang"
 
     # ---- global: nothing leaked, everything accounted ------------------
     assert not faults.active()

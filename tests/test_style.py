@@ -645,6 +645,33 @@ def test_autotune_reads_telemetry_via_public_apis_only():
     assert not offenders, offenders
 
 
+def test_autoscaler_drives_the_fleet_via_public_seams_only():
+    """fleet/autoscaler.py composes the controller, router, SLO
+    engine, cost model and knob tuner and may drive them ONLY through
+    their public seams (ISSUE 19 satellite): no single-underscore
+    attribute of ANY foreign object is touched anywhere in the module
+    (``self._x``/``cls._x`` own-state access is the only exception).
+    The control loop must survive each subsystem refactoring its
+    internals - a private reach would weld capacity decisions to
+    implementation details four packages away."""
+    p = ROOT / "fleet" / "autoscaler.py"
+    offenders = []
+    tree = ast.parse(p.read_text(encoding="utf-8"))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        attr = node.attr
+        if not attr.startswith("_") or attr.startswith("__"):
+            continue
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            continue
+        offenders.append(f"{p}:{node.lineno} .{attr}")
+    assert not offenders, offenders
+
+
 def test_continuous_drives_subsystems_via_public_seams_only():
     """continuous/ composes five earlier subsystems (reader follow
     mode, drift monitor, fused-train cache, registry, fleet) and may

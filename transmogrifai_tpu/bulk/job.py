@@ -20,6 +20,11 @@ PR-17 TCP channels: the router's at-least-once failover plus the
 ``ReplicaHealth`` detector reassign work when a replica dies mid-shard
 (``bulk.replica_die_midshard`` drill), while the journal's
 commit-after-durable-write discipline keeps the OUTPUT exactly-once.
+``router=`` accepts a :class:`~..fleet.FleetRouter`, a
+:class:`~..fleet.FleetController`, or a zero-arg callable returning
+either; the job RE-RESOLVES it at every shard boundary so an elastic
+fleet (ISSUE 19) growing or shrinking mid-job fans the next shard out
+to the CURRENT membership, never a stale snapshot.
 
 Fault points: ``bulk.output_crash`` kills the job between the durable
 output-shard write and its journal commit - the canonical "did the
@@ -268,7 +273,12 @@ class BulkScoringJob:
         self.buffer_chunks = int(buffer_chunks)
         self.fused_backend = fused_backend
         self.use_native = use_native
-        self.router = router
+        #: what the caller handed us (router / controller / callable);
+        #: ``self.router`` is the CURRENT resolution, refreshed at
+        #: every shard boundary (elastic fleets change membership
+        #: mid-job)
+        self._router_source = router
+        self.router = self._resolve_router()
         self.batch_timeout_s = float(batch_timeout_s)
         self.max_in_flight = max(int(max_in_flight), 1)
         self.instance = str(instance) if instance else (
@@ -467,6 +477,9 @@ class BulkScoringJob:
                         self._seal_shard(j, pipe, k, sid_of[k], [], [],
                                          writer, assigned)
                 if current != pc.shard_id:
+                    # shard boundary: re-resolve the replica set so an
+                    # elastic fleet's grow/shrink lands on this shard
+                    self.router = self._resolve_router()
                     assigned.add(sid_of[pc.shard_id])
                     writer.submit(j.mark_assigned, sid_of[pc.shard_id],
                                   self.instance)
@@ -508,6 +521,25 @@ class BulkScoringJob:
             return b"".join(lines), len(lines)
 
     # -- fleet fan-out -------------------------------------------------------
+    def _resolve_router(self):
+        """The CURRENT router behind ``router=``: a FleetRouter is
+        itself, a FleetController yields its live router, a zero-arg
+        callable is invoked.  Re-run at shard boundaries so a fleet
+        that grew or shrank mid-job fans the next shard out to current
+        members instead of a snapshot taken at job start."""
+        src = self._router_source
+        if src is None:
+            return None
+        if hasattr(src, "submit"):
+            return src  # a router directly
+        if hasattr(src, "router"):
+            return src.router  # a FleetController
+        if callable(src):
+            return src()
+        raise TypeError(
+            f"router= must be a FleetRouter, FleetController, or "
+            f"callable, got {type(src).__name__}")
+
     def _submit_chunk(self, chunk, parts: list[bytes],
                       pending: list[Any]) -> None:
         """Dispatch one chunk's records to the fleet; drain the oldest

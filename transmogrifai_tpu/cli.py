@@ -832,6 +832,7 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
     from .obs.fleet import (
         SHARD_SUFFIX,
         FleetAggregator,
+        autoscaler_views,
         health_views,
         read_json_torn_safe,
         serving_views,
@@ -858,6 +859,7 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
             # from ONE consistent document, no shard re-reads
             health_by_replica: dict = {}
             fleet_health: dict = {}
+            autoscaler: dict = {}
             for shard in shards:
                 for _key, snap in health_views(
                         shard.get("metrics", {})):
@@ -865,6 +867,14 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
                         health_by_replica[str(inst)] = h
                     fleet_health = {k: v for k, v in snap.items()
                                     if k != "replicas"}
+                # the ISSUE-19 capacity control loop ships one
+                # autoscaler view from wherever it runs; fold the
+                # freshest one in as its own status column
+                for _key, snap in autoscaler_views(
+                        shard.get("metrics", {})):
+                    if snap.get("steps", 0) >= autoscaler.get(
+                            "steps", -1):
+                        autoscaler = snap
             replicas = {}
             for shard in shards:
                 inst = str(shard.get("instance"))
@@ -898,6 +908,8 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
                    "replicas": replicas}
             if fleet_health:
                 out["fleet_health"] = fleet_health
+            if autoscaler:
+                out["autoscaler"] = autoscaler
             return out
     raise ValueError(
         f"{path!r} holds neither a fleet status document nor an obs "

@@ -20,15 +20,28 @@ The channel speaks AF_UNIX on-host (the fast path) or TCP cross-host
 (``transport="tcp"`` / any ``host:port`` address), with per-frame
 CRC32 integrity and an OP_HELLO handshake either way.
 
+The ISSUE-19 :mod:`.autoscaler` closes the capacity loop: a
+:class:`FleetAutoscaler` control loop grows and shrinks the replica
+count elastically against offered load (SLO burn triggers, cost-model
+sizing, hysteresis via :class:`ScaleGovernor`), with probe-gated
+admission on scale-up and shed-never-hang drain on scale-down.
+
 Fault points: ``fleet.replica_kill`` (a worker dies mid-serve like a
 SIGKILL), ``fleet.router_stall`` (the dispatcher wedges for a beat),
-and the ISSUE-17 socket seams - ``fleet.partition`` (both directions
-dark), ``fleet.half_open`` (accepts, never responds),
-``fleet.slow_peer``, ``channel.corrupt_frame``,
+``autoscaler.crash`` (the capacity control loop dies; the data plane
+keeps serving), and the ISSUE-17 socket seams - ``fleet.partition``
+(both directions dark), ``fleet.half_open`` (accepts, never
+responds), ``fleet.slow_peer``, ``channel.corrupt_frame``,
 ``fleet.reconnect_storm``.  ``tx fleet status|drain`` is the operator
-surface; ``python bench.py --fleet`` writes FLEET_BENCH.json and
-``--fleet-faults`` writes FLEET_FAULTS_BENCH.json.
+surface; ``python bench.py --fleet`` writes FLEET_BENCH.json,
+``--fleet-faults`` writes FLEET_FAULTS_BENCH.json, and
+``--autoscale`` writes AUTOSCALE_BENCH.json.
 """
+from .autoscaler import (
+    AutoscaleDecision,
+    FleetAutoscaler,
+    ScaleGovernor,
+)
 from .channel import (
     ChannelClosedError,
     ChannelProtocolError,
@@ -58,6 +71,7 @@ from .router import (
 from .worker import ReplicaWorker
 
 __all__ = [
+    "AutoscaleDecision",
     "BrownoutShedError",
     "ChannelClosedError",
     "ChannelProtocolError",
@@ -66,6 +80,7 @@ __all__ = [
     "FleetChannel",
     "FleetController",
     "FleetDecodeError",
+    "FleetAutoscaler",
     "FleetError",
     "FleetResult",
     "FleetRouter",
@@ -73,6 +88,7 @@ __all__ = [
     "ReplicaHandle",
     "ReplicaHealth",
     "ReplicaWorker",
+    "ScaleGovernor",
     "decode_records",
     "decode_results",
     "encode_records",

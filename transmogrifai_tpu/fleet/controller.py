@@ -132,6 +132,9 @@ class _Replica:
     #: a replica warm-up; supervision of the REST of the fleet must not
     #: stall behind it)
     reconnecting: bool = False
+    #: a scale-down drain is in flight: supervision must NOT restart
+    #: this replica when its process exits - retirement owns it
+    retiring: bool = False
     events: list = field(default_factory=list)
 
 
@@ -163,6 +166,9 @@ class FleetController:
         ship_interval_s: float = 0.25,
         use_cost_model: bool = True,
         monitor_interval_s: float = 0.2,
+        eject_after: Optional[int] = None,
+        probe_interval_s: Optional[float] = None,
+        probe_timeout_s: Optional[float] = None,
     ) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -211,8 +217,21 @@ class FleetController:
         self.policy = policy if policy is not None else RollbackPolicy()
         self.policy.slo_engine = self.slo_engine
         self._router_kw = dict(router_kw or {})
+        # ReplicaHealth eject/readmit knobs surfaced here (ISSUE 19
+        # satellite) instead of router_kw-only: explicit kwargs win
+        # over router_kw defaults, None leaves the router's own
+        for knob, val in (("eject_after", eject_after),
+                          ("probe_interval_s", probe_interval_s),
+                          ("probe_timeout_s", probe_timeout_s)):
+            if val is not None:
+                self._router_kw[knob] = val
         self.router: Optional[FleetRouter] = None
         self.canary_version: Optional[str] = None
+        #: attached by :class:`~.autoscaler.FleetAutoscaler.start` -
+        #: folds its decision snapshot into ``status()`` /
+        #: ``fleet_status.json``
+        self.autoscaler = None
+        self._next_index = 0
         self._replicas: dict[str, _Replica] = {}
         self._events: list[dict] = []
         self._events_lock = threading.Lock()
@@ -242,20 +261,8 @@ class FleetController:
                 self.fleet_dir, interval_s=self.ship_interval_s,
                 instance="router").start()
         try:
-            for i in range(self.n_replicas):
-                if self.transport == "tcp":
-                    address = (f"{self.tcp_host}:"
-                               f"{_free_port(self.tcp_host)}")
-                else:
-                    address = os.path.join(self.work_dir,
-                                           f"replica-{i}.sock")
-                rep = _Replica(
-                    index=i,
-                    instance=f"replica-{i}",
-                    socket_path=address,
-                    heartbeat_path=os.path.join(self.work_dir,
-                                                f"replica-{i}.hb"),
-                )
+            for _ in range(self.n_replicas):
+                rep = self._new_replica()
                 self._replicas[rep.instance] = rep
                 self._spawn(rep)
             # connect AFTER spawning: replicas warm concurrently
@@ -306,6 +313,24 @@ class FleetController:
             log.warning("cost model load failed (round-robin-ish "
                         "weights): %s", e)
             return None
+
+    def _new_replica(self) -> _Replica:
+        """Allocate the next replica slot (monotonic index: a retired
+        ``replica-2`` is never reused for a later scale-up, so events,
+        heartbeat files, and trace history stay unambiguous)."""
+        i = self._next_index
+        self._next_index += 1
+        if self.transport == "tcp":
+            address = f"{self.tcp_host}:{_free_port(self.tcp_host)}"
+        else:
+            address = os.path.join(self.work_dir, f"replica-{i}.sock")
+        return _Replica(
+            index=i,
+            instance=f"replica-{i}",
+            socket_path=address,
+            heartbeat_path=os.path.join(self.work_dir,
+                                        f"replica-{i}.hb"),
+        )
 
     def _worker_cmd(self, rep: _Replica) -> list[str]:
         cmd = [
@@ -402,7 +427,8 @@ class FleetController:
 
     def _check_replicas(self) -> None:
         for rep in list(self._replicas.values()):
-            if rep.gave_up or rep.proc is None or rep.reconnecting:
+            if rep.gave_up or rep.proc is None or rep.reconnecting \
+                    or rep.retiring:
                 continue
             rc = rep.proc.poll()
             stale = staleness(rep.heartbeat_path)
@@ -485,6 +511,119 @@ class FleetController:
             except OSError as e:
                 log.warning("could not consume command file %s: %s",
                             path, e)
+
+    # -- elastic membership (ISSUE 19) --------------------------------------
+    def member_instances(self) -> list[str]:
+        """Instance names the controller currently OWNS (spawned, not
+        retiring) - the autoscaler's notion of fleet size.  A replica
+        mid-backoff or gave-up still counts as a member; capacity
+        accounting (not membership) handles its missing throughput."""
+        return [r.instance for r in self._replicas.values()
+                if not r.retiring]
+
+    def gave_up_instances(self) -> list[str]:
+        """Members whose restart budget is exhausted: dead weight the
+        supervisor will never bring back.  The autoscaler replaces
+        their CAPACITY (sized from demand) instead of blindly
+        restarting 1:1."""
+        return [r.instance for r in self._replicas.values()
+                if r.gave_up and not r.retiring]
+
+    def add_replica(self, probe_timeout_s: float = 30.0) -> str:
+        """Grow the fleet by one replica with probe-gated admission:
+        spawn at the next free index (warming from the AOT executable
+        cache like any bring-up), connect it DRAINED so no score
+        traffic can reach it, health-probe it with a ``ping`` control
+        round trip, and only then undrain.  A replica that fails to
+        warm or answer the probe is reaped and never admitted - a bad
+        scale-up is a no-op, not a degraded fleet."""
+        rep = self._new_replica()
+        self._replicas[rep.instance] = rep
+        self._spawn(rep)
+        try:
+            self.router.add_replica(
+                rep.instance, rep.socket_path,
+                connect_timeout_s=self.connect_timeout_s,
+                pid=rep.proc.pid if rep.proc else None,
+                drained=True)
+            self.router.control(rep.instance, "ping",
+                                timeout_s=probe_timeout_s)
+            self.router.set_drained(rep.instance, False)
+        except BaseException:
+            # failed bring-up must not leak the process or a dead
+            # handle: reap both, leave the fleet exactly as it was
+            self._replicas.pop(rep.instance, None)
+            self.router.remove_replica(rep.instance,
+                                       reason="admission failed")
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.kill()
+                try:
+                    rep.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    log.warning("unadmitted replica %s did not reap",
+                                rep.instance)
+            raise
+        self.n_replicas = len(self.member_instances())
+        self._event("replica_added", instance=rep.instance,
+                    pid=rep.proc.pid if rep.proc else None,
+                    members=self.n_replicas)
+        self._write_status()
+        log.info("%s replica %s admitted after health probe "
+                 "(%d members)", LOG_PREFIX, rep.instance,
+                 self.n_replicas)
+        return rep.instance
+
+    def remove_replica(self, instance: str,
+                       drain_timeout_s: float = 30.0) -> dict:
+        """Shrink the fleet by retiring ``instance``, shed-never-hang:
+        mark it retiring (supervision stops restarting it), drain via
+        the router (no new dispatches; in-flight batches finish), then
+        retire the handle and terminate the process.  A victim that
+        dies mid-drain - SIGKILL included - is already owned by the
+        router's failover: anything it stranded re-dispatches to
+        survivors, and removal proceeds."""
+        rep = self._replicas.get(instance)
+        if rep is None:
+            raise FleetError(f"unknown replica {instance!r}")
+        if rep.retiring:
+            return {"instance": instance, "already_retiring": True}
+        rep.retiring = True
+        report: dict = {"instance": instance, "drained": False}
+        t0 = time.perf_counter()
+        try:
+            self.router.set_drained(instance, True)
+            report["drained"] = self.router.wait_drained(
+                instance, drain_timeout_s)
+        except FleetError:
+            # already out of router membership (died mid-drain and a
+            # racing removal reaped the handle): failover owned its
+            # in-flight work, nothing left to drain
+            report["drained"] = True
+        self.router.remove_replica(instance, reason="scale_down")
+        if rep.proc is not None and rep.proc.poll() is None:
+            rep.proc.terminate()
+            deadline = time.monotonic() + 10.0
+            while rep.proc.poll() is None \
+                    and time.monotonic() < deadline:
+                time.sleep(QUANTUM_S)
+            if rep.proc.poll() is None:
+                rep.proc.kill()
+                try:
+                    rep.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    log.warning("retired replica %s did not reap",
+                                instance)
+        self._replicas.pop(instance, None)
+        self.n_replicas = max(1, len(self.member_instances()))
+        report["drain_s"] = round(time.perf_counter() - t0, 4)
+        self._event("replica_retired", **report,
+                    members=len(self.member_instances()))
+        self._write_status()
+        log.info("%s replica %s retired (drained=%s, %.2fs, %d "
+                 "members left)", LOG_PREFIX, instance,
+                 report["drained"], report["drain_s"],
+                 len(self.member_instances()))
+        return report
 
     # -- rolling deploy -----------------------------------------------------
     def rolling_deploy(self, version: str,
@@ -691,7 +830,7 @@ class FleetController:
             }
         with self._events_lock:
             events = [dict(e) for e in self._events]
-        return {
+        out = {
             "t": time.time(),
             "registry_root": self.registry_root,
             "stable_version": self.registry.stable,
@@ -702,6 +841,12 @@ class FleetController:
             "shards": dict(self.aggregator.last_report),
             "events": events,
         }
+        if self.autoscaler is not None:
+            try:
+                out["autoscaler"] = self.autoscaler.snapshot()
+            except Exception as e:  # noqa: BLE001 - status must publish
+                out["autoscaler"] = {"error": str(e)}
+        return out
 
     def _write_status(self, shards=None) -> None:
         """Atomically publish the status doc (tempfile + replace: a

@@ -570,15 +570,20 @@ class FleetRouter:
     # -- replica membership -------------------------------------------------
     def add_replica(self, instance: str, socket_path: str,
                     connect_timeout_s: float = 60.0,
-                    pid: Optional[int] = None) -> ReplicaHandle:
+                    pid: Optional[int] = None,
+                    drained: bool = False) -> ReplicaHandle:
         """Connect a replica's channel (unix path or ``host:port``) and
         start its receiver thread.  Re-adding an instance name (a
         restarted worker) replaces the dead handle; its in-flight work
-        was already failed over."""
+        was already failed over.  ``drained=True`` admits the handle
+        with dispatch OFF - the scale-up path's probe gate: control
+        traffic (ping) flows, score traffic waits for the explicit
+        undrain after the health probe passes."""
         channel = connect(socket_path, timeout_s=connect_timeout_s)
         handle = ReplicaHandle(instance, channel, pid=pid,
                                address=socket_path,
                                eject_after=self.eject_after)
+        handle.drained = bool(drained)
         handle.receiver = _ctx_thread(
             self._receive_loop, f"tx-fleet-recv-{instance}", handle)
         with self._handles_lock:
@@ -606,6 +611,36 @@ class FleetRouter:
         if h is None:
             raise FleetError(f"unknown replica {instance!r}")
         return h
+
+    def remove_replica(self, instance: str,
+                       reason: str = "scale_down",
+                       timeout_s: float = 5.0) -> None:
+        """Retire a replica from membership entirely (scale-down):
+        stop dispatching to it, fail over anything still pending to
+        survivors, close the channel, and FORGET the handle - unlike
+        ejection, which keeps the handle around for probe-gated
+        readmission.  Idempotent on unknown names (a victim that
+        crashed mid-drain may already be gone)."""
+        with self._handles_lock:
+            handle = self._handles.pop(instance, None)
+        if handle is None:
+            return
+        with handle.lock:
+            handle.alive = False
+            handle.drained = True
+            orphans = list(handle.pending.values())
+            handle.pending.clear()
+            handle.in_flight_rows = 0
+        handle.channel.close()
+        self._capacity.set()  # wake a parked dispatcher to re-plan
+        tracer().event("fleet.remove", instance=instance,
+                       reason=str(reason))
+        log.info("%s replica %s removed from membership (%s): %d "
+                 "in-flight request(s) failing over", LOG_PREFIX,
+                 instance, reason, len(orphans))
+        self._requeue_orphans(handle, orphans, f"removed: {reason}")
+        if handle.receiver is not None:
+            handle.receiver.join(timeout_s)
 
     # -- submission ---------------------------------------------------------
     def _priority(self, tenant: Optional[str]) -> int:

@@ -119,6 +119,15 @@ class ReplicaWorker:
         self.accept_timeout_s = float(accept_timeout_s)
         self._endpoint_kw = dict(endpoint_kw)
         self._factory = load_workflow_factory(workflow_spec)
+        # live-retunable serving knobs (ISSUE 19 satellite): the
+        # worker-side mirror of MicroBatchScheduler.retune - the
+        # ``retune`` control verb applies them between batches.
+        # max_batch_size caps the score-chunk size (smaller chunks pad
+        # to smaller XLA buckets); None = hand-set default (whole
+        # batch, endpoint bucket chunking only)
+        self.max_batch_size: Optional[int] = None
+        self.max_wait_us: Optional[int] = None
+        self.knob_source = "hand_set"
         self._stopping = False
         self._in_flight_rows = 0
         self.rows_scored = 0
@@ -181,9 +190,39 @@ class ReplicaWorker:
             "batches": self.batches,
             "in_flight_rows": self._in_flight_rows,
             "deadline_dropped": self.deadline_dropped,
+            "knobs": self.knobs(),
             "wire": self._wire_stats(),
             "uptime_s": round(time.monotonic() - self.started_at, 3),
         }
+
+    # -- live knobs ---------------------------------------------------------
+    def knobs(self) -> dict:
+        """Current live knobs + provenance (the
+        ``MicroBatchScheduler.knobs()`` contract, worker-side)."""
+        return {"max_batch_size": self.max_batch_size,
+                "max_wait_us": self.max_wait_us,
+                "source": self.knob_source}
+
+    def retune(self, max_batch_size: Optional[int] = None,
+               max_wait_us: Optional[int] = None,
+               source: str = "autotune") -> dict:
+        """Apply knob changes live, between batches (the
+        ``MicroBatchScheduler.retune()`` contract: atomic attribute
+        writes, returns what was applied).  ``max_batch_size <= 0``
+        resets to the hand-set default (no chunk cap)."""
+        applied: dict = {}
+        if max_batch_size is not None:
+            cap = int(max_batch_size)
+            self.max_batch_size = cap if cap > 0 else None
+            applied["max_batch_size"] = self.max_batch_size
+        if max_wait_us is not None:
+            # recorded for knob-contract parity; the single-threaded
+            # serve loop has no micro-batch wait to apply it to
+            self.max_wait_us = max(0, int(max_wait_us))
+            applied["max_wait_us"] = self.max_wait_us
+        if applied:
+            self.knob_source = str(source)
+        return applied
 
     def _wire_stats(self) -> dict:
         chan = self._chan
@@ -358,7 +397,7 @@ class ReplicaWorker:
         _faults.inject_kill("bulk.replica_die_midshard")
         self._in_flight_rows = len(records)
         try:
-            results, info = self.controller.score_batch_with_info(records)
+            results, info = self._score_records(records)
         except Exception as e:  # noqa: BLE001 - per-request isolation
             self._send(chan, OP_ERROR, rid,
                        {"error": f"{type(e).__name__}: {e}"})
@@ -376,6 +415,26 @@ class ReplicaWorker:
         }
         self._send(chan, OP_RESULT, rid, out_meta,
                    encode_results(results))
+
+    def _score_records(self, records: list) -> tuple:
+        """Score one wire batch, honoring the live ``max_batch_size``
+        chunk cap: smaller chunks pad to smaller XLA buckets, which is
+        exactly the knob the autoscaler's A/B retune probes.  Chunk
+        canary_rows are summed; version/generation come from the last
+        chunk (a deploy cannot land mid-batch - the replica is drained
+        first)."""
+        cap = self.max_batch_size
+        if not cap or len(records) <= cap:
+            return self.controller.score_batch_with_info(records)
+        results: list = []
+        canary_rows = 0
+        info: dict = {}
+        for i in range(0, len(records), cap):
+            chunk, info = self.controller.score_batch_with_info(
+                records[i:i + cap])
+            results.extend(chunk)
+            canary_rows += int(info.get("canary_rows", 0) or 0)
+        return results, dict(info, canary_rows=canary_rows)
 
     # -- control ------------------------------------------------------------
     def _handle_control(self, chan: FleetChannel, rid: int,
@@ -457,6 +516,14 @@ class ReplicaWorker:
             decision = ctl.check_canary()
             return {"ok": True,
                     "decision": decision.to_json() if decision else None}
+        if cmd == "retune":
+            applied = self.retune(
+                max_batch_size=meta.get("max_batch_size"),
+                max_wait_us=meta.get("max_wait_us"),
+                source=str(meta.get("source", "autotune")))
+            self._ship_soon()
+            return {"ok": True, "applied": applied,
+                    "knobs": self.knobs()}
         if cmd == "stop":
             self._stopping = True
             return {"ok": True, "stopping": True}

@@ -472,3 +472,16 @@ def health_views(metrics_doc: dict):
         if key.partition("/")[0] == "fleet_health" \
                 and isinstance(snap, dict):
             yield key, snap
+
+
+def autoscaler_views(metrics_doc: dict):
+    """``(view_key, snapshot)`` pairs for every ``autoscaler`` view in
+    one metrics document (the ISSUE-19 elastic-capacity control loop
+    registers exactly one per process) - the shared filter for
+    consumers surfacing scale-decision state from a control-plane
+    shard (``tx fleet status`` over an aggregation dir, dashboards
+    scraping ``tx_autoscaler_*``)."""
+    for key, snap in (metrics_doc.get("views") or {}).items():
+        if key.partition("/")[0] == "autoscaler" \
+                and isinstance(snap, dict):
+            yield key, snap

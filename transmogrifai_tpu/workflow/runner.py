@@ -689,8 +689,15 @@ class OpWorkflowRunner:
         replicas, tenants under priority 1 shed loudly),
         ``fleet_response_timeout_s`` (per-request silence ceiling
         driving ejection), ``fleet_deadline_ms`` (per-batch deadline
-        that rides the wire so replicas drop abandoned work).  Exports
-        the one-document fleet status + router counters to
+        that rides the wire so replicas drop abandoned work).
+        ISSUE-19 knobs: the ReplicaHealth eject/readmit pair
+        ``fleet_eject_after`` / ``fleet_probe_interval_s`` /
+        ``fleet_probe_timeout_s`` (surfaced params, not
+        constructor-only defaults), and the elastic-capacity loop -
+        ``fleet_autoscale`` (bool, attach a FleetAutoscaler),
+        ``fleet_min_replicas`` / ``fleet_max_replicas``,
+        ``fleet_autoscale_interval_s``, ``fleet_target_utilization``.
+        Exports the one-document fleet status + router counters to
         ``<metrics_location>/fleet_metrics.json``."""
         from ..fleet import FleetController
         from ..registry import ModelRegistry
@@ -749,6 +756,15 @@ class OpWorkflowRunner:
         step = max(int(cp.get("fleet_batch_rows", 512)), 1)
         batches = [records[lo:lo + step]
                    for lo in range(0, len(records), step)]
+        health_kw = {}
+        if cp.get("fleet_eject_after") is not None:
+            health_kw["eject_after"] = int(cp["fleet_eject_after"])
+        if cp.get("fleet_probe_interval_s") is not None:
+            health_kw["probe_interval_s"] = float(
+                cp["fleet_probe_interval_s"])
+        if cp.get("fleet_probe_timeout_s") is not None:
+            health_kw["probe_timeout_s"] = float(
+                cp["fleet_probe_timeout_s"])
         controller = FleetController(
             root, str(spec),
             n_replicas=int(cp.get("fleet_replicas", 2)),
@@ -758,13 +774,29 @@ class OpWorkflowRunner:
             router_kw=router_kw,
             worker_args=worker_args,
             transport=str(cp.get("fleet_transport", "unix")),
+            **health_kw,
         )
         deadline_ms = cp.get("fleet_deadline_ms")
         deadline_ms = None if deadline_ms is None else float(deadline_ms)
         rows_ok = rows_failed = 0
         rolling_report = None
+        autoscaler = None
         with controller:
             import threading
+
+            if cp.get("fleet_autoscale"):
+                from ..fleet import FleetAutoscaler
+
+                autoscaler = FleetAutoscaler(
+                    controller,
+                    min_replicas=int(cp.get("fleet_min_replicas", 1)),
+                    max_replicas=int(cp.get("fleet_max_replicas", 8)),
+                    interval_s=float(
+                        cp.get("fleet_autoscale_interval_s", 0.5)),
+                    target_utilization=float(
+                        cp.get("fleet_target_utilization", 0.7)),
+                )
+                autoscaler.start()
 
             n_threads = max(int(cp.get("fleet_concurrency", 4)), 1)
             lock = threading.Lock()
@@ -812,6 +844,8 @@ class OpWorkflowRunner:
                     f"running at fleet_pump_timeout_s - row counts "
                     f"are partial")
             rows_ok, rows_failed = counts["ok"], counts["failed"]
+            if autoscaler is not None:
+                autoscaler.stop()
             status = controller.status()
         metrics = {
             "run_type": "fleet",
