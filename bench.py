@@ -976,6 +976,279 @@ def _fleet_section(result: dict) -> None:
     }
 
 
+def multimodel_bench() -> dict:
+    """Multi-model serving proof -> MULTIMODEL_BENCH.json (ISSUE 20
+    acceptance): 12 hosted models on a 4-replica fleet under ONE trace
+    id - per-model routed aggregate throughput >= 0.8x the same-run
+    single-model baseline, one model hot-swapped canary->promote WHILE
+    another rolls back with zero dropped/mixed rows per model, one
+    replica SIGKILLed mid-traffic with exact per-model row
+    conservation, and the cold-model hit p99 bounded by the AOT
+    rehydrate deserialize (never a retrace)."""
+    import signal
+    import threading
+
+    import jax
+
+    from transmogrifai_tpu.fleet import (
+        FleetController,
+        ModelTable,
+        PlacementPlanner,
+        encode_records,
+    )
+    from transmogrifai_tpu.obs.trace import tracer
+    from transmogrifai_tpu.registry import ModelRegistry
+    from transmogrifai_tpu.testkit.drills import serving_fleet_workflow
+
+    spec = "transmogrifai_tpu.testkit.drills:serving_fleet_workflow"
+    out: dict = {"platform": jax.default_backend()}
+    wf, records = serving_fleet_workflow()
+    model = wf.train()
+    work_root = tempfile.mkdtemp(prefix="tx-mm-bench-")
+    root = os.path.join(work_root, "registry")
+    reg = ModelRegistry(root)
+    v1 = reg.publish(model, stage="stable").version
+    v2 = reg.publish(model).version
+    v3 = reg.publish(model).version
+    model_ids = [f"m{i:02d}" for i in range(12)]
+    batch_rows = 256
+    batch = (records * (batch_rows // len(records) + 1))[:batch_rows]
+    payload = encode_records(batch)
+    window_s = 3.0
+    n_threads = 8
+
+    def sustained(fc, ids, window=None) -> dict:
+        """Pump concurrent model-routed load (round-robin over ``ids``;
+        ``[None]`` = the un-routed single-model lane) for one window;
+        per-model delivered rows, zero-drop proof."""
+        stop_at = time.monotonic() + (window or window_s)
+        per_model: dict = {}
+        errs: list = []
+        lock = threading.Lock()
+
+        def pump(i: int) -> None:
+            mid = ids[i % len(ids)]
+            rows = 0
+            while time.monotonic() < stop_at:
+                try:
+                    rows += fc.router.submit(
+                        payload=payload, n_rows=batch_rows,
+                        model_id=mid).wait(120.0).n_rows
+                except Exception as e:  # noqa: BLE001 - counted
+                    with lock:
+                        errs.append(f"{type(e).__name__}: {e}")
+            with lock:
+                key = mid or "_default"
+                per_model[key] = per_model.get(key, 0) + rows
+        threads = [threading.Thread(target=pump, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        total = sum(per_model.values())
+        return {"rows": total, "wall_s": round(wall, 3),
+                "rows_per_s": round(total / wall, 1),
+                "per_model_rows": dict(sorted(per_model.items())),
+                "errors": errs[:8]}
+
+    with tracer().span("multimodel-bench") as bench_span:
+        out["trace_id"] = bench_span.trace_id
+        fc = FleetController(
+            root, spec, n_replicas=4,
+            work_dir=os.path.join(work_root, "fleet"),
+            models={model_ids[0]: v1},
+            # replication=4: every model hosted on every replica, so the
+            # multiplex measurement isolates the model-table machinery
+            # (per-model endpoints, LRU, quota ledger) from placement
+            # fan-in - and SIGKILL survivors still host everything.
+            placement=PlacementPlanner(replication=4),
+            router_kw={"max_in_flight_per_replica": 3,
+                       "max_queue": 512},
+            worker_args=["--buckets", "1,8,32,128,512"],
+            max_restarts=0,
+        )
+        try:
+            fc.start()
+            fc.router.score_batch(batch, timeout_s=120.0)  # warm
+            fc.router.score_batch(batch, timeout_s=120.0,
+                                  model_id=model_ids[0])
+            # -- same-run single-model baseline (un-routed lane, all
+            # four replicas serving ONE model) ------------------------
+            # -- multiplex: grow to 12 hosted models at runtime -------
+            t0 = time.monotonic()
+            for mid in model_ids[1:]:
+                fc.host_model(mid, v1)
+            out["host_12_models_wall_s"] = round(
+                time.monotonic() - t0, 3)
+            out["placement"] = fc.placement.to_json()
+            out["models_hosted"] = len(fc.models)
+            out["replicas"] = len(fc.member_instances())
+            for mid in model_ids:  # one warm batch per model
+                fc.router.score_batch(batch, timeout_s=120.0,
+                                      model_id=mid)
+            # unrecorded pre-warm window so the baseline and multiplex
+            # measurements below see an equally warm fleet (single-CPU
+            # hosts are brutally order-sensitive: the first sustained
+            # window pays JIT/page-cache warm-up whoever runs it)
+            sustained(fc, model_ids, window=1.0)
+            sustained(fc, [None], window=1.0)
+            baseline = sustained(fc, [None])
+            out["single_model_baseline"] = baseline
+            # routed flavour of the same baseline (one model through the
+            # model table) - reported for transparency; the acceptance
+            # ratio below compares against the stricter un-routed number
+            out["routed_single_model_baseline"] = sustained(
+                fc, [model_ids[0]])
+            multi = sustained(fc, model_ids)
+            out["multiplexed_12_models"] = multi
+            ratio = (multi["rows_per_s"] / baseline["rows_per_s"]
+                     if baseline["rows_per_s"] else None)
+            out["multiplex_throughput_ratio"] = (
+                round(ratio, 4) if ratio is not None else None)
+            out["acceptance_ratio_08"] = bool(ratio and ratio >= 0.8)
+            # -- concurrent independent canaries mid-traffic: m00
+            # hot-swaps canary->promote WHILE m01 rolls back ----------
+            stop = threading.Event()
+            per_model: dict = {}
+            errors: list = []
+            lock = threading.Lock()
+
+            def pump2(mid: str) -> None:
+                rows = 0
+                mixed = 0
+                while not stop.is_set():
+                    try:
+                        res = fc.router.submit(
+                            payload=payload, n_rows=batch_rows,
+                            model_id=mid).wait(120.0)
+                        rows += res.n_rows
+                        if res.n_rows != batch_rows:
+                            mixed += 1
+                    except Exception as e:  # noqa: BLE001 - counted
+                        with lock:
+                            errors.append(f"{type(e).__name__}: {e}")
+                with lock:
+                    per_model[mid] = {
+                        "rows": rows, "short_batches": mixed}
+            threads = [threading.Thread(target=pump2, args=(mid,))
+                       for mid in model_ids[:4] for _ in range(2)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            t0 = time.monotonic()
+            fc.start_model_canary(model_ids[0], v2, fraction=0.5)
+            fc.start_model_canary(model_ids[1], v3, fraction=0.5)
+            time.sleep(0.8)
+            fc.promote_model_canary(model_ids[0])
+            fc.rollback_model_canary(model_ids[1], reason="bench")
+            canary_wall = time.monotonic() - t0
+            time.sleep(0.3)
+            # -- one replica SIGKILLed mid-traffic --------------------
+            victim = fc._replicas["replica-3"]
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            time.sleep(1.5)
+            stop.set()
+            for t in threads:
+                t.join(timeout=120.0)
+            snap = fc.router.snapshot()
+            out["concurrent_canaries"] = {
+                "promoted": {model_ids[0]: fc.models[model_ids[0]]},
+                "rolled_back": {model_ids[1]: fc.models[model_ids[1]]},
+                "lifecycle_wall_s": round(canary_wall, 3),
+                "independent": fc.models[model_ids[0]] == v2
+                and fc.models[model_ids[1]] == v1,
+            }
+            out["replica_kill"] = {
+                "replica_deaths": snap["replica_deaths"],
+                "requests_retried": snap["retries"],
+                "dropped": len(errors),
+                "per_model": {m: d for m, d in
+                              sorted(per_model.items())},
+                "rows_conserved": all(
+                    d["short_batches"] == 0
+                    for d in per_model.values()),
+            }
+            out["rows_by_model"] = snap["rows_by_model"]
+            out["multimodel_drills_ok"] = bool(
+                not errors
+                and out["concurrent_canaries"]["independent"]
+                and out["replica_kill"]["rows_conserved"]
+                and snap["replica_deaths"] == 1)
+        finally:
+            fc.stop()
+
+        # -- cold-model hit p99 vs rehydrate (in-process table) -------
+        from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+        twf, _d, trecords, _p = tiny_drill_pipeline()
+        tmodel = twf.train()
+        troot = os.path.join(work_root, "tiny-registry")
+        treg = ModelRegistry(troot)
+        tv = treg.publish(tmodel, stage="stable").version
+        table = ModelTable(treg, lambda: tiny_drill_pipeline()[0],
+                           max_resident=4, evict_min_interval_s=0.0,
+                           batch_buckets=(1, 8, 32))
+        tbatch = trecords[:32]
+        for i in range(12):
+            table.host(f"t{i:02d}", tv)
+        # LRU distance 12 over a 4-slot cache: every round-robin hit is
+        # cold (rehydrate = AOT deserialize), measured by the table
+        for _ in range(3):
+            for i in range(12):
+                table.score(f"t{i:02d}", tbatch)
+        warm_ms: list = []
+        hot = f"t{11:02d}"
+        for _ in range(20):
+            t0 = time.perf_counter()
+            table.score(hot, tbatch)
+            warm_ms.append((time.perf_counter() - t0) * 1e3)
+        tsnap = table.snapshot()
+        warm_ms.sort()
+        warm_p99 = warm_ms[int(0.99 * (len(warm_ms) - 1))]
+        cold_p99 = tsnap["cold_hit_ms"]["p99"]
+        rehydrate_p99 = tsnap["rehydrate_ms"]["p99"]
+        out["cold_hit"] = {
+            "cold_hits": tsnap["cold_hits"],
+            "evictions": tsnap["evictions"],
+            "rehydrate_ms": tsnap["rehydrate_ms"],
+            "cold_hit_ms": tsnap["cold_hit_ms"],
+            "warm_p99_ms": round(warm_p99, 3),
+            # a cold hit must cost warm + deserialize, never a retrace:
+            # the bound is the measured rehydrate p99 plus warm scoring
+            # overheads, with slack far below any compile wall
+            "bound_ms": round(rehydrate_p99 + 5 * max(warm_p99, 1.0)
+                              + 20.0, 3),
+            "p99_bounded_by_rehydrate": bool(
+                cold_p99 <= rehydrate_p99 + 5 * max(warm_p99, 1.0)
+                + 20.0),
+        }
+    return out
+
+
+def _multimodel_section(result: dict) -> None:
+    mm = multimodel_bench()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTIMODEL_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(dict(mm,
+                       bench_commit=result.get("bench_commit",
+                                               "unknown")),
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+    result["multimodel"] = {
+        "models_hosted": mm.get("models_hosted"),
+        "multiplex_throughput_ratio":
+            mm.get("multiplex_throughput_ratio"),
+        "acceptance_ratio_08": mm.get("acceptance_ratio_08"),
+        "multimodel_drills_ok": mm.get("multimodel_drills_ok"),
+        "cold_hit_p99_bounded":
+            mm.get("cold_hit", {}).get("p99_bounded_by_rehydrate"),
+    }
+
+
 def autoscale_bench() -> dict:
     """Elastic autoscaling proof -> AUTOSCALE_BENCH.json (ISSUE 19
     acceptance): one traffic-ramp drill over a live loopback-TCP fleet
@@ -4253,6 +4526,27 @@ if __name__ == "__main__":
         except Exception:
             _res["bench_commit"] = "unknown"
         _fleet_section(_res)
+        print(json.dumps(_res))
+        sys.exit(0)
+    if "--multimodel" in sys.argv:
+        # model-multiplexed fleet proof: writes MULTIMODEL_BENCH.json
+        # (12 models on 4 replicas under one trace id: >=0.8x
+        # single-model aggregate, concurrent canary promote+rollback,
+        # SIGKILL per-model conservation, cold-hit p99 vs rehydrate)
+        # and prints it (ISSUE 20)
+        _ensure_working_backend()
+        _res = {}
+        try:
+            import subprocess as _sp
+
+            _res["bench_commit"] = _sp.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _res["bench_commit"] = "unknown"
+        _multimodel_section(_res)
         print(json.dumps(_res))
         sys.exit(0)
     if "--autoscale" in sys.argv:

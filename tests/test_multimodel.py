@@ -1,0 +1,538 @@
+"""Multi-model serving drills (ISSUE 20; fleet/multimodel.py).
+
+The fleet as a model-multiplexed platform: the per-replica
+:class:`ModelTable` (weighted LRU over AOT executables - evict cold,
+rehydrate by deserialize, never retrace), the router's per-model
+dispatch/quotas, the cost-model-driven :class:`PlacementPlanner`
+re-planned on membership changes, and the per-model canary lifecycle
+(two hosted models canary concurrently; one promotes while the other
+rolls back).  The ``fleet.model_evict_storm`` fault proves eviction
+thrash stays rate-bounded.
+
+All drills are seeded: the drill pipeline's data seed and deterministic
+placement ties pin every run to the same schedule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from transmogrifai_tpu.faults import injection as faults
+from transmogrifai_tpu.fleet import (
+    FleetController,
+    FleetRouter,
+    ModelQuotaError,
+    ModelTable,
+    MultiModelError,
+    PlacementPlanner,
+    UnhostedModelError,
+    UnknownModelError,
+    format_models_arg,
+    parse_models_arg,
+)
+from transmogrifai_tpu.fleet.multimodel import artifact_cache_bytes
+from transmogrifai_tpu.registry import ModelRegistry
+from transmogrifai_tpu.testkit.drills import tiny_drill_pipeline
+
+WORKFLOW_SPEC = "transmogrifai_tpu.testkit.drills:tiny_drill_pipeline"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# shared registry: one tiny trained model published as three versions
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mm_registry(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("mm-registry"))
+    wf, _data, records, pred_name = tiny_drill_pipeline()
+    model = wf.train()
+    reg = ModelRegistry(root)
+    v1 = reg.publish(model, stage="stable")
+    v2 = reg.publish(model)
+    v3 = reg.publish(model)
+    return {
+        "root": root, "records": records, "pred_name": pred_name,
+        "v1": v1.version, "v2": v2.version, "v3": v3.version,
+    }
+
+
+def _fresh_workflow():
+    return tiny_drill_pipeline()[0]
+
+
+def _table(mm_registry, **kw):
+    kw.setdefault("batch_buckets", (1, 8, 32))
+    reg = ModelRegistry(mm_registry["root"], create=False)
+    return ModelTable(reg, _fresh_workflow, **kw)
+
+
+def _wait_status(fc, cond, timeout_s=45.0):
+    """Poll the controller's status doc until ``cond(doc)`` holds (the
+    per-model rows fold from obs shards shipped on an interval)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        doc = fc.status()
+        if cond(doc):
+            return doc
+        if time.monotonic() >= deadline:
+            return doc  # let the caller's assert show the state
+        time.sleep(0.2)
+
+
+def _controller(mm_registry, tmp_path, n_replicas, **kw):
+    kw.setdefault("router_kw", {})
+    kw["router_kw"].setdefault("max_in_flight_per_replica", 2)
+    kw["router_kw"].setdefault("max_queue", 64)
+    return FleetController(
+        mm_registry["root"], WORKFLOW_SPEC,
+        n_replicas=n_replicas, work_dir=str(tmp_path / "fleet"),
+        ship_interval_s=0.15, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the --models grammar (worker argv and controller must never drift)
+# ---------------------------------------------------------------------------
+def test_models_arg_roundtrip_and_rejects_blanks():
+    models = {"alpha": "v1", "beta": "v2"}
+    assert parse_models_arg(format_models_arg(models)) == models
+    assert parse_models_arg(" alpha = v1 , beta=v2 ,") == models
+    with pytest.raises(ValueError):
+        parse_models_arg("alpha")  # no '='
+    with pytest.raises(ValueError):
+        parse_models_arg("=v1")
+    with pytest.raises(ValueError):
+        parse_models_arg(",,")
+
+
+def test_artifact_cache_bytes_weighs_published_versions(mm_registry):
+    reg = ModelRegistry(mm_registry["root"], create=False)
+    w = artifact_cache_bytes(reg, mm_registry["v1"])
+    assert w > 0
+    assert artifact_cache_bytes(reg, "v999") == 0  # unknown weighs 0
+
+
+# ---------------------------------------------------------------------------
+# PlacementPlanner: deterministic, replicated, harmonic capacities
+# ---------------------------------------------------------------------------
+def test_placement_replicates_and_is_deterministic():
+    planner = PlacementPlanner(replication=2)
+    models = [
+        {"model_id": "a", "weight_bytes": 300, "rows_per_s": 1000.0},
+        {"model_id": "b", "weight_bytes": 200, "rows_per_s": 4000.0},
+        {"model_id": "c", "weight_bytes": 100, "rows_per_s": 2000.0},
+    ]
+    insts = ["replica-0", "replica-1", "replica-2"]
+    plan = planner.plan(models, insts)
+    # every model lands on exactly `replication` replicas
+    for m in ("a", "b", "c"):
+        assert len(plan.hosts(m)) == 2, plan.assignments
+    # deterministic: a fresh planner over the same input re-derives the
+    # same assignments (re-planning must not shuffle gratuitously)
+    again = PlacementPlanner(replication=2).plan(models, insts)
+    assert again.assignments == plan.assignments
+    assert plan.rev == 1 and planner.plan(models, insts).rev == 2
+
+
+def test_placement_capacity_is_the_harmonic_blend():
+    planner = PlacementPlanner(replication=1)
+    models = [
+        {"model_id": "fast", "rows_per_s": 4000.0},
+        {"model_id": "slow", "rows_per_s": 1000.0},
+    ]
+    plan = planner.plan(models, ["only"])
+    # one replica hosting both: 2 / (1/4000 + 1/1000) = 1600, NOT the
+    # arithmetic mean 2500 - the slow model drags the achievable rate
+    assert plan.replica_capacity("only") == pytest.approx(1600.0)
+    assert plan.mean_capacity() == pytest.approx(1600.0)
+    doc = plan.to_json()
+    assert doc["assignments"]["only"] == ["fast", "slow"]
+    assert doc["model_rows_s"]["slow"] == 1000.0
+
+
+def test_placement_respects_cache_budget_headroom():
+    planner = PlacementPlanner(replication=1, cache_budget_bytes=250)
+    models = [
+        {"model_id": "big", "weight_bytes": 200, "rows_per_s": 100.0},
+        {"model_id": "mid", "weight_bytes": 150, "rows_per_s": 100.0},
+        {"model_id": "sml", "weight_bytes": 40, "rows_per_s": 100.0},
+    ]
+    plan = planner.plan(models, ["replica-0", "replica-1"])
+    by_inst = plan.pressure_bytes
+    # first-fit-decreasing under the budget: no replica takes big+mid
+    assert max(by_inst.values()) <= 250
+    assert sorted(plan.hosts("big") + plan.hosts("mid")) == [
+        "replica-0", "replica-1"]
+
+
+def test_placement_refuses_an_empty_fleet():
+    with pytest.raises(ValueError):
+        PlacementPlanner().plan([{"model_id": "a"}], [])
+
+
+# ---------------------------------------------------------------------------
+# ModelTable: weighted LRU over AOT executables
+# ---------------------------------------------------------------------------
+def test_table_eviction_and_rehydration_counters_exact(mm_registry):
+    table = _table(mm_registry, max_resident=1,
+                   evict_min_interval_s=0.0)
+    records = mm_registry["records"][:8]
+    table.host("alpha", mm_registry["v1"])
+    table.host("beta", mm_registry["v2"])
+    # max_resident=1: hosting beta evicted alpha (LRU), exactly once
+    rows = {r["model_id"]: r for r in table.rows()}
+    assert rows["beta"]["resident"] and not rows["alpha"]["resident"]
+    assert table.evictions == 1 and table.rehydrations == 0
+    # a hit on the evicted model rehydrates from the artifact's AOT
+    # cache (deserialize, not retrace) and is measured
+    results, info = table.score("alpha", records)
+    assert len(results) == 8 and info["model_id"] == "alpha"
+    assert info["cold_hit"] is True and info["rehydrate_ms"] > 0
+    assert table.rehydrations == 1 and table.cold_hits == 1
+    snap = table.snapshot()
+    assert snap["rehydrate_ms"]["p99"] is not None
+    assert snap["cold_hit_ms"]["p99"] is not None
+    # the rehydrate pushed beta out in turn; a warm re-hit on alpha is
+    # NOT a cold hit
+    rows = {r["model_id"]: r for r in table.rows()}
+    assert rows["alpha"]["resident"] and not rows["beta"]["resident"]
+    _results, info = table.score("alpha", records)
+    assert "cold_hit" not in info
+    assert table.cold_hits == 1
+
+
+def test_table_unknown_model_is_loud(mm_registry):
+    table = _table(mm_registry)
+    table.host("alpha", mm_registry["v1"])
+    with pytest.raises(UnknownModelError):
+        table.score("ghost", mm_registry["records"][:4])
+    assert table.unknown_model_errors == 1
+
+
+def test_table_canary_pins_model_against_eviction(mm_registry):
+    table = _table(mm_registry, max_resident=1,
+                   evict_min_interval_s=0.0)
+    table.host("alpha", mm_registry["v1"])
+    table.start_canary("alpha", mm_registry["v3"], fraction=0.5)
+    table.host("beta", mm_registry["v2"])
+    # pressure wants alpha out (LRU) but its in-flight canary pins it
+    rows = {r["model_id"]: r for r in table.rows()}
+    assert rows["alpha"]["resident"]
+    assert rows["alpha"]["canary_version"] == mm_registry["v3"]
+    assert table.evictions == 0
+    with pytest.raises(MultiModelError):
+        table.unhost("alpha")  # pinned models cannot be dropped either
+    gen = table.promote_canary("alpha")
+    assert gen.version == mm_registry["v3"]
+    rows = {r["model_id"]: r for r in table.rows()}
+    assert rows["alpha"]["version"] == mm_registry["v3"]
+    # promotion releases the pin: the next pressure wave can evict
+    table.host("beta", mm_registry["v2"])
+    assert table.evictions >= 1
+
+
+def test_evict_storm_fault_is_rate_bounded(mm_registry):
+    """``fleet.model_evict_storm`` demands an eviction on EVERY cache
+    decision; the rate bound must absorb the storm into denied-eviction
+    counters instead of thrashing the executable cache."""
+    table = _table(mm_registry, evict_min_interval_s=60.0)
+    records = mm_registry["records"][:4]
+    table.host("alpha", mm_registry["v1"])
+    table.host("beta", mm_registry["v2"])
+    evictions_before = table.evictions
+    faults.configure("fleet.model_evict_storm:every=1")
+    try:
+        for _ in range(4):
+            r1, _ = table.score("alpha", records)
+            r2, _ = table.score("beta", records)
+            assert len(r1) == 4 and len(r2) == 4
+    finally:
+        faults.reset()
+    # at most ONE eviction landed inside the 60s window; every other
+    # storm demand was denied and counted
+    assert table.evictions - evictions_before <= 1
+    assert table.evictions_denied >= 3
+
+
+# ---------------------------------------------------------------------------
+# router: per-model dispatch, hosting fold, quotas (unit, fake replicas)
+# ---------------------------------------------------------------------------
+def _fake_router(model_quotas=None):
+    import socket as socket_mod
+
+    from transmogrifai_tpu.fleet.channel import FleetChannel
+    from transmogrifai_tpu.fleet.router import ReplicaHandle
+
+    router = FleetRouter(start=False, model_quotas=model_quotas)
+    socks = []
+    for i in range(2):
+        a, b = socket_mod.socketpair(socket_mod.AF_UNIX,
+                                     socket_mod.SOCK_STREAM)
+        socks.append(b)
+        router._handles[f"replica-{i}"] = ReplicaHandle(
+            f"replica-{i}", FleetChannel(a))
+    return router, socks
+
+
+def test_router_dispatch_filters_on_hosting():
+    router, _socks = _fake_router()
+    try:
+        router.set_hosting({"replica-0": ["alpha"],
+                            "replica-1": ["beta"]})
+        assert router.hosting_map() == {"replica-0": ["alpha"],
+                                        "replica-1": ["beta"]}
+        h = router._pick(8, model_id="beta")
+        assert h is not None and h.instance == "replica-1"
+        assert router._pick(8, model_id="alpha").instance == "replica-0"
+        assert router._pick(8) is not None  # unpinned: anyone
+    finally:
+        router.close()
+
+
+def test_router_unhosted_model_sheds_loudly():
+    router, _socks = _fake_router()
+    try:
+        router.set_hosting({"replica-0": ["alpha"], "replica-1": []})
+        with pytest.raises(UnhostedModelError):
+            router.submit(records=[{"a": 1.0}], model_id="ghost")
+        assert router.snapshot()["unhosted_model_errors"] == 1
+    finally:
+        router.close()
+
+
+def test_router_per_model_quota_bounds_in_flight_rows():
+    router, _socks = _fake_router(model_quotas={"alpha": 8})
+    try:
+        router.set_hosting({"replica-0": ["alpha", "beta"],
+                            "replica-1": ["alpha", "beta"]})
+        records = [{"a": float(i)} for i in range(6)]
+        router.submit(records=records, model_id="alpha")
+        # 6 rows in flight (the fakes never answer); 6 + 6 > 8 -> shed
+        with pytest.raises(ModelQuotaError):
+            router.submit(records=records, model_id="alpha")
+        # quota is per model: beta is unaffected
+        router.submit(records=records, model_id="beta")
+        snap = router.snapshot()
+        assert snap["shed_model_quota"] == 1
+    finally:
+        router.close()
+
+
+def test_refresh_from_shards_folds_hosting_from_replica_view():
+    router, _socks = _fake_router()
+    try:
+        docs = [
+            {"instance": "replica-0",
+             "views": {
+                 "serving/0": {"batch_rows_per_s": 1000.0,
+                               "latency_ms": {"p99": 4.0},
+                               "queue_depth": {}, "rows_scored": 10},
+                 "fleet_replica/0": {
+                     "models": [{"model_id": "alpha"},
+                                {"model_id": "gamma"}]},
+             }},
+        ]
+        router.refresh_from_shards(docs)
+        h = router._handles["replica-0"]
+        assert h.hosted_models == {"alpha", "gamma"}
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a model-multiplexed fleet
+# ---------------------------------------------------------------------------
+def test_multimodel_fleet_dispatch_quota_and_status(mm_registry,
+                                                    tmp_path):
+    records = mm_registry["records"]
+    models = {"alpha": mm_registry["v1"], "beta": mm_registry["v2"]}
+    with _controller(
+            mm_registry, tmp_path, 2, models=models,
+            router_kw={"model_quotas": {"beta": 4096}}) as fc:
+        assert fc.placement is not None and fc.placement.rev >= 1
+        # both replicas host both models (replication=2, width 2)
+        assert sorted(fc.model_hosts("alpha")) == sorted(
+            fc.model_hosts("beta"))
+        out_a = fc.router.score_batch(records[:24], model_id="alpha")
+        out_b = fc.router.score_batch(records[:16], model_id="beta")
+        assert len(out_a) == 24 and len(out_b) == 16
+        assert all(isinstance(r, dict) for r in out_a + out_b)
+        with pytest.raises(UnhostedModelError):
+            fc.router.score_batch(records[:4], model_id="ghost")
+        status = _wait_status(fc, lambda d: (
+            d.get("models", {}).get("alpha", {}).get("rows_scored")
+            == 24
+            and len(d["models"]["alpha"].get("hosts", [])) == 2))
+        rows = status["models"]
+        assert set(rows) == {"alpha", "beta"}
+        assert rows["alpha"]["version"] == mm_registry["v1"]
+        assert rows["alpha"]["rows_delivered"] == 24
+        assert rows["beta"]["rows_delivered"] == 16
+        assert len(rows["alpha"]["hosts"]) == 2
+        assert status["placement"]["rev"] == fc.placement.rev
+        assert status["router"]["rows_by_model"] == {
+            "alpha": 24, "beta": 16}
+        # per-replica table rows summed per model
+        assert rows["alpha"]["rows_scored"] == 24
+        # the status doc is what fleet_status.json carries: the CLI's
+        # per-model rows come straight from it
+        fc._write_status()
+        doc = json.load(open(os.path.join(
+            fc.control_dir, "fleet_status.json")))
+        assert set(doc["models"]) == {"alpha", "beta"}
+
+
+def test_concurrent_canaries_one_promotes_one_rolls_back(mm_registry,
+                                                         tmp_path):
+    """Two hosted models run INDEPENDENT canary lifecycles at once:
+    alpha's canary promotes while beta's rolls back, with zero dropped
+    rows on either model throughout."""
+    records = mm_registry["records"]
+    models = {"alpha": mm_registry["v1"], "beta": mm_registry["v2"]}
+    with _controller(mm_registry, tmp_path, 2, models=models) as fc:
+        fc.start_model_canary("alpha", mm_registry["v3"], fraction=0.5)
+        fc.start_model_canary("beta", mm_registry["v3"], fraction=0.5)
+        assert fc.model_canaries == {"alpha": mm_registry["v3"],
+                                     "beta": mm_registry["v3"]}
+        for _ in range(3):
+            assert len(fc.router.score_batch(
+                records[:16], model_id="alpha")) == 16
+            assert len(fc.router.score_batch(
+                records[:16], model_id="beta")) == 16
+        fc.promote_model_canary("alpha")
+        fc.rollback_model_canary("beta", reason="drill")
+        assert fc.models["alpha"] == mm_registry["v3"]
+        assert fc.models["beta"] == mm_registry["v2"]
+        assert fc.model_canaries == {}
+        # both models keep serving after their (opposite) verdicts
+        out_a = fc.router.score_batch(records[:8], model_id="alpha")
+        out_b = fc.router.score_batch(records[:8], model_id="beta")
+        assert len(out_a) == 8 and len(out_b) == 8
+        rows = _wait_status(fc, lambda d: (
+            d.get("models", {}).get("alpha", {}).get("version")
+            == mm_registry["v3"]))["models"]
+        assert rows["alpha"]["version"] == mm_registry["v3"]
+        assert rows["beta"]["version"] == mm_registry["v2"]
+        assert rows["alpha"]["canary_version"] is None
+        assert rows["beta"]["canary_version"] is None
+
+
+def test_scale_up_replans_placement_and_hosts_on_new_replica(
+        mm_registry, tmp_path):
+    records = mm_registry["records"]
+    models = {"alpha": mm_registry["v1"], "beta": mm_registry["v2"]}
+    with _controller(mm_registry, tmp_path, 1, models=models) as fc:
+        rev0 = fc.placement.rev
+        assert fc.model_hosts("alpha") == ["replica-0"]
+        inst = fc.add_replica()
+        assert fc.placement.rev > rev0
+        assert inst in fc.placement.assignments
+        # the new replica converged onto its assigned models: ask IT
+        doc = fc.router.control(inst, "models", timeout_s=60.0)
+        hosted = {r["model_id"] for r in doc["table"]["models"]}
+        assert hosted == set(fc.placement.models_for(inst))
+        assert len(fc.router.score_batch(records[:16],
+                                         model_id="alpha")) == 16
+
+
+# ---------------------------------------------------------------------------
+# bulk scoring selects a hosted model (satellite)
+# ---------------------------------------------------------------------------
+def test_bulk_job_scores_one_hosted_model(mm_registry, tmp_path):
+    from transmogrifai_tpu.bulk import BulkScoringJob
+    from transmogrifai_tpu.testkit.drills import write_shard_csv
+    from transmogrifai_tpu.utils.uid import reset_uids
+
+    reset_uids()
+    wf, data, _records, _pred = tiny_drill_pipeline(n=80, seed=0)
+    model = wf.train()
+    rows = [{"y": data["y"][i], "a": data["a"][i], "c": data["c"][i]}
+            for i in range(80)]
+    shards = []
+    for k in range(2):
+        p = str(tmp_path / f"in-{k}.csv")
+        write_shard_csv(p, rows[k * 40:(k + 1) * 40])
+        shards.append(p)
+    models = {"alpha": mm_registry["v1"], "beta": mm_registry["v2"]}
+    with _controller(mm_registry, tmp_path, 2, models=models) as fc:
+        jd = str(tmp_path / "job")
+        job = BulkScoringJob(model, jd, shards, router=fc.router,
+                             model_id="alpha", chunk_rows=16, workers=1)
+        summary = job.run()
+        led = summary["ledger"]
+        assert led["rows_in"] == 80 and led["rows_out"] == 80
+        assert led["rows_in"] == led["rows_out"] + led["rows_quarantined"]
+        # the journal records which model scored the job
+        doc = json.load(open(os.path.join(jd, "journal.json")))
+        assert doc["params"]["model_id"] == "alpha"
+        # every delivered row was attributed to alpha
+        assert fc.router.snapshot()["rows_by_model"].get("alpha") == 80
+        # an unhosted model fails LOUDLY before any scoring
+        job2 = BulkScoringJob(model, str(tmp_path / "job2"), shards,
+                              router=fc.router, model_id="ghost",
+                              chunk_rows=16, workers=1)
+        with pytest.raises(UnhostedModelError):
+            job2.run()
+    with pytest.raises(ValueError):
+        BulkScoringJob(model, str(tmp_path / "job3"), shards,
+                       model_id="alpha")  # model_id needs a fleet
+
+
+# ---------------------------------------------------------------------------
+# observability: the model_id label rides the Prometheus exposition
+# ---------------------------------------------------------------------------
+def test_prometheus_exposition_carries_model_id_label():
+    from transmogrifai_tpu.obs import prometheus_text_from_json
+    from transmogrifai_tpu.serving import ServingTelemetry
+
+    tel = ServingTelemetry()
+    tel.set_model_id("alpha")
+    tel.record_batch(8, 8, 0.002)
+    doc = {"views": {"serving/0": tel.snapshot()}, "series": {}}
+    text = prometheus_text_from_json(doc)
+    lines = [ln for ln in text.splitlines()
+             if "rows_scored" in ln and not ln.startswith("#")]
+    assert lines and all('model_id="alpha"' in ln for ln in lines)
+
+
+def test_autoscaler_sizes_from_heterogeneous_capacity_mix():
+    """Satellite: with a placement plan the autoscaler sizes from the
+    per-replica capacity MIX, not ceil(demand / one-capacity)."""
+    from transmogrifai_tpu.fleet.autoscaler import FleetAutoscaler
+    from transmogrifai_tpu.fleet.multimodel import PlacementPlan
+
+    class _Ctl:
+        placement = PlacementPlan(
+            assignments={"replica-0": ["a"], "replica-1": ["b"]},
+            capacity_rows_s={"replica-0": 3000.0, "replica-1": 1000.0},
+            model_rows_s={"a": 3000.0, "b": 1000.0})
+    scaler = FleetAutoscaler.__new__(FleetAutoscaler)
+    scaler.controller = _Ctl()
+    scaler.target_utilization = 0.5
+    scaler.max_replicas = 8
+    capacity = {"per_replica_rows_s": 2000.0}
+    mix = scaler._capacity_mix(["replica-0", "replica-1"], [], capacity)
+    # ratios follow the plan, anchored to the observed absolute level
+    # (mean of the mix == the waterfall estimate)
+    assert mix["replica-0"] == pytest.approx(3000.0)
+    assert mix["replica-1"] == pytest.approx(1000.0)
+    # demand 2500 at 50% target needs 5000 rows/s of capacity: the
+    # 3000 replica + the 1000 replica + one assumed-mean addition
+    n = scaler._sized_target({
+        "demand_rows_s": 2500.0, "capacity_mix": mix,
+        "capacity": {"per_replica_rows_s": 2000.0}})
+    assert n == 3
+    # homogeneous fallback (no plan): byte-for-byte the old rule
+    n = scaler._sized_target({
+        "demand_rows_s": 2500.0, "capacity_mix": {},
+        "capacity": {"per_replica_rows_s": 2000.0}})
+    assert n == 3  # ceil(2500 / (2000 * 0.5))

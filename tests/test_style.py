@@ -672,6 +672,34 @@ def test_autoscaler_drives_the_fleet_via_public_seams_only():
     assert not offenders, offenders
 
 
+def test_multimodel_drives_subsystems_via_public_seams_only():
+    """fleet/multimodel.py composes the registry, the deployment
+    controller, the cost model and the fault plane and may drive them
+    ONLY through their public seams (ISSUE 20 satellite): no
+    single-underscore attribute of ANY foreign object is touched
+    anywhere in the module (``self._x``/``cls._x`` own-state access is
+    the only exception).  The model table and placement planner must
+    survive each subsystem refactoring its internals - a private reach
+    would weld the multiplexing layer to lifecycle implementation
+    details it does not own."""
+    p = ROOT / "fleet" / "multimodel.py"
+    offenders = []
+    tree = ast.parse(p.read_text(encoding="utf-8"))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        attr = node.attr
+        if not attr.startswith("_") or attr.startswith("__"):
+            continue
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            continue
+        offenders.append(f"{p}:{node.lineno} .{attr}")
+    assert not offenders, offenders
+
+
 def test_continuous_drives_subsystems_via_public_seams_only():
     """continuous/ composes five earlier subsystems (reader follow
     mode, drift monitor, fused-train cache, registry, fleet) and may

@@ -41,6 +41,8 @@ __all__ = [
     "CostModel",
     "candidate_features",
     "params_hash",
+    "serve_model_key",
+    "predict_serve_rows_per_s",
 ]
 
 #: artifact format version: bump when the feature layout changes so a
@@ -366,3 +368,27 @@ class CostModel:
 def key_for_fit(family: str) -> str:
     """The workload key candidate-fit observations file under."""
     return f"fit:{family}"
+
+
+def serve_model_key(model_id: str) -> str:
+    """The workload key one hosted model's serve-batch walls file
+    under (ISSUE 20 multi-model placement: per-model cost curves so
+    a slow GBT and a fast LR sharing one fleet get rated apart —
+    the ``serve.batch`` key stays the model-blind aggregate)."""
+    return f"serve.model/{model_id}"
+
+
+def predict_serve_rows_per_s(cost_model: "CostModel", model_id: str,
+                             n_rows: int = 512,
+                             n_features: int = 0) -> Optional[float]:
+    """Predicted serving throughput (rows/s) for one hosted model at a
+    nominal batch shape, from its per-model serve key; None while the
+    key is cold (fewer than ``min_obs`` observations) — callers fall
+    back to observation or a default, never to "free"."""
+    wall_ms = cost_model.predict_wall_ms(
+        serve_model_key(model_id),
+        candidate_features(n_rows, n_features, bucket=float(n_rows)),
+    )
+    if wall_ms is None or wall_ms <= 0.0:
+        return None
+    return float(n_rows) / (wall_ms / 1e3)

@@ -24,7 +24,12 @@ commit-after-durable-write discipline keeps the OUTPUT exactly-once.
 :class:`~..fleet.FleetController`, or a zero-arg callable returning
 either; the job RE-RESOLVES it at every shard boundary so an elastic
 fleet (ISSUE 19) growing or shrinking mid-job fans the next shard out
-to the CURRENT membership, never a stale snapshot.
+to the CURRENT membership, never a stale snapshot.  On a multi-model
+fleet (ISSUE 20) ``model_id=`` pins every batch to ONE hosted model:
+the router dispatches only to replicas hosting it and raises
+``UnhostedModelError`` loudly - at job start and again mid-job if
+hosting vanishes - rather than silently scoring with whatever model a
+replica has; the exactly-once ledger discipline is unchanged.
 
 Fault points: ``bulk.output_crash`` kills the job between the durable
 output-shard write and its journal commit - the canonical "did the
@@ -259,6 +264,7 @@ class BulkScoringJob:
         fused_backend: Optional[str] = None,
         use_native: bool = True,
         router=None,
+        model_id: Optional[str] = None,
         batch_timeout_s: float = 120.0,
         max_in_flight: int = 8,
         instance: Optional[str] = None,
@@ -279,6 +285,12 @@ class BulkScoringJob:
         #: mid-job)
         self._router_source = router
         self.router = self._resolve_router()
+        self.model_id = str(model_id) if model_id else None
+        if self.model_id and self.router is None:
+            raise ValueError(
+                "model_id= selects a hosted model on a multi-model "
+                "fleet; it requires router= (local scoring has exactly "
+                "one model: the one passed in)")
         self.batch_timeout_s = float(batch_timeout_s)
         self.max_in_flight = max(int(max_in_flight), 1)
         self.instance = str(instance) if instance else (
@@ -353,6 +365,7 @@ class BulkScoringJob:
                 "chunk_rows": self.chunk_rows,
                 "workers": self.workers,
                 "mode": "fleet" if self.router is not None else "local",
+                "model_id": self.model_id,
             },
         )
 
@@ -416,6 +429,7 @@ class BulkScoringJob:
                                     recovered, rescored)
             todo = j.uncommitted()
             if todo:
+                self._check_model_hosted()
                 self._score_shards(j, todo)
             wall = time.perf_counter() - t0
             led = j.ledger()
@@ -540,6 +554,21 @@ class BulkScoringJob:
             f"router= must be a FleetRouter, FleetController, or "
             f"callable, got {type(src).__name__}")
 
+    def _check_model_hosted(self) -> None:
+        """Fail LOUDLY before scoring starts when ``model_id=`` names
+        a model no live replica hosts - a billion-row job must not
+        discover an unhosted model one chunk at a time."""
+        if not self.model_id or self.router is None:
+            return
+        if not any(h.alive and h.hosts(self.model_id)
+                   for h in self.router.replicas()):
+            from ..fleet.multimodel import UnhostedModelError
+
+            raise UnhostedModelError(
+                f"bulk job {self.job_dir}: model {self.model_id!r} is "
+                f"not hosted by any live replica; host it "
+                f"(FleetController.host_model) before scoring")
+
     def _submit_chunk(self, chunk, parts: list[bytes],
                       pending: list[Any]) -> None:
         """Dispatch one chunk's records to the fleet; drain the oldest
@@ -548,7 +577,8 @@ class BulkScoringJob:
         records = _records_from_chunk(chunk, self._features)
         while len(pending) >= self.max_in_flight:
             parts.append(self._drain_result(pending.pop(0)))
-        pending.append(self.router.submit(records=records))
+        pending.append(self.router.submit(records=records,
+                                          model_id=self.model_id))
 
     def _drain_result(self, req) -> tuple[bytes, int]:
         res = req.wait(timeout=self.batch_timeout_s)

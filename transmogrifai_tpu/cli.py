@@ -49,7 +49,10 @@ Fleet commands over the scale-out serving fleet (fleet/; ISSUE 14):
 in-flight, restart budget) and falls back to assembling the view from
 the obs aggregation shards; ``drain`` queues a command file the live
 controller applies (the router stops dispatching to the replica while
-it stays warm - the manual half of a rolling deploy).
+it stays warm - the manual half of a rolling deploy).  On a
+multi-model fleet (ISSUE 20) both paths carry per-model rows - hosted
+version, residency, cold hits, any in-flight canary - and the
+placement plan.
 """
 from __future__ import annotations
 
@@ -906,6 +909,9 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
             out = {"source": agg_path,
                    "shards": dict(agg.last_report),
                    "replicas": replicas}
+            models = _fold_model_rows(replicas)
+            if models:
+                out["models"] = models
             if fleet_health:
                 out["fleet_health"] = fleet_health
             if autoscaler:
@@ -914,6 +920,32 @@ def _fleet_status_doc(path: str, stale_after_s=None) -> dict:
     raise ValueError(
         f"{path!r} holds neither a fleet status document nor an obs "
         "aggregation dir")
+
+
+def _fold_model_rows(replicas: dict) -> dict:
+    """Per-model aggregate rows (ISSUE 20) from each replica's
+    ``fleet.models`` table rows: where it is hosted/resident, the
+    summed row/cold-hit counters, any in-flight canary."""
+    models: dict = {}
+    for inst in sorted(replicas):
+        fleet = replicas[inst].get("fleet") or {}
+        for row in fleet.get("models") or []:
+            mid = str(row.get("model_id"))
+            m = models.setdefault(mid, {
+                "version": row.get("version"),
+                "hosts": [], "resident_on": [], "evicted_on": [],
+                "rows_scored": 0, "cold_hits": 0, "rehydrations": 0,
+                "canary_version": None,
+            })
+            m["hosts"].append(inst)
+            m["resident_on" if row.get("resident")
+              else "evicted_on"].append(inst)
+            m["rows_scored"] += int(row.get("rows_scored") or 0)
+            m["cold_hits"] += int(row.get("cold_hits") or 0)
+            m["rehydrations"] += int(row.get("rehydrations") or 0)
+            if row.get("canary_version"):
+                m["canary_version"] = row["canary_version"]
+    return models
 
 
 def _fleet_main(args) -> int:

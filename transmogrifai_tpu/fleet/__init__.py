@@ -26,6 +26,17 @@ count elastically against offered load (SLO burn triggers, cost-model
 sizing, hysteresis via :class:`ScaleGovernor`), with probe-gated
 admission on scale-up and shed-never-hang drain on scale-down.
 
+The ISSUE-20 :mod:`.multimodel` layer turns the fleet into a
+model-multiplexed platform: each replica hosts N registry versions in
+a :class:`ModelTable` (weighted LRU over the AOT executable cache -
+evict cold, rehydrate by deserializing, never retrace), the router
+dispatches per ``model_id`` with per-model quotas, a
+:class:`PlacementPlanner` decides co-residency from the cost model,
+and every hosted model keeps its own canary -> promote / rollback
+lifecycle.  ``python bench.py --multimodel`` writes
+MULTIMODEL_BENCH.json; the ``fleet.model_evict_storm`` fault point
+proves eviction thrash stays rate-bounded.
+
 Fault points: ``fleet.replica_kill`` (a worker dies mid-serve like a
 SIGKILL), ``fleet.router_stall`` (the dispatcher wedges for a beat),
 ``autoscaler.crash`` (the capacity control loop dies; the data plane
@@ -57,6 +68,16 @@ from .controller import (
     FleetController,
     merge_serving_snapshots,
 )
+from .multimodel import (
+    ModelTable,
+    MultiModelError,
+    PlacementPlan,
+    PlacementPlanner,
+    UnhostedModelError,
+    UnknownModelError,
+    format_models_arg,
+    parse_models_arg,
+)
 from .router import (
     BrownoutShedError,
     FleetBatch,
@@ -65,6 +86,7 @@ from .router import (
     FleetResult,
     FleetRouter,
     FleetWorkerError,
+    ModelQuotaError,
     ReplicaHandle,
     ReplicaHealth,
 )
@@ -85,14 +107,23 @@ __all__ = [
     "FleetResult",
     "FleetRouter",
     "FleetWorkerError",
+    "ModelQuotaError",
+    "ModelTable",
+    "MultiModelError",
+    "PlacementPlan",
+    "PlacementPlanner",
     "ReplicaHandle",
     "ReplicaHealth",
     "ReplicaWorker",
     "ScaleGovernor",
+    "UnhostedModelError",
+    "UnknownModelError",
     "decode_records",
     "decode_results",
     "encode_records",
     "encode_results",
+    "format_models_arg",
     "merge_serving_snapshots",
     "parse_address",
+    "parse_models_arg",
 ]

@@ -412,8 +412,18 @@ class FleetAutoscaler:
         capacity = self._replica_capacity(router)
         serving_n = healthy if healthy > 0 else max(
             1, len(members) - len(gave_up))
-        utilization = demand / max(
-            capacity["per_replica_rows_s"] * serving_n, 1e-9)
+        # heterogeneous capacity (ISSUE 20): under a multi-model
+        # placement plan replicas have DIFFERENT predicted capacities
+        # (each hosts a different model mix), so fleet capacity is the
+        # SUM of the per-replica mix, not one-capacity * N
+        mix = self._capacity_mix(members, gave_up, capacity)
+        fleet_capacity = sum(mix.values())
+        if mix and len(mix) != serving_n:
+            fleet_capacity *= serving_n / len(mix)
+        if fleet_capacity <= 0:
+            fleet_capacity = (capacity["per_replica_rows_s"]
+                              * serving_n)
+        utilization = demand / max(fleet_capacity, 1e-9)
         self._last_capacity = capacity
         self._last_utilization = utilization
         self._last_demand = demand
@@ -430,8 +440,30 @@ class FleetAutoscaler:
             "served_rows_s": round(self._served_ewma, 1),
             "demand_rows_s": round(demand, 1),
             "capacity": capacity,
+            "capacity_mix": {k: round(v, 1)
+                             for k, v in sorted(mix.items())},
+            "fleet_capacity_rows_s": round(fleet_capacity, 1),
             "utilization": round(utilization, 4),
         }
+
+    def _capacity_mix(self, members: Sequence[str],
+                      gave_up: Sequence[str],
+                      capacity: dict) -> dict:
+        """Per-replica capacity map for the serving members.  With a
+        multi-model placement plan each replica's predicted capacity
+        under its hosted mix shapes the ratios, anchored to the
+        observed/predicted absolute level (``capacity`` waterfall);
+        without one every replica gets the homogeneous estimate -
+        byte-for-byte the old sizing."""
+        base = float(capacity["per_replica_rows_s"])
+        serving = [m for m in members if m not in set(gave_up)]
+        plan = getattr(self.controller, "placement", None)
+        if plan is None or not getattr(plan, "capacity_rows_s", None):
+            return {m: base for m in serving}
+        mean = plan.mean_capacity() or base
+        factor = base / mean if mean > 0 else 1.0
+        return {m: plan.replica_capacity(m, mean) * factor
+                for m in serving}
 
     def _replica_capacity(self, router) -> dict:
         """Per-replica capacity estimate with its provenance: the
@@ -511,8 +543,22 @@ class FleetAutoscaler:
     def _sized_target(self, evidence: dict) -> int:
         """How many SERVING replicas the current demand needs at the
         target utilization - the cost-model sizing rule, never '+1'."""
-        capacity = evidence["capacity"]["per_replica_rows_s"]
         demand = evidence["demand_rows_s"]
+        mix = evidence.get("capacity_mix") or {}
+        if mix:
+            # heterogeneous fleet: accumulate the per-replica capacity
+            # mix (largest first - existing replicas keep serving)
+            # until the demand fits at target utilization; replicas we
+            # would ADD beyond the current mix are assumed mean-sized
+            caps = sorted(mix.values(), reverse=True)
+            mean = sum(caps) / len(caps)
+            need = demand / max(self.target_utilization, 1e-9)
+            total, n = 0.0, 0
+            while total < need and n < self.max_replicas + len(caps):
+                total += caps[n] if n < len(caps) else max(mean, 1e-9)
+                n += 1
+            return n
+        capacity = evidence["capacity"]["per_replica_rows_s"]
         return int(math.ceil(
             demand / max(capacity * self.target_utilization, 1e-9)))
 

@@ -55,7 +55,14 @@ from ..obs.slo import SLOEngine, default_objectives
 from ..registry import ModelRegistry, RollbackDecision, RollbackPolicy
 from ..workflow.supervisor import backoff_delay_s, staleness
 from .channel import QUANTUM_S
-from .router import FleetError, FleetRouter
+from .multimodel import (
+    PlacementPlan,
+    PlacementPlanner,
+    UnhostedModelError,
+    artifact_cache_bytes,
+    format_models_arg,
+)
+from .router import FleetError, FleetRouter, FleetWorkerError
 
 log = logging.getLogger("transmogrifai_tpu.fleet")
 
@@ -169,6 +176,8 @@ class FleetController:
         eject_after: Optional[int] = None,
         probe_interval_s: Optional[float] = None,
         probe_timeout_s: Optional[float] = None,
+        models: Optional[dict] = None,
+        placement: Optional[PlacementPlanner] = None,
     ) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -227,6 +236,19 @@ class FleetController:
                 self._router_kw[knob] = val
         self.router: Optional[FleetRouter] = None
         self.canary_version: Optional[str] = None
+        # multi-model serving (ISSUE 20): {model_id: version} hosted
+        # across the fleet; the placement planner decides co-residency
+        # and is re-run on membership changes
+        self.models = {str(k): str(v)
+                       for k, v in (models or {}).items()}
+        self.placement_planner = placement
+        if self.models and self.placement_planner is None:
+            self.placement_planner = PlacementPlanner()
+        self.placement: Optional[PlacementPlan] = None
+        #: per-model in-flight fleet canaries: {model_id: version} -
+        #: each hosted model's lifecycle is independent of the fleet's
+        #: single-model canary slot above
+        self.model_canaries: dict[str, str] = {}
         #: attached by :class:`~.autoscaler.FleetAutoscaler.start` -
         #: folds its decision snapshot into ``status()`` /
         #: ``fleet_status.json``
@@ -264,6 +286,10 @@ class FleetController:
             for _ in range(self.n_replicas):
                 rep = self._new_replica()
                 self._replicas[rep.instance] = rep
+            # place BEFORE spawning so each worker's --models carries
+            # exactly its assigned co-residency set (ISSUE 20)
+            self._replan_placement(reason="fleet_start")
+            for rep in self._replicas.values():
                 self._spawn(rep)
             # connect AFTER spawning: replicas warm concurrently
             for rep in self._replicas.values():
@@ -271,6 +297,8 @@ class FleetController:
                     rep.instance, rep.socket_path,
                     connect_timeout_s=self.connect_timeout_s,
                     pid=rep.proc.pid if rep.proc else None)
+            if self.placement is not None:
+                self.router.set_hosting(self.placement.assignments)
         except BaseException:
             # a partially-failed bring-up (bad workflow spec, worker
             # crash at startup) must not leak spawned processes, the
@@ -347,8 +375,23 @@ class FleetController:
         ]
         if self.version:
             cmd += ["--version", self.version]
+        assigned = self._models_for_instance(rep.instance)
+        if assigned:
+            cmd += ["--models", format_models_arg(assigned)]
         cmd += self.worker_args
         return cmd
+
+    def _models_for_instance(self, instance: str) -> dict:
+        """{model_id: version} this replica should host under the
+        current placement plan (all configured models when no plan has
+        been computed yet)."""
+        if not self.models:
+            return {}
+        if self.placement is None:
+            return dict(self.models)
+        return {m: self.models[m]
+                for m in self.placement.models_for(instance)
+                if m in self.models}
 
     def _spawn(self, rep: _Replica) -> None:
         env = child_env(dict(
@@ -539,6 +582,9 @@ class FleetController:
         scale-up is a no-op, not a degraded fleet."""
         rep = self._new_replica()
         self._replicas[rep.instance] = rep
+        # re-plan placement BEFORE spawning so the new worker's
+        # --models carries exactly its assigned co-residency set
+        self._replan_placement(reason=f"scale_up:{rep.instance}")
         self._spawn(rep)
         try:
             self.router.add_replica(
@@ -549,12 +595,18 @@ class FleetController:
             self.router.control(rep.instance, "ping",
                                 timeout_s=probe_timeout_s)
             self.router.set_drained(rep.instance, False)
+            if self.placement is not None:
+                # existing replicas may have lost/gained assignments
+                # under the new plan: converge them
+                self._reconcile_hosting()
         except BaseException:
             # failed bring-up must not leak the process or a dead
             # handle: reap both, leave the fleet exactly as it was
             self._replicas.pop(rep.instance, None)
             self.router.remove_replica(rep.instance,
                                        reason="admission failed")
+            self._replan_placement(
+                reason=f"admission_failed:{rep.instance}")
             if rep.proc is not None and rep.proc.poll() is None:
                 rep.proc.kill()
                 try:
@@ -615,6 +667,11 @@ class FleetController:
                                 instance)
         self._replicas.pop(instance, None)
         self.n_replicas = max(1, len(self.member_instances()))
+        if self.models:
+            # the victim's hosted models need their replication copies
+            # back on survivors: re-plan and converge
+            self._replan_placement(reason=f"scale_down:{instance}")
+            self._reconcile_hosting()
         report["drain_s"] = round(time.perf_counter() - t0, 4)
         self._event("replica_retired", **report,
                     members=len(self.member_instances()))
@@ -624,6 +681,228 @@ class FleetController:
                  report["drained"], report["drain_s"],
                  len(self.member_instances()))
         return report
+
+    # -- multi-model placement (ISSUE 20) -----------------------------------
+    def _replan_placement(self,
+                          reason: str = "membership"
+                          ) -> Optional[PlacementPlan]:
+        """Re-run the placement planner over current membership and
+        push the hosting map to the router.  Called at fleet start and
+        on every membership change (scale-up/down re-balances
+        co-residency)."""
+        if not self.models or self.placement_planner is None:
+            return None
+        if (self.placement_planner.cost_model is None
+                and self.router is not None
+                and self.router.cost_model is not None):
+            self.placement_planner.cost_model = self.router.cost_model
+        instances = self.member_instances()
+        if not instances:
+            return None
+        specs = [
+            {"model_id": m, "version": v,
+             "weight_bytes": artifact_cache_bytes(self.registry, v)}
+            for m, v in sorted(self.models.items())
+        ]
+        self.placement = self.placement_planner.plan(specs, instances)
+        if self.router is not None:
+            self.router.set_hosting(self.placement.assignments)
+        self._event("placement_replan", reason=reason,
+                    rev=self.placement.rev,
+                    assignments=self.placement.assignments)
+        log.info("%s placement re-planned (%s): rev %d", LOG_PREFIX,
+                 reason, self.placement.rev)
+        return self.placement
+
+    def _reconcile_hosting(self, ctl_timeout_s: float = 300.0) -> dict:
+        """Converge every live replica's ModelTable onto the current
+        placement plan: host what the plan assigns it but it lacks,
+        unhost what the plan moved away.  Per-replica errors are
+        captured (one slow/broken replica must not abort fleet-wide
+        convergence); a pinned model (canary in flight) stays put."""
+        if self.placement is None:
+            return {}
+        report: dict = {}
+        for h in list(self.router.live_replicas()):
+            want = set(self.placement.models_for(h.instance))
+            steps: list = []
+            try:
+                doc = self.router.control(h.instance, "models",
+                                          timeout_s=ctl_timeout_s)
+                table = (doc or {}).get("table") or {}
+                have = {str(r["model_id"])
+                        for r in table.get("models", [])}
+            except (FleetError, FleetWorkerError) as e:
+                report[h.instance] = {"error": str(e)}
+                continue
+            for model_id in sorted(want - have):
+                try:
+                    self.router.control(
+                        h.instance, "host",
+                        {"model_id": model_id,
+                         "version": self.models[model_id]},
+                        timeout_s=ctl_timeout_s)
+                    steps.append({"host": model_id})
+                except (FleetError, FleetWorkerError) as e:
+                    steps.append({"host": model_id, "error": str(e)})
+            for model_id in sorted(have - want):
+                try:
+                    self.router.control(h.instance, "unhost",
+                                        {"model_id": model_id},
+                                        timeout_s=ctl_timeout_s)
+                    steps.append({"unhost": model_id})
+                except (FleetError, FleetWorkerError) as e:
+                    steps.append({"unhost": model_id, "error": str(e)})
+            report[h.instance] = {"steps": steps}
+        self.router.set_hosting(self.placement.assignments)
+        if any(r for r in report.values() if r.get("steps")):
+            self._event("hosting_reconciled", report=report)
+        return report
+
+    def model_hosts(self, model_id: str) -> list[str]:
+        """Live replica instances hosting ``model_id`` (the router's
+        converged view, which follows the placement plan)."""
+        return [inst for inst, models
+                in self.router.hosting_map().items()
+                if model_id in models]
+
+    def _hosting_instances(self, model_id: str) -> list[str]:
+        hosts = self.model_hosts(model_id)
+        if not hosts:
+            raise UnhostedModelError(
+                f"no live replica hosts model {model_id!r} "
+                f"(hosting: {self.router.hosting_map()})")
+        return hosts
+
+    def host_model(self, model_id: str, version: str,
+                   ctl_timeout_s: float = 300.0) -> dict:
+        """Add (or hot-swap) one hosted model fleet-wide: record it in
+        the model map, re-plan placement, and converge the replicas."""
+        self.models[str(model_id)] = str(version)
+        self._replan_placement(reason=f"host:{model_id}")
+        report = self._reconcile_hosting(ctl_timeout_s=ctl_timeout_s)
+        self._write_status()
+        return report
+
+    def unhost_model(self, model_id: str,
+                     ctl_timeout_s: float = 120.0) -> dict:
+        """Retire one hosted model fleet-wide."""
+        self.models.pop(str(model_id), None)
+        self.model_canaries.pop(str(model_id), None)
+        self._replan_placement(reason=f"unhost:{model_id}")
+        report = self._reconcile_hosting(ctl_timeout_s=ctl_timeout_s)
+        self._write_status()
+        return report
+
+    # -- per-model canary lifecycle (ISSUE 20) ------------------------------
+    def start_model_canary(self, model_id: str, version: str,
+                           fraction: float = 0.05,
+                           shadow: bool = False,
+                           ctl_timeout_s: float = 300.0) -> dict:
+        """Bring ``version`` up as ``model_id``'s canary on every
+        replica hosting it — each hosted model's canary lifecycle is
+        independent: two models can canary (and one promote while the
+        other rolls back) concurrently."""
+        model_id = str(model_id)
+        out: dict = {}
+        errors: dict = {}
+        for inst in self._hosting_instances(model_id):
+            try:
+                out[inst] = self.router.control(
+                    inst, "canary",
+                    {"model_id": model_id, "version": version,
+                     "fraction": fraction, "shadow": shadow},
+                    timeout_s=ctl_timeout_s)
+            except (FleetError, FleetWorkerError) as e:
+                errors[inst] = str(e)
+                out[inst] = {"error": str(e)}
+        if errors and len(errors) == len(out):
+            raise FleetError(
+                f"canary {version} for model {model_id!r} failed on "
+                f"every hosting replica: {errors}")
+        self.model_canaries[model_id] = str(version)
+        self._event("model_canary_start", model_id=model_id,
+                    version=version, fraction=fraction, shadow=shadow,
+                    replicas=sorted(set(out) - set(errors)),
+                    errors=errors or None)
+        return out
+
+    def _model_ctl(self, model_id: str, cmd: str,
+                   args: Optional[dict] = None,
+                   ctl_timeout_s: float = 120.0) -> dict:
+        out: dict = {}
+        for inst in self._hosting_instances(model_id):
+            try:
+                out[inst] = self.router.control(
+                    inst, cmd, dict(args or {}, model_id=model_id),
+                    timeout_s=ctl_timeout_s)
+            except (FleetError, FleetWorkerError) as e:
+                out[inst] = {"error": str(e)}
+        return out
+
+    def promote_model_canary(self, model_id: str) -> dict:
+        model_id = str(model_id)
+        out = self._model_ctl(model_id, "promote_canary")
+        version = self.model_canaries.pop(model_id, None)
+        if version is not None:
+            self.models[model_id] = version
+        self._event("model_canary_promote", model_id=model_id,
+                    version=version, replicas=sorted(out))
+        self._write_status()
+        return out
+
+    def rollback_model_canary(self, model_id: str,
+                              decision: Optional[RollbackDecision]
+                              = None,
+                              reason: str = "fleet-policy") -> dict:
+        model_id = str(model_id)
+        out = self._model_ctl(
+            model_id, "rollback",
+            {"reason": reason if decision is None else "policy"})
+        version = self.model_canaries.pop(model_id, None)
+        self._event(
+            "model_canary_rollback", model_id=model_id,
+            version=version,
+            reason=reason if decision is None else "policy",
+            reasons=[dict(r) for r in decision.reasons] if decision
+            else [],
+            replicas=sorted(out))
+        self._write_status()
+        log.warning("%s model %s canary %s ROLLED BACK across %d "
+                    "replicas", LOG_PREFIX, model_id, version, len(out))
+        return out
+
+    def release_model_canary(self, model_id: str,
+                             reason: str = "undecided") -> dict:
+        model_id = str(model_id)
+        out = self._model_ctl(model_id, "release_canary",
+                              {"reason": reason})
+        version = self.model_canaries.pop(model_id, None)
+        self._event("model_canary_release", model_id=model_id,
+                    version=version, reason=reason,
+                    replicas=sorted(out))
+        self._write_status()
+        return out
+
+    def check_model_canary(self, model_id: str
+                           ) -> Optional[RollbackDecision]:
+        """Evaluate the rollback policy against ``model_id``'s own
+        merged stable/canary telemetry split; a breach rolls back ONLY
+        this model's canary — the other hosted models' lifecycles are
+        untouched."""
+        model_id = str(model_id)
+        if model_id not in self.model_canaries:
+            return None
+        stable_snaps, canary_snaps = self._arm_snapshots(
+            model_id=model_id,
+            canary_version=self.model_canaries[model_id])
+        decision = self.policy.evaluate(
+            merge_serving_snapshots(stable_snaps),
+            merge_serving_snapshots(canary_snaps),
+        )
+        if decision.rollback:
+            self.rollback_model_canary(model_id, decision=decision)
+        return decision
 
     # -- rolling deploy -----------------------------------------------------
     def rolling_deploy(self, version: str,
@@ -687,11 +966,18 @@ class FleetController:
                     errors=errors or None)
         return out
 
-    def _arm_snapshots(self) -> tuple[list[dict], list[dict]]:
+    def _arm_snapshots(self, model_id: Optional[str] = None,
+                       canary_version: Optional[str] = None
+                       ) -> tuple[list[dict], list[dict]]:
         """Split every live shard's serving views into (stable pool,
-        canary pool) by model version."""
+        canary pool) by model version.  With ``model_id`` only that
+        hosted model's views are pooled (each ServingTelemetry carries
+        its model_id label, ISSUE 20) and the split compares against
+        ``canary_version`` instead of the fleet-wide canary slot."""
         from ..obs.fleet import serving_views
 
+        against = (canary_version if model_id is not None
+                   else self.canary_version)
         stable_snaps: list[dict] = []
         canary_snaps: list[dict] = []
         for doc in self.aggregator.shards():
@@ -701,19 +987,27 @@ class FleetController:
                 # folding it in would pollute the canary verdict pools
                 continue
             for _key, snap in serving_views(doc.get("metrics", {})):
-                if snap.get("model_version") == self.canary_version:
+                if model_id is not None \
+                        and snap.get("model_id") != model_id:
+                    continue
+                if snap.get("model_version") == against:
                     canary_snaps.append(snap)
                 else:
                     stable_snaps.append(snap)
         return stable_snaps, canary_snaps
 
-    def canary_telemetry(self) -> dict:
+    def canary_telemetry(self, model_id: Optional[str] = None) -> dict:
         """The merged (stable, canary) serving telemetry split — the
         PUBLIC read seam for automated canary verdicts (ISSUE 16: the
         continuous trainer polls this for canary row counts instead of
         reaching into the aggregator's internals).  Same merge
-        :meth:`check_canary` evaluates the rollback policy against."""
-        stable_snaps, canary_snaps = self._arm_snapshots()
+        :meth:`check_canary` evaluates the rollback policy against.
+        With ``model_id`` the split covers that hosted model alone
+        (its own canary slot, ISSUE 20)."""
+        stable_snaps, canary_snaps = self._arm_snapshots(
+            model_id=None if model_id is None else str(model_id),
+            canary_version=(None if model_id is None
+                            else self.model_canaries.get(str(model_id))))
         return {
             "stable": merge_serving_snapshots(stable_snaps),
             "canary": merge_serving_snapshots(canary_snaps),
@@ -841,11 +1135,53 @@ class FleetController:
             "shards": dict(self.aggregator.last_report),
             "events": events,
         }
+        if self.models:
+            out["models"] = self._model_status_rows(shard_fleet)
+            out["model_canaries"] = dict(self.model_canaries)
+            if self.placement is not None:
+                out["placement"] = self.placement.to_json()
         if self.autoscaler is not None:
             try:
                 out["autoscaler"] = self.autoscaler.snapshot()
             except Exception as e:  # noqa: BLE001 - status must publish
                 out["autoscaler"] = {"error": str(e)}
+        return out
+
+    def _model_status_rows(self, shard_fleet: dict) -> dict:
+        """Fold every replica's per-model table rows (shipped in its
+        ``fleet`` shard info) into one fleet-wide per-model document:
+        who hosts it, cache-resident vs evicted copies, per-model rows
+        scored — the per-model rows ``tx fleet status`` renders."""
+        rows_by_model = {}
+        if self.router is not None:
+            rows_by_model = self.router.snapshot().get(
+                "rows_by_model", {})
+        out: dict = {}
+        for instance, info in sorted(shard_fleet.items()):
+            for row in (info or {}).get("models") or []:
+                model_id = str(row.get("model_id"))
+                agg = out.setdefault(model_id, {
+                    "version": row.get("version"),
+                    "hosts": [],
+                    "resident_on": [],
+                    "evicted_on": [],
+                    "rows_scored": 0,
+                    "cold_hits": 0,
+                    "rehydrations": 0,
+                    "canary_version":
+                        self.model_canaries.get(model_id),
+                })
+                agg["hosts"].append(instance)
+                key = ("resident_on" if row.get("resident")
+                       else "evicted_on")
+                agg[key].append(instance)
+                agg["rows_scored"] += int(row.get("rows_scored", 0)
+                                          or 0)
+                agg["cold_hits"] += int(row.get("cold_hits", 0) or 0)
+                agg["rehydrations"] += int(row.get("rehydrations", 0)
+                                           or 0)
+        for model_id, agg in out.items():
+            agg["rows_delivered"] = rows_by_model.get(model_id, 0)
         return out
 
     def _write_status(self, shards=None) -> None:

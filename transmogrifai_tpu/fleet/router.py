@@ -86,6 +86,7 @@ from ..serving.admission import (
     TenantQuotaError,
     _Request,
 )
+from .multimodel import UnhostedModelError
 from .channel import (
     HANDSHAKE_TIMEOUT_S,
     OP_CONTROL,
@@ -159,6 +160,12 @@ class BrownoutShedError(QueueFullError):
     tenant is below the brownout priority floor (planned degradation:
     lowest-priority traffic goes first, the fleet never queues toward a
     stall)."""
+
+
+class ModelQuotaError(QueueFullError):
+    """Shed at the front door because one model's in-flight rows hit
+    its configured quota (ISSUE 20: a chatty model's tenants shed while
+    the other hosted models' traffic keeps admitting)."""
 
 
 class ReplicaHealth:
@@ -296,6 +303,9 @@ class FleetBatch:
     kind: str = "score"  # score | ctl | probe
     ctl: dict = field(default_factory=dict)
     retries: int = 0
+    #: per-model dispatch (ISSUE 20): routed only to replicas hosting
+    #: this model; None = the legacy single-model lane
+    model_id: Optional[str] = None
 
 
 class FleetResult:
@@ -392,7 +402,16 @@ class ReplicaHandle:
                      "corrupt_injected": 0}
         #: latest shard-observed stats (refresh_from_shards)
         self.obs: dict = {}
+        #: model_ids this replica hosts (ISSUE 20), fed by the
+        #: placement plan (set_hosting) and by the replica's own
+        #: shipped ``fleet_replica`` view (refresh_from_shards);
+        #: model-routed batches only dispatch to hosting replicas
+        self.hosted_models: set[str] = set()
         self.receiver: Optional[threading.Thread] = None
+
+    def hosts(self, model_id: str) -> bool:
+        with self.lock:
+            return model_id in self.hosted_models
 
     # -- load estimate ------------------------------------------------------
     def service_s_per_row(self, cost_model=None) -> float:
@@ -461,6 +480,7 @@ class ReplicaHandle:
                 "health": self.health.snapshot(),
                 "wire": self.wire_stats(),
                 "obs": dict(self.obs),
+                "hosted_models": sorted(self.hosted_models),
             }
 
 
@@ -499,6 +519,7 @@ class FleetRouter:
         quorum: Optional[int] = None,
         tenant_priority: Optional[dict] = None,
         brownout_min_priority: int = 1,
+        model_quotas: Optional[dict] = None,
         start: bool = True,
     ) -> None:
         if max_in_flight_per_replica < 1:
@@ -518,6 +539,11 @@ class FleetRouter:
         self.quorum = None if quorum is None else int(quorum)
         self._tenant_priority = dict(tenant_priority or {})
         self.brownout_min_priority = int(brownout_min_priority)
+        #: per-model in-flight row caps (ISSUE 20): {model_id: rows};
+        #: a model at its cap sheds NEW submissions with
+        #: ModelQuotaError while other models keep admitting
+        self.model_quotas = {
+            str(k): int(v) for k, v in (model_quotas or {}).items()}
         self.admission = AdmissionController(
             max_queue=max_queue, clock=clock, tenant_quota=tenant_quota)
         self._handles: dict[str, ReplicaHandle] = {}
@@ -541,6 +567,8 @@ class FleetRouter:
         self.shed_quota = 0
         self.shed_deadline = 0
         self.shed_brownout = 0
+        self.shed_model_quota = 0
+        self.unhosted_model_errors = 0
         self.retries = 0
         self.replica_deaths = 0
         self.router_stalls = 0
@@ -553,6 +581,10 @@ class FleetRouter:
         self.probes_sent = 0
         self.probes_failed = 0
         self._rows_by_generation: dict[str, int] = {}
+        #: exact per-model row conservation ledger (ISSUE 20): every
+        #: delivered scored row attributed to its model (None-keyed
+        #: rows ride the legacy single-model lane)
+        self._rows_by_model: dict[str, int] = {}
         metrics_registry().register_view("fleet_router", self)
         self._health_view = _FleetHealthView(self)
         metrics_registry().register_view("fleet_health",
@@ -650,13 +682,17 @@ class FleetRouter:
                payload: Optional[bytes] = None,
                n_rows: Optional[int] = None,
                tenant: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> _Request:
+               deadline_ms: Optional[float] = None,
+               model_id: Optional[str] = None) -> _Request:
         """Queue one batch; returns the admission ``_Request`` handle
         (``.wait(timeout)`` -> :class:`FleetResult`).  Pass ``records``
         (encoded here, once) or an already-encoded ``payload`` +
         ``n_rows`` - the wire-form path for callers that hold the
         serialized batch already (a network front end, the bench's
-        sustained-load driver)."""
+        sustained-load driver).  ``model_id`` selects one hosted model
+        (ISSUE 20): the batch only dispatches to replicas hosting it,
+        sheds loudly when nothing does, and counts toward that model's
+        in-flight quota."""
         if payload is None:
             if records is None:
                 raise ValueError("submit needs records or payload")
@@ -666,6 +702,24 @@ class FleetRouter:
             n_rows = len(records)
         if n_rows is None:
             raise ValueError("payload submission needs n_rows")
+        if model_id is not None:
+            model_id = str(model_id)
+            if not any(h.alive and h.hosts(model_id)
+                       for h in self.replicas()):
+                with self._ctr_lock:
+                    self.unhosted_model_errors += 1
+                raise UnhostedModelError(
+                    f"no replica hosts model {model_id!r} "
+                    f"(hosting: {self.hosting_map()})")
+            cap = self.model_quotas.get(model_id)
+            if cap is not None:
+                held = self._model_inflight_rows(model_id)
+                if held + int(n_rows) > cap:
+                    with self._ctr_lock:
+                        self.shed_model_quota += 1
+                    raise ModelQuotaError(
+                        f"model {model_id!r} quota exceeded: "
+                        f"{held} rows in flight + {n_rows} > {cap}")
         if self.quorum is not None:
             healthy = len(self.healthy_replicas())
             if (healthy < self.quorum
@@ -679,7 +733,7 @@ class FleetRouter:
                     f"{self._priority(tenant)} < "
                     f"{self.brownout_min_priority})")
         batch = FleetBatch(payload=payload, n_rows=int(n_rows),
-                           tenant=tenant)
+                           tenant=tenant, model_id=model_id)
         slept = _faults.inject_sleep("fleet.router_stall")
         if slept:
             with self._ctr_lock:
@@ -703,13 +757,53 @@ class FleetRouter:
 
     def score_batch(self, records: Sequence, timeout_s: float = 30.0,
                     tenant: Optional[str] = None,
-                    deadline_ms: Optional[float] = None) -> list:
+                    deadline_ms: Optional[float] = None,
+                    model_id: Optional[str] = None) -> list:
         """Synchronous scoring through the fleet; element i aligns with
         records[i] (the endpoint contract, preserved end to end)."""
         req = self.submit(records=records, tenant=tenant,
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms, model_id=model_id)
         res: FleetResult = req.wait(timeout_s)
         return res.results
+
+    # -- per-model hosting + quotas (ISSUE 20) ------------------------------
+    def set_hosting(self, assignments: dict) -> None:
+        """Install a placement plan's ``{instance: [model_id, ...]}``
+        map onto the handles (unknown instances ignored: the plan may
+        lead membership during a scale-up)."""
+        for h in self.replicas():
+            models = assignments.get(h.instance)
+            if models is None:
+                continue
+            with h.lock:
+                h.hosted_models = {str(m) for m in models}
+
+    def hosting_map(self) -> dict:
+        """``{instance: sorted hosted model_ids}`` across live
+        replicas."""
+        out = {}
+        for h in self.replicas():
+            if not h.alive:
+                continue
+            with h.lock:
+                out[h.instance] = sorted(h.hosted_models)
+        return out
+
+    def _model_inflight_rows(self, model_id: str) -> int:
+        """Rows currently dispatched (pending on some replica) or in
+        the retry lane for one model - the quantity the per-model quota
+        caps."""
+        total = 0
+        for h in self.replicas():
+            with h.lock:
+                for req in h.pending.values():
+                    if getattr(req.record, "model_id", None) == model_id:
+                        total += req.record.n_rows
+        with self._retry_lock:
+            for req in self._retry:
+                if getattr(req.record, "model_id", None) == model_id:
+                    total += req.record.n_rows
+        return total
 
     # -- dispatch -----------------------------------------------------------
     def _try_fast_dispatch(self) -> None:
@@ -734,7 +828,8 @@ class FleetRouter:
             return
         req = live[0]
         while not self._stop.is_set():
-            handle = self._pick(req.record.n_rows)
+            handle = self._pick(req.record.n_rows,
+                                getattr(req.record, "model_id", None))
             if handle is None:
                 # capacity vanished between the probe and the take
                 # (racing caller): hand the head back to the FRONT of
@@ -766,12 +861,14 @@ class FleetRouter:
                     self.shed_deadline += 1
         return live[0] if live else None
 
-    def _pick(self, n_rows: int) -> Optional[ReplicaHandle]:
+    def _pick(self, n_rows: int,
+              model_id: Optional[str] = None) -> Optional[ReplicaHandle]:
         candidates = [
             h for h in self.replicas()
             if h.alive and not h.drained
             and h.health.state == "healthy"
             and h.in_flight() < self.max_in_flight_per_replica
+            and (model_id is None or h.hosts(model_id))
         ]
         if not candidates:
             return None
@@ -811,7 +908,7 @@ class FleetRouter:
             # clear BEFORE picking: a response landing between the pick
             # and the wait still wakes the next wait immediately
             self._capacity.clear()
-            handle = self._pick(batch.n_rows)
+            handle = self._pick(batch.n_rows, batch.model_id)
             if handle is not None:
                 done, _rid = self._send_to(handle, req)
                 if done:
@@ -822,6 +919,20 @@ class FleetRouter:
                     "no live replica to serve on"))
                 with self._ctr_lock:
                     self.requests_failed += 1
+                return
+            if (batch.model_id is not None
+                    and not any(h.alive and h.hosts(batch.model_id)
+                                for h in self.replicas())):
+                # the hosting set changed after admission (scale-down,
+                # unhost): parked work for a model nobody hosts must
+                # fail loudly, not wait forever for capacity
+                if req.resolve_delivered(error=UnhostedModelError(
+                        f"no replica hosts model {batch.model_id!r} "
+                        "anymore")):
+                    with self._ctr_lock:
+                        self.unhosted_model_errors += 1
+                        self.requests_failed += 1
+                        self.rows_failed += batch.n_rows
                 return
             # all replicas full (or ejected, probing toward
             # readmission): park until a response frees capacity,
@@ -843,6 +954,8 @@ class FleetRouter:
         rid = next(self._req_ids)
         if op == OP_SCORE:
             meta = {"tenant": batch.tenant, "n_rows": batch.n_rows}
+            if batch.model_id is not None:
+                meta["model_id"] = batch.model_id
             if req.deadline is not None:
                 # the caller's remaining budget rides the wire as an
                 # absolute wall-clock deadline (cross-host clock skew
@@ -991,12 +1104,15 @@ class FleetRouter:
             handle.rows_ok += n
             handle.requests_ok += 1
         gen_key = f"{meta.get('version')}/g{meta.get('generation')}"
+        model_key = str(meta.get("model_id", batch.model_id) or "_default")
         with self._ctr_lock:
             if delivered:
                 self.requests_ok += 1
                 self.rows_ok += n
                 self._rows_by_generation[gen_key] = (
                     self._rows_by_generation.get(gen_key, 0) + n)
+                self._rows_by_model[model_key] = (
+                    self._rows_by_model.get(model_key, 0) + n)
 
     def _count_decode_error(self) -> None:
         with self._ctr_lock:
@@ -1324,6 +1440,19 @@ class FleetRouter:
             if best:
                 h.obs = best
                 updated += 1
+            # fold the replica's own hosted-model report (its
+            # fleet_replica view rides the same shard): the replica is
+            # the authority on what it actually hosts, so a placement
+            # plan applied out-of-band still converges here
+            for key, snap in (doc.get("views") or {}).items():
+                if (key.partition("/")[0] == "fleet_replica"
+                        and isinstance(snap, dict)
+                        and snap.get("models")):
+                    with h.lock:
+                        h.hosted_models = {
+                            str(r.get("model_id"))
+                            for r in snap["models"]
+                            if r.get("model_id")}
         return updated
 
     # -- reporting ----------------------------------------------------------
@@ -1340,6 +1469,8 @@ class FleetRouter:
                 "shed_quota": self.shed_quota,
                 "shed_deadline": self.shed_deadline,
                 "shed_brownout": self.shed_brownout,
+                "shed_model_quota": self.shed_model_quota,
+                "unhosted_model_errors": self.unhosted_model_errors,
                 "retries": self.retries,
                 "replica_deaths": self.replica_deaths,
                 "router_stalls": self.router_stalls,
@@ -1352,6 +1483,7 @@ class FleetRouter:
                 "probes_sent": self.probes_sent,
                 "probes_failed": self.probes_failed,
                 "rows_by_generation": dict(self._rows_by_generation),
+                "rows_by_model": dict(self._rows_by_model),
             }
         out["queue_depth"] = len(self.admission)
         out["tenants_held"] = {

@@ -40,7 +40,7 @@ import logging
 import os
 import threading
 import time
-from typing import Optional
+from typing import Mapping, Optional
 
 from ..faults import injection as _faults
 from ..obs import set_process_instance
@@ -49,6 +49,7 @@ from ..obs.metrics import metrics_registry
 from ..registry import DeploymentController, ModelRegistry, RollbackPolicy
 from ..workflow.supervisor import beat
 from . import channel as _ch
+from .multimodel import ModelTable, UnknownModelError, parse_models_arg
 from .channel import (
     OP_CONTROL,
     OP_CONTROL_RESULT,
@@ -105,6 +106,10 @@ class ReplicaWorker:
         fleet_status_path: Optional[str] = None,
         ship_interval_s: float = 0.5,
         accept_timeout_s: float = DEFAULT_ACCEPT_TIMEOUT_S,
+        models: Optional[Mapping[str, str]] = None,
+        model_cache_bytes: Optional[int] = None,
+        max_resident_models: Optional[int] = None,
+        evict_min_interval_s: Optional[float] = None,
         **endpoint_kw,
     ) -> None:
         self.registry_root = registry_root
@@ -142,6 +147,16 @@ class ReplicaWorker:
         self.controller: Optional[DeploymentController] = None
         self.registry: Optional[ModelRegistry] = None
         self._shipper: Optional[ObsShipper] = None
+        # multi-model hosting (ISSUE 20): N registry versions behind
+        # this one serve lane, each with its own lifecycle, under a
+        # weighted LRU over their AOT executables.  None until start()
+        # (and stays None on a pure single-model replica with no
+        # ``models`` map - zero new moving parts on the legacy path).
+        self.initial_models = dict(models) if models else {}
+        self.model_cache_bytes = model_cache_bytes
+        self.max_resident_models = max_resident_models
+        self.evict_min_interval_s = evict_min_interval_s
+        self.models_table: Optional[ModelTable] = None
 
     def _fresh_workflow(self):
         built = self._factory()
@@ -165,6 +180,8 @@ class ReplicaWorker:
                 f"registry at {self.registry_root} has no stable version "
                 "to serve")
         self.controller.deploy_version(version, self._fresh_workflow())
+        if self.initial_models:
+            self._init_model_table()
         metrics_registry().register_view("fleet_replica", self)
         if self.fleet_dir:
             self._shipper = ObsShipper(
@@ -174,11 +191,31 @@ class ReplicaWorker:
             ).start()
         return self
 
+    def _init_model_table(self) -> None:
+        """Bring the ModelTable up (lazily on the first model-scoped
+        control verb, eagerly when ``models`` was configured) and host
+        the initial map."""
+        if self.models_table is None:
+            table_kw: dict = {}
+            if self.evict_min_interval_s is not None:
+                table_kw["evict_min_interval_s"] = float(
+                    self.evict_min_interval_s)
+            self.models_table = ModelTable(
+                self.registry, self._fresh_workflow,
+                capacity_bytes=self.model_cache_bytes,
+                max_resident=self.max_resident_models,
+                policy=RollbackPolicy(), **table_kw,
+                **self._endpoint_kw)
+        for model_id, version in self.initial_models.items():
+            if not self.models_table.has(model_id):
+                self.models_table.host(model_id, version)
+
     def replica_info(self) -> dict:
         gen = self.controller.stable_generation if self.controller \
             else None
         can = self.controller.canary_generation if self.controller \
             else None
+        table = self.models_table
         return {
             "instance": self.instance,
             "pid": os.getpid(),
@@ -193,6 +230,13 @@ class ReplicaWorker:
             "knobs": self.knobs(),
             "wire": self._wire_stats(),
             "uptime_s": round(time.monotonic() - self.started_at, 3),
+            # multi-model hosting (ISSUE 20): per-model rows ride the
+            # obs shard's `fleet` info, so `tx fleet status` and the
+            # router's refresh_from_shards learn who hosts what without
+            # a new wire verb
+            "models": table.rows() if table is not None else [],
+            "model_table": table.counters() if table is not None
+            else None,
         }
 
     # -- live knobs ---------------------------------------------------------
@@ -396,8 +440,18 @@ class ReplicaWorker:
         # keeps the output shard exactly-once
         _faults.inject_kill("bulk.replica_die_midshard")
         self._in_flight_rows = len(records)
+        # per-model dispatch (ISSUE 20): model_id rides the meta dict
+        # (no wire-format change); absent -> the legacy single-model
+        # lane, byte-for-byte today's path
+        model_id = meta.get("model_id")
         try:
-            results, info = self._score_records(records)
+            results, info = self._score_records(records,
+                                                model_id=model_id)
+        except UnknownModelError as e:
+            self._send(chan, OP_ERROR, rid,
+                       {"error": str(e), "kind": "unknown_model",
+                        "model_id": model_id})
+            return
         except Exception as e:  # noqa: BLE001 - per-request isolation
             self._send(chan, OP_ERROR, rid,
                        {"error": f"{type(e).__name__}: {e}"})
@@ -413,28 +467,52 @@ class ReplicaWorker:
             "canary_rows": info.get("canary_rows", 0),
             "canary_version": info.get("canary_version"),
         }
+        if model_id is not None:
+            out_meta["model_id"] = info.get("model_id", model_id)
+            if info.get("cold_hit"):
+                out_meta["cold_hit"] = True
+                out_meta["rehydrate_ms"] = info.get("rehydrate_ms")
         self._send(chan, OP_RESULT, rid, out_meta,
                    encode_results(results))
 
-    def _score_records(self, records: list) -> tuple:
+    def _score_records(self, records: list,
+                       model_id: Optional[str] = None) -> tuple:
         """Score one wire batch, honoring the live ``max_batch_size``
         chunk cap: smaller chunks pad to smaller XLA buckets, which is
         exactly the knob the autoscaler's A/B retune probes.  Chunk
         canary_rows are summed; version/generation come from the last
         chunk (a deploy cannot land mid-batch - the replica is drained
-        first)."""
+        first).  With ``model_id`` the batch dispatches through the
+        ModelTable (ISSUE 20) instead of the default controller."""
+        if model_id is not None:
+            if self.models_table is None:
+                raise UnknownModelError(
+                    f"model {model_id!r}: this replica hosts no "
+                    "multi-model table")
+            score = lambda recs: self.models_table.score(  # noqa: E731
+                model_id, recs)
+        else:
+            score = self.controller.score_batch_with_info
         cap = self.max_batch_size
         if not cap or len(records) <= cap:
-            return self.controller.score_batch_with_info(records)
+            return score(records)
         results: list = []
         canary_rows = 0
+        cold_hit = False
+        rehydrate_ms = None
         info: dict = {}
         for i in range(0, len(records), cap):
-            chunk, info = self.controller.score_batch_with_info(
-                records[i:i + cap])
+            chunk, info = score(records[i:i + cap])
             results.extend(chunk)
             canary_rows += int(info.get("canary_rows", 0) or 0)
-        return results, dict(info, canary_rows=canary_rows)
+            if info.get("cold_hit"):
+                cold_hit = True
+                rehydrate_ms = info.get("rehydrate_ms")
+        info = dict(info, canary_rows=canary_rows)
+        if cold_hit:
+            info["cold_hit"] = True
+            info["rehydrate_ms"] = rehydrate_ms
+        return results, info
 
     # -- control ------------------------------------------------------------
     def _handle_control(self, chan: FleetChannel, rid: int,
@@ -473,6 +551,12 @@ class ReplicaWorker:
 
     def _control(self, cmd: str, meta: dict) -> dict:
         ctl = self.controller
+        # a model-scoped verb (meta carries model_id) routes through
+        # the ModelTable's per-model controller; without one it is the
+        # legacy single-model lane, unchanged
+        model_id = meta.get("model_id")
+        if model_id is not None:
+            return self._control_model(cmd, str(model_id), meta)
         if cmd == "ping":
             return {"ok": True, "instance": self.instance,
                     "pid": os.getpid()}
@@ -480,6 +564,10 @@ class ReplicaWorker:
             return dict(self.replica_info(),
                         events=len(ctl.events()),
                         telemetry=self._stable_telemetry())
+        if cmd == "models":
+            table = self.models_table
+            return {"ok": True,
+                    "table": table.snapshot() if table else None}
         if cmd == "deploy":
             gen = ctl.deploy_version(str(meta["version"]),
                                      self._fresh_workflow())
@@ -529,6 +617,74 @@ class ReplicaWorker:
             return {"ok": True, "stopping": True}
         raise ValueError(f"unknown fleet control command {cmd!r}")
 
+    def _control_model(self, cmd: str, model_id: str,
+                       meta: dict) -> dict:
+        """Model-scoped control verbs (ISSUE 20): each hosted model's
+        deploy/canary lifecycle is independent, so every single-model
+        verb has a per-model twin selected by ``meta["model_id"]``."""
+        if cmd in ("host", "deploy", "canary") \
+                and self.models_table is None:
+            # first model-scoped mutation on a legacy replica brings
+            # the table up lazily
+            self._init_model_table()
+        table = self.models_table
+        if table is None:
+            raise UnknownModelError(
+                f"model {model_id!r}: this replica hosts no "
+                "multi-model table")
+        if cmd in ("host", "deploy"):
+            gen = table.host(model_id, str(meta["version"]))
+            self._ship_soon()
+            return {"ok": True, "model_id": model_id,
+                    "version": gen.version,
+                    "generation": gen.generation}
+        if cmd == "unhost":
+            table.unhost(model_id)
+            self._ship_soon()
+            return {"ok": True, "model_id": model_id,
+                    "unhosted": True}
+        if cmd == "canary":
+            gen = table.start_canary(
+                model_id, str(meta["version"]),
+                fraction=meta.get("fraction"),
+                shadow=meta.get("shadow"))
+            self._ship_soon()
+            return {"ok": True, "model_id": model_id,
+                    "version": gen.version,
+                    "generation": gen.generation}
+        if cmd == "promote_canary":
+            gen = table.promote_canary(model_id)
+            self._ship_soon()
+            return {"ok": True, "model_id": model_id,
+                    "version": gen.version,
+                    "generation": gen.generation}
+        if cmd == "rollback":
+            event = table.rollback_canary(
+                model_id, reason=str(meta.get("reason", "fleet")))
+            self._ship_soon()
+            return {"ok": True, "model_id": model_id,
+                    "rolled_back": event is not None, "event": event}
+        if cmd == "release_canary":
+            event = table.release_canary(
+                model_id, reason=str(meta.get("reason", "fleet")))
+            self._ship_soon()
+            return {"ok": True, "model_id": model_id,
+                    "released": event is not None, "event": event}
+        if cmd == "check_canary":
+            decision = table.check_canary(model_id)
+            return {"ok": True, "model_id": model_id,
+                    "decision": decision.to_json() if decision
+                    else None}
+        if cmd == "status":
+            rows = [r for r in table.rows()
+                    if r["model_id"] == model_id]
+            if not rows:
+                raise UnknownModelError(
+                    f"model {model_id!r} is not hosted here")
+            return dict(rows[0], ok=True)
+        raise ValueError(
+            f"unknown model-scoped fleet control command {cmd!r}")
+
     def _stable_telemetry(self) -> Optional[dict]:
         gen = self.controller.stable_generation
         if gen is None:
@@ -577,6 +733,17 @@ def main(argv=None) -> int:
     p.add_argument("--fused-backend", default=None,
                    choices=("auto", "numpy", "xla"))
     p.add_argument("--canary-fraction", type=float, default=0.05)
+    p.add_argument("--models", default=None,
+                   help="host N models: model_id=version[,model_id="
+                        "version...] (ISSUE 20 multi-model serving)")
+    p.add_argument("--model-cache-bytes", type=int, default=None,
+                   help="weighted-LRU byte budget over hosted models' "
+                        "AOT executables")
+    p.add_argument("--max-resident-models", type=int, default=None,
+                   help="cap on concurrently-resident hosted models")
+    p.add_argument("--evict-min-interval-s", type=float, default=None,
+                   help="minimum spacing between LRU evictions (thrash "
+                        "rate bound)")
     args = p.parse_args(argv)
     endpoint_kw: dict = {
         "drift_policy": args.drift_policy,
@@ -598,6 +765,10 @@ def main(argv=None) -> int:
         fleet_status_path=args.fleet_status_path,
         ship_interval_s=args.ship_interval_s,
         accept_timeout_s=args.accept_timeout_s,
+        models=parse_models_arg(args.models) if args.models else None,
+        model_cache_bytes=args.model_cache_bytes,
+        max_resident_models=args.max_resident_models,
+        evict_min_interval_s=args.evict_min_interval_s,
         **endpoint_kw,
     )
     worker.start()

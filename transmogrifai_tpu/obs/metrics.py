@@ -508,10 +508,19 @@ def prometheus_text_from_json(doc: dict,
         lines.append(f"{pname}{{{ilabel}}} {_fmt_value(s.get('value', 0.0))}")
     for key, snap in sorted(doc.get("views", {}).items()):
         kind, _, idx = key.partition("/")
+        labels = f'{ilabel},view="{idx}"'
+        # multi-model serving (ISSUE 20): a view that names the hosted
+        # model it serves gets a model_id label on every sample, so one
+        # scrape separates tx_serving_*{model_id="a"} from model "b"
+        # on the same replica (sanitized like instance - a foreign
+        # document must not inject label syntax)
+        model_id = snap.get("model_id") if isinstance(snap, dict) else None
+        if isinstance(model_id, str) and model_id:
+            labels += f',model_id="{_sanitize_instance(model_id)}"'
         for path, value in sorted(_numeric_leaves(snap)):
             pname = sanitize_metric_name(kind + "_" + "_".join(path))
             lines.append(
-                f'{pname}{{{ilabel},view="{idx}"}} {_fmt_value(value)}')
+                f'{pname}{{{labels}}} {_fmt_value(value)}')
     return "\n".join(lines) + "\n"
 
 

@@ -115,11 +115,25 @@ class DeploymentController:
         shadow: bool = False,
         split_seed: int = 42,
         check_every_batches: int = 8,
+        model_id: Optional[str] = None,
+        track_registry: bool = True,
         **endpoint_kw: Any,
     ) -> None:
         if not (0.0 <= canary_fraction <= 1.0):
             raise ValueError("canary_fraction must be in [0, 1]")
         self.registry = registry
+        #: multi-model serving (ISSUE 20): which hosted model this
+        #: controller's lifecycle belongs to.  None = the single-model
+        #: surface; set, it rides every generation's telemetry as the
+        #: ``model_id`` label.
+        self.model_id = None if model_id is None else str(model_id)
+        #: whether lifecycle transitions mutate the registry's single
+        #: stable/canary stage slots.  A model-multiplexed replica hosts
+        #: N versions with INDEPENDENT lifecycles — N controllers racing
+        #: one registry stage pointer would churn it, so the ModelTable
+        #: runs with ``track_registry=False`` (loads still come from the
+        #: registry; only stage promotion/rollback bookkeeping is off).
+        self.track_registry = bool(track_registry)
         self.policy = policy if policy is not None else RollbackPolicy()
         self.canary_fraction = float(canary_fraction)
         self.shadow = bool(shadow)
@@ -169,6 +183,8 @@ class DeploymentController:
         telemetry = kw.pop("telemetry", None) or ServingTelemetry()
         gen_id = self._gen_counter + 1
         telemetry.set_model_version(version, generation=gen_id)
+        if self.model_id is not None:
+            telemetry.set_model_id(self.model_id)
         t0 = time.perf_counter()
         endpoint = compile_endpoint(model, telemetry=telemetry, **kw)
         warm_s = time.perf_counter() - t0
@@ -222,7 +238,8 @@ class DeploymentController:
         if self.registry is None:
             raise RegistryError("deploy_version needs an attached registry")
         model = self.registry.load(version, workflow)
-        if self.registry.get(version).stage != "stable":
+        if (self.track_registry
+                and self.registry.get(version).stage != "stable"):
             self.registry.promote(version, to="stable")
         return self.deploy(model, version=version, **endpoint_kw)
 
@@ -265,7 +282,7 @@ class DeploymentController:
             warm_s=round(warm_s, 4),
         )
         gen.endpoint.telemetry.record_lifecycle(event)
-        if self.registry is not None:
+        if self.registry is not None and self.track_registry:
             try:
                 if self.registry.get(version).stage != "canary":
                     self.registry.promote(version, to="canary")
@@ -299,7 +316,7 @@ class DeploymentController:
             from_version=old.version if old else None,
         )
         canary.endpoint.telemetry.record_lifecycle(event)
-        if self.registry is not None:
+        if self.registry is not None and self.track_registry:
             try:
                 self.registry.promote(canary.version, to="stable")
             except RegistryError as e:
@@ -337,7 +354,7 @@ class DeploymentController:
                 for r in event["reasons"]
             ) or reason,
         )
-        if self.registry is not None:
+        if self.registry is not None and self.track_registry:
             try:
                 self.registry.rollback(
                     version=canary.version,
@@ -374,13 +391,54 @@ class DeploymentController:
             "%s canary generation %d (version %s) released undecided: "
             "%s", LOG_PREFIX, canary.generation, canary.version, reason,
         )
-        if self.registry is not None:
+        if self.registry is not None and self.track_registry:
             try:
                 self.registry.release_canary(reason=reason)
             except RegistryError as e:
                 log.warning("released canary %s not tracked in the "
                             "registry: %s", canary.version, e)
         return event
+
+    def unload(self) -> Optional[str]:
+        """Drop the stable generation pointer — the eviction seam the
+        multi-model weighted LRU (fleet/multimodel.py) pulls when a cold
+        model's compiled executables must yield cache space.  Returns
+        the version that was serving (what a later rehydrate must
+        redeploy), or None when nothing was loaded.  Refuses while a
+        canary is in flight: an active lifecycle pins the model.  A call
+        that raced a scoring batch is safe — the batch resolved its
+        generation pointer before the flip and finishes on the live
+        object; only NEW calls see the unloaded state (RegistryError),
+        which the ModelTable answers with a rehydrate."""
+        with self._deploy_lock:
+            with self._route_lock:
+                if self._canary is not None:
+                    raise RegistryError(
+                        f"cannot unload: canary generation "
+                        f"{self._canary.generation} "
+                        f"({self._canary.version}) is in flight"
+                    )
+                stable = self._stable
+                self._stable = None
+        if stable is None:
+            return None
+        event = self._event(
+            "unload", version=stable.version,
+            generation=stable.generation,
+        )
+        stable.endpoint.telemetry.record_lifecycle(event)
+        log.info(
+            "%s generation %d (version %s) unloaded (executables "
+            "released; rehydrate on next hit)", LOG_PREFIX,
+            stable.generation, stable.version,
+        )
+        return stable.version
+
+    @property
+    def loaded(self) -> bool:
+        """True while a stable generation is resident (serving)."""
+        with self._route_lock:
+            return self._stable is not None
 
     # -- routing + scoring --------------------------------------------------
     @property

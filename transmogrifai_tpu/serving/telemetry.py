@@ -57,6 +57,11 @@ class ServingTelemetry:
         # hot-swap (the Mesh/Data telemetry classes carry the same pair)
         self.model_version: Optional[str] = None
         self.generation: Optional[int] = None
+        # multi-model attribution (ISSUE 20): which HOSTED MODEL this
+        # accumulator serves when a replica multiplexes N models behind
+        # one lane - None on single-model surfaces, a model_id label in
+        # the Prometheus exposition otherwise
+        self.model_id: Optional[str] = None
         self._lifecycle: list[dict] = []
         self._latencies_s: list[float] = []
         self._batch_sizes: list[int] = []
@@ -271,6 +276,13 @@ class ServingTelemetry:
             self.model_version = version
             self.generation = generation
 
+    def set_model_id(self, model_id: Optional[str]) -> None:
+        """Attribute this accumulator to one hosted model of a
+        multi-model replica (ISSUE 20); surfaces as the ``model_id``
+        label on every ``tx_serving_*`` sample this view exports."""
+        with self._lock:
+            self.model_id = None if model_id is None else str(model_id)
+
     #: lifecycle events kept per accumulator (bounded like samples)
     _MAX_LIFECYCLE = 256
 
@@ -331,6 +343,7 @@ class ServingTelemetry:
                 "wall_s": round(wall, 3),
                 "model_version": self.model_version,
                 "generation": self.generation,
+                "model_id": self.model_id,
                 "lifecycle": [dict(e) for e in self._lifecycle],
                 "rows_scored": self.rows_ok,
                 "rows_failed": self.rows_failed,
